@@ -1,0 +1,20 @@
+from .types import (
+    Version,
+    Key,
+    Value,
+    KeyRange,
+    Mutation,
+    MutationType,
+    CommitTransaction,
+    TransactionCommitResult,
+    key_after,
+    strinc,
+    single_key_range,
+    ALL_KEYS,
+    VERSIONS_PER_SECOND,
+    MAX_WRITE_TRANSACTION_LIFE_VERSIONS,
+)
+from .error import FDBError
+from .rng import DeterministicRandom, g_random, g_nondeterministic_random
+from .knobs import SERVER_KNOBS, CLIENT_KNOBS, FLOW_KNOBS
+from .trace import TraceEvent, TraceBatch, g_trace, g_trace_batch, Severity

@@ -1,0 +1,109 @@
+"""Typed, name-addressed tunables.
+
+Analog of the reference's three knob registries (flow/Knobs.h:31-45,
+fdbserver/Knobs.cpp). Knobs default in one place, can be overridden by name
+(`--knob_name=value` style), and in simulation BUGGIFY may randomize marked
+knobs so rare configurations get exercised.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from .rng import DeterministicRandom
+
+
+class Knobs:
+    def __init__(self) -> None:
+        self._values: Dict[str, Any] = {}
+        self._randomizers: Dict[str, Callable[[DeterministicRandom], Any]] = {}
+
+    def init(self, name: str, value: Any, buggify: Optional[Callable[[DeterministicRandom], Any]] = None):
+        self._values[name] = value
+        if buggify is not None:
+            self._randomizers[name] = buggify
+        return value
+
+    def set_knob(self, name: str, value: str) -> None:
+        if name not in self._values:
+            raise KeyError(f"unknown knob: {name}")
+        cur = self._values[name]
+        if isinstance(cur, bool):
+            self._values[name] = value.lower() in ("1", "true", "on")
+        elif isinstance(cur, int):
+            self._values[name] = int(value)
+        elif isinstance(cur, float):
+            self._values[name] = float(value)
+        else:
+            self._values[name] = value
+
+    def randomize(self, rng: DeterministicRandom, probability: float = 0.25) -> None:
+        """BUGGIFY-style knob randomization, applied per-simulation
+        (reference pattern: `init(KNOB, v); if(randomize && BUGGIFY) ...`,
+        fdbserver/Knobs.cpp)."""
+        for name, fn in self._randomizers.items():
+            if rng.random01() < probability:
+                self._values[name] = fn(rng)
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self.__dict__["_values"][name.lower()]
+        except KeyError:
+            raise AttributeError(name)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+
+def _make_server_knobs() -> Knobs:
+    k = Knobs()
+    # Version / MVCC window (reference: fdbserver/Knobs.cpp)
+    k.init("versions_per_second", 1_000_000)
+    k.init("max_write_transaction_life_versions", 5_000_000)
+    k.init("max_read_transaction_life_versions", 5_000_000)
+    # Proxy commit batching (reference: COMMIT_TRANSACTION_BATCH_* knobs)
+    k.init("commit_transaction_batch_interval", 0.0005, lambda r: r.random01() * 0.005)
+    k.init("commit_transaction_batch_count_max", 32768, lambda r: r.random_int(1, 100))
+    k.init("commit_transaction_batch_bytes_max", 8 << 20)
+    k.init("resolver_state_memory_limit", 1 << 20)
+    k.init("grv_batch_interval", 0.0005, lambda r: r.random01() * 0.005)
+    # Ratekeeper (reference: fdbserver/Knobs.cpp ratekeeper section)
+    k.init("ratekeeper_update_interval", 0.25)
+    k.init("target_storage_queue_bytes", 250 << 20)
+    k.init("spring_storage_queue_bytes", 50 << 20)
+    k.init("target_tlog_queue_bytes", 1 << 30)
+    k.init("max_transactions_per_second", 1e7)
+    # Storage
+    k.init("storage_durability_lag_versions", 2_000_000)
+    k.init("desired_total_bytes", 150_000)
+    # Failure detection (reference: CC failureDetectionServer)
+    k.init("failure_detection_delay", 1.0, lambda r: 0.2 + r.random01() * 2)
+    k.init("heartbeat_interval", 0.25)
+    # TPU conflict engine capacities (ours)
+    k.init("conflict_table_capacity", 1 << 16)
+    k.init("conflict_key_words", 4)
+    k.init("conflict_max_batch_txns", 1 << 12)
+    k.init("conflict_max_batch_ranges", 1 << 13)
+    return k
+
+
+def _make_client_knobs() -> Knobs:
+    k = Knobs()
+    k.init("max_backoff", 1.0)
+    k.init("initial_backoff", 0.01)
+    k.init("backoff_growth_rate", 2.0)
+    k.init("grv_batch_size_max", 1024)
+    k.init("location_cache_size", 100_000)
+    return k
+
+
+def _make_flow_knobs() -> Knobs:
+    k = Knobs()
+    k.init("min_delay", 0.0001)
+    k.init("max_buggified_delay", 0.2)
+    k.init("connection_latency", 0.0005)
+    return k
+
+
+SERVER_KNOBS = _make_server_knobs()
+CLIENT_KNOBS = _make_client_knobs()
+FLOW_KNOBS = _make_flow_knobs()

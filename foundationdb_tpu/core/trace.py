@@ -1,0 +1,131 @@
+"""Structured event tracing.
+
+Analog of the reference's TraceEvent system (flow/Trace.h, flow/Trace.cpp):
+structured events with typed details, severity gating, and machine-readable
+output (we use JSON lines rather than the reference's XML). SevError events
+fail simulation tests, like the reference harness.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Severity:
+    DEBUG = 5
+    INFO = 10
+    WARN = 20
+    WARN_ALWAYS = 30
+    ERROR = 40
+
+
+class TraceCollector:
+    """Collects trace events; in simulation, registered observers (e.g. the
+    test harness's SevError watchdog) see every event."""
+
+    def __init__(self) -> None:
+        self.events: List[Dict[str, Any]] = []
+        self.observers: List[Callable[[Dict[str, Any]], None]] = []
+        self.min_severity = Severity.INFO
+        self.file = None
+        self.buffer_limit = 100_000
+        self._lock = threading.Lock()
+
+    def emit(self, event: Dict[str, Any]) -> None:
+        with self._lock:
+            self.events.append(event)
+            if len(self.events) > self.buffer_limit:
+                del self.events[: self.buffer_limit // 2]
+            if self.file is not None:
+                self.file.write(json.dumps(event, default=str) + "\n")
+        for obs in list(self.observers):
+            obs(event)
+
+    def clear(self) -> None:
+        with self._lock:
+            self.events.clear()
+
+    def find(self, event_type: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [e for e in self.events if e.get("Type") == event_type]
+
+
+g_trace = TraceCollector()
+
+#: Virtual-time source, installed by the simulator so events carry sim time.
+_now: Callable[[], float] = time.monotonic
+
+
+def set_time_source(now: Callable[[], float]) -> None:
+    global _now
+    _now = now
+
+
+class TraceEvent:
+    """`TraceEvent("Type", id).detail("K", v)...` — logs on destruction or
+    explicit .log(), mirroring the reference's builder idiom."""
+
+    def __init__(self, event_type: str, id: Any = None, severity: int = Severity.INFO):
+        self._event: Dict[str, Any] = {
+            "Severity": severity,
+            "Time": round(_now(), 6),
+            "Type": event_type,
+        }
+        if id is not None:
+            self._event["ID"] = id
+        self._logged = False
+
+    def detail(self, key: str, value: Any) -> "TraceEvent":
+        self._event[key] = value
+        return self
+
+    def error(self, err: BaseException) -> "TraceEvent":
+        self._event["Error"] = str(err)
+        if self._event["Severity"] < Severity.WARN:
+            self._event["Severity"] = Severity.WARN
+        return self
+
+    def log(self) -> None:
+        if self._logged:
+            return
+        self._logged = True
+        if self._event["Severity"] >= g_trace.min_severity:
+            g_trace.emit(self._event)
+
+    def __del__(self) -> None:
+        try:
+            self.log()
+        except Exception:
+            pass
+
+    def __enter__(self) -> "TraceEvent":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.log()
+
+
+class TraceBatch:
+    """Latency micro-probes stitched per debug id across roles
+    (reference: g_traceBatch, flow/Trace.h:55-60; used by the commit-path
+    probes in Resolver.actor.cpp:84-131)."""
+
+    def __init__(self) -> None:
+        self.events: List[Dict[str, Any]] = []
+
+    def add_event(self, name: str, debug_id: int, location: str) -> None:
+        self.events.append(
+            {"Type": name, "ID": debug_id, "Location": location, "Time": _now()}
+        )
+
+    def add_attach(self, name: str, from_id: int, to_id: int) -> None:
+        self.events.append({"Type": name, "From": from_id, "To": to_id, "Time": _now()})
+
+    def timeline(self, debug_id: int) -> List[Dict[str, Any]]:
+        return [e for e in self.events if e.get("ID") == debug_id]
+
+
+g_trace_batch = TraceBatch()

@@ -1,0 +1,470 @@
+"""TPU-native batched conflict detection — the north-star kernel.
+
+Re-design of the reference resolver's versioned skip list
+(fdbserver/SkipList.cpp) as a data-parallel, fixed-shape XLA program:
+
+  reference                      this kernel
+  ---------                      -----------
+  skip-list nodes                sorted boundary table hkeys[H, K] in HBM
+  per-level maxVersion pyramid   sparse table (block-max) over hvers[H]
+  16-way pipelined CheckMax      vectorized binary search + range-max gather
+  radix sortPoints (:227)        one lax.sort of all endpoints w/ tie codes
+  MiniConflictSet sweep (:1133)  overlap matrix + DAG fixpoint (while_loop)
+  skip-list insert/remove        sort-free merge: searchsorted + scatter
+  removeBefore GC (:665)         vectorized keep rule + compaction
+
+Exactness: verdicts are a pure function of the logical version-interval map
+(see ops/oracle.py); every op here (max, OR, integer compares) is
+order-insensitive, so results are bit-identical to the oracle and hence to
+the reference CPU resolver, for keys within the configured exact width.
+
+Versions on device are int32 offsets from a host-tracked base (the 5-second
+MVCC window MAX_WRITE_TRANSACTION_LIFE_VERSIONS = 5e6 << 2^31); versions at
+or below the base are clamped to -1, which is semantics-preserving because
+any read that passes the too-old gate has snapshot >= base.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import error
+from ..core.types import (
+    CommitTransaction,
+    TransactionCommitResult,
+    Version,
+)
+from . import keypack
+
+NEG_VERSION = jnp.int32(-(2**30))
+
+
+@dataclass(frozen=True)
+class KernelConfig:
+    key_words: int = 4          # exact-compare width = 4*key_words bytes
+    capacity: int = 1 << 16     # H: max boundaries in the interval table
+    max_reads: int = 1 << 12    # R: read conflict ranges per device batch
+    max_writes: int = 1 << 12   # W: write conflict ranges per device batch
+    max_txns: int = 1 << 12     # T: transactions per device batch
+
+    @property
+    def lanes(self) -> int:     # K: words per packed key incl. length
+        return self.key_words + 1
+
+    @property
+    def search_steps(self) -> int:
+        return int(math.ceil(math.log2(self.capacity))) + 1
+
+    @property
+    def levels(self) -> int:    # sparse-table levels
+        return int(math.ceil(math.log2(self.capacity))) + 1
+
+
+def _key_less(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Lexicographic a < b over trailing lane axis (uint32 words + length)."""
+    neq = a != b
+    idx = jnp.argmax(neq, axis=-1)
+    any_neq = jnp.any(neq, axis=-1)
+    av = jnp.take_along_axis(a, idx[..., None], axis=-1)[..., 0]
+    bv = jnp.take_along_axis(b, idx[..., None], axis=-1)[..., 0]
+    return any_neq & (av < bv)
+
+
+def _key_eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == b, axis=-1)
+
+
+def _search(cfg: KernelConfig, table: jnp.ndarray, count: jnp.ndarray, q: jnp.ndarray, lower: bool) -> jnp.ndarray:
+    """Vectorized binary search over table[0:count] (sorted, [N,K]).
+
+    lower=True  -> first i with table[i] >= q   (lower_bound)
+    lower=False -> first i with table[i] >  q   (upper_bound)
+    """
+    nq = q.shape[0]
+    lo = jnp.zeros((nq,), jnp.int32)
+    hi = jnp.full((nq,), count, jnp.int32)
+    for _ in range(cfg.search_steps):
+        m = lo < hi
+        mid = (lo + hi) >> 1
+        row = table[mid]
+        go_right = _key_less(row, q) if lower else ~_key_less(q, row)
+        lo = jnp.where(m & go_right, mid + 1, lo)
+        hi = jnp.where(m & ~go_right, mid, hi)
+    return lo
+
+
+def _build_sparse_max(cfg: KernelConfig, vers: jnp.ndarray, n: jnp.ndarray) -> jnp.ndarray:
+    """Sparse table: out[k, i] = max(vers[i : i+2^k]) with invalid slots -> NEG.
+
+    This is the skip-list maxVersion pyramid (SkipList.cpp:350-357) flattened
+    into a dense, gather-friendly layout."""
+    h = cfg.capacity
+    base = jnp.where(jnp.arange(h) < n, vers, NEG_VERSION)
+    levels = [base]
+    for k in range(1, cfg.levels):
+        half = 1 << (k - 1)
+        prev = levels[-1]
+        shifted = jnp.concatenate([prev[half:], jnp.full((half,), NEG_VERSION, prev.dtype)])
+        levels.append(jnp.maximum(prev, shifted))
+    return jnp.stack(levels)  # [levels, H]
+
+
+def _range_max(cfg: KernelConfig, sparse: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray) -> jnp.ndarray:
+    """max(vers[lo:hi]) for hi > lo, via two overlapping power-of-two blocks."""
+    s = (hi - lo).astype(jnp.uint32)
+    k = (31 - lax.clz(s)).astype(jnp.int32)
+    flat = sparse.reshape(-1)
+    h = cfg.capacity
+    m1 = flat[k * h + lo]
+    m2 = flat[k * h + hi - (1 << k).astype(jnp.int32)]
+    return jnp.maximum(m1, m2)
+
+
+def _compact_rows(keys: jnp.ndarray, vals: jnp.ndarray, keep: jnp.ndarray, out_rows: int) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Scatter kept rows to the front of a fresh [out_rows] table (stable)."""
+    pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    tgt = jnp.where(keep, pos, out_rows)  # dropped rows go out of bounds
+    ok = jnp.zeros((out_rows, keys.shape[1]), keys.dtype).at[tgt].set(keys, mode="drop")
+    ov = jnp.full((out_rows,), NEG_VERSION, vals.dtype).at[tgt].set(vals, mode="drop")
+    return ok, ov, jnp.sum(keep.astype(jnp.int32))
+
+
+def resolve_step(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[str, jnp.ndarray]) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+    """One resolver batch: (state, batch) -> (state', outputs). Pure; jit me.
+
+    batch fields (fixed shapes; see JaxConflictEngine._pack_batch):
+      rb, re   uint32 [R, K]   read range begin/end (packed keys)
+      r_snap   int32  [R]      read snapshot, relative to base (>= 0)
+      r_txn    int32  [R]      owning transaction index
+      r_valid  bool   [R]
+      wb, we   uint32 [W, K]   write ranges (non-empty only)
+      w_txn    int32  [W]
+      w_valid  bool   [W]
+      t_ok     bool   [T]      valid txn, not too-old
+      t_too_old bool  [T]
+      now      int32  []       commit version - base
+      gc       int32  []       new_oldest - base (<=0: no GC/rebase)
+    """
+    hkeys, hvers, n = state["hkeys"], state["hvers"], state["n"]
+    R = cfg.max_reads
+    W = cfg.max_writes
+    T = cfg.max_txns
+    H = cfg.capacity
+    K = cfg.lanes
+
+    rb, re = batch["rb"], batch["re"]
+    wb, we = batch["wb"], batch["we"]
+    r_txn, w_txn = batch["r_txn"], batch["w_txn"]
+    r_valid, w_valid = batch["r_valid"], batch["w_valid"]
+    now = batch["now"]
+
+    # ---- Phase 1: reads vs. history (checkReadConflictRanges:1210) ----
+    sparse = _build_sparse_max(cfg, hvers, n)
+    empty_r = ~_key_less(rb, re)
+    lo_ne = _search(cfg, hkeys, n, rb, lower=False) - 1      # interval containing rb
+    hi_ne = _search(cfg, hkeys, n, re, lower=True)           # first boundary >= re
+    lo_e = jnp.maximum(_search(cfg, hkeys, n, rb, lower=True) - 1, 0)
+    lo = jnp.where(empty_r, lo_e, lo_ne)
+    hi = jnp.where(empty_r, lo_e + 1, hi_ne)
+    rmax = _range_max(cfg, sparse, lo, hi)
+    r_hit = r_valid & (rmax > batch["r_snap"])
+    hist_conflict = jnp.zeros((T,), jnp.int32).at[r_txn].max(r_hit.astype(jnp.int32), mode="drop") > 0
+
+    # ---- Phase 2: intra-batch (checkIntraBatchConflicts:1133) ----
+    # Endpoint order with the reference's tie codes (getCharacter,
+    # SkipList.cpp:147-177): at equal keys  end-read < end-write < begin-write
+    # < begin-read, which makes integer position compare == exact half-open
+    # overlap. Invalid rows sort last via a leading flag.
+    P = 2 * R + 2 * W
+    pkeys = jnp.concatenate([rb, re, wb, we], axis=0)                    # [P, K]
+    pcode = jnp.concatenate([
+        jnp.full((R,), 3, jnp.uint32),   # begin-read
+        jnp.full((R,), 0, jnp.uint32),   # end-read
+        jnp.full((W,), 2, jnp.uint32),   # begin-write
+        jnp.full((W,), 1, jnp.uint32),   # end-write
+    ])
+    pvalid = jnp.concatenate([r_valid, r_valid, w_valid, w_valid])
+    pinv = (~pvalid).astype(jnp.uint32)
+    pidx = jnp.arange(P, dtype=jnp.uint32)
+    ops = (pinv,) + tuple(pkeys[:, c] for c in range(K)) + (pcode, pidx)
+    sorted_ops = lax.sort(ops, num_keys=K + 2, is_stable=True)
+    sorted_idx = sorted_ops[-1]
+    pos = jnp.zeros((P,), jnp.int32).at[sorted_idx].set(jnp.arange(P, dtype=jnp.int32))
+    pos_rb, pos_re = pos[:R], pos[R : 2 * R]
+    pos_wb, pos_we = pos[2 * R : 2 * R + W], pos[2 * R + W :]
+
+    ov = (
+        (pos_rb[:, None] < pos_re[:, None])      # non-empty read
+        & (pos_rb[:, None] < pos_we[None, :])    # rb < we
+        & (pos_wb[None, :] < pos_re[:, None])    # wb < re
+        & r_valid[:, None]
+        & w_valid[None, :]
+    )
+    # Reduce [R, W] -> per-transaction graph O[t, u] via one-hot matmuls (MXU).
+    tids = jnp.arange(T, dtype=jnp.int32)
+    a = (r_txn[:, None] == tids[None, :]) & r_valid[:, None]             # [R, T]
+    b = (w_txn[:, None] == tids[None, :]) & w_valid[:, None]             # [W, T]
+    ovb = jnp.dot(ov.astype(jnp.float32), b.astype(jnp.float32),
+                  precision=lax.Precision.HIGHEST)                        # [R, T]
+    o_cnt = jnp.dot(a.astype(jnp.float32).T, ovb,
+                    precision=lax.Precision.HIGHEST)                      # [T, T]
+    o_strict = (o_cnt > 0) & (tids[None, :] < tids[:, None])             # u < t
+    o_f32 = o_strict.astype(jnp.float32)
+
+    base_commit = batch["t_ok"] & ~hist_conflict
+    # Earlier-in-batch-wins is a DAG over u < t edges; iterate to its unique
+    # fixpoint (equivalent to the reference's in-order sweep).
+    def fix_cond(carry):
+        c, prev, it = carry
+        return jnp.any(c != prev) & (it < T)
+
+    def fix_body(carry):
+        c, _, it = carry
+        blocked = jnp.dot(o_f32, c.astype(jnp.float32),
+                          precision=lax.Precision.HIGHEST) > 0
+        return base_commit & ~blocked, c, it + 1
+
+    c0 = base_commit
+    c1 = base_commit & ~(jnp.dot(o_f32, c0.astype(jnp.float32), precision=lax.Precision.HIGHEST) > 0)
+    committed, _, _ = lax.while_loop(fix_cond, fix_body, (c1, c0, jnp.int32(0)))
+
+    # ---- Phase 3: committed-write union (combineWriteConflictRanges:1320) ----
+    cw = w_valid & committed[w_txn]
+    ekeys = jnp.concatenate([wb, we], axis=0)                             # [2W, K]
+    edelta = jnp.concatenate([jnp.ones((W,), jnp.int32), jnp.full((W,), -1, jnp.int32)])
+    ecode = jnp.concatenate([jnp.zeros((W,), jnp.uint32), jnp.ones((W,), jnp.uint32)])
+    evalid = jnp.concatenate([cw, cw])
+    einv = (~evalid).astype(jnp.uint32)
+    eops = (einv,) + tuple(ekeys[:, c] for c in range(K)) + (ecode, edelta.astype(jnp.uint32),) + tuple(
+        ekeys[:, c] for c in range(K)
+    )
+    es = lax.sort(eops, num_keys=K + 2, is_stable=True)
+    s_valid = es[0] == 0
+    s_delta = jnp.where(es[K + 2].astype(jnp.int32) == 1, 1, -1)
+    s_keys = jnp.stack(es[K + 3 :], axis=1)                               # [2W, K]
+    d = jnp.where(s_valid, s_delta, 0)
+    cum = jnp.cumsum(d)
+    is_ub = s_valid & (s_delta > 0) & ((cum - d) == 0)
+    is_ue = s_valid & (s_delta < 0) & (cum == 0)
+    ubi = jnp.cumsum(is_ub.astype(jnp.int32)) - 1
+    uei = jnp.cumsum(is_ue.astype(jnp.int32)) - 1
+    u_count = jnp.sum(is_ub.astype(jnp.int32))
+    ub_keys = jnp.zeros((W, K), jnp.uint32).at[jnp.where(is_ub, ubi, W)].set(s_keys, mode="drop")
+    ue_keys = jnp.zeros((W, K), jnp.uint32).at[jnp.where(is_ue, uei, W)].set(s_keys, mode="drop")
+    # Version at each union end = pre-batch map value there (preserved tail).
+    ue_ver = hvers[_search(cfg, hkeys, n, ue_keys, lower=False) - 1]
+
+    # ---- Phase 4: merge union into the boundary table at version `now` ----
+    jslot = jnp.arange(H, dtype=jnp.int32)
+    jj = _search(cfg, ub_keys, u_count, hkeys, lower=False) - 1          # per old row
+    covered = (jj >= 0) & _key_less(hkeys, ue_keys[jnp.maximum(jj, 0)])
+    old_keep = (jslot < n) & ~covered
+
+    # New rows: interleave begins (version=now) and ends (version=ue_ver);
+    # the interleaving [ub0, ue0, ub1, ue1, ...] is already key-sorted.
+    nb_keys = jnp.stack([ub_keys, ue_keys], axis=1).reshape(2 * W, K)
+    nb_vers = jnp.stack([jnp.full((W,), now, jnp.int32), ue_ver], axis=1).reshape(2 * W)
+    j_of = jnp.repeat(jnp.arange(W, dtype=jnp.int32), 2)
+    is_end_row = jnp.tile(jnp.array([False, True]), W)
+    nb_valid = j_of < u_count
+    # Drop an end row when an equal, uncovered old boundary already exists
+    # (same version by construction, so keeping the old row is exact).
+    eqi = _search(cfg, hkeys, n, nb_keys, lower=True)
+    eq_exists = (eqi < n) & _key_eq(hkeys[jnp.minimum(eqi, H - 1)], nb_keys) & ~covered[jnp.minimum(eqi, H - 1)]
+    nb_keep = nb_valid & ~(is_end_row & eq_exists)
+
+    ncomp_pos = jnp.cumsum(nb_keep.astype(jnp.int32)) - 1
+    nc = jnp.sum(nb_keep.astype(jnp.int32))
+    nck = jnp.zeros((2 * W, K), jnp.uint32).at[jnp.where(nb_keep, ncomp_pos, 2 * W)].set(nb_keys, mode="drop")
+    ncv = jnp.zeros((2 * W,), jnp.int32).at[jnp.where(nb_keep, ncomp_pos, 2 * W)].set(nb_vers, mode="drop")
+
+    cum_keep = jnp.cumsum(old_keep.astype(jnp.int32))
+    new_before_old = _search(cfg, nck, nc, hkeys, lower=True)
+    pos_old = cum_keep - 1 + new_before_old
+    lb_old = _search(cfg, hkeys, n, nck, lower=True)
+    cum_cov = jnp.cumsum(covered.astype(jnp.int32))
+    cov_before = jnp.where(lb_old > 0, cum_cov[jnp.maximum(lb_old - 1, 0)], 0)
+    pos_new = jnp.arange(2 * W, dtype=jnp.int32) + (lb_old - cov_before)
+
+    out_k = jnp.zeros((H, K), jnp.uint32)
+    out_v = jnp.full((H,), NEG_VERSION, jnp.int32)
+    out_k = out_k.at[jnp.where(old_keep, pos_old, H)].set(hkeys, mode="drop")
+    out_v = out_v.at[jnp.where(old_keep, pos_old, H)].set(hvers, mode="drop")
+    nc_mask = jnp.arange(2 * W) < nc
+    out_k = out_k.at[jnp.where(nc_mask, pos_new, H)].set(nck, mode="drop")
+    out_v = out_v.at[jnp.where(nc_mask, pos_new, H)].set(ncv, mode="drop")
+    n1 = cum_keep[-1] + nc
+    overflow = n1 > H
+
+    # ---- Phase 5: GC + rebase (removeBefore:665; keep rule :686-698) ----
+    gc = batch["gc"]
+    do_gc = gc > 0
+    prev_v = jnp.concatenate([jnp.array([2**30], jnp.int32), out_v[:-1]])
+    keep = (jslot < n1) & (~do_gc | (jslot == 0) | (out_v >= gc) | (prev_v >= gc))
+    fin_k, fin_v, n2 = _compact_rows(out_k, out_v, keep, H)
+    delta = jnp.maximum(gc, 0)
+    fin_v = jnp.where(jslot < n2, jnp.maximum(fin_v - delta, -1), NEG_VERSION)
+
+    status = jnp.where(
+        batch["t_too_old"],
+        jnp.int32(int(TransactionCommitResult.TOO_OLD)),
+        jnp.where(committed, jnp.int32(int(TransactionCommitResult.COMMITTED)),
+                  jnp.int32(int(TransactionCommitResult.CONFLICT))),
+    )
+    new_state = {"hkeys": fin_k, "hvers": fin_v, "n": n2}
+    out = {"status": status, "overflow": overflow, "n": n2}
+    return new_state, out
+
+
+def initial_state(cfg: KernelConfig, version_rel: int = 0) -> Dict[str, jnp.ndarray]:
+    hkeys = np.zeros((cfg.capacity, cfg.lanes), np.uint32)  # row 0 = empty key
+    hvers = np.full((cfg.capacity,), int(NEG_VERSION), np.int32)
+    hvers[0] = version_rel
+    return {
+        "hkeys": jnp.asarray(hkeys),
+        "hvers": jnp.asarray(hvers),
+        "n": jnp.asarray(1, jnp.int32),
+    }
+
+
+class JaxConflictEngine:
+    """ConflictSet engine backed by the XLA/TPU kernel.
+
+    Same resolve() contract as OracleConflictEngine; host side tracks
+    oldest_version (== device version base) and packs batches to fixed
+    shapes. Batches larger than the device caps are split on transaction
+    boundaries, which is exact: sub-batch writes land at version `now` and
+    every later read in the same batch has snapshot < now, so history-vs-
+    intra-batch classification cannot change any verdict."""
+
+    name = "jax"
+
+    def __init__(self, cfg: KernelConfig = KernelConfig(), initial_version: Version = 0):
+        self.cfg = cfg
+        self.base: Version = 0
+        self.oldest_version: Version = 0
+        self.state = initial_state(cfg, version_rel=initial_version)
+        self._step = jax.jit(
+            functools.partial(resolve_step, cfg),
+            donate_argnums=(0,),
+        )
+
+    def clear(self, version: Version) -> None:
+        self.state = initial_state(self.cfg, version_rel=self._rel(version))
+
+    def _rel(self, v: Version) -> int:
+        r = v - self.base
+        if r >= 2**30:
+            raise error.client_invalid_operation(
+                f"version {v} too far beyond base {self.base} for int32 device window"
+            )
+        return max(r, -1)
+
+    def resolve(
+        self,
+        transactions: Sequence[CommitTransaction],
+        now: Version,
+        new_oldest: Version,
+    ) -> List[TransactionCommitResult]:
+        cfg = self.cfg
+        results: List[TransactionCommitResult] = []
+        i = 0
+        ntx = len(transactions)
+        while True:
+            # Greedy prefix respecting all three device caps.
+            j, nr, nw = i, 0, 0
+            while j < ntx and (j - i) < cfg.max_txns:
+                tr = transactions[j]
+                tr_r = len(tr.read_conflict_ranges)
+                tr_w = sum(1 for w in tr.write_conflict_ranges if w.begin < w.end)
+                if tr_r > cfg.max_reads or tr_w > cfg.max_writes:
+                    raise error.client_invalid_operation(
+                        "single transaction exceeds device conflict-range capacity"
+                    )
+                if nr + tr_r > cfg.max_reads or nw + tr_w > cfg.max_writes:
+                    break
+                nr += tr_r
+                nw += tr_w
+                j += 1
+            last = j >= ntx
+            results.extend(self._resolve_chunk(transactions[i:j], now, new_oldest if last else 0))
+            if last:
+                break
+            i = j
+        if new_oldest > self.oldest_version:
+            self.oldest_version = new_oldest
+            self.base += max(0, new_oldest - self.base)
+        return results
+
+    def _resolve_chunk(
+        self, transactions: Sequence[CommitTransaction], now: Version, new_oldest: Version
+    ) -> List[TransactionCommitResult]:
+        cfg = self.cfg
+        T, R, W, K = cfg.max_txns, cfg.max_reads, cfg.max_writes, cfg.lanes
+        n = len(transactions)
+        assert n <= T
+
+        too_old = np.zeros((T,), bool)
+        t_ok = np.zeros((T,), bool)
+        r_keys_b: List[bytes] = []
+        r_keys_e: List[bytes] = []
+        r_snap: List[int] = []
+        r_txn: List[int] = []
+        w_keys_b: List[bytes] = []
+        w_keys_e: List[bytes] = []
+        w_txn: List[int] = []
+        for t, tr in enumerate(transactions):
+            is_old = tr.read_snapshot < self.oldest_version and bool(tr.read_conflict_ranges)
+            too_old[t] = is_old
+            t_ok[t] = not is_old
+            if is_old:
+                continue
+            for r in tr.read_conflict_ranges:
+                r_keys_b.append(r.begin)
+                r_keys_e.append(r.end)
+                r_snap.append(self._rel(tr.read_snapshot))
+                r_txn.append(t)
+            for w in tr.write_conflict_ranges:
+                if w.begin < w.end:
+                    w_keys_b.append(w.begin)
+                    w_keys_e.append(w.end)
+                    w_txn.append(t)
+        nr, nw = len(r_txn), len(w_txn)
+        assert nr <= R and nw <= W
+
+        def padk(keys: List[bytes], cap: int) -> np.ndarray:
+            arr = np.zeros((cap, K), np.uint32)
+            if keys:
+                arr[: len(keys)] = keypack.pack_keys(keys, cfg.key_words)
+            return arr
+
+        batch = {
+            "rb": jnp.asarray(padk(r_keys_b, R)),
+            "re": jnp.asarray(padk(r_keys_e, R)),
+            "r_snap": jnp.asarray(np.pad(np.asarray(r_snap, np.int32), (0, R - nr))),
+            "r_txn": jnp.asarray(np.pad(np.asarray(r_txn, np.int32), (0, R - nr))),
+            "r_valid": jnp.asarray(np.arange(R) < nr),
+            "wb": jnp.asarray(padk(w_keys_b, W)),
+            "we": jnp.asarray(padk(w_keys_e, W)),
+            "w_txn": jnp.asarray(np.pad(np.asarray(w_txn, np.int32), (0, W - nw))),
+            "w_valid": jnp.asarray(np.arange(W) < nw),
+            "t_ok": jnp.asarray(t_ok),
+            "t_too_old": jnp.asarray(too_old),
+            "now": jnp.asarray(self._rel(now), jnp.int32),
+            "gc": jnp.asarray(self._rel(new_oldest) if new_oldest > self.oldest_version else 0, jnp.int32),
+        }
+        self.state, out = self._step(self.state, batch)
+        if bool(out["overflow"]):
+            raise error.conflict_capacity_exceeded(
+                f"boundary table needs > {cfg.capacity} rows"
+            )
+        status = np.asarray(out["status"][:n])
+        return [TransactionCommitResult(int(s)) for s in status]

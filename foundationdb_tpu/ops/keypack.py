@@ -1,0 +1,55 @@
+"""Fixed-width order-preserving key packing for the TPU conflict kernel.
+
+A key (bytes) is packed into ``key_words`` big-endian uint32 words (zero
+padded) plus a final length word. Lexicographic comparison of the resulting
+(words..., length) tuple is *exactly* the reference's key order — bytewise,
+shorter-is-less on equal prefix (fdbserver/SkipList.cpp:113-120) — for all
+keys of length <= 4*key_words. Longer keys raise; the engine's exact-compare
+width is a configuration knob (production configs size it to the schema's
+conflict-key width; a digest+host-verify tier for unbounded keys is a later
+milestone, cf. SURVEY.md §7 hard parts).
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from ..core import error
+
+
+def max_key_bytes(key_words: int) -> int:
+    return 4 * key_words
+
+
+def pack_keys(keys: Sequence[bytes], key_words: int) -> np.ndarray:
+    """Pack N keys -> uint32 [N, key_words + 1] (words..., length)."""
+    n = len(keys)
+    kb = max_key_bytes(key_words)
+    out_bytes = np.zeros((n, kb), dtype=np.uint8)
+    lens = np.empty((n,), dtype=np.uint32)
+    for i, k in enumerate(keys):
+        lk = len(k)
+        if lk > kb:
+            raise error.key_too_large(f"key of {lk} bytes > engine width {kb}")
+        out_bytes[i, :lk] = np.frombuffer(k, dtype=np.uint8)
+        lens[i] = lk
+    words = out_bytes.reshape(n, key_words, 4).astype(np.uint32)
+    packed = (
+        (words[:, :, 0] << 24) | (words[:, :, 1] << 16) | (words[:, :, 2] << 8) | words[:, :, 3]
+    )
+    return np.concatenate([packed, lens[:, None]], axis=1)
+
+
+def pack_key(key: bytes, key_words: int) -> np.ndarray:
+    return pack_keys([key], key_words)[0]
+
+
+def unpack_key(packed: np.ndarray, key_words: int) -> bytes:
+    """Inverse of pack_key (for debugging/tests)."""
+    length = int(packed[key_words])
+    words = packed[:key_words].astype(np.uint32)
+    raw = bytearray()
+    for w in words:
+        raw += bytes([(int(w) >> 24) & 0xFF, (int(w) >> 16) & 0xFF, (int(w) >> 8) & 0xFF, int(w) & 0xFF])
+    return bytes(raw[:length])

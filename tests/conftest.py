@@ -1,0 +1,23 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before JAX loads.
+
+Tests validate numerics and sharding on CPU (deterministic, no TPU needed);
+the driver's bench runs on the real chip. Mirrors the reference's strategy of
+testing a multi-node system inside one process (Sim2), here applied to the
+device mesh as well.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    from foundationdb_tpu.core.rng import DeterministicRandom
+
+    return DeterministicRandom(12345)

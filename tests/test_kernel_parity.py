@@ -1,0 +1,137 @@
+"""Bit-exact parity: JAX/TPU conflict kernel vs. the reference-semantics oracle.
+
+This is the round-1 analog of the reference's oracle strategy
+(SlowConflictSet, fdbserver/SkipList.cpp:59-88): every engine must produce
+identical verdict streams on randomized workloads."""
+import numpy as np
+import pytest
+
+from foundationdb_tpu.core.rng import DeterministicRandom
+from foundationdb_tpu.core.types import CommitTransaction, KeyRange
+from foundationdb_tpu.ops.conflict_kernel import JaxConflictEngine, KernelConfig
+from foundationdb_tpu.ops.oracle import OracleConflictEngine
+
+SMALL = KernelConfig(key_words=2, capacity=512, max_reads=128, max_writes=128, max_txns=32)
+
+
+def random_key(rng: DeterministicRandom, alphabet=b"ab\x00\xff", maxlen=6) -> bytes:
+    n = rng.random_int(0, maxlen + 1)
+    return bytes(rng.random_choice(alphabet) for _ in range(n))
+
+
+def random_range(rng, allow_empty=False):
+    a, b = random_key(rng), random_key(rng)
+    if a > b:
+        a, b = b, a
+    if a == b and not allow_empty:
+        b = a + b"\x00"
+    return KeyRange(a, b)
+
+
+def random_txn(rng, version_floor, version_now, allow_empty_reads):
+    t = CommitTransaction()
+    t.read_snapshot = rng.random_int(max(0, version_floor - 40), version_now)
+    for _ in range(rng.random_int(0, 4)):
+        t.read_conflict_ranges.append(random_range(rng, allow_empty=allow_empty_reads))
+    for _ in range(rng.random_int(0, 4)):
+        t.write_conflict_ranges.append(random_range(rng, allow_empty=True))
+    return t
+
+
+def run_stream(seed, batches=60, txns_per_batch=12, allow_empty_reads=False, cfg=SMALL):
+    rng = DeterministicRandom(seed)
+    oracle = OracleConflictEngine()
+    kernel = JaxConflictEngine(cfg)
+    now = 10
+    oldest = 0
+    for b in range(batches):
+        now += rng.random_int(1, 30)
+        if rng.random01() < 0.3:
+            oldest = max(oldest, now - rng.random_int(20, 120))
+        txns = [
+            random_txn(rng, oldest, now, allow_empty_reads)
+            for _ in range(rng.random_int(1, txns_per_batch + 1))
+        ]
+        want = oracle.resolve(txns, now, oldest)
+        got = kernel.resolve(txns, now, oldest)
+        assert got == want, f"seed={seed} batch={b}: {got} != {want}"
+    return True
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5, 6, 7, 8])
+def test_random_parity(seed):
+    assert run_stream(seed)
+
+
+def test_random_parity_empty_reads():
+    assert run_stream(99, allow_empty_reads=True)
+
+
+def test_parity_hot_key_contention():
+    """Zipf-ish contention: many txns fighting over few keys."""
+    rng = DeterministicRandom(7)
+    oracle = OracleConflictEngine()
+    kernel = JaxConflictEngine(SMALL)
+    hot = [b"h%d" % i for i in range(4)]
+    now = 100
+    for b in range(40):
+        now += 10
+        txns = []
+        for _ in range(10):
+            t = CommitTransaction()
+            t.read_snapshot = now - rng.random_int(1, 30)
+            k = rng.random_choice(hot)
+            t.read_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            k2 = rng.random_choice(hot)
+            t.write_conflict_ranges.append(KeyRange(k2, k2 + b"\x00"))
+            txns.append(t)
+        assert kernel.resolve(txns, now, now - 50) == oracle.resolve(txns, now, now - 50)
+
+
+def test_parity_range_clears():
+    """AtomicOps + wide range-clear shaped load (BASELINE.json config 4)."""
+    rng = DeterministicRandom(11)
+    oracle = OracleConflictEngine()
+    kernel = JaxConflictEngine(SMALL)
+    now = 100
+    for b in range(30):
+        now += 7
+        txns = []
+        for _ in range(8):
+            t = CommitTransaction()
+            t.read_snapshot = now - rng.random_int(1, 25)
+            if rng.random01() < 0.5:
+                t.write_conflict_ranges.append(random_range(rng))  # wide clear
+            else:
+                k = random_key(rng)
+                t.read_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+                t.write_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            txns.append(t)
+        assert kernel.resolve(txns, now, max(0, now - 60)) == oracle.resolve(txns, now, max(0, now - 60))
+
+
+def test_batch_splitting_is_exact():
+    """Engine must split oversized batches on txn boundaries without changing
+    any verdict (sub-batch writes land at `now` > every later snapshot)."""
+    tiny = KernelConfig(key_words=2, capacity=256, max_reads=8, max_writes=8, max_txns=4)
+    rng = DeterministicRandom(21)
+    oracle = OracleConflictEngine()
+    kernel = JaxConflictEngine(tiny)
+    now = 50
+    for b in range(15):
+        now += 9
+        txns = [random_txn(rng, 0, now, False) for _ in range(11)]  # > max_txns
+        assert kernel.resolve(txns, now, 0) == oracle.resolve(txns, now, 0)
+
+
+def test_clear_resets_history():
+    kernel = JaxConflictEngine(SMALL)
+    oracle = OracleConflictEngine()
+    t = CommitTransaction()
+    t.write_conflict_ranges.append(KeyRange(b"a", b"b"))
+    for e in (kernel, oracle):
+        e.resolve([t], 10, 0)
+        e.clear(20)
+    r = CommitTransaction(read_snapshot=15)
+    r.read_conflict_ranges = [KeyRange(b"zzz", b"zzz\x00")]
+    assert kernel.resolve([r], 30, 0) == oracle.resolve([r], 30, 0)

@@ -1,0 +1,142 @@
+"""Semantics tests for the oracle conflict engine.
+
+Each case encodes a behavior pinned by the reference implementation
+(fdbserver/SkipList.cpp, see ops/oracle.py docstring for the mapping)."""
+from foundationdb_tpu.core.types import (
+    CommitTransaction,
+    KeyRange,
+    TransactionCommitResult as R,
+    single_key_range,
+)
+from foundationdb_tpu.ops.oracle import OracleConflictEngine, VersionIntervalMap
+
+
+def txn(reads=(), writes=(), snapshot=0):
+    t = CommitTransaction(read_snapshot=snapshot)
+    t.read_conflict_ranges = [KeyRange(b, e) for b, e in reads]
+    t.write_conflict_ranges = [KeyRange(b, e) for b, e in writes]
+    return t
+
+
+def test_interval_map_write_and_query():
+    m = VersionIntervalMap(0)
+    m.write(b"b", b"d", 10)
+    assert m.version_at(b"a") == 0
+    assert m.version_at(b"b") == 10
+    assert m.version_at(b"c") == 10
+    assert m.version_at(b"d") == 0
+    assert m.range_max(b"a", b"b") == 0
+    assert m.range_max(b"a", b"b\x00") == 10
+    assert m.range_max(b"c", b"z") == 10
+    assert m.range_max(b"d", b"z") == 0
+
+
+def test_interval_map_overwrite_preserves_end_value():
+    m = VersionIntervalMap(0)
+    m.write(b"b", b"z", 5)
+    m.write(b"c", b"e", 9)
+    assert m.version_at(b"b") == 5
+    assert m.version_at(b"c") == 9
+    assert m.version_at(b"d\xff") == 9
+    assert m.version_at(b"e") == 5  # tail of the old [b,z) range survives
+    assert m.version_at(b"z") == 0
+
+
+def test_simple_conflict():
+    e = OracleConflictEngine()
+    # writer at v10
+    assert e.resolve([txn(writes=[(b"k", b"k\x00")])], 10, 0) == [R.COMMITTED]
+    # reader with snapshot 5 (< 10) conflicts
+    assert e.resolve([txn(reads=[(b"k", b"k\x00")], snapshot=5)], 11, 0) == [R.CONFLICT]
+    # reader with snapshot 10 does not
+    assert e.resolve([txn(reads=[(b"k", b"k\x00")], snapshot=10)], 12, 0) == [R.COMMITTED]
+
+
+def test_read_your_own_batch_write_no_conflict():
+    e = OracleConflictEngine()
+    t = txn(reads=[(b"a", b"b")], writes=[(b"a", b"b")], snapshot=0)
+    assert e.resolve([t], 5, 0) == [R.COMMITTED]
+
+
+def test_intra_batch_earlier_wins():
+    e = OracleConflictEngine()
+    w = txn(writes=[(b"a", b"c")])
+    r = txn(reads=[(b"b", b"b\x00")], snapshot=0)
+    # writer first: reader conflicts
+    assert e.resolve([w, r], 5, 0) == [R.COMMITTED, R.CONFLICT]
+    e2 = OracleConflictEngine()
+    # reader first: both commit
+    assert e2.resolve([r, w], 5, 0) == [R.COMMITTED, R.COMMITTED]
+
+
+def test_intra_batch_aborted_writer_does_not_poison():
+    e = OracleConflictEngine()
+    e.resolve([txn(writes=[(b"x", b"y")])], 10, 0)
+    # t0 conflicts on history; its write to [a,b) must NOT abort t1's read
+    t0 = txn(reads=[(b"x", b"x\x00")], writes=[(b"a", b"b")], snapshot=5)
+    t1 = txn(reads=[(b"a", b"b")], snapshot=10)
+    assert e.resolve([t0, t1], 11, 0) == [R.CONFLICT, R.COMMITTED]
+
+
+def test_intra_batch_chain():
+    # t0 commits, t1 conflicts with t0, t2 reads t1's write range -> commits
+    # because t1 aborted (DAG evaluation, not naive transitive closure).
+    e = OracleConflictEngine()
+    t0 = txn(writes=[(b"a", b"b")])
+    t1 = txn(reads=[(b"a", b"b")], writes=[(b"c", b"d")], snapshot=0)
+    t2 = txn(reads=[(b"c", b"d")], snapshot=0)
+    assert e.resolve([t0, t1, t2], 5, 0) == [R.COMMITTED, R.CONFLICT, R.COMMITTED]
+
+
+def test_touching_ranges_do_not_conflict():
+    e = OracleConflictEngine()
+    w = txn(writes=[(b"a", b"b")])
+    r = txn(reads=[(b"b", b"c")], snapshot=0)
+    assert e.resolve([w, r], 5, 0) == [R.COMMITTED, R.COMMITTED]
+    # and vs history too
+    r2 = txn(reads=[(b"b", b"c")], snapshot=0)
+    assert e.resolve([r2], 6, 0) == [R.COMMITTED]
+
+
+def test_too_old():
+    e = OracleConflictEngine()
+    e.resolve([txn(writes=[(b"k", b"l")])], 10, 8)
+    assert e.oldest_version == 8
+    assert e.resolve([txn(reads=[(b"z", b"z\x00")], snapshot=7)], 11, 8) == [R.TOO_OLD]
+    # write-only txn is never too old (SkipList.cpp:985 requires read ranges)
+    assert e.resolve([txn(writes=[(b"z", b"z\x00")], snapshot=0)], 12, 8) == [R.COMMITTED]
+    # snapshot == oldest is fine
+    assert e.resolve([txn(reads=[(b"q", b"q\x00")], snapshot=8)], 13, 8) == [R.COMMITTED]
+
+
+def test_gc_does_not_change_visible_answers():
+    e = OracleConflictEngine()
+    for i in range(50):
+        k = b"k%03d" % i
+        e.resolve([txn(writes=[(k, k + b"\x00")])], 100 + i, 0)
+    size_before = len(e.map.keys)
+    # advance horizon past some of the writes
+    e.resolve([txn(writes=[(b"zz", b"zz\x00")])], 200, 130)
+    assert len(e.map.keys) < size_before
+    # a read at snapshot >= oldest over GC'd region: all those versions <= 129 < 130 <= snapshot
+    assert e.resolve([txn(reads=[(b"k000", b"k999")], snapshot=199)], 201, 130) == [R.COMMITTED]
+    # but a read with snapshot below a surviving recent write still conflicts
+    assert e.resolve([txn(reads=[(b"zz", b"zz\x00")], snapshot=150)], 202, 130) == [R.CONFLICT]
+
+
+def test_empty_read_range_checks_interval_below():
+    # Pinned skip-list edge semantics (CheckMax with begin==end).
+    e = OracleConflictEngine()
+    e.resolve([txn(writes=[(b"b", b"d")])], 10, 0)
+    # [c,c) with snapshot 5: interval strictly below "c" is [b,d)@10 -> conflict
+    assert e.resolve([txn(reads=[(b"c", b"c")], snapshot=5)], 11, 0) == [R.CONFLICT]
+    # [b,b): interval strictly below "b" is (-inf,b)@0 -> no conflict
+    assert e.resolve([txn(reads=[(b"b", b"b")], snapshot=5)], 12, 0) == [R.COMMITTED]
+
+
+def test_shorter_key_sorts_first():
+    e = OracleConflictEngine()
+    e.resolve([txn(writes=[(b"aa", b"ab")])], 10, 0)
+    # read [a, aa) must not see the write at [aa, ab)
+    assert e.resolve([txn(reads=[(b"a", b"aa")], snapshot=0)], 11, 0) == [R.COMMITTED]
+    assert e.resolve([txn(reads=[(b"a", b"aa\x00")], snapshot=0)], 12, 0) == [R.CONFLICT]
