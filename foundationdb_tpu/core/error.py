@@ -53,7 +53,7 @@ commit_unknown_result = _define(
 transaction_cancelled = _define(1025, "transaction_cancelled", "Transaction cancelled")
 connection_failed = _define(1026, "connection_failed", "Connection failed", retryable=True)
 coordinators_changed = _define(1027, "coordinators_changed", "Coordinators changed", retryable=True)
-request_maybe_delivered = _define(1514, "request_maybe_delivered", "Request may or may not have been delivered")
+request_maybe_delivered = _define(1030, "request_maybe_delivered", "Request may or may not have been delivered")
 broken_promise = _define(1100, "broken_promise", "The promise was dropped before being set")
 master_recovery_failed = _define(1203, "master_recovery_failed", "Master recovery failed")
 tlog_stopped = _define(1011, "tlog_stopped", "TLog stopped")
@@ -64,7 +64,7 @@ movekeys_conflict = _define(1010, "movekeys_conflict", "Concurrent data-distribu
 please_reboot = _define(1207, "please_reboot", "Process should reboot")
 io_error = _define(1510, "io_error", "Disk i/o operation failed")
 file_not_found = _define(1511, "file_not_found", "File not found")
-key_outside_legal_range = _define(2003, "key_outside_legal_range", "Key outside legal range")
+key_outside_legal_range = _define(2004, "key_outside_legal_range", "Key outside legal range")
 inverted_range = _define(2005, "inverted_range", "Range begin key exceeds end key")
 used_during_commit = _define(2017, "used_during_commit", "Operation issued while a commit was outstanding")
 client_invalid_operation = _define(2000, "client_invalid_operation", "Invalid API operation")

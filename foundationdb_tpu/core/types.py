@@ -181,46 +181,82 @@ class TransactionCommitResult(enum.IntEnum):
     COMMITTED = 2
 
 
+#: reference: CLIENT_KNOBS->VALUE_SIZE_LIMIT (fdbclient/Knobs.cpp:56)
+VALUE_SIZE_LIMIT: int = 100_000
+
+
 def apply_atomic_op(op: MutationType, existing: Optional[Value], param: Value) -> Value:
-    """Pure atomic-op evaluation applied at storage servers
-    (reference: fdbclient/Atomic.h). Little-endian arithmetic over the
-    operand-length window, like the reference."""
+    """Pure atomic-op evaluation applied at storage servers — reference-exact
+    (fdbclient/Atomic.h). Except for APPEND_IF_FITS and the BYTE_* winners,
+    the result always has len(param): the existing value is implicitly
+    truncated/zero-extended to the operand's width ("the window")."""
     old = existing if existing is not None else b""
+    n = len(param)
+    m = min(len(old), n)
+
+    def old_window() -> Value:
+        # existing truncated to len(param) and zero-extended (doMax/doMin's
+        # copy loops, Atomic.h:146-155,176-199).
+        return old[:n] + b"\x00" * (n - m)
+
     if op == MutationType.ADD_VALUE:
+        # doLittleEndianAdd (Atomic.h:27-48): carry propagates through all of
+        # param's bytes; result is len(param).
+        if not old or not param:
+            return param
+        a = int.from_bytes(old[:n], "little")
+        b = int.from_bytes(param, "little")
+        return ((a + b) & ((1 << (8 * n)) - 1)).to_bytes(n, "little")
+    if op in (MutationType.AND, MutationType.AND_V2):
+        # doAnd (Atomic.h:50-63): bytes beyond the existing value are 0; an
+        # absent/empty existing value yields all-zeros. V2 (Atomic.h:65-70)
+        # returns param when the key is missing.
+        if op == MutationType.AND_V2 and existing is None:
+            return param
+        if not param:
+            return param
+        return bytes(x & y for x, y in zip(old, param)) + b"\x00" * (n - m)
+    if op == MutationType.OR:
+        if not old or not param:
+            return param
+        return bytes(x | y for x, y in zip(old, param)) + param[m:]
+    if op == MutationType.XOR:
+        if not old or not param:
+            return param
+        return bytes(x ^ y for x, y in zip(old, param)) + param[m:]
+    if op == MutationType.APPEND_IF_FITS:
+        # doAppendIfFits (Atomic.h:107-126)
         if not old:
             return param
-        n = min(len(old), len(param))
-        a = int.from_bytes(old[:n], "little")
-        b = int.from_bytes(param[:n], "little")
-        out = ((a + b) & ((1 << (8 * n)) - 1)).to_bytes(n, "little") if n else b""
-        return out + old[n:]
-    if op in (MutationType.AND, MutationType.AND_V2):
-        if op == MutationType.AND and existing is None:
+        if not param:
+            return old
+        return old + param if len(old) + len(param) <= VALUE_SIZE_LIMIT else old
+    if op == MutationType.MAX:
+        # doMax (Atomic.h:128-158): little-endian compare over param's width;
+        # param wins ties; existing wins as its zero-extended window.
+        if not old or not param:
             return param
-        n = min(len(old), len(param))
-        return bytes(x & y for x, y in zip(old[:n], param[:n])) + param[n:]
-
-    if op == MutationType.OR:
-        n = min(len(old), len(param))
-        return bytes(x | y for x, y in zip(old[:n], param[:n])) + param[n:]
-    if op == MutationType.XOR:
-        n = min(len(old), len(param))
-        return bytes(x ^ y for x, y in zip(old[:n], param[:n])) + param[n:]
-    if op == MutationType.APPEND_IF_FITS:
-        return old + param if len(old) + len(param) <= 131072 else old
-    if op in (MutationType.MAX, MutationType.BYTE_MAX):
-        if op == MutationType.MAX:
-            n = max(len(old), len(param))
-            a = int.from_bytes(old, "little")
-            b = int.from_bytes(param, "little")
-            return (old if a > b else param) if n else b""
-        return max(old, param) if existing is not None else param
-    if op in (MutationType.MIN, MutationType.MIN_V2, MutationType.BYTE_MIN):
-        if op == MutationType.BYTE_MIN:
-            return min(old, param) if existing is not None else param
+        pw = int.from_bytes(param, "little")
+        ow = int.from_bytes(old_window(), "little")
+        return param if pw >= ow else old_window()
+    if op == MutationType.BYTE_MAX:
+        # doByteMax (Atomic.h:160-168): winner returned verbatim (full length).
         if existing is None:
-            return param if op == MutationType.MIN_V2 else b"\x00" * len(param)
-        a = int.from_bytes(old, "little")
-        b = int.from_bytes(param, "little")
-        return old if a < b else param
+            return param
+        return old if old > param else param
+    if op in (MutationType.MIN, MutationType.MIN_V2):
+        # doMin (Atomic.h:170-213); V2 (Atomic.h:215-220) returns param when
+        # the key is missing. An absent key in MIN behaves as zeros.
+        if op == MutationType.MIN_V2 and existing is None:
+            return param
+        if not param:
+            return param
+        pw = int.from_bytes(param, "little")
+        ow = int.from_bytes(old_window(), "little")
+        return param if pw <= ow else old_window()
+    if op == MutationType.BYTE_MIN:
+        # doByteMin (Atomic.h:222-230)
+        if existing is None:
+            return param
+        return old if old < param else param
     raise ValueError(f"not an atomic op: {op}")

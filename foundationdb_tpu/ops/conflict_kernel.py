@@ -25,23 +25,16 @@ any read that passes the too-old gate has snapshot >= base.
 """
 from __future__ import annotations
 
-import functools
 import math
 from dataclasses import dataclass
-from typing import Any, Dict, List, Sequence, Tuple
+from typing import Dict, List, Tuple
 
 import numpy as np
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..core import error
-from ..core.types import (
-    CommitTransaction,
-    TransactionCommitResult,
-    Version,
-)
+from ..core.types import TransactionCommitResult
 from . import keypack
 
 NEG_VERSION = jnp.int32(-(2**30))
@@ -137,10 +130,16 @@ def _compact_rows(keys: jnp.ndarray, vals: jnp.ndarray, keep: jnp.ndarray, out_r
     return ok, ov, jnp.sum(keep.astype(jnp.int32))
 
 
-def resolve_step(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[str, jnp.ndarray]) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
-    """One resolver batch: (state, batch) -> (state', outputs). Pure; jit me.
+def local_phases(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[str, jnp.ndarray]) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Phases 1-2, shard-local: reads vs. history + intra-batch overlap graph.
 
-    batch fields (fixed shapes; see JaxConflictEngine._pack_batch):
+    Returns (hist_hits int32 [T], o_cnt float32 [T, T]). Both are additive
+    across key-range shards (a hit/overlap occurs in >= 1 shard iff it occurs
+    globally), so the multi-shard engine psums them over the mesh axis — the
+    "conflict bitmaps allreduced over ICI" of the north star — before running
+    the order-dependent fixpoint identically on every shard.
+
+    batch fields (fixed shapes; see build_batch_arrays):
       rb, re   uint32 [R, K]   read range begin/end (packed keys)
       r_snap   int32  [R]      read snapshot, relative to base (>= 0)
       r_txn    int32  [R]      owning transaction index
@@ -157,14 +156,12 @@ def resolve_step(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[s
     R = cfg.max_reads
     W = cfg.max_writes
     T = cfg.max_txns
-    H = cfg.capacity
     K = cfg.lanes
 
     rb, re = batch["rb"], batch["re"]
     wb, we = batch["wb"], batch["we"]
     r_txn, w_txn = batch["r_txn"], batch["w_txn"]
     r_valid, w_valid = batch["r_valid"], batch["w_valid"]
-    now = batch["now"]
 
     # ---- Phase 1: reads vs. history (checkReadConflictRanges:1210) ----
     sparse = _build_sparse_max(cfg, hvers, n)
@@ -176,7 +173,7 @@ def resolve_step(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[s
     hi = jnp.where(empty_r, lo_e + 1, hi_ne)
     rmax = _range_max(cfg, sparse, lo, hi)
     r_hit = r_valid & (rmax > batch["r_snap"])
-    hist_conflict = jnp.zeros((T,), jnp.int32).at[r_txn].max(r_hit.astype(jnp.int32), mode="drop") > 0
+    hist_hits = jnp.zeros((T,), jnp.int32).at[r_txn].max(r_hit.astype(jnp.int32), mode="drop")
 
     # ---- Phase 2: intra-batch (checkIntraBatchConflicts:1133) ----
     # Endpoint order with the reference's tie codes (getCharacter,
@@ -216,10 +213,19 @@ def resolve_step(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[s
                   precision=lax.Precision.HIGHEST)                        # [R, T]
     o_cnt = jnp.dot(a.astype(jnp.float32).T, ovb,
                     precision=lax.Precision.HIGHEST)                      # [T, T]
+    return hist_hits, o_cnt
+
+
+def commit_fixpoint(cfg: KernelConfig, t_ok: jnp.ndarray, hist_hits: jnp.ndarray, o_cnt: jnp.ndarray) -> jnp.ndarray:
+    """Earlier-in-batch-wins verdicts from the (globally combined) conflict
+    inputs. Pure function of allreduced values, so every shard computes the
+    identical committed vector with no further communication."""
+    T = cfg.max_txns
+    tids = jnp.arange(T, dtype=jnp.int32)
     o_strict = (o_cnt > 0) & (tids[None, :] < tids[:, None])             # u < t
     o_f32 = o_strict.astype(jnp.float32)
 
-    base_commit = batch["t_ok"] & ~hist_conflict
+    base_commit = t_ok & ~(hist_hits > 0)
     # Earlier-in-batch-wins is a DAG over u < t edges; iterate to its unique
     # fixpoint (equivalent to the reference's in-order sweep).
     def fix_cond(carry):
@@ -235,6 +241,20 @@ def resolve_step(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[s
     c0 = base_commit
     c1 = base_commit & ~(jnp.dot(o_f32, c0.astype(jnp.float32), precision=lax.Precision.HIGHEST) > 0)
     committed, _, _ = lax.while_loop(fix_cond, fix_body, (c1, c0, jnp.int32(0)))
+    return committed
+
+
+def apply_writes_and_gc(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[str, jnp.ndarray], committed: jnp.ndarray) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """Phases 3-5, shard-local: committed-write union, boundary-table merge,
+    GC/rebase. Returns (new_state, overflow)."""
+    hkeys, hvers, n = state["hkeys"], state["hvers"], state["n"]
+    W = cfg.max_writes
+    H = cfg.capacity
+    K = cfg.lanes
+    wb, we = batch["wb"], batch["we"]
+    w_txn = batch["w_txn"]
+    w_valid = batch["w_valid"]
+    now = batch["now"]
 
     # ---- Phase 3: committed-write union (combineWriteConflictRanges:1320) ----
     cw = w_valid & committed[w_txn]
@@ -313,19 +333,38 @@ def resolve_step(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[s
     delta = jnp.maximum(gc, 0)
     fin_v = jnp.where(jslot < n2, jnp.maximum(fin_v - delta, -1), NEG_VERSION)
 
-    status = jnp.where(
-        batch["t_too_old"],
+    new_state = {"hkeys": fin_k, "hvers": fin_v, "n": n2}
+    return new_state, overflow
+
+
+def status_of(t_too_old: jnp.ndarray, committed: jnp.ndarray) -> jnp.ndarray:
+    return jnp.where(
+        t_too_old,
         jnp.int32(int(TransactionCommitResult.TOO_OLD)),
         jnp.where(committed, jnp.int32(int(TransactionCommitResult.COMMITTED)),
                   jnp.int32(int(TransactionCommitResult.CONFLICT))),
     )
-    new_state = {"hkeys": fin_k, "hvers": fin_v, "n": n2}
-    out = {"status": status, "overflow": overflow, "n": n2}
+
+
+def resolve_step(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[str, jnp.ndarray]) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+    """One single-shard resolver batch: (state, batch) -> (state', outputs).
+    Pure; jit me. See local_phases for the batch layout."""
+    hist_hits, o_cnt = local_phases(cfg, state, batch)
+    committed = commit_fixpoint(cfg, batch["t_ok"], hist_hits, o_cnt)
+    new_state, overflow = apply_writes_and_gc(cfg, state, batch, committed)
+    out = {
+        "status": status_of(batch["t_too_old"], committed),
+        "overflow": overflow,
+        "n": new_state["n"],
+    }
     return new_state, out
 
 
-def initial_state(cfg: KernelConfig, version_rel: int = 0) -> Dict[str, jnp.ndarray]:
-    hkeys = np.zeros((cfg.capacity, cfg.lanes), np.uint32)  # row 0 = empty key
+def initial_state(cfg: KernelConfig, version_rel: int = 0, first_key: bytes = b"") -> Dict[str, jnp.ndarray]:
+    """Fresh boundary table whose single interval [first_key, +inf) carries
+    version_rel. Key-range shards pass their span begin as first_key."""
+    hkeys = np.zeros((cfg.capacity, cfg.lanes), np.uint32)
+    hkeys[0] = keypack.pack_key(first_key, cfg.key_words)
     hvers = np.full((cfg.capacity,), int(NEG_VERSION), np.int32)
     hvers[0] = version_rel
     return {
@@ -335,136 +374,44 @@ def initial_state(cfg: KernelConfig, version_rel: int = 0) -> Dict[str, jnp.ndar
     }
 
 
-class JaxConflictEngine:
-    """ConflictSet engine backed by the XLA/TPU kernel.
+def build_batch_arrays(
+    cfg: KernelConfig,
+    r_keys_b: List[bytes], r_keys_e: List[bytes], r_snap: List[int], r_txn: List[int],
+    w_keys_b: List[bytes], w_keys_e: List[bytes], w_txn: List[int],
+    t_ok: np.ndarray, t_too_old: np.ndarray,
+    now_rel: int, gc_rel: int,
+) -> Dict[str, np.ndarray]:
+    """Pad host-side range lists to the kernel's fixed shapes (numpy)."""
+    R, W, K = cfg.max_reads, cfg.max_writes, cfg.lanes
+    nr, nw = len(r_txn), len(w_txn)
 
-    Same resolve() contract as OracleConflictEngine; host side tracks
-    oldest_version (== device version base) and packs batches to fixed
-    shapes. Batches larger than the device caps are split on transaction
-    boundaries, which is exact: sub-batch writes land at version `now` and
-    every later read in the same batch has snapshot < now, so history-vs-
-    intra-batch classification cannot change any verdict."""
+    def padk(keys: List[bytes], cap: int) -> np.ndarray:
+        arr = np.zeros((cap, K), np.uint32)
+        if keys:
+            arr[: len(keys)] = keypack.pack_keys(keys, cfg.key_words)
+        return arr
 
-    name = "jax"
+    return {
+        "rb": padk(r_keys_b, R),
+        "re": padk(r_keys_e, R),
+        "r_snap": np.pad(np.asarray(r_snap, np.int32), (0, R - nr)),
+        "r_txn": np.pad(np.asarray(r_txn, np.int32), (0, R - nr)),
+        "r_valid": np.arange(R) < nr,
+        "wb": padk(w_keys_b, W),
+        "we": padk(w_keys_e, W),
+        "w_txn": np.pad(np.asarray(w_txn, np.int32), (0, W - nw)),
+        "w_valid": np.arange(W) < nw,
+        "t_ok": np.asarray(t_ok, bool),
+        "t_too_old": np.asarray(t_too_old, bool),
+        "now": np.asarray(now_rel, np.int32),
+        "gc": np.asarray(gc_rel, np.int32),
+    }
 
-    def __init__(self, cfg: KernelConfig = KernelConfig(), initial_version: Version = 0):
-        self.cfg = cfg
-        self.base: Version = 0
-        self.oldest_version: Version = 0
-        self.state = initial_state(cfg, version_rel=initial_version)
-        self._step = jax.jit(
-            functools.partial(resolve_step, cfg),
-            donate_argnums=(0,),
-        )
 
-    def clear(self, version: Version) -> None:
-        self.state = initial_state(self.cfg, version_rel=self._rel(version))
+def __getattr__(name):  # PEP 562: JaxConflictEngine lives in host_engine
+    # (which imports this module); re-export lazily to avoid an import cycle.
+    if name == "JaxConflictEngine":
+        from .host_engine import JaxConflictEngine
 
-    def _rel(self, v: Version) -> int:
-        r = v - self.base
-        if r >= 2**30:
-            raise error.client_invalid_operation(
-                f"version {v} too far beyond base {self.base} for int32 device window"
-            )
-        return max(r, -1)
-
-    def resolve(
-        self,
-        transactions: Sequence[CommitTransaction],
-        now: Version,
-        new_oldest: Version,
-    ) -> List[TransactionCommitResult]:
-        cfg = self.cfg
-        results: List[TransactionCommitResult] = []
-        i = 0
-        ntx = len(transactions)
-        while True:
-            # Greedy prefix respecting all three device caps.
-            j, nr, nw = i, 0, 0
-            while j < ntx and (j - i) < cfg.max_txns:
-                tr = transactions[j]
-                tr_r = len(tr.read_conflict_ranges)
-                tr_w = sum(1 for w in tr.write_conflict_ranges if w.begin < w.end)
-                if tr_r > cfg.max_reads or tr_w > cfg.max_writes:
-                    raise error.client_invalid_operation(
-                        "single transaction exceeds device conflict-range capacity"
-                    )
-                if nr + tr_r > cfg.max_reads or nw + tr_w > cfg.max_writes:
-                    break
-                nr += tr_r
-                nw += tr_w
-                j += 1
-            last = j >= ntx
-            results.extend(self._resolve_chunk(transactions[i:j], now, new_oldest if last else 0))
-            if last:
-                break
-            i = j
-        if new_oldest > self.oldest_version:
-            self.oldest_version = new_oldest
-            self.base += max(0, new_oldest - self.base)
-        return results
-
-    def _resolve_chunk(
-        self, transactions: Sequence[CommitTransaction], now: Version, new_oldest: Version
-    ) -> List[TransactionCommitResult]:
-        cfg = self.cfg
-        T, R, W, K = cfg.max_txns, cfg.max_reads, cfg.max_writes, cfg.lanes
-        n = len(transactions)
-        assert n <= T
-
-        too_old = np.zeros((T,), bool)
-        t_ok = np.zeros((T,), bool)
-        r_keys_b: List[bytes] = []
-        r_keys_e: List[bytes] = []
-        r_snap: List[int] = []
-        r_txn: List[int] = []
-        w_keys_b: List[bytes] = []
-        w_keys_e: List[bytes] = []
-        w_txn: List[int] = []
-        for t, tr in enumerate(transactions):
-            is_old = tr.read_snapshot < self.oldest_version and bool(tr.read_conflict_ranges)
-            too_old[t] = is_old
-            t_ok[t] = not is_old
-            if is_old:
-                continue
-            for r in tr.read_conflict_ranges:
-                r_keys_b.append(r.begin)
-                r_keys_e.append(r.end)
-                r_snap.append(self._rel(tr.read_snapshot))
-                r_txn.append(t)
-            for w in tr.write_conflict_ranges:
-                if w.begin < w.end:
-                    w_keys_b.append(w.begin)
-                    w_keys_e.append(w.end)
-                    w_txn.append(t)
-        nr, nw = len(r_txn), len(w_txn)
-        assert nr <= R and nw <= W
-
-        def padk(keys: List[bytes], cap: int) -> np.ndarray:
-            arr = np.zeros((cap, K), np.uint32)
-            if keys:
-                arr[: len(keys)] = keypack.pack_keys(keys, cfg.key_words)
-            return arr
-
-        batch = {
-            "rb": jnp.asarray(padk(r_keys_b, R)),
-            "re": jnp.asarray(padk(r_keys_e, R)),
-            "r_snap": jnp.asarray(np.pad(np.asarray(r_snap, np.int32), (0, R - nr))),
-            "r_txn": jnp.asarray(np.pad(np.asarray(r_txn, np.int32), (0, R - nr))),
-            "r_valid": jnp.asarray(np.arange(R) < nr),
-            "wb": jnp.asarray(padk(w_keys_b, W)),
-            "we": jnp.asarray(padk(w_keys_e, W)),
-            "w_txn": jnp.asarray(np.pad(np.asarray(w_txn, np.int32), (0, W - nw))),
-            "w_valid": jnp.asarray(np.arange(W) < nw),
-            "t_ok": jnp.asarray(t_ok),
-            "t_too_old": jnp.asarray(too_old),
-            "now": jnp.asarray(self._rel(now), jnp.int32),
-            "gc": jnp.asarray(self._rel(new_oldest) if new_oldest > self.oldest_version else 0, jnp.int32),
-        }
-        self.state, out = self._step(self.state, batch)
-        if bool(out["overflow"]):
-            raise error.conflict_capacity_exceeded(
-                f"boundary table needs > {cfg.capacity} rows"
-            )
-        status = np.asarray(out["status"][:n])
-        return [TransactionCommitResult(int(s)) for s in status]
+        return JaxConflictEngine
+    raise AttributeError(name)

@@ -1,0 +1,260 @@
+"""Shared host-side machinery for device-backed ConflictSet engines.
+
+Everything that is NOT the device program lives here exactly once: the int32
+version window (device versions are offsets from a host-tracked base), the
+key-range shard map + routing/clipping (the analog of the proxy's
+`keyResolvers` range map, MasterProxyServer.actor.cpp:263-316), the greedy
+transaction chunking against per-shard device caps, and fixed-shape batch
+packing. Engines (single-chip jit, multi-chip shard_map) subclass and supply
+only `_run_step`.
+
+Batch splitting on transaction boundaries is exact: sub-batch writes land at
+version `now` and every later read in the same batch has snapshot < now, so
+history-vs-intra-batch classification cannot change any verdict.
+"""
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import error
+from ..core.types import CommitTransaction, Key, TransactionCommitResult, Version
+from . import conflict_kernel as ck
+from .conflict_kernel import KernelConfig, build_batch_arrays
+
+
+class KeyShardMap:
+    """Static partition of the keyspace into S contiguous spans.
+
+    Span s = [begins[s], begins[s+1]) with begins[0] = b'' and a virtual
+    +inf end for the last span (the analog of the keyResolvers range map,
+    ProxyCommitData:169)."""
+
+    def __init__(self, split_keys: Sequence[Key]):
+        assert list(split_keys) == sorted(split_keys), "split keys must be sorted"
+        assert all(k for k in split_keys), "split keys must be non-empty"
+        self.begins: List[Key] = [b""] + list(split_keys)
+        self.n_shards = len(self.begins)
+
+    @staticmethod
+    def uniform(n_shards: int) -> "KeyShardMap":
+        """Evenly split on the first key byte."""
+        if n_shards == 1:
+            return KeyShardMap([])
+        splits = [bytes([(256 * i) // n_shards]) for i in range(1, n_shards)]
+        return KeyShardMap(splits)
+
+    def span_end(self, s: int) -> Optional[Key]:
+        return self.begins[s + 1] if s + 1 < self.n_shards else None
+
+    def shard_of_point_below(self, key: Key) -> int:
+        """Shard owning the interval strictly below `key` (for empty reads:
+        mirrors VersionIntervalMap.version_strictly_below's max(i,0))."""
+        return max(bisect.bisect_left(self.begins, key) - 1, 0)
+
+    def shards_of_range(self, begin: Key, end: Key) -> List[Tuple[int, Key, Key]]:
+        """(shard, clipped_begin, clipped_end) for every span intersecting
+        the non-empty range [begin, end)."""
+        out = []
+        lo = max(bisect.bisect_right(self.begins, begin) - 1, 0)
+        for s in range(lo, self.n_shards):
+            sb = self.begins[s]
+            if sb >= end:
+                break
+            se = self.span_end(s)
+            cb = max(begin, sb)
+            ce = end if se is None else min(end, se)
+            if cb < ce:
+                out.append((s, cb, ce))
+        return out
+
+
+@dataclass
+class _RoutedTxn:
+    """One transaction's conflict ranges, clipped per shard (computed once)."""
+
+    reads: List[Tuple[int, Key, Key]]   # (shard, begin, end) — may be empty ranges
+    writes: List[Tuple[int, Key, Key]]  # (shard, begin, end) — non-empty only
+    n_reads: List[int]                  # per-shard counts
+    n_writes: List[int]
+    snapshot: Version
+
+
+class RoutedConflictEngineBase:
+    """Host side of a device-backed ConflictSet engine. Subclasses implement
+    `_run_step(per_shard_batches) -> (status[T] np.ndarray, overflow bool)`
+    and `_reset_device_state(version_rel)`."""
+
+    name = "routed"
+
+    def __init__(self, cfg: KernelConfig, shards: KeyShardMap, initial_version: Version = 0):
+        self.cfg = cfg
+        self.shards = shards
+        self.n_shards = shards.n_shards
+        self.base: Version = 0
+        self.oldest_version: Version = 0
+
+    # -- subclass interface -------------------------------------------------
+    def _run_step(self, per_shard: List[Dict[str, np.ndarray]]) -> Tuple[np.ndarray, bool]:
+        raise NotImplementedError
+
+    def _reset_device_state(self, version_rel: int) -> None:
+        raise NotImplementedError
+
+    # -- shared implementation ---------------------------------------------
+    def clear(self, version: Version) -> None:
+        """reference: clearConflictSet (SkipList.cpp:957-959)."""
+        self._reset_device_state(self._rel(version))
+
+    def _rel(self, v: Version) -> int:
+        r = v - self.base
+        if r >= 2**30:
+            raise error.client_invalid_operation(
+                f"version {v} too far beyond base {self.base} for int32 device window"
+            )
+        return max(r, -1)
+
+    def _route_txn(self, tr: CommitTransaction) -> _RoutedTxn:
+        S = self.n_shards
+        reads: List[Tuple[int, Key, Key]] = []
+        writes: List[Tuple[int, Key, Key]] = []
+        n_reads = [0] * S
+        n_writes = [0] * S
+        for r in tr.read_conflict_ranges:
+            if r.begin >= r.end:
+                s = self.shards.shard_of_point_below(r.begin)
+                reads.append((s, r.begin, r.end))
+                n_reads[s] += 1
+            else:
+                for s, cb, ce in self.shards.shards_of_range(r.begin, r.end):
+                    reads.append((s, cb, ce))
+                    n_reads[s] += 1
+        for w in tr.write_conflict_ranges:
+            if w.begin < w.end:
+                for s, cb, ce in self.shards.shards_of_range(w.begin, w.end):
+                    writes.append((s, cb, ce))
+                    n_writes[s] += 1
+        if max(n_reads) > self.cfg.max_reads or max(n_writes) > self.cfg.max_writes:
+            raise error.client_invalid_operation(
+                "single transaction exceeds device conflict-range capacity"
+            )
+        return _RoutedTxn(reads, writes, n_reads, n_writes, tr.read_snapshot)
+
+    def resolve(
+        self,
+        transactions: Sequence[CommitTransaction],
+        now: Version,
+        new_oldest: Version,
+    ) -> List[TransactionCommitResult]:
+        cfg = self.cfg
+        S = self.n_shards
+        routed = [self._route_txn(tr) for tr in transactions]
+        results: List[TransactionCommitResult] = []
+        i = 0
+        ntx = len(transactions)
+        while True:
+            # Greedy prefix respecting every shard's device caps.
+            j = i
+            nr = [0] * S
+            nw = [0] * S
+            while j < ntx and (j - i) < cfg.max_txns:
+                rt = routed[j]
+                if any(nr[s] + rt.n_reads[s] > cfg.max_reads for s in range(S)) or any(
+                    nw[s] + rt.n_writes[s] > cfg.max_writes for s in range(S)
+                ):
+                    break
+                for s in range(S):
+                    nr[s] += rt.n_reads[s]
+                    nw[s] += rt.n_writes[s]
+                j += 1
+            last = j >= ntx
+            results.extend(self._resolve_chunk(routed[i:j], now, new_oldest if last else 0))
+            if last:
+                break
+            i = j
+        if new_oldest > self.oldest_version:
+            self.oldest_version = new_oldest
+            self.base += max(0, new_oldest - self.base)
+        return results
+
+    def _resolve_chunk(
+        self, routed: Sequence[_RoutedTxn], now: Version, new_oldest: Version
+    ) -> List[TransactionCommitResult]:
+        cfg = self.cfg
+        S = self.n_shards
+        n = len(routed)
+        assert n <= cfg.max_txns
+
+        too_old = np.zeros((cfg.max_txns,), bool)
+        t_ok = np.zeros((cfg.max_txns,), bool)
+        rb: List[List[bytes]] = [[] for _ in range(S)]
+        re_: List[List[bytes]] = [[] for _ in range(S)]
+        rs: List[List[int]] = [[] for _ in range(S)]
+        rt_: List[List[int]] = [[] for _ in range(S)]
+        wb: List[List[bytes]] = [[] for _ in range(S)]
+        we: List[List[bytes]] = [[] for _ in range(S)]
+        wt: List[List[int]] = [[] for _ in range(S)]
+        for t, rt in enumerate(routed):
+            is_old = rt.snapshot < self.oldest_version and bool(rt.reads)
+            too_old[t] = is_old
+            t_ok[t] = not is_old
+            if is_old:
+                continue
+            snap = self._rel(rt.snapshot)
+            for s, cb, ce in rt.reads:
+                rb[s].append(cb)
+                re_[s].append(ce)
+                rs[s].append(snap)
+                rt_[s].append(t)
+            for s, cb, ce in rt.writes:
+                wb[s].append(cb)
+                we[s].append(ce)
+                wt[s].append(t)
+
+        now_rel = self._rel(now)
+        gc_rel = self._rel(new_oldest) if new_oldest > self.oldest_version else 0
+        per = [
+            build_batch_arrays(
+                cfg, rb[s], re_[s], rs[s], rt_[s], wb[s], we[s], wt[s],
+                t_ok, too_old, now_rel, gc_rel,
+            )
+            for s in range(S)
+        ]
+        status, overflow = self._run_step(per)
+        if overflow:
+            raise error.conflict_capacity_exceeded(
+                f"a shard's boundary table needs > {cfg.capacity} rows"
+            )
+        return [TransactionCommitResult(int(v)) for v in status[:n]]
+
+
+class JaxConflictEngine(RoutedConflictEngineBase):
+    """Single-chip ConflictSet engine backed by the XLA/TPU kernel
+    (one shard, plain jit). Same resolve() contract as OracleConflictEngine."""
+
+    name = "jax"
+
+    def __init__(self, cfg: KernelConfig = KernelConfig(), initial_version: Version = 0):
+        super().__init__(cfg, KeyShardMap([]), initial_version)
+        self.state = ck.initial_state(cfg, version_rel=initial_version)
+        self._step = jax.jit(
+            functools.partial(ck.resolve_step, cfg),
+            donate_argnums=(0,),
+        )
+
+    def _reset_device_state(self, version_rel: int) -> None:
+        self.state = ck.initial_state(self.cfg, version_rel=version_rel)
+
+    def _run_step(self, per_shard: List[Dict[str, np.ndarray]]) -> Tuple[np.ndarray, bool]:
+        (arrays,) = per_shard
+        batch = {k: jnp.asarray(v) for k, v in arrays.items()}
+        self.state, out = self._step(self.state, batch)
+        return np.asarray(out["status"]), bool(out["overflow"])

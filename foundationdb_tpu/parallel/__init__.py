@@ -1,0 +1,12 @@
+"""Device-mesh parallelism for the TPU conflict-detection engine.
+
+The reference scales conflict detection by key-range sharding across Resolver
+processes (SURVEY.md §2.6.2; fdbserver/MasterProxyServer.actor.cpp:263-316,
+masterserver.actor.cpp:919-977). Here the same partitioning maps onto a
+jax.sharding.Mesh: one key-range shard per TPU core, per-shard interval
+tables resident in that core's HBM, and the commit verdict combined by
+allreducing conflict bitmaps over ICI (psum inside shard_map).
+"""
+from .sharding import KeyShardMap, ShardedConflictEngine, make_sharded_step
+
+__all__ = ["ShardedConflictEngine", "KeyShardMap", "make_sharded_step"]

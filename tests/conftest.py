@@ -7,13 +7,31 @@ device mesh as well.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the environment points JAX at a real accelerator
+# (JAX_PLATFORMS=axon tunnel): correctness tests need the 8-device virtual
+# mesh; only bench.py runs on the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "1")
 
 import pytest  # noqa: E402
+import jax  # noqa: E402
+
+# The environment's axon site hook re-points JAX at the real TPU regardless of
+# JAX_PLATFORMS; the config update below takes precedence.
+jax.config.update("jax_platforms", "cpu")
+
+# Persistent compile cache: XLA compiles of the conflict kernel dominate test
+# wall-clock; cache them across pytest runs (analogous to the reference's
+# incremental build — correctness runs shouldn't repay compile time).
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.expanduser("~"), ".cache", "fdb_tpu_jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
 @pytest.fixture

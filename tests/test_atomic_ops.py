@@ -1,0 +1,71 @@
+"""apply_atomic_op vs. the reference's doXxx semantics (fdbclient/Atomic.h).
+
+Cases chosen to pin the window rules: results are len(param) wide (except
+APPEND_IF_FITS / BYTE_*), the existing value is truncated/zero-extended to
+that window, and carry propagates through param's tail.
+"""
+import pytest
+
+from foundationdb_tpu.core.types import MutationType as M, apply_atomic_op
+
+
+def test_add_result_is_param_width_with_carry():
+    # doLittleEndianAdd: result always len(param); carry crosses into tail.
+    assert apply_atomic_op(M.ADD_VALUE, b"\x01\x02", b"\x01") == b"\x02"
+    assert apply_atomic_op(M.ADD_VALUE, b"\xff", b"\x01\x00") == b"\x00\x01"
+    assert apply_atomic_op(M.ADD_VALUE, None, b"\x05") == b"\x05"
+    assert apply_atomic_op(M.ADD_VALUE, b"", b"\x05") == b"\x05"
+    assert apply_atomic_op(M.ADD_VALUE, b"\x03", b"") == b""
+    assert apply_atomic_op(M.ADD_VALUE, b"\xff\xff", b"\x01\x00") == b"\x00\x00"
+
+
+def test_and_zero_fills_beyond_existing():
+    assert apply_atomic_op(M.AND, b"\xff", b"\xff\xff") == b"\xff\x00"
+    assert apply_atomic_op(M.AND, None, b"\xff") == b"\x00"
+    assert apply_atomic_op(M.AND, b"", b"\xff\xff") == b"\x00\x00"
+    assert apply_atomic_op(M.AND, b"\x0f\xf0", b"\xff") == b"\x0f"
+    # V2: missing key returns param verbatim; present key behaves like AND.
+    assert apply_atomic_op(M.AND_V2, None, b"\xff") == b"\xff"
+    assert apply_atomic_op(M.AND_V2, b"\xff", b"\xff\xff") == b"\xff\x00"
+
+
+def test_or_xor_copy_param_tail():
+    assert apply_atomic_op(M.OR, b"\x01", b"\x02\x04") == b"\x03\x04"
+    assert apply_atomic_op(M.XOR, b"\x0f", b"\xff\x08") == b"\xf0\x08"
+    assert apply_atomic_op(M.OR, None, b"\x02") == b"\x02"
+    assert apply_atomic_op(M.XOR, b"\x01", b"") == b""
+
+
+def test_append_if_fits():
+    from foundationdb_tpu.core.types import VALUE_SIZE_LIMIT
+
+    assert apply_atomic_op(M.APPEND_IF_FITS, b"ab", b"cd") == b"abcd"
+    assert apply_atomic_op(M.APPEND_IF_FITS, None, b"cd") == b"cd"
+    assert apply_atomic_op(M.APPEND_IF_FITS, b"ab", b"") == b"ab"
+    big = b"x" * VALUE_SIZE_LIMIT
+    assert apply_atomic_op(M.APPEND_IF_FITS, big, b"y") == big
+
+
+def test_max_min_window_compare():
+    # doMax: only param's width is compared; existing returned as its window.
+    assert apply_atomic_op(M.MAX, b"\x05\x01", b"\x06") == b"\x06"
+    assert apply_atomic_op(M.MAX, b"\x07", b"\x06\x00") == b"\x07\x00"
+    assert apply_atomic_op(M.MAX, b"\x05", b"\x05") == b"\x05"  # param wins ties
+    assert apply_atomic_op(M.MAX, None, b"\x01") == b"\x01"
+    # doMin: absent key behaves as zeros (pre-V2 quirk).
+    assert apply_atomic_op(M.MIN, None, b"\x05") == b"\x00"
+    assert apply_atomic_op(M.MIN, b"\x01\x01", b"\x05") == b"\x01"
+    assert apply_atomic_op(M.MIN, b"\x06", b"\x05\x01") == b"\x06\x00"
+    assert apply_atomic_op(M.MIN_V2, None, b"\x05") == b"\x05"
+
+
+def test_byte_min_max_keep_winner_verbatim():
+    assert apply_atomic_op(M.BYTE_MAX, b"zz", b"a") == b"zz"
+    assert apply_atomic_op(M.BYTE_MAX, None, b"a") == b"a"
+    assert apply_atomic_op(M.BYTE_MIN, b"a", b"zz") == b"a"
+    assert apply_atomic_op(M.BYTE_MIN, None, b"zz") == b"zz"
+
+
+def test_non_atomic_op_raises():
+    with pytest.raises(ValueError):
+        apply_atomic_op(M.SET_VALUE, b"a", b"b")
