@@ -1,0 +1,158 @@
+"""North-star benchmark: resolved transactions/sec/chip for the TPU conflict
+kernel (the analog of `fdbserver -r skiplisttest`, SkipList.cpp:1412-1502,
+which measures ConflictBatch::detectConflicts in isolation).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+vs_baseline is against the BASELINE.json north star of 10M resolved txns/sec
+on a v5e-8, i.e. 1.25M txns/sec/chip.
+
+Workload shape mirrors the Cycle/RandomReadWrite configs: single-key reads +
+single-key writes over a hot key pool (16-byte keys like the reference's
+performance.rst setup), full device batches, GC horizon trailing by a few
+batches so the boundary table reaches a steady state.
+
+Throughput is measured with the batches device-resident and the step loop
+inside one lax.scan: this measures the device's sustained resolve rate, not
+the per-call dispatch overhead of the host link (the tunneled dev TPU adds
+~7ms per dispatch; production resolvers pipeline dispatches). p99 latency is
+reported separately from per-call timing and does include that link.
+"""
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from foundationdb_tpu.ops import conflict_kernel as ck
+
+BASELINE_TXNS_PER_SEC_PER_CHIP = 10_000_000 / 8
+
+CFG = ck.KernelConfig(
+    key_words=5,          # 20-byte exact window: fits 16B keys + \x00 range ends
+    capacity=1 << 15,
+    max_reads=4096,
+    max_writes=4096,
+    max_txns=2048,
+)
+READS_PER_TXN = 2
+WRITES_PER_TXN = 2
+POOL = 8192               # hot-key pool; steady-state boundaries stay < capacity
+N_DISTINCT_BATCHES = 8
+SCAN_STEPS = 64           # one compiled program: scan of this many batches
+THROUGHPUT_SCANS = 4
+LATENCY_STEPS = 30
+VERSIONS_PER_BATCH = CFG.max_txns
+GC_LAG_BATCHES = 4
+
+
+def synth_batches(rng: np.random.Generator):
+    """Device batches synthesized directly in packed form (no host bytes)."""
+    K = CFG.lanes
+    R, W, T = CFG.max_reads, CFG.max_writes, CFG.max_txns
+    pool = np.zeros((POOL, K), np.uint32)
+    pool[:, :4] = rng.integers(0, 2**32, size=(POOL, 4), dtype=np.uint32)
+    pool[:, 4] = 0
+    pool[:, 5] = 16                      # 16-byte keys
+    pool = pool[np.lexsort([pool[:, c] for c in range(K - 1, -1, -1)])]
+
+    batches = []
+    for _ in range(N_DISTINCT_BATCHES):
+        r_idx = rng.integers(0, POOL, size=R)
+        w_idx = rng.integers(0, POOL, size=W)
+        rb = pool[r_idx].copy()
+        re = pool[r_idx].copy()
+        re[:, 5] = 17                    # key + \x00 => same words, length 17
+        wb = pool[w_idx].copy()
+        we = pool[w_idx].copy()
+        we[:, 5] = 17
+        batches.append({
+            "rb": rb, "re": re,
+            "r_txn": np.repeat(np.arange(T, dtype=np.int32), READS_PER_TXN),
+            "r_valid": np.ones((R,), bool),
+            "wb": wb, "we": we,
+            "w_txn": np.repeat(np.arange(T, dtype=np.int32), WRITES_PER_TXN),
+            "w_valid": np.ones((W,), bool),
+            "t_ok": np.ones((T,), bool),
+            "t_too_old": np.zeros((T,), bool),
+        })
+    # Stack to [B, ...] for device residency + scan.
+    return jax.device_put(jax.tree.map(lambda *xs: np.stack(xs), *batches))
+
+
+def versioned(batch, now):
+    """Attach device-computed version fields (resolver batch at version now)."""
+    snap = jnp.maximum(now - VERSIONS_PER_BATCH // 2, 0)
+    gc = jnp.maximum(now - GC_LAG_BATCHES * VERSIONS_PER_BATCH, 0)
+    return dict(
+        batch,
+        r_snap=jnp.full((CFG.max_reads,), snap, jnp.int32),
+        now=jnp.asarray(now, jnp.int32),
+        gc=jnp.asarray(gc, jnp.int32),
+    )
+
+
+def step_fn(carry, i):
+    state, now = carry
+    batch = jax.tree.map(lambda x: x[i % N_DISTINCT_BATCHES], BATCHES)
+    state, out = ck.resolve_step(CFG, state, versioned(batch, now))
+    return (state, now + VERSIONS_PER_BATCH), out["n"]
+
+
+def main():
+    global BATCHES
+    dev = jax.devices()[0]
+    rng = np.random.default_rng(2026)
+    BATCHES = synth_batches(rng)
+    state = jax.device_put(ck.initial_state(CFG))
+
+    run = jax.jit(
+        lambda st, now: lax.scan(step_fn, (st, now), jnp.arange(SCAN_STEPS)),
+        donate_argnums=(0,),
+    )
+    single = jax.jit(
+        lambda st, now: ck.resolve_step(
+            CFG, st, versioned(jax.tree.map(lambda x: x[0], BATCHES), now)
+        ),
+        donate_argnums=(0,),
+    )
+
+    # Warm both programs (compile + first run happen here).
+    (state, now), _ = run(state, jnp.int32(1))
+    jax.block_until_ready(state["n"])
+    state, out = single(state, now)
+    jax.block_until_ready(out["status"])
+    now = now + VERSIONS_PER_BATCH
+
+    t0 = time.perf_counter()
+    for _ in range(THROUGHPUT_SCANS):
+        (state, now), ns = run(state, now)
+    jax.block_until_ready(ns)
+    dt = time.perf_counter() - t0
+    txns_per_sec = THROUGHPUT_SCANS * SCAN_STEPS * CFG.max_txns / dt
+
+    # Per-call latency (includes host link / dispatch overhead).
+    lat = []
+    for _ in range(LATENCY_STEPS):
+        t1 = time.perf_counter()
+        state, out = single(state, now)
+        jax.block_until_ready(out["status"])
+        lat.append(time.perf_counter() - t1)
+        now = now + VERSIONS_PER_BATCH
+    p99_ms = float(np.percentile(np.asarray(lat) * 1e3, 99))
+
+    print(json.dumps({
+        "metric": "resolved_txns_per_sec_per_chip",
+        "value": round(txns_per_sec, 1),
+        "unit": "txn/s",
+        "vs_baseline": round(txns_per_sec / BASELINE_TXNS_PER_SEC_PER_CHIP, 4),
+        "p99_resolve_ms": round(p99_ms, 3),
+        "batch_txns": CFG.max_txns,
+        "device": str(dev),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -283,33 +283,52 @@ def apply_writes_and_gc(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch:
     ue_ver = hvers[_search(cfg, hkeys, n, ue_keys, lower=False) - 1]
 
     # ---- Phase 4: merge union into the boundary table at version `now` ----
+    # All searches below are W/2W-query (never H-query): positions of old
+    # rows relative to the union are recovered with scatter+cumsum sweeps
+    # over the table instead of per-old-row binary searches (H >> W made
+    # those the dominant cost on TPU).
     jslot = jnp.arange(H, dtype=jnp.int32)
-    jj = _search(cfg, ub_keys, u_count, hkeys, lower=False) - 1          # per old row
-    covered = (jj >= 0) & _key_less(hkeys, ue_keys[jnp.maximum(jj, 0)])
+    valid_u = jnp.arange(W, dtype=jnp.int32) < u_count
+    # covered[h] iff some union range [ub_i, ue_i) contains hkeys[h]:
+    # delta sweep over [start_i, stop_i) index windows.
+    u_start = _search(cfg, hkeys, n, ub_keys, lower=True)                # [W]
+    u_stop = _search(cfg, hkeys, n, ue_keys, lower=True)                 # [W]
+    cov_delta = (
+        jnp.zeros((H + 1,), jnp.int32)
+        .at[jnp.where(valid_u, u_start, H + 1)].add(1, mode="drop")
+        .at[jnp.where(valid_u, u_stop, H + 1)].add(-1, mode="drop")
+    )
+    covered = jnp.cumsum(cov_delta[:H]) > 0
     old_keep = (jslot < n) & ~covered
 
     # New rows: interleave begins (version=now) and ends (version=ue_ver);
     # the interleaving [ub0, ue0, ub1, ue1, ...] is already key-sorted.
     nb_keys = jnp.stack([ub_keys, ue_keys], axis=1).reshape(2 * W, K)
     nb_vers = jnp.stack([jnp.full((W,), now, jnp.int32), ue_ver], axis=1).reshape(2 * W)
+    nb_lb = jnp.stack([u_start, u_stop], axis=1).reshape(2 * W)          # lower bound in hkeys
     j_of = jnp.repeat(jnp.arange(W, dtype=jnp.int32), 2)
     is_end_row = jnp.tile(jnp.array([False, True]), W)
     nb_valid = j_of < u_count
     # Drop an end row when an equal, uncovered old boundary already exists
     # (same version by construction, so keeping the old row is exact).
-    eqi = _search(cfg, hkeys, n, nb_keys, lower=True)
-    eq_exists = (eqi < n) & _key_eq(hkeys[jnp.minimum(eqi, H - 1)], nb_keys) & ~covered[jnp.minimum(eqi, H - 1)]
+    lbc = jnp.minimum(nb_lb, H - 1)
+    eq_exists = (nb_lb < n) & _key_eq(hkeys[lbc], nb_keys) & ~covered[lbc]
     nb_keep = nb_valid & ~(is_end_row & eq_exists)
 
     ncomp_pos = jnp.cumsum(nb_keep.astype(jnp.int32)) - 1
     nc = jnp.sum(nb_keep.astype(jnp.int32))
     nck = jnp.zeros((2 * W, K), jnp.uint32).at[jnp.where(nb_keep, ncomp_pos, 2 * W)].set(nb_keys, mode="drop")
     ncv = jnp.zeros((2 * W,), jnp.int32).at[jnp.where(nb_keep, ncomp_pos, 2 * W)].set(nb_vers, mode="drop")
+    lb_old = jnp.zeros((2 * W,), jnp.int32).at[jnp.where(nb_keep, ncomp_pos, 2 * W)].set(nb_lb, mode="drop")
 
     cum_keep = jnp.cumsum(old_keep.astype(jnp.int32))
-    new_before_old = _search(cfg, nck, nc, hkeys, lower=True)
+    # new_before_old[h] = # kept new rows whose insertion point <= h.
+    new_cnt = (
+        jnp.zeros((H + 1,), jnp.int32)
+        .at[jnp.where(jnp.arange(2 * W) < nc, lb_old, H + 1)].add(1, mode="drop")
+    )
+    new_before_old = jnp.cumsum(new_cnt[:H])
     pos_old = cum_keep - 1 + new_before_old
-    lb_old = _search(cfg, hkeys, n, nck, lower=True)
     cum_cov = jnp.cumsum(covered.astype(jnp.int32))
     cov_before = jnp.where(lb_old > 0, cum_cov[jnp.maximum(lb_old - 1, 0)], 0)
     pos_new = jnp.arange(2 * W, dtype=jnp.int32) + (lb_old - cov_before)

@@ -49,6 +49,7 @@ class KeyShardMap:
         """Evenly split on the first key byte."""
         if n_shards == 1:
             return KeyShardMap([])
+        assert n_shards <= 256, "one-byte granularity cannot split past 256 shards"
         splits = [bytes([(256 * i) // n_shards]) for i in range(1, n_shards)]
         return KeyShardMap(splits)
 
@@ -95,7 +96,9 @@ class RoutedConflictEngineBase:
 
     name = "routed"
 
-    def __init__(self, cfg: KernelConfig, shards: KeyShardMap, initial_version: Version = 0):
+    def __init__(self, cfg: KernelConfig, shards: KeyShardMap):
+        # Subclasses seed their device state (incl. any initial version, as a
+        # base-relative offset) via _reset_device_state.
         self.cfg = cfg
         self.shards = shards
         self.n_shards = shards.n_shards
@@ -243,7 +246,7 @@ class JaxConflictEngine(RoutedConflictEngineBase):
     name = "jax"
 
     def __init__(self, cfg: KernelConfig = KernelConfig(), initial_version: Version = 0):
-        super().__init__(cfg, KeyShardMap([]), initial_version)
+        super().__init__(cfg, KeyShardMap([]))
         self.state = ck.initial_state(cfg, version_rel=initial_version)
         self._step = jax.jit(
             functools.partial(ck.resolve_step, cfg),

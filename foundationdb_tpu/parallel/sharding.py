@@ -58,9 +58,11 @@ def make_sharded_step(cfg: KernelConfig, mesh: Mesh, axis: str = "shard"):
         batch = jax.tree.map(lambda x: x[0], batch)
         hist_hits, o_cnt = ck.local_phases(cfg, state, batch)
         # The ICI allreduce of the north star: per-shard conflict bitmaps ->
-        # global history-hit vector + intra-batch overlap counts.
+        # global history-hit vector + intra-batch overlap flags. Only
+        # existence matters downstream, so clip to 0/1 uint8 before the
+        # collective (4x less ICI traffic than raw f32 counts).
         hist_hits = lax.psum(hist_hits, axis)
-        o_cnt = lax.psum(o_cnt, axis)
+        o_cnt = lax.psum((o_cnt > 0).astype(jnp.uint8), axis)
         committed = ck.commit_fixpoint(cfg, batch["t_ok"], hist_hits, o_cnt)
         new_state, overflow = ck.apply_writes_and_gc(cfg, state, batch, committed)
         out = {
@@ -92,7 +94,7 @@ class ShardedConflictEngine(RoutedConflictEngineBase):
             n = len(devs) if shards is None else shards.n_shards
             mesh = jax.make_mesh((n,), ("shard",), devices=devs[:n])
         (n_devices,) = mesh.devices.shape
-        super().__init__(cfg, shards or KeyShardMap.uniform(n_devices), initial_version)
+        super().__init__(cfg, shards or KeyShardMap.uniform(n_devices))
         assert self.n_shards == n_devices
         self.mesh = mesh
         self._sharding = NamedSharding(mesh, P("shard"))
