@@ -59,10 +59,11 @@ def make_sharded_step(cfg: KernelConfig, mesh: Mesh, axis: str = "shard"):
         hist_hits, o_cnt = ck.local_phases(cfg, state, batch)
         # The ICI allreduce of the north star: per-shard conflict bitmaps ->
         # global history-hit vector + intra-batch overlap flags. Only
-        # existence matters downstream, so clip to 0/1 uint8 before the
-        # collective (4x less ICI traffic than raw f32 counts).
+        # existence matters downstream, so reduce uint8 flags with pmax
+        # (4x less ICI traffic than f32 counts, and no wraparound at any
+        # shard count, unlike a psum of narrow ints).
         hist_hits = lax.psum(hist_hits, axis)
-        o_cnt = lax.psum((o_cnt > 0).astype(jnp.uint8), axis)
+        o_cnt = lax.pmax((o_cnt > 0).astype(jnp.uint8), axis)
         committed = ck.commit_fixpoint(cfg, batch["t_ok"], hist_hits, o_cnt)
         new_state, overflow = ck.apply_writes_and_gc(cfg, state, batch, committed)
         out = {

@@ -1,0 +1,40 @@
+"""Deterministic simulation runtime.
+
+The reference's single most load-bearing design decision (SURVEY.md §1, §4)
+is that flow/ + fdbrpc/ virtualize the entire world — time, network, disk,
+randomness — behind one seam (INetwork / ISimulator), making a whole
+multi-datacenter cluster simulable deterministically inside one process.
+This package is the TPU framework's version of that seam:
+
+  loop.py       Future/Promise + cooperative scheduler with virtual time and
+                task priorities (flow/flow.h, flow/network.h:30-76, Net2/Sim2)
+  actors.py     combinator library (flow/genericactors.actor.h)
+  network.py    token-addressed endpoints + simulated message bus with
+                latency/clogging/partitions (fdbrpc/FlowTransport, Sim2Conn)
+  simulator.py  processes/machines/DCs, kill/reboot/clog APIs
+                (fdbrpc/simulator.h:35-316)
+
+Determinism contract: given a seed, every run produces the identical event
+sequence. All scheduling ties break on (virtual time, -priority, insertion
+seq); all randomness flows from one DeterministicRandom; TPU/JAX calls are
+dispatched from exactly one logical queue.
+"""
+from .loop import (
+    Future,
+    Promise,
+    Scheduler,
+    SimError,
+    Task,
+    TaskPriority,
+    current_scheduler,
+    delay,
+    never,
+    now,
+    spawn,
+    yield_now,
+)
+
+__all__ = [
+    "Future", "Promise", "Scheduler", "SimError", "Task", "TaskPriority",
+    "current_scheduler", "delay", "never", "now", "spawn", "yield_now",
+]

@@ -1,0 +1,402 @@
+"""Deterministic simulation core: scheduler, futures, combinators, network.
+
+The key property under test is the reference's (SURVEY.md §4-5): given a
+seed, a whole multi-process run produces an identical event trace, and fault
+injection (kill/clog/partition) behaves deterministically too.
+"""
+import pytest
+
+from foundationdb_tpu.core import error
+from foundationdb_tpu.sim.actors import (
+    ActorCollection,
+    AsyncTrigger,
+    AsyncVar,
+    FutureStream,
+    NotifiedVersion,
+    PromiseStream,
+    all_of,
+    any_of,
+    quorum,
+    timeout_after,
+)
+from foundationdb_tpu.sim.loop import (
+    Future,
+    Promise,
+    Scheduler,
+    TaskPriority,
+    set_scheduler,
+)
+from foundationdb_tpu.sim.network import Endpoint
+from foundationdb_tpu.sim.simulator import KillType, Simulator
+
+
+@pytest.fixture(autouse=True)
+def reset_scheduler():
+    yield
+    set_scheduler(None)
+
+
+def test_virtual_time_and_delay_ordering():
+    s = Scheduler(seed=1)
+    set_scheduler(s)
+    trace = []
+
+    async def actor(name, dt):
+        await s.delay(dt)
+        trace.append((name, s.time))
+
+    s.spawn(actor("b", 2.0))
+    s.spawn(actor("a", 1.0))
+    s.run()
+    assert trace == [("a", 1.0), ("b", 2.0)]
+
+
+def test_priority_breaks_ties_at_equal_time():
+    s = Scheduler(seed=1)
+    set_scheduler(s)
+    trace = []
+
+    async def lo():
+        await s.delay(1.0, TaskPriority.LOW)
+        trace.append("lo")
+
+    async def hi():
+        await s.delay(1.0, TaskPriority.PROXY_COMMIT)
+        trace.append("hi")
+
+    s.spawn(lo())
+    s.spawn(hi())
+    s.run()
+    assert trace == ["hi", "lo"]
+
+
+def test_same_priority_fifo():
+    s = Scheduler(seed=1)
+    set_scheduler(s)
+    trace = []
+
+    async def actor(n):
+        trace.append(n)
+
+    for i in range(5):
+        s.spawn(actor(i))
+    s.run()
+    assert trace == [0, 1, 2, 3, 4]
+
+
+def test_future_error_propagates_through_await():
+    s = Scheduler(seed=1)
+    set_scheduler(s)
+    p = Promise()
+
+    async def reader():
+        return await p.future
+
+    t = s.spawn(reader())
+
+    async def failer():
+        await s.delay(0.5)
+        p.send_error(error.not_committed())
+
+    s.spawn(failer())
+    s.run()
+    assert t.is_error
+    with pytest.raises(error.FDBError, match="not_committed"):
+        t.get()
+
+
+def test_task_cancel_releases_waiters():
+    s = Scheduler(seed=1)
+    set_scheduler(s)
+
+    async def hangs():
+        await Future()  # never
+
+    t = s.spawn(hangs())
+    s.run()
+    assert not t.is_ready
+    t.cancel()
+    assert t.is_error
+    with pytest.raises(error.FDBError, match="operation_cancelled"):
+        t.get()
+
+
+def test_cancel_forces_through_swallowed_cancellation():
+    """Actors may not wait during cancellation: a coroutine that catches the
+    cancellation error and awaits again is forced closed, and the task still
+    resolves (else anything awaiting it hangs forever)."""
+    s = Scheduler(seed=1)
+    set_scheduler(s)
+    cleaned = []
+
+    async def stubborn():
+        try:
+            await Future()  # never
+        except error.FDBError:
+            cleaned.append("cleanup")
+            await s.delay(1.0)  # forbidden wait during cancellation
+            cleaned.append("unreachable")
+
+    t = s.spawn(stubborn())
+    s.run()
+    t.cancel()
+    assert t.is_ready and t.is_error
+    assert cleaned == ["cleanup"]
+
+
+def test_combinators():
+    s = Scheduler(seed=1)
+    set_scheduler(s)
+    a, b, c = Promise(), Promise(), Promise()
+    all_f = all_of([a.future, b.future, c.future])
+    any_f = any_of([a.future, b.future, c.future])
+    q = quorum([a.future, b.future, c.future], 2)
+
+    async def do():
+        await s.delay(1)
+        b.send("B")
+        await s.delay(1)
+        a.send("A")
+        await s.delay(1)
+        c.send("C")
+
+    s.spawn(do())
+    s.run()
+    assert all_f.get() == ["A", "B", "C"]
+    assert any_f.get() == (1, "B")
+    assert q.is_ready
+
+
+def test_timeout_after():
+    s = Scheduler(seed=1)
+    set_scheduler(s)
+    p = Promise()
+    t = timeout_after(p.future, 5.0, timeout_value="timed-out")
+    s.run()
+    assert t.get() == "timed-out"
+
+
+def test_promise_stream_fifo_and_close():
+    s = Scheduler(seed=1)
+    set_scheduler(s)
+    ps = PromiseStream()
+    got = []
+
+    async def consumer():
+        while True:
+            try:
+                got.append(await ps.stream.pop())
+            except error.FDBError as e:
+                got.append(e.name)
+                return
+
+    s.spawn(consumer())
+
+    async def producer():
+        for i in range(3):
+            ps.send(i)
+            await s.delay(0.1)
+        ps.close()
+
+    s.spawn(producer())
+    s.run()
+    assert got == [0, 1, 2, "end_of_stream"]
+
+
+def test_notified_version_chaining():
+    s = Scheduler(seed=1)
+    set_scheduler(s)
+    nv = NotifiedVersion(0)
+    order = []
+
+    async def waiter(v):
+        await nv.when_at_least(v)
+        order.append(v)
+
+    s.spawn(waiter(10))
+    s.spawn(waiter(5))
+    s.spawn(waiter(7))
+
+    async def bump():
+        await s.delay(1)
+        nv.set(6)
+        await s.delay(1)
+        nv.set(10)
+
+    s.spawn(bump())
+    s.run()
+    assert order == [5, 7, 10]
+
+
+def test_async_var_and_trigger():
+    s = Scheduler(seed=1)
+    set_scheduler(s)
+    av = AsyncVar(1)
+    trig = AsyncTrigger()
+    seen = []
+
+    async def watch():
+        while True:
+            await av.on_change()
+            seen.append(av.get())
+            if av.get() == 3:
+                return
+
+    s.spawn(watch())
+
+    async def drive():
+        await s.delay(1)
+        av.set(2)
+        await s.delay(1)
+        av.set(2)  # no-op: same value
+        av.set(3)
+
+    s.spawn(drive())
+    s.run()
+    assert seen == [2, 3]
+
+
+# -- network / simulator -----------------------------------------------------
+
+
+def build_echo_world(seed):
+    sim = Simulator(seed)
+    server = sim.new_process("server")
+    client = sim.new_process("client")
+
+    async def echo(msg):
+        return ("echo", msg)
+
+    ep = server.register("echo", echo)
+    return sim, server, client, ep
+
+
+def test_request_reply_and_latency():
+    sim, server, client, ep = build_echo_world(7)
+    f = sim.net.request(client.address, ep, 42)
+    sim.run_until(f)
+    assert f.get() == ("echo", 42)
+    assert sim.sched.time > 0  # latency was paid
+
+
+def test_request_to_dead_process_fails():
+    sim, server, client, ep = build_echo_world(7)
+    sim.kill_process(server)
+    f = sim.net.request(client.address, ep, 1)
+    sim.run()
+    assert f.is_error
+    with pytest.raises(error.FDBError, match="connection_failed"):
+        f.get()
+
+
+def test_kill_mid_flight_breaks_reply():
+    sim = Simulator(3)
+    server = sim.new_process("server")
+    client = sim.new_process("client")
+    started = []
+
+    async def slow(msg):
+        started.append(msg)
+        await sim.sched.delay(10.0)
+        return "done"
+
+    ep = server.register("slow", slow)
+    f = sim.net.request(client.address, ep, "x")
+
+    async def killer():
+        await sim.sched.delay(1.0)
+        sim.kill_process(server)
+
+    sim.sched.spawn(killer())
+    sim.run()
+    assert started == ["x"]
+    assert f.is_error
+    with pytest.raises(error.FDBError, match="request_maybe_delivered"):
+        f.get()
+
+
+def test_clog_delays_delivery():
+    sim, server, client, ep = build_echo_world(7)
+    sim.net.clog_pair(client.address, server.address, 5.0)
+    f = sim.net.request(client.address, ep, 1)
+    sim.run_until(f)
+    assert sim.sched.time >= 5.0
+
+
+def test_partition_strands_request():
+    sim, server, client, ep = build_echo_world(7)
+    sim.net.partition(client.address, server.address)
+    f = sim.net.request(client.address, ep, 1)
+    sim.run(until=60.0)
+    assert not f.is_ready
+
+
+def test_reboot_restarts_boot_fn():
+    boots = []
+
+    async def boot(sim, proc):
+        boots.append(sim.sched.time)
+
+        async def pong(msg):
+            return "pong"
+
+        proc.register("ping", pong)
+
+    sim = Simulator(5)
+    proc = sim.new_process("p", boot_fn=boot)
+    client = sim.new_process("c")
+    sim.run(until=0.1)
+    assert len(boots) == 1
+    sim.kill_process(proc, KillType.REBOOT)
+    sim.run(until=10.0)
+    assert len(boots) == 2 and proc.reboots == 1
+    f = sim.net.request(client.address, Endpoint(proc.address, "ping"), None)
+    sim.run_until(f)
+    assert f.get() == "pong"
+
+
+def trace_of_world(seed):
+    """A multi-actor run with faults; returns the (time, event) trace."""
+    sim = Simulator(seed)
+    trace = []
+    server = sim.new_process("server")
+    clients = [sim.new_process(f"c{i}") for i in range(3)]
+
+    async def serve(msg):
+        await sim.sched.delay(sim.sched.rng.random01() * 0.01)
+        return msg * 2
+
+    ep = server.register("double", serve)
+
+    async def client_loop(c, n):
+        for i in range(n):
+            try:
+                r = await sim.net.request(c.address, ep, i)
+                trace.append((round(sim.sched.time, 9), c.name, r))
+            except error.FDBError as e:
+                trace.append((round(sim.sched.time, 9), c.name, e.name))
+            await sim.sched.delay(0.05)
+
+    for i, c in enumerate(clients):
+        sim.sched.spawn(client_loop(c, 5 + i))
+
+    async def chaos():
+        await sim.sched.delay(0.12)
+        sim.clog_process(clients[0], 0.2)
+        await sim.sched.delay(0.2)
+        sim.kill_process(server)
+
+    sim.sched.spawn(chaos())
+    sim.run(until=30.0)
+    return trace
+
+
+def test_seed_determinism():
+    t1 = trace_of_world(1234)
+    t2 = trace_of_world(1234)
+    assert t1 == t2
+    assert len(t1) > 5
+
+
+def test_different_seeds_differ():
+    assert trace_of_world(1) != trace_of_world(2)
