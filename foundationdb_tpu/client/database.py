@@ -1,0 +1,348 @@
+"""Database + Transaction: the client of the transaction system.
+
+Round-1 scope of fdbclient/NativeAPI.actor.cpp + ReadYourWrites.actor.cpp:
+
+  * GRV from the proxy (readVersionBatcher batches on the proxy side here)
+  * key -> storage-server location cache filled from the proxy
+    (getKeyLocation_internal:1028) with wrong_shard_server invalidation
+  * reads at the read version from storage replicas (getValue:1165,
+    getRange:1604), recording read conflict ranges (unless snapshot)
+  * a read-your-writes overlay: uncommitted sets/clears/atomic-ops are
+    visible to this transaction's own reads (WriteMap semantics)
+  * commit via the proxy; on_error implements the reference's retry loop
+    with randomized exponential backoff (Transaction::onError:2630)
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..core import error
+from ..core.types import (
+    CommitTransaction,
+    Key,
+    KeyRange,
+    Mutation,
+    MutationType,
+    SINGLE_KEY_MUTATIONS,
+    Value,
+    Version,
+    apply_atomic_op,
+    key_after,
+    single_key_range,
+)
+from ..sim.loop import TaskPriority, current_scheduler, delay
+from ..sim.network import Endpoint
+from ..server import proxy as proxy_mod
+from ..server import storage as storage_mod
+from ..server.messages import (
+    CommitTransactionRequest,
+    GetKeyValuesRequest,
+    GetKeyServerLocationsRequest,
+    GetReadVersionRequest,
+    GetValueRequest,
+)
+
+MAX_BACKOFF = 1.0
+INITIAL_BACKOFF = 0.01
+USER_KEYSPACE_END = b"\xff"
+
+_WRONG_SHARD = error.wrong_shard_server("").code
+_MAYBE_DELIVERED = error.request_maybe_delivered("").code
+_CONNECTION_FAILED = error.connection_failed("").code
+
+
+def _map_read_error(e: error.FDBError) -> error.FDBError:
+    """Reads are idempotent: a maybe-delivered request is safely retryable,
+    so surface it as connection_failed (which on_error retries). The
+    reference gets this for free from loadBalance re-issuing to replicas."""
+    if e.code == _MAYBE_DELIVERED:
+        return error.connection_failed("retrying idempotent read")
+    return e
+
+
+class Database:
+    def __init__(self, net, client_addr: str, proxy_addrs: List[str]):
+        self.net = net
+        self.client_addr = client_addr
+        self.proxy_addrs = proxy_addrs
+        # location cache: sorted [(range, [storage addrs])]
+        self._locations: List[Tuple[KeyRange, List[str]]] = []
+
+    def _proxy(self) -> str:
+        rng = current_scheduler().rng
+        return self.proxy_addrs[rng.random_int(0, len(self.proxy_addrs))]
+
+    def create_transaction(self) -> "Transaction":
+        return Transaction(self)
+
+    async def run(self, fn, *args):
+        """Retry loop (the @fdb.transactional decorator of the bindings):
+        fn(tr, *args) is retried until commit succeeds."""
+        tr = self.create_transaction()
+        while True:
+            try:
+                result = await fn(tr, *args)
+                await tr.commit()
+                return result
+            except error.FDBError as e:
+                await tr.on_error(e)
+
+    # -- location cache ------------------------------------------------------
+    def invalidate_cache(self) -> None:
+        self._locations = []
+
+    async def get_locations(self, begin: Key, end: Key) -> List[Tuple[KeyRange, List[str]]]:
+        covered = self._cached_locations(begin, end)
+        if covered is not None:
+            return covered
+        reply = await self.net.request(
+            self.client_addr,
+            Endpoint(self._proxy(), proxy_mod.LOCATIONS_TOKEN),
+            GetKeyServerLocationsRequest(begin=begin, end=end),
+            TaskPriority.DEFAULT_ENDPOINT,
+        )
+        for rng, addrs in reply.results:
+            self._insert_location(rng, addrs)
+        return reply.results
+
+    def _cached_locations(self, begin: Key, end: Key) -> Optional[List[Tuple[KeyRange, List[str]]]]:
+        out = []
+        at = begin
+        for rng, addrs in self._locations:
+            if rng.begin <= at < rng.end:
+                out.append((rng, addrs))
+                at = rng.end
+                if at >= end:
+                    return out
+        return None
+
+    def _insert_location(self, rng: KeyRange, addrs: List[str]) -> None:
+        kept = [(r, a) for (r, a) in self._locations if not r.intersects(rng)]
+        kept.append((rng, addrs))
+        kept.sort(key=lambda x: x[0].begin)
+        self._locations = kept
+
+
+class Transaction:
+    def __init__(self, db: Database):
+        self.db = db
+        self.read_version: Optional[Version] = None
+        self.mutations: List[Mutation] = []
+        self.read_conflict_ranges: List[KeyRange] = []
+        self.write_conflict_ranges: List[KeyRange] = []
+        self.committed_version: Optional[Version] = None
+        self._backoff = INITIAL_BACKOFF
+        self._committing = False
+
+    # -- versions ------------------------------------------------------------
+    async def get_read_version(self) -> Version:
+        if self.read_version is None:
+            reply = await self.db.net.request(
+                self.db.client_addr,
+                Endpoint(self.db._proxy(), proxy_mod.GRV_TOKEN),
+                GetReadVersionRequest(),
+                TaskPriority.GET_CONSISTENT_READ_VERSION,
+            )
+            self.read_version = reply.version
+        return self.read_version
+
+    # -- the RYW overlay -----------------------------------------------------
+    def _overlay_value(self, key: Key, base: Optional[Value]) -> Optional[Value]:
+        """Apply this transaction's own buffered mutations for `key` on top
+        of the storage value (WriteMap semantics, fdbclient/WriteMap.h)."""
+        v = base
+        for m in self.mutations:
+            if m.type == MutationType.SET_VALUE and m.param1 == key:
+                v = m.param2
+            elif m.type == MutationType.CLEAR_RANGE and m.param1 <= key < m.param2:
+                v = None
+            elif m.type in SINGLE_KEY_MUTATIONS and m.param1 == key:
+                v = apply_atomic_op(m.type, v, m.param2)
+        return v
+
+    def _needs_base_read(self, key: Key) -> bool:
+        """False when buffered mutations fully determine the value: any SET
+        or covering CLEAR makes the storage base irrelevant (atomic ops after
+        it apply to a known value)."""
+        for m in self.mutations:
+            if m.type == MutationType.SET_VALUE and m.param1 == key:
+                return False
+            if m.type == MutationType.CLEAR_RANGE and m.param1 <= key < m.param2:
+                return False
+        return True
+
+    # -- reads ---------------------------------------------------------------
+    async def get(self, key: Key, snapshot: bool = False) -> Optional[Value]:
+        version = await self.get_read_version()
+        if not snapshot:
+            self.read_conflict_ranges.append(single_key_range(key))
+        base: Optional[Value] = None
+        if self._needs_base_read(key):
+            base = await self._storage_get(key, version)
+        return self._overlay_value(key, base)
+
+    async def get_range(
+        self, begin: Key, end: Key, limit: int = 10_000, snapshot: bool = False, reverse: bool = False
+    ) -> List[Tuple[Key, Value]]:
+        if begin >= end:
+            return []
+        version = await self.get_read_version()
+        if not snapshot:
+            self.read_conflict_ranges.append(KeyRange(begin, end))
+        data = await self._storage_get_range(begin, end, version, limit if not self.mutations else 10_000, reverse)
+        merged = self._overlay_range(begin, end, data)
+        if reverse:
+            merged = sorted(merged, key=lambda kv: kv[0], reverse=True)
+        return merged[:limit]
+
+    def _overlay_range(
+        self, begin: Key, end: Key, data: List[Tuple[Key, Value]]
+    ) -> List[Tuple[Key, Value]]:
+        if not self.mutations:
+            return list(data)
+        result: Dict[Key, Optional[Value]] = dict(data)
+        for m in self.mutations:
+            if m.type == MutationType.SET_VALUE:
+                if begin <= m.param1 < end:
+                    result[m.param1] = m.param2
+            elif m.type == MutationType.CLEAR_RANGE:
+                for k in [k for k in result if m.param1 <= k < m.param2]:
+                    result[k] = None
+            elif m.type in SINGLE_KEY_MUTATIONS:
+                if begin <= m.param1 < end:
+                    result[m.param1] = apply_atomic_op(m.type, result.get(m.param1), m.param2)
+        return sorted(
+            [(k, v) for k, v in result.items() if v is not None], key=lambda kv: kv[0]
+        )
+
+    # -- storage rpc with location cache + retry -----------------------------
+    async def _storage_get(self, key: Key, version: Version) -> Optional[Value]:
+        while True:
+            locs = await self.db.get_locations(key, key_after(key))
+            addr = locs[0][1][0]
+            try:
+                reply = await self.db.net.request(
+                    self.db.client_addr,
+                    Endpoint(addr, storage_mod.GET_VALUE_TOKEN),
+                    GetValueRequest(key=key, version=version),
+                    TaskPriority.DEFAULT_ENDPOINT,
+                )
+                return reply.value
+            except error.FDBError as e:
+                if e.code == _WRONG_SHARD:
+                    self.db.invalidate_cache()
+                    continue
+                raise _map_read_error(e)
+
+    async def _storage_get_range(
+        self, begin: Key, end: Key, version: Version, limit: int, reverse: bool
+    ) -> List[Tuple[Key, Value]]:
+        out: List[Tuple[Key, Value]] = []
+        while True:
+            locs = await self.db.get_locations(begin, end)
+            if reverse:
+                locs = list(reversed(locs))
+            try:
+                for rng, addrs in locs:
+                    cb, ce = max(begin, rng.begin), min(end, rng.end)
+                    if cb >= ce:
+                        continue
+                    reply = await self.db.net.request(
+                        self.db.client_addr,
+                        Endpoint(addrs[0], storage_mod.GET_KEY_VALUES_TOKEN),
+                        GetKeyValuesRequest(begin=cb, end=ce, version=version, limit=limit, reverse=reverse),
+                        TaskPriority.DEFAULT_ENDPOINT,
+                    )
+                    out.extend(reply.data)
+                    if len(out) >= limit:
+                        break
+                return out
+            except error.FDBError as e:
+                if e.code == _WRONG_SHARD:
+                    self.db.invalidate_cache()
+                    out = []
+                    continue
+                raise _map_read_error(e)
+
+    # -- writes ----------------------------------------------------------------
+    def set(self, key: Key, value: Value) -> None:
+        self._check_writable(key)
+        self.mutations.append(Mutation(MutationType.SET_VALUE, key, value))
+        self.write_conflict_ranges.append(single_key_range(key))
+
+    def clear(self, key: Key) -> None:
+        self.clear_range(key, key_after(key))
+
+    def clear_range(self, begin: Key, end: Key) -> None:
+        self._check_writable(begin)
+        if begin >= end:
+            return
+        self.mutations.append(Mutation(MutationType.CLEAR_RANGE, begin, end))
+        self.write_conflict_ranges.append(KeyRange(begin, end))
+
+    def atomic_op(self, key: Key, param: Value, op: MutationType) -> None:
+        self._check_writable(key)
+        self.mutations.append(Mutation(op, key, param))
+        self.write_conflict_ranges.append(single_key_range(key))
+
+    def add_read_conflict_range(self, begin: Key, end: Key) -> None:
+        self.read_conflict_ranges.append(KeyRange(begin, end))
+
+    def add_write_conflict_range(self, begin: Key, end: Key) -> None:
+        self.write_conflict_ranges.append(KeyRange(begin, end))
+
+    def _check_writable(self, key: Key) -> None:
+        if self._committing:
+            raise error.used_during_commit()
+        if key >= USER_KEYSPACE_END:
+            raise error.key_outside_legal_range()
+
+    # -- commit / retry --------------------------------------------------------
+    async def commit(self) -> Version:
+        if not self.mutations and not self.write_conflict_ranges:
+            # Read-only transactions commit trivially (reference:
+            # Transaction::commit fast path).
+            self.committed_version = self.read_version or 0
+            return self.committed_version
+        self._committing = True
+        txn = CommitTransaction(
+            read_conflict_ranges=list(self.read_conflict_ranges),
+            write_conflict_ranges=list(self.write_conflict_ranges),
+            mutations=list(self.mutations),
+            read_snapshot=await self.get_read_version(),
+        )
+        try:
+            reply = await self.db.net.request(
+                self.db.client_addr,
+                Endpoint(self.db._proxy(), proxy_mod.COMMIT_TOKEN),
+                CommitTransactionRequest(transaction=txn),
+                TaskPriority.PROXY_COMMIT,
+            )
+        except error.FDBError as e:
+            if e.code in (_MAYBE_DELIVERED, _CONNECTION_FAILED):
+                # The commit may or may not have happened (reference:
+                # tryCommit maps transport loss to commit_unknown_result).
+                raise error.commit_unknown_result(e.name)
+            raise
+        finally:
+            self._committing = False
+        self.committed_version = reply.version
+        return reply.version
+
+    async def on_error(self, e: error.FDBError) -> None:
+        """reference: Transaction::onError (NativeAPI.actor.cpp:2630):
+        retryable errors reset the transaction after randomized backoff;
+        everything else re-raises."""
+        if not isinstance(e, error.FDBError) or not e.is_retryable():
+            raise e
+        rng = current_scheduler().rng
+        await delay(self._backoff * rng.random01())
+        self._backoff = min(self._backoff * 2, MAX_BACKOFF)
+        self.reset()
+
+    def reset(self) -> None:
+        self.read_version = None
+        self.mutations = []
+        self.read_conflict_ranges = []
+        self.write_conflict_ranges = []
+        self._committing = False

@@ -1,0 +1,10 @@
+"""Server roles of the transaction system.
+
+The reference hosts every role in one binary (fdbserver/worker.actor.cpp);
+here each role is an async actor class registered on a SimProcess. Round-1
+scope is the reference's "seed mode" minimum (masterserver.actor.cpp:325
+newSeedServers + SURVEY.md §7.5): master (version authority), proxies (GRV +
+5-phase pipelined commit), resolvers (TPU/oracle conflict engines behind the
+same interface), tlogs (tag-partitioned in-memory log), storage servers
+(MVCC reads), recruited statically by cluster.py.
+"""

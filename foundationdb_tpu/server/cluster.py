@@ -1,0 +1,101 @@
+"""Static cluster assembly — the reference's seed mode.
+
+Builds a complete minimum transaction system inside a Simulator: one master,
+one proxy, N resolvers (pluggable conflict engines), one tlog, M storage
+servers with a static uniform shard map. The analog of SimulatedCluster's
+setup + masterserver.actor.cpp:325 newSeedServers, before dynamic
+recruitment/recovery land in a later round.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+from ..core.types import KeyRange
+from ..ops.host_engine import KeyShardMap
+from ..ops.oracle import OracleConflictEngine
+from ..sim.network import Endpoint
+from ..sim.simulator import Simulator
+from ..client.database import Database
+from . import tlog as tlog_mod
+from .master import Master
+from .proxy import Proxy, ProxyConfig
+from .resolver import Resolver
+from .storage import StorageServer
+from .tlog import TLog
+
+
+@dataclass
+class ClusterConfig:
+    n_resolvers: int = 1
+    n_storage: int = 2
+    #: () -> conflict engine; default is the reference-exact oracle. Pass
+    #: lambda: JaxConflictEngine(...) for the TPU path.
+    engine_factory: Callable = OracleConflictEngine
+    start_version: int = 1
+
+
+class Cluster:
+    """Handles to every role plus client factories."""
+
+    def __init__(self, sim: Simulator, cfg: ClusterConfig):
+        self.sim = sim
+        self.cfg = cfg
+        sv = cfg.start_version
+
+        self.master_proc = sim.new_process("master")
+        self.master = Master(self.master_proc, start_version=sv)
+
+        self.tlog_proc = sim.new_process("tlog")
+        self.tlog = TLog(self.tlog_proc, start_version=sv)
+
+        self.resolver_shards = KeyShardMap.uniform(cfg.n_resolvers)
+        self.resolver_procs = [sim.new_process(f"resolver{i}") for i in range(cfg.n_resolvers)]
+        self.resolvers = [
+            Resolver(p, cfg.engine_factory(), start_version=sv) for p in self.resolver_procs
+        ]
+
+        self.storage_shards = KeyShardMap.uniform(cfg.n_storage)
+        self.storage_procs = [sim.new_process(f"storage{i}") for i in range(cfg.n_storage)]
+        self.storages: List[StorageServer] = []
+        for i, p in enumerate(self.storage_procs):
+            begin = self.storage_shards.begins[i]
+            end = self.storage_shards.span_end(i) or b"\xff\xff\xff"
+            self.storages.append(
+                StorageServer(
+                    p,
+                    tag=i,
+                    shard=KeyRange(begin, end),
+                    tlog_commit_ep=Endpoint(self.tlog_proc.address, tlog_mod.COMMIT_TOKEN),
+                    tlog_peek_ep=Endpoint(self.tlog_proc.address, tlog_mod.PEEK_TOKEN),
+                    tlog_pop_ep=Endpoint(self.tlog_proc.address, tlog_mod.POP_TOKEN),
+                    net=sim.net,
+                    start_version=sv,
+                )
+            )
+
+        self.proxy_proc = sim.new_process("proxy")
+        self.proxy = Proxy(
+            self.proxy_proc,
+            sim.net,
+            ProxyConfig(
+                master_addr=self.master_proc.address,
+                resolver_addrs=[p.address for p in self.resolver_procs],
+                resolver_shards=self.resolver_shards,
+                tlog_addr=self.tlog_proc.address,
+                storage_addrs=[p.address for p in self.storage_procs],
+                storage_shards=self.storage_shards,
+            ),
+            start_version=sv,
+        )
+        self._n_clients = 0
+
+    def new_client(self) -> Database:
+        self._n_clients += 1
+        proc = self.sim.new_process(f"client{self._n_clients}")
+        return Database(self.sim.net, proc.address, [self.proxy_proc.address])
+
+
+def build_cluster(seed: int = 0, cfg: Optional[ClusterConfig] = None) -> Cluster:
+    sim = Simulator(seed)
+    return Cluster(sim, cfg or ClusterConfig())

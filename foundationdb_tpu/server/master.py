@@ -1,0 +1,43 @@
+"""Master: the commit-version authority.
+
+Round-1 scope of masterserver.actor.cpp: getVersion (:786) — monotonically
+increasing commit versions advancing ~VERSIONS_PER_SECOND with virtual wall
+clock, handed out as (prev_version, version) pairs so resolvers and tlogs
+can chain batches into a total order. Per-proxy request_num dedup mirrors
+the reference's replyToProxies window. Recovery epochs arrive in a later
+round; the seed master starts its epoch at version 1.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from ..core.types import VERSIONS_PER_SECOND, Version
+from ..sim.loop import TaskPriority, now
+from ..sim.network import SimProcess
+from .messages import GetCommitVersionRequest, GetCommitVersionReply
+
+GET_COMMIT_VERSION_TOKEN = "master.getCommitVersion"
+
+
+class Master:
+    def __init__(self, proc: SimProcess, start_version: Version = 1):
+        self.proc = proc
+        self.version: Version = start_version
+        self.last_version_time: float = now()
+        # proxy_id -> (request_num, reply) replay window
+        self._proxy_window: Dict[str, Tuple[int, GetCommitVersionReply]] = {}
+        proc.register(GET_COMMIT_VERSION_TOKEN, self.get_commit_version)
+
+    async def get_commit_version(self, req: GetCommitVersionRequest) -> GetCommitVersionReply:
+        """reference: getVersion, masterserver.actor.cpp:786-850."""
+        last = self._proxy_window.get(req.proxy_id)
+        if last is not None and last[0] == req.request_num:
+            return last[1]  # retried request: same version pair
+        t = now()
+        advance = max(1, int((t - self.last_version_time) * VERSIONS_PER_SECOND))
+        prev = self.version
+        self.version = prev + advance
+        self.last_version_time = t
+        reply = GetCommitVersionReply(version=self.version, prev_version=prev)
+        self._proxy_window[req.proxy_id] = (req.request_num, reply)
+        return reply

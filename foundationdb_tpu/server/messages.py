@@ -1,0 +1,157 @@
+"""Typed request/reply payloads between roles.
+
+Analogs of the reference's *Interface.h structs (MasterProxyInterface.h,
+ResolverInterface.h:83-98, TLogInterface.h, StorageServerInterface.h). The
+sim network passes them by reference; roles must treat them as immutable.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..core.types import CommitTransaction, Key, KeyRange, Mutation, Version
+
+# -- master ------------------------------------------------------------------
+
+
+@dataclass
+class GetCommitVersionRequest:
+    """reference: GetCommitVersionRequest (MasterInterface.h); requestNum
+    dedups retried proxy requests."""
+
+    request_num: int
+    proxy_id: str
+
+
+@dataclass
+class GetCommitVersionReply:
+    version: Version
+    prev_version: Version
+
+
+# -- resolver ----------------------------------------------------------------
+
+
+@dataclass
+class ResolveTransactionBatchRequest:
+    """reference: ResolverInterface.h:83-98."""
+
+    prev_version: Version
+    version: Version
+    last_received_version: Version
+    transactions: List[CommitTransaction] = field(default_factory=list)
+
+
+@dataclass
+class ResolveTransactionBatchReply:
+    committed: List[int] = field(default_factory=list)  # TransactionCommitResult values
+
+
+# -- tlog --------------------------------------------------------------------
+
+
+@dataclass
+class TLogCommitRequest:
+    """reference: TLogCommitRequest (TLogInterface.h); messages are
+    (tag -> mutations) for one commit version."""
+
+    prev_version: Version
+    version: Version
+    messages: Dict[int, List[Mutation]] = field(default_factory=dict)
+
+
+@dataclass
+class TLogPeekRequest:
+    """Pull messages for one tag from begin_version on; blocks until the
+    tlog's version advances past begin_version (reference: tLogPeekMessages,
+    TLogServer.actor.cpp:950)."""
+
+    tag: int
+    begin_version: Version
+
+
+@dataclass
+class TLogPeekReply:
+    messages: List[Tuple[Version, List[Mutation]]] = field(default_factory=list)
+    end_version: Version = 0   # peeker may advance its version to this
+
+
+@dataclass
+class TLogPopRequest:
+    """Storage persisted through `version`; tlog may discard (tLogPop:898)."""
+
+    tag: int
+    version: Version
+
+
+# -- proxy -------------------------------------------------------------------
+
+
+@dataclass
+class GetReadVersionRequest:
+    """reference: GetReadVersionRequest (MasterProxyInterface.h)."""
+
+    priority: int = 0
+
+
+@dataclass
+class GetReadVersionReply:
+    version: Version
+
+
+@dataclass
+class CommitTransactionRequest:
+    transaction: CommitTransaction
+
+
+@dataclass
+class CommitReply:
+    """version set on success; error raised otherwise (not_committed /
+    transaction_too_old propagate as FDBError through the sim network)."""
+
+    version: Version
+
+
+@dataclass
+class GetKeyServerLocationsRequest:
+    begin: Key
+    end: Key
+
+
+@dataclass
+class GetKeyServerLocationsReply:
+    """(range, [storage addresses]) pairs covering [begin, end)."""
+
+    results: List[Tuple[KeyRange, List[str]]] = field(default_factory=list)
+
+
+# -- storage -----------------------------------------------------------------
+
+
+@dataclass
+class GetValueRequest:
+    key: Key
+    version: Version
+
+
+@dataclass
+class GetValueReply:
+    value: Optional[bytes]
+
+
+@dataclass
+class GetKeyValuesRequest:
+    """Range read [begin, end) at version, up to `limit` pairs
+    (reference: GetKeyValuesRequest, StorageServerInterface.h)."""
+
+    begin: Key
+    end: Key
+    version: Version
+    limit: int = 10_000
+    reverse: bool = False
+
+
+@dataclass
+class GetKeyValuesReply:
+    data: List[Tuple[Key, bytes]] = field(default_factory=list)
+    more: bool = False

@@ -1,0 +1,266 @@
+"""Proxy: the transaction front door.
+
+Re-design of fdbserver/MasterProxyServer.actor.cpp round-1 scope:
+
+  * GRV path: requests batch over a short interval and are answered with the
+    proxy's committed version (queueTransactionStartRequests:113,
+    transactionStarter:947; ratekeeper admission arrives in a later round).
+  * Commit path: commitBatch:319's five phases, pipelined across batches via
+    two NotifiedVersion tokens exactly like the reference's
+    latestLocalCommitBatchResolving/Logging (:362-364,414-415,424-426,
+    800-803): batch N+1 may fetch its commit version while batch N resolves,
+    and may resolve while N logs — but version-order is preserved at the
+    resolver and tlog by (prev_version -> version) chaining.
+  * Key-range sharding of resolution: each transaction's conflict ranges are
+    split/clipped across resolvers by the static resolver shard map
+    (ResolutionRequestBuilder::addTransaction:263-316); every touched
+    resolver must vote COMMITTED; votes combine with min (:489-500). Every
+    resolver receives every batch (possibly with zero transactions) so its
+    version chain never stalls.
+  * Serves GetKeyServerLocationsRequest from the static storage shard map
+    (readRequestServer:1058).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..core import error
+from ..core.types import (
+    CommitTransaction,
+    Key,
+    KeyRange,
+    Mutation,
+    MutationType,
+    TransactionCommitResult,
+    Version,
+)
+from ..ops.host_engine import KeyShardMap
+from ..sim.actors import NotifiedVersion, PromiseStream, all_of, any_of
+from ..sim.loop import Future, Promise, TaskPriority, delay, spawn
+from ..sim.network import Endpoint, SimProcess
+from .messages import (
+    CommitReply,
+    CommitTransactionRequest,
+    GetCommitVersionRequest,
+    GetKeyServerLocationsReply,
+    GetKeyServerLocationsRequest,
+    GetReadVersionReply,
+    GetReadVersionRequest,
+    ResolveTransactionBatchReply,
+    ResolveTransactionBatchRequest,
+    TLogCommitRequest,
+)
+from .master import GET_COMMIT_VERSION_TOKEN
+from .resolver import RESOLVE_TOKEN
+from .tlog import COMMIT_TOKEN as TLOG_COMMIT_TOKEN
+
+GRV_TOKEN = "proxy.getReadVersion"
+COMMIT_TOKEN = "proxy.commit"
+LOCATIONS_TOKEN = "proxy.getKeyServerLocations"
+
+GRV_BATCH_INTERVAL = 0.0005      # reference: START_TRANSACTION_BATCH_INTERVAL_MIN
+COMMIT_BATCH_INTERVAL = 0.001    # reference: COMMIT_TRANSACTION_BATCH_INTERVAL_MIN
+MAX_COMMIT_BATCH = 512
+
+
+@dataclass
+class ProxyConfig:
+    master_addr: str
+    resolver_addrs: List[str]
+    resolver_shards: KeyShardMap
+    tlog_addr: str
+    storage_addrs: List[str]
+    storage_shards: KeyShardMap
+
+
+class Proxy:
+    def __init__(self, proc: SimProcess, net, cfg: ProxyConfig, start_version: Version = 1):
+        self.proc = proc
+        self.net = net
+        self.cfg = cfg
+        self.committed_version = NotifiedVersion(start_version)
+        self.batch_resolving = NotifiedVersion(0)
+        self.batch_logging = NotifiedVersion(0)
+        self._batch_num = 0
+        self._request_num = 0
+        self._grv_waiters: List[Promise] = []
+        self._commit_queue: PromiseStream = PromiseStream()
+        proc.register(GRV_TOKEN, self.get_read_version)
+        proc.register(COMMIT_TOKEN, self.commit)
+        proc.register(LOCATIONS_TOKEN, self.get_key_server_locations)
+        proc.actors.add(spawn(self.commit_batcher(), TaskPriority.PROXY_COMMIT_BATCHER, name="commitBatcher"))
+
+    # -- GRV path ------------------------------------------------------------
+    async def get_read_version(self, req: GetReadVersionRequest) -> GetReadVersionReply:
+        p = Promise()
+        self._grv_waiters.append(p)
+        if len(self._grv_waiters) == 1:
+            spawn(self._grv_flush(), TaskPriority.PROXY_GRV_TIMER, name="grvBatch")
+        await p.future
+        return GetReadVersionReply(version=self.committed_version.get())
+
+    async def _grv_flush(self) -> None:
+        await delay(GRV_BATCH_INTERVAL, TaskPriority.PROXY_GRV_TIMER)
+        waiters, self._grv_waiters = self._grv_waiters, []
+        for p in waiters:
+            p.send(None)
+
+    # -- locations -----------------------------------------------------------
+    async def get_key_server_locations(self, req: GetKeyServerLocationsRequest) -> GetKeyServerLocationsReply:
+        out: List[Tuple[KeyRange, List[str]]] = []
+        for s, cb, ce in self.cfg.storage_shards.shards_of_range(req.begin, req.end):
+            out.append((KeyRange(cb, ce), [self.cfg.storage_addrs[s]]))
+        return GetKeyServerLocationsReply(results=out)
+
+    # -- commit path -----------------------------------------------------------
+    async def commit(self, req: CommitTransactionRequest) -> CommitReply:
+        p = Promise()
+        self._commit_queue.send((req.transaction, p))
+        return await p.future
+
+    async def commit_batcher(self) -> None:
+        """Dynamic-interval batcher (reference: batcher.actor.h via
+        MasterProxyServer.actor.cpp:880-886)."""
+        pending = self._commit_queue.stream.pop()
+        while True:
+            first = await pending
+            pending = self._commit_queue.stream.pop()
+            batch = [first]
+            deadline = delay(COMMIT_BATCH_INTERVAL, TaskPriority.PROXY_COMMIT_BATCHER)
+            while len(batch) < MAX_COMMIT_BATCH:
+                which, _ = await any_of([pending, deadline])
+                if which == 1:
+                    break
+                batch.append(pending.get())
+                pending = self._commit_queue.stream.pop()
+            self._batch_num += 1
+            spawn(
+                self.commit_batch(self._batch_num, batch),
+                TaskPriority.PROXY_COMMIT_DISPATCH,
+                name=f"commitBatch:{self._batch_num}",
+            )
+
+    async def commit_batch(self, bn: int, items: List[Tuple[CommitTransaction, Promise]]) -> None:
+        try:
+            await self._commit_batch_impl(bn, items)
+        except error.FDBError as e:
+            # A role failed mid-batch: clients must assume the worst
+            # (commit_unknown_result) until recovery rounds land.
+            self.batch_resolving.advance(bn)
+            self.batch_logging.advance(bn)
+            for _, p in items:
+                if not p.is_set:
+                    p.send_error(error.commit_unknown_result(e.name))
+
+    async def _commit_batch_impl(self, bn: int, items: List[Tuple[CommitTransaction, Promise]]) -> None:
+        cfg = self.cfg
+        n_res = len(cfg.resolver_addrs)
+
+        # ---- Phase 1: take a commit version, in batch order (:361) ----
+        await self.batch_resolving.when_at_least(bn - 1)
+        self._request_num += 1
+        vr = await self.net.request(
+            self.proc.address,
+            Endpoint(cfg.master_addr, GET_COMMIT_VERSION_TOKEN),
+            GetCommitVersionRequest(self._request_num, self.proc.address),
+            TaskPriority.PROXY_COMMIT,
+        )
+        prev_v, v = vr.prev_version, vr.version
+
+        # Build per-resolver transaction views (clipped conflict ranges).
+        per_res: List[List[CommitTransaction]] = [[] for _ in range(n_res)]
+        # txn -> [(resolver, index within that resolver's batch)]
+        per_res_idx: List[List[Tuple[int, int]]] = []
+        for t, (txn, _) in enumerate(items):
+            views: Dict[int, CommitTransaction] = {}
+
+            def view(r: int) -> CommitTransaction:
+                if r not in views:
+                    views[r] = CommitTransaction(read_snapshot=txn.read_snapshot)
+                return views[r]
+
+            for rng in txn.read_conflict_ranges:
+                if rng.begin >= rng.end:
+                    r = cfg.resolver_shards.shard_of_point_below(rng.begin)
+                    view(r).read_conflict_ranges.append(rng)
+                else:
+                    for r, cb, ce in cfg.resolver_shards.shards_of_range(rng.begin, rng.end):
+                        view(r).read_conflict_ranges.append(KeyRange(cb, ce))
+            for rng in txn.write_conflict_ranges:
+                if rng.begin < rng.end:
+                    for r, cb, ce in cfg.resolver_shards.shards_of_range(rng.begin, rng.end):
+                        view(r).write_conflict_ranges.append(KeyRange(cb, ce))
+            placed = []
+            for r, vw in views.items():
+                placed.append((r, len(per_res[r])))
+                per_res[r].append(vw)
+            per_res_idx.append(placed)
+
+        # ---- Phase 2: resolve everywhere; next batch may start (:417) ----
+        resolve_futures = [
+            self.net.request(
+                self.proc.address,
+                Endpoint(addr, RESOLVE_TOKEN),
+                ResolveTransactionBatchRequest(
+                    prev_version=prev_v,
+                    version=v,
+                    last_received_version=prev_v,
+                    transactions=per_res[r],
+                ),
+                TaskPriority.PROXY_RESOLVER_REPLY,
+            )
+            for r, addr in enumerate(cfg.resolver_addrs)
+        ]
+        self.batch_resolving.advance(bn)
+        replies: List[ResolveTransactionBatchReply] = await all_of(resolve_futures)
+
+        # ---- Phase 3: combine votes with min (:489-500) ----
+        verdicts: List[int] = []
+        for t in range(len(items)):
+            placed = per_res_idx[t]
+            if not placed:
+                verdicts.append(int(TransactionCommitResult.COMMITTED))
+            else:
+                verdicts.append(min(int(replies[r].committed[i]) for r, i in placed))
+
+        # Assign committed mutations to storage tags, preserving batch order.
+        messages: Dict[int, List[Mutation]] = {}
+        for t, (txn, _) in enumerate(items):
+            if verdicts[t] != int(TransactionCommitResult.COMMITTED):
+                continue
+            for m in txn.mutations:
+                if m.type == MutationType.CLEAR_RANGE:
+                    for s, cb, ce in cfg.storage_shards.shards_of_range(m.param1, m.param2):
+                        messages.setdefault(s, []).append(Mutation(m.type, cb, ce))
+                else:
+                    s = _shard_of_key(cfg.storage_shards, m.param1)
+                    messages.setdefault(s, []).append(m)
+
+        # ---- Phase 4: log, in version order (:805) ----
+        await self.batch_logging.when_at_least(bn - 1)
+        await self.net.request(
+            self.proc.address,
+            Endpoint(cfg.tlog_addr, TLOG_COMMIT_TOKEN),
+            TLogCommitRequest(prev_version=prev_v, version=v, messages=messages),
+            TaskPriority.PROXY_COMMIT,
+        )
+        self.batch_logging.advance(bn)
+
+        # ---- Phase 5: report (:824-860) ----
+        if v > self.committed_version.get():
+            self.committed_version.set(v)
+        for t, (_, p) in enumerate(items):
+            verdict = verdicts[t]
+            if verdict == int(TransactionCommitResult.COMMITTED):
+                p.send(CommitReply(version=v))
+            elif verdict == int(TransactionCommitResult.TOO_OLD):
+                p.send_error(error.transaction_too_old())
+            else:
+                p.send_error(error.not_committed())
+
+
+def _shard_of_key(shards: KeyShardMap, key: Key) -> int:
+    import bisect
+
+    return max(bisect.bisect_right(shards.begins, key) - 1, 0)

@@ -1,0 +1,60 @@
+"""Resolver: OCC conflict detection behind the ConflictSet interface.
+
+Re-design of fdbserver/Resolver.actor.cpp (320 LoC): batches are serialized
+into the global commit order by (prev_version -> version) chaining
+(resolveBatch:110 `version.whenAtLeast(req.prevVersion)`), each batch runs
+through a pluggable ConflictSet engine — the reference-exact oracle or the
+TPU kernel engine (the north star) — and the GC horizon advances to
+version - MAX_WRITE_TRANSACTION_LIFE_VERSIONS (SkipList removeBefore).
+
+The engine's resolve() is synchronous from the actor's point of view: in
+simulation the JAX dispatch happens inline on the one logical device queue,
+which keeps runs deterministic (SURVEY.md §5 race-detection strategy).
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from ..core.types import MAX_WRITE_TRANSACTION_LIFE_VERSIONS, Version
+from ..sim.actors import NotifiedVersion
+from ..sim.network import SimProcess
+from .messages import ResolveTransactionBatchRequest, ResolveTransactionBatchReply
+
+RESOLVE_TOKEN = "resolver.resolve"
+
+
+class Resolver:
+    def __init__(self, proc: SimProcess, engine, start_version: Version = 0):
+        """`engine` implements resolve(transactions, now, new_oldest) and
+        clear(version) — OracleConflictEngine, JaxConflictEngine or
+        ShardedConflictEngine (ops/, parallel/)."""
+        self.proc = proc
+        self.engine = engine
+        self.version = NotifiedVersion(start_version)
+        # replay window: version -> reply, for proxy retries after
+        # request_maybe_delivered (reference keeps recentStateTransactions)
+        self._recent: Dict[Version, ResolveTransactionBatchReply] = {}
+        proc.register(RESOLVE_TOKEN, self.resolve_batch)
+
+    async def resolve_batch(self, req: ResolveTransactionBatchRequest) -> ResolveTransactionBatchReply:
+        """reference: resolveBatch, Resolver.actor.cpp:71-260."""
+        if req.version <= self.version.get():
+            # Already resolved (proxy retry): replay the recorded verdicts.
+            cached = self._recent.get(req.version)
+            assert cached is not None, "resolver asked to re-resolve a GC'd version"
+            return cached
+        await self.version.when_at_least(req.prev_version)
+        if req.version <= self.version.get():
+            # A duplicate delivery resolved this version while we waited.
+            cached = self._recent.get(req.version)
+            assert cached is not None, "resolver asked to re-resolve a GC'd version"
+            return cached
+        new_oldest = max(0, req.version - MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
+        verdicts = self.engine.resolve(req.transactions, req.version, new_oldest)
+        reply = ResolveTransactionBatchReply(committed=[int(v) for v in verdicts])
+        self._recent[req.version] = reply
+        # GC the replay window along with the conflict window.
+        for v in [v for v in self._recent if v < new_oldest]:
+            del self._recent[v]
+        self.version.set(req.version)
+        return reply

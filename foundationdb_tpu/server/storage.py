@@ -1,0 +1,206 @@
+"""Storage server: MVCC reads over a versioned in-memory store.
+
+Round-1 scope of fdbserver/storageserver.actor.cpp: a per-key version-chain
+store standing in for VersionedMap (fdbclient/VersionedMap.h) over a durable
+engine; an update loop pulling the server's tag from the tlog (update:2340),
+applying mutations (incl. atomic ops, Atomic.h) in version order; reads wait
+for the requested version (waitForVersion:644), answer from the MVCC window,
+and reject out-of-window versions with transaction_too_old / future_version.
+"""
+from __future__ import annotations
+
+import bisect
+from typing import Dict, List, Optional, Tuple
+
+from ..core import error
+from ..core.types import (
+    MAX_WRITE_TRANSACTION_LIFE_VERSIONS,
+    Key,
+    KeyRange,
+    Mutation,
+    MutationType,
+    SINGLE_KEY_MUTATIONS,
+    Value,
+    Version,
+    apply_atomic_op,
+)
+from ..sim.actors import NotifiedVersion
+from ..sim.loop import TaskPriority, delay, spawn
+from ..sim.network import Endpoint, SimProcess
+from .messages import (
+    GetKeyValuesReply,
+    GetKeyValuesRequest,
+    GetValueReply,
+    GetValueRequest,
+    TLogPeekRequest,
+    TLogPopRequest,
+)
+
+GET_VALUE_TOKEN = "storage.getValue"
+GET_KEY_VALUES_TOKEN = "storage.getKeyValues"
+
+#: how far ahead of the storage version a read may wait before future_version
+#: (reference: storageserver waitForVersion MVCC window)
+MAX_READ_AHEAD_VERSIONS = MAX_WRITE_TRANSACTION_LIFE_VERSIONS
+
+
+class VersionedStore:
+    """Sorted keys, each with an append-only (version, value|None) chain;
+    None = cleared. The logical content at version V is the last entry <= V
+    of every chain. Plays VersionedMap's role with plain bisect structures —
+    adequate for simulation scale; the Pallas/native engines replace it in
+    the storage-engine round."""
+
+    def __init__(self) -> None:
+        self._keys: List[Key] = []
+        self._chains: Dict[Key, List[Tuple[Version, Optional[Value]]]] = {}
+        self.oldest_version: Version = 0
+
+    def _chain(self, key: Key) -> List[Tuple[Version, Optional[Value]]]:
+        c = self._chains.get(key)
+        if c is None:
+            bisect.insort(self._keys, key)
+            c = self._chains[key] = []
+        return c
+
+    def value_at(self, key: Key, version: Version) -> Optional[Value]:
+        c = self._chains.get(key)
+        if not c:
+            return None
+        i = bisect.bisect_right(c, version, key=lambda e: e[0]) - 1
+        if i < 0:
+            return None
+        return c[i][1]
+
+    def set(self, key: Key, value: Value, version: Version) -> None:
+        self._chain(key).append((version, value))
+
+    def clear_range(self, begin: Key, end: Key, version: Version) -> None:
+        lo = bisect.bisect_left(self._keys, begin)
+        hi = bisect.bisect_left(self._keys, end)
+        for k in self._keys[lo:hi]:
+            c = self._chains[k]
+            if c and c[-1][1] is not None:
+                c.append((version, None))
+
+    def range_at(
+        self, begin: Key, end: Key, version: Version, limit: int, reverse: bool = False
+    ) -> Tuple[List[Tuple[Key, Value]], bool]:
+        lo = bisect.bisect_left(self._keys, begin)
+        hi = bisect.bisect_left(self._keys, end)
+        keys = self._keys[lo:hi]
+        if reverse:
+            keys = list(reversed(keys))
+        out: List[Tuple[Key, Value]] = []
+        for i, k in enumerate(keys):
+            v = self.value_at(k, version)
+            if v is not None:
+                out.append((k, v))
+                if len(out) >= limit:
+                    return out, i + 1 < len(keys)
+        return out, False
+
+    def forget_before(self, version: Version) -> None:
+        """Drop history below `version`, keeping each chain's latest entry at
+        or below it (the storage analog of removeBefore)."""
+        self.oldest_version = max(self.oldest_version, version)
+        dead: List[Key] = []
+        for k, c in self._chains.items():
+            i = bisect.bisect_right(c, version, key=lambda e: e[0]) - 1
+            if i > 0:
+                del c[: i]
+            if len(c) == 1 and c[0][1] is None:
+                dead.append(k)
+        for k in dead:
+            del self._chains[k]
+            i = bisect.bisect_left(self._keys, k)
+            del self._keys[i]
+
+
+class StorageServer:
+    def __init__(
+        self,
+        proc: SimProcess,
+        tag: int,
+        shard: KeyRange,
+        tlog_commit_ep: Endpoint,
+        tlog_peek_ep: Endpoint,
+        tlog_pop_ep: Endpoint,
+        net,
+        start_version: Version = 0,
+    ):
+        self.proc = proc
+        self.tag = tag
+        self.shard = shard
+        self.net = net
+        self.peek_ep = tlog_peek_ep
+        self.pop_ep = tlog_pop_ep
+        self.store = VersionedStore()
+        self.version = NotifiedVersion(start_version)
+        proc.register(GET_VALUE_TOKEN, self.get_value)
+        proc.register(GET_KEY_VALUES_TOKEN, self.get_key_values)
+        proc.actors.add(spawn(self.update_loop(), TaskPriority.STORAGE, name=f"ss-update:{tag}"))
+
+    # -- write path ----------------------------------------------------------
+    def _apply(self, m: Mutation, version: Version) -> None:
+        if m.type == MutationType.SET_VALUE:
+            self.store.set(m.param1, m.param2, version)
+        elif m.type == MutationType.CLEAR_RANGE:
+            self.store.clear_range(m.param1, m.param2, version)
+        elif m.type in SINGLE_KEY_MUTATIONS:
+            existing = self.store.value_at(m.param1, version)
+            self.store.set(m.param1, apply_atomic_op(m.type, existing, m.param2), version)
+        else:
+            raise error.client_invalid_operation(f"unsupported mutation {m.type}")
+
+    async def update_loop(self) -> None:
+        """Pull this server's tag from the tlog forever (update:2340 +
+        updateStorage:2585 merged: in-memory apply == durable here)."""
+        while True:
+            reply = await self.net.request(
+                self.proc.address,
+                self.peek_ep,
+                TLogPeekRequest(tag=self.tag, begin_version=self.version.get() + 1),
+                TaskPriority.TLOG_PEEK,
+            )
+            for v, muts in reply.messages:
+                if v <= self.version.get():
+                    continue
+                for m in muts:
+                    self._apply(m, v)
+            if reply.end_version > self.version.get():
+                self.version.set(reply.end_version)
+                window = self.version.get() - MAX_WRITE_TRANSACTION_LIFE_VERSIONS
+                if window > 0:
+                    self.store.forget_before(window)
+                self.net.one_way(
+                    self.proc.address,
+                    self.pop_ep,
+                    TLogPopRequest(tag=self.tag, version=self.version.get()),
+                    TaskPriority.TLOG_POP,
+                )
+
+    # -- read path -----------------------------------------------------------
+    async def _wait_for_version(self, version: Version) -> None:
+        """reference: waitForVersion, storageserver.actor.cpp:644."""
+        if version < self.store.oldest_version:
+            raise error.transaction_too_old()
+        if version > self.version.get() + MAX_READ_AHEAD_VERSIONS:
+            raise error.future_version()
+        await self.version.when_at_least(version)
+
+    def _check_shard(self, begin: Key, end: Key) -> None:
+        if begin < self.shard.begin or end > self.shard.end:
+            raise error.wrong_shard_server()
+
+    async def get_value(self, req: GetValueRequest) -> GetValueReply:
+        if not self.shard.contains(req.key):
+            raise error.wrong_shard_server()
+        await self._wait_for_version(req.version)
+        return GetValueReply(value=self.store.value_at(req.key, req.version))
+
+    async def get_key_values(self, req: GetKeyValuesRequest) -> GetKeyValuesReply:
+        self._check_shard(req.begin, req.end)
+        await self._wait_for_version(req.version)
+        data, more = self.store.range_at(req.begin, req.end, req.version, req.limit, req.reverse)
+        return GetKeyValuesReply(data=data, more=more)

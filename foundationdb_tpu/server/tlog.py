@@ -1,0 +1,77 @@
+"""TLog: tag-partitioned in-memory durable log.
+
+Round-1 scope of fdbserver/TLogServer.actor.cpp: commits arrive per version
+with messages already bucketed by destination tag (tLogCommit:1158), are
+serialized by (prev_version -> version) chaining, indexed per tag, and
+served to storage servers via blocking peeks (tLogPeekMessages:950) with
+pops (tLogPop:898) trimming acknowledged prefixes. The DiskQueue + spill
+machinery arrives with the durable-storage round; in-memory plus a simulated
+fsync delay preserves the commit path's latency structure.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ..core.types import Mutation, Version
+from ..sim.actors import NotifiedVersion
+from ..sim.loop import TaskPriority, delay
+from ..sim.network import SimProcess
+from .messages import (
+    TLogCommitRequest,
+    TLogPeekReply,
+    TLogPeekRequest,
+    TLogPopRequest,
+)
+
+COMMIT_TOKEN = "tlog.commit"
+PEEK_TOKEN = "tlog.peek"
+POP_TOKEN = "tlog.pop"
+
+FSYNC_SECONDS = 0.0005
+
+
+class TLog:
+    def __init__(self, proc: SimProcess, start_version: Version = 0):
+        self.proc = proc
+        self.version = NotifiedVersion(start_version)
+        # tag -> ordered [(version, mutations)]
+        self.tag_data: Dict[int, List[Tuple[Version, List[Mutation]]]] = {}
+        self.popped: Dict[int, Version] = {}
+        proc.register(COMMIT_TOKEN, self.commit)
+        proc.register(PEEK_TOKEN, self.peek)
+        proc.register(POP_TOKEN, self.pop)
+
+    async def commit(self, req: TLogCommitRequest) -> Version:
+        """Append one version; ack after (simulated) fsync. Returns the
+        durable version."""
+        if req.version <= self.version.get():
+            return self.version.get()  # duplicate (proxy retry)
+        await self.version.when_at_least(req.prev_version)
+        if req.version <= self.version.get():
+            return self.version.get()
+        for tag, muts in req.messages.items():
+            self.tag_data.setdefault(tag, []).append((req.version, muts))
+        await delay(FSYNC_SECONDS, TaskPriority.TLOG_COMMIT)
+        # Chained waiters run only after this version is durable.
+        self.version.set(req.version)
+        return req.version
+
+    async def peek(self, req: TLogPeekRequest) -> TLogPeekReply:
+        """Messages for req.tag with version >= begin_version; blocks until
+        the tlog has seen begin_version so the peeker always advances."""
+        await self.version.when_at_least(req.begin_version)
+        data = self.tag_data.get(req.tag, [])
+        # Clip to the durable version: entries beyond it are mid-fsync and
+        # would be applied twice by a peeker that can't advance past them.
+        durable = self.version.get()
+        msgs = [(v, m) for (v, m) in data if req.begin_version <= v <= durable]
+        return TLogPeekReply(messages=msgs, end_version=durable)
+
+    async def pop(self, req: TLogPopRequest) -> None:
+        prev = self.popped.get(req.tag, 0)
+        if req.version <= prev:
+            return
+        self.popped[req.tag] = req.version
+        data = self.tag_data.get(req.tag)
+        if data:
+            self.tag_data[req.tag] = [(v, m) for (v, m) in data if v > req.version]

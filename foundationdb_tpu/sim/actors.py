@@ -272,6 +272,12 @@ class NotifiedVersion:
             _, _, f = heapq.heappop(self._waiters)
             f._set(None)
 
+    def advance(self, value: int) -> None:
+        """set(max(current, value)) — for pipelines where stages may complete
+        out of order but the token only gates 'at least this far'."""
+        if value > self._value:
+            self.set(value)
+
 
 class ActorCollection:
     """Holds tasks; errors from any of them surface on `error_future`
