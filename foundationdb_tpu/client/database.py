@@ -131,6 +131,7 @@ class Transaction:
         self.read_conflict_ranges: List[KeyRange] = []
         self.write_conflict_ranges: List[KeyRange] = []
         self.committed_version: Optional[Version] = None
+        self.committed_batch_index: int = 0
         self._backoff = INITIAL_BACKOFF
         self._committing = False
 
@@ -187,13 +188,27 @@ class Transaction:
         if begin >= end:
             return []
         version = await self.get_read_version()
-        if not snapshot:
-            self.read_conflict_ranges.append(KeyRange(begin, end))
-        data = await self._storage_get_range(begin, end, version, limit if not self.mutations else 10_000, reverse)
+        # With buffered mutations the overlay may add/remove rows, so the
+        # storage limit cannot be trusted; fetch the whole range (paged).
+        fetch_limit = limit if not self.mutations else None
+        data = await self._storage_get_range(begin, end, version, fetch_limit, reverse)
         merged = self._overlay_range(begin, end, data)
         if reverse:
             merged = sorted(merged, key=lambda kv: kv[0], reverse=True)
-        return merged[:limit]
+        result = merged[:limit]
+        if not snapshot:
+            # When the limit truncates the read, narrow the conflict range to
+            # the keys actually observed (reference: ReadYourWrites narrows
+            # to the returned ranges) — a write past the last returned key
+            # was never read and must not abort us.
+            if len(merged) > limit and result:
+                if reverse:
+                    self.read_conflict_ranges.append(KeyRange(result[-1][0], end))
+                else:
+                    self.read_conflict_ranges.append(KeyRange(begin, key_after(result[-1][0])))
+            else:
+                self.read_conflict_ranges.append(KeyRange(begin, end))
+        return result
 
     def _overlay_range(
         self, begin: Key, end: Key, data: List[Tuple[Key, Value]]
@@ -235,8 +250,10 @@ class Transaction:
                 raise _map_read_error(e)
 
     async def _storage_get_range(
-        self, begin: Key, end: Key, version: Version, limit: int, reverse: bool
+        self, begin: Key, end: Key, version: Version, limit: Optional[int], reverse: bool
     ) -> List[Tuple[Key, Value]]:
+        """limit=None fetches the whole range, paging per shard until each
+        shard is exhausted."""
         out: List[Tuple[Key, Value]] = []
         while True:
             locs = await self.db.get_locations(begin, end)
@@ -245,17 +262,23 @@ class Transaction:
             try:
                 for rng, addrs in locs:
                     cb, ce = max(begin, rng.begin), min(end, rng.end)
-                    if cb >= ce:
-                        continue
-                    reply = await self.db.net.request(
-                        self.db.client_addr,
-                        Endpoint(addrs[0], storage_mod.GET_KEY_VALUES_TOKEN),
-                        GetKeyValuesRequest(begin=cb, end=ce, version=version, limit=limit, reverse=reverse),
-                        TaskPriority.DEFAULT_ENDPOINT,
-                    )
-                    out.extend(reply.data)
-                    if len(out) >= limit:
-                        break
+                    while cb < ce:
+                        want = 10_000 if limit is None else min(limit - len(out), 10_000)
+                        reply = await self.db.net.request(
+                            self.db.client_addr,
+                            Endpoint(addrs[0], storage_mod.GET_KEY_VALUES_TOKEN),
+                            GetKeyValuesRequest(begin=cb, end=ce, version=version, limit=want, reverse=reverse),
+                            TaskPriority.DEFAULT_ENDPOINT,
+                        )
+                        out.extend(reply.data)
+                        if limit is not None and len(out) >= limit:
+                            return out
+                        if not reply.more or not reply.data:
+                            break
+                        if reverse:
+                            ce = reply.data[-1][0]
+                        else:
+                            cb = key_after(reply.data[-1][0])
                 return out
             except error.FDBError as e:
                 if e.code == _WRONG_SHARD:
@@ -275,6 +298,9 @@ class Transaction:
 
     def clear_range(self, begin: Key, end: Key) -> None:
         self._check_writable(begin)
+        if end > USER_KEYSPACE_END:
+            # The end bound is exclusive, so end == \xff is legal.
+            raise error.key_outside_legal_range()
         if begin >= end:
             return
         self.mutations.append(Mutation(MutationType.CLEAR_RANGE, begin, end))
@@ -327,6 +353,7 @@ class Transaction:
         finally:
             self._committing = False
         self.committed_version = reply.version
+        self.committed_batch_index = reply.txn_batch_index
         return reply.version
 
     async def on_error(self, e: error.FDBError) -> None:

@@ -56,6 +56,10 @@ class KeyShardMap:
     def span_end(self, s: int) -> Optional[Key]:
         return self.begins[s + 1] if s + 1 < self.n_shards else None
 
+    def shard_of_key(self, key: Key) -> int:
+        """Shard owning `key` (span containing it)."""
+        return max(bisect.bisect_right(self.begins, key) - 1, 0)
+
     def shard_of_point_below(self, key: Key) -> int:
         """Shard owning the interval strictly below `key` (for empty reads:
         mirrors VersionIntervalMap.version_strictly_below's max(i,0))."""

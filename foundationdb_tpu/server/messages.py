@@ -107,9 +107,12 @@ class CommitTransactionRequest:
 @dataclass
 class CommitReply:
     """version set on success; error raised otherwise (not_committed /
-    transaction_too_old propagate as FDBError through the sim network)."""
+    transaction_too_old propagate as FDBError through the sim network).
+    txn_batch_index orders transactions that share a commit version
+    (reference: CommitID's batchIndex, used by versionstamps)."""
 
     version: Version
+    txn_batch_index: int = 0
 
 
 @dataclass

@@ -84,6 +84,9 @@ class Proxy:
         self.batch_logging = NotifiedVersion(0)
         self._batch_num = 0
         self._request_num = 0
+        #: bn -> (prev_version, version) for batches whose version is taken
+        #: from the master but not yet durably chained (crash repair)
+        self._batch_versions: Dict[int, Tuple[Version, Version]] = {}
         self._grv_waiters: List[Promise] = []
         self._commit_queue: PromiseStream = PromiseStream()
         proc.register(GRV_TOKEN, self.get_read_version)
@@ -149,9 +152,43 @@ class Proxy:
             # (commit_unknown_result) until recovery rounds land.
             self.batch_resolving.advance(bn)
             self.batch_logging.advance(bn)
+            versions = self._batch_versions.pop(bn, None)
+            if versions is not None:
+                # Version v is in the master's chain but may never have
+                # reached the resolvers/tlog; plug the hole or every later
+                # batch waits on when_at_least(v) forever. Resolvers and the
+                # tlog dedupe versions, so repair is idempotent.
+                spawn(self._repair_chain(*versions), TaskPriority.PROXY_COMMIT, name=f"repair:{bn}")
             for _, p in items:
                 if not p.is_set:
                     p.send_error(error.commit_unknown_result(e.name))
+
+    async def _repair_chain(self, prev_v: Version, v: Version) -> None:
+        """Push an empty batch for (prev_v, v) until every chained consumer
+        has it (the stand-in for epoch-ending recovery this round)."""
+        while True:
+            try:
+                for r, addr in enumerate(self.cfg.resolver_addrs):
+                    await self.net.request(
+                        self.proc.address,
+                        Endpoint(addr, RESOLVE_TOKEN),
+                        ResolveTransactionBatchRequest(
+                            prev_version=prev_v, version=v,
+                            last_received_version=prev_v, transactions=[],
+                        ),
+                        TaskPriority.PROXY_RESOLVER_REPLY,
+                    )
+                await self.net.request(
+                    self.proc.address,
+                    Endpoint(self.cfg.tlog_addr, TLOG_COMMIT_TOKEN),
+                    TLogCommitRequest(prev_version=prev_v, version=v, messages={}),
+                    TaskPriority.PROXY_COMMIT,
+                )
+                if v > self.committed_version.get():
+                    self.committed_version.set(v)
+                return
+            except error.FDBError:
+                await delay(0.1)
 
     async def _commit_batch_impl(self, bn: int, items: List[Tuple[CommitTransaction, Promise]]) -> None:
         cfg = self.cfg
@@ -167,6 +204,7 @@ class Proxy:
             TaskPriority.PROXY_COMMIT,
         )
         prev_v, v = vr.prev_version, vr.version
+        self._batch_versions[bn] = (prev_v, v)
 
         # Build per-resolver transaction views (clipped conflict ranges).
         per_res: List[List[CommitTransaction]] = [[] for _ in range(n_res)]
@@ -234,7 +272,7 @@ class Proxy:
                     for s, cb, ce in cfg.storage_shards.shards_of_range(m.param1, m.param2):
                         messages.setdefault(s, []).append(Mutation(m.type, cb, ce))
                 else:
-                    s = _shard_of_key(cfg.storage_shards, m.param1)
+                    s = cfg.storage_shards.shard_of_key(m.param1)
                     messages.setdefault(s, []).append(m)
 
         # ---- Phase 4: log, in version order (:805) ----
@@ -248,19 +286,14 @@ class Proxy:
         self.batch_logging.advance(bn)
 
         # ---- Phase 5: report (:824-860) ----
+        self._batch_versions.pop(bn, None)
         if v > self.committed_version.get():
             self.committed_version.set(v)
         for t, (_, p) in enumerate(items):
             verdict = verdicts[t]
             if verdict == int(TransactionCommitResult.COMMITTED):
-                p.send(CommitReply(version=v))
+                p.send(CommitReply(version=v, txn_batch_index=t))
             elif verdict == int(TransactionCommitResult.TOO_OLD):
                 p.send_error(error.transaction_too_old())
             else:
                 p.send_error(error.not_committed())
-
-
-def _shard_of_key(shards: KeyShardMap, key: Key) -> int:
-    import bisect
-
-    return max(bisect.bisect_right(shards.begins, key) - 1, 0)

@@ -37,6 +37,7 @@ class TLog:
         # tag -> ordered [(version, mutations)]
         self.tag_data: Dict[int, List[Tuple[Version, List[Mutation]]]] = {}
         self.popped: Dict[int, Version] = {}
+        self._inflight: set = set()  # versions appended but not yet durable
         proc.register(COMMIT_TOKEN, self.commit)
         proc.register(PEEK_TOKEN, self.peek)
         proc.register(POP_TOKEN, self.pop)
@@ -44,15 +45,21 @@ class TLog:
     async def commit(self, req: TLogCommitRequest) -> Version:
         """Append one version; ack after (simulated) fsync. Returns the
         durable version."""
-        if req.version <= self.version.get():
-            return self.version.get()  # duplicate (proxy retry)
-        await self.version.when_at_least(req.prev_version)
-        if req.version <= self.version.get():
+        if req.version <= self.version.get() or req.version in self._inflight:
+            # Duplicate delivery (proxy retry) — possibly while the first
+            # copy is mid-fsync; never append twice.
+            await self.version.when_at_least(req.version)
             return self.version.get()
+        await self.version.when_at_least(req.prev_version)
+        if req.version <= self.version.get() or req.version in self._inflight:
+            await self.version.when_at_least(req.version)
+            return self.version.get()
+        self._inflight.add(req.version)
         for tag, muts in req.messages.items():
             self.tag_data.setdefault(tag, []).append((req.version, muts))
         await delay(FSYNC_SECONDS, TaskPriority.TLOG_COMMIT)
         # Chained waiters run only after this version is durable.
+        self._inflight.discard(req.version)
         self.version.set(req.version)
         return req.version
 

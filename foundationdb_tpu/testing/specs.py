@@ -1,0 +1,88 @@
+"""Named test specs (the analog of tests/*.txt).
+
+Each spec composes workloads + cluster config like the reference's
+declarative files: tests/fast/CycleTest.txt = Cycle + RandomClogging +
+Attrition; attrition joins once recovery lands. Run via the CLI:
+
+    python -m foundationdb_tpu.testing.runner --spec CycleTest --seed 7
+    python -m foundationdb_tpu.testing.runner --list
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from ..server.cluster import ClusterConfig
+from .workload import Spec
+from .workloads import (
+    AtomicOpsWorkload,
+    ConflictRangeWorkload,
+    CycleWorkload,
+    IncrementWorkload,
+    RandomCloggingWorkload,
+    RandomReadWriteWorkload,
+    WriteDuringReadWorkload,
+)
+
+
+def _tpu_engine_factory():
+    from ..ops.conflict_kernel import KernelConfig
+    from ..ops.host_engine import JaxConflictEngine
+
+    cfg = KernelConfig(key_words=4, capacity=1024, max_reads=256, max_writes=256, max_txns=64)
+    return JaxConflictEngine(cfg)
+
+
+SPECS: Dict[str, Callable[[], Spec]] = {
+    # tests/fast/CycleTest.txt: Cycle + RandomClogging ×2
+    "CycleTest": lambda: Spec(
+        title="CycleTest",
+        workloads=[
+            (CycleWorkload, {"nodes": 12, "transactions": 15}),
+            (RandomCloggingWorkload, {"scale": 0.02}),
+        ],
+        cluster=ClusterConfig(n_resolvers=2, n_storage=2),
+        client_count=3,
+    ),
+    # the north star: same cycle churn, resolvers on the TPU kernel
+    "CycleTestTPU": lambda: Spec(
+        title="CycleTestTPU",
+        workloads=[(CycleWorkload, {"nodes": 10, "transactions": 8})],
+        cluster=ClusterConfig(n_resolvers=2, n_storage=2, engine_factory=_tpu_engine_factory),
+        client_count=2,
+    ),
+    "IncrementTest": lambda: Spec(
+        title="IncrementTest",
+        workloads=[(IncrementWorkload, {"transactions": 12})],
+        cluster=ClusterConfig(n_resolvers=2, n_storage=2),
+        client_count=3,
+    ),
+    # tests/rare/ConflictRangeCheck.txt
+    "ConflictRangeCheck": lambda: Spec(
+        title="ConflictRangeCheck",
+        workloads=[(ConflictRangeWorkload, {"rounds": 20})],
+        cluster=ClusterConfig(n_resolvers=4, n_storage=2),
+        client_count=4,
+    ),
+    "WriteDuringRead": lambda: Spec(
+        title="WriteDuringRead",
+        workloads=[(WriteDuringReadWorkload, {"rounds": 12})],
+        cluster=ClusterConfig(n_resolvers=2, n_storage=2),
+        client_count=2,
+    ),
+    "AtomicOps": lambda: Spec(
+        title="AtomicOps",
+        workloads=[(AtomicOpsWorkload, {"transactions": 15})],
+        cluster=ClusterConfig(n_resolvers=2, n_storage=2),
+        client_count=3,
+    ),
+    # tests/RandomReadWrite.txt: the 90/10 metric workload + clogging
+    "RandomReadWrite": lambda: Spec(
+        title="RandomReadWrite",
+        workloads=[
+            (RandomReadWriteWorkload, {"transactions": 20}),
+            (RandomCloggingWorkload, {"scale": 0.02}),
+        ],
+        cluster=ClusterConfig(n_resolvers=4, n_storage=4),
+        client_count=4,
+    ),
+}

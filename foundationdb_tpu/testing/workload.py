@@ -1,0 +1,135 @@
+"""TestWorkload interface + spec runner.
+
+reference: fdbserver/workloads/workloads.h:42-85 (description/setup/start/
+check + clientId/clientCount), fdbserver/tester.actor.cpp:778-1124 (phase
+driving), tests/*.txt (declarative specs composing workloads).
+
+A Spec composes workload classes with options; run_spec builds a simulated
+cluster from the seed, runs setup -> start (all workloads and clients
+concurrently) -> quiesce -> check, and returns collected metrics. Fault
+injectors (clogging etc.) are workloads whose start() runs until the test
+phase ends, exactly like the reference's anti-quiescence workloads.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Type
+
+from ..core import error
+from ..core.rng import DeterministicRandom
+from ..client.database import Database
+from ..server.cluster import Cluster, ClusterConfig
+from ..sim.actors import all_of
+from ..sim.loop import Future, set_scheduler
+from ..sim.simulator import Simulator
+
+
+class WorkloadContext:
+    def __init__(
+        self,
+        cluster: Cluster,
+        client_id: int,
+        client_count: int,
+        rng: DeterministicRandom,
+        options: Dict[str, Any],
+        shared: Optional[Dict[str, Any]] = None,
+    ):
+        self.cluster = cluster
+        self.client_id = client_id
+        self.client_count = client_count
+        self.rng = rng
+        self.options = options
+        self.metrics: Dict[str, float] = {}
+        #: one dict per workload entry, shared by all its clients — for
+        #: cross-client totals the check phase needs (the reference tester
+        #: sums getMetrics across clients before checking)
+        self.shared: Dict[str, Any] = shared if shared is not None else {}
+
+    def count(self, key: str, delta: float = 1) -> None:
+        self.shared[key] = self.shared.get(key, 0) + delta
+        self.metrics[key] = self.metrics.get(key, 0) + delta
+
+
+class TestWorkload:
+    """Subclass and override; every phase gets a fresh Database client."""
+
+    name = "workload"
+    #: fault injectors keep running during start and are cancelled at
+    #: quiescence instead of awaited (reference: anti-quiescence workloads)
+    anti_quiescence = False
+
+    def __init__(self, ctx: WorkloadContext):
+        self.ctx = ctx
+
+    async def setup(self, db: Database) -> None:
+        pass
+
+    async def start(self, db: Database) -> None:
+        pass
+
+    async def check(self, db: Database) -> bool:
+        return True
+
+
+@dataclass
+class Spec:
+    """One test = cluster config + composed workloads (tests/fast/*.txt)."""
+
+    title: str
+    workloads: List[tuple] = field(default_factory=list)  # (cls, options)
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    client_count: int = 1
+    timeout: float = 3600.0
+
+
+@dataclass
+class SpecResult:
+    ok: bool
+    metrics: Dict[str, float]
+    seed: int
+    virtual_time: float
+
+
+def run_spec(spec: Spec, seed: int) -> SpecResult:
+    """Deterministic: same spec+seed -> same result and metrics."""
+    sim = Simulator(seed)
+    cluster = Cluster(sim, spec.cluster)
+    instances: List[TestWorkload] = []
+    for cls, options in spec.workloads:
+        shared: Dict[str, Any] = {}
+        for cid in range(spec.client_count):
+            ctx = WorkloadContext(cluster, cid, spec.client_count, sim.sched.rng, dict(options), shared)
+            instances.append(cls(ctx))
+
+    metrics: Dict[str, float] = {}
+    ok = True
+
+    async def drive():
+        nonlocal ok
+        # setup: client 0 of each workload only (reference: clientId==0 gates)
+        for w in instances:
+            if w.ctx.client_id == 0:
+                await w.setup(cluster.new_client())
+        # start: all clients concurrently; injectors cancelled at quiescence
+        main_tasks = []
+        injector_tasks = []
+        for w in instances:
+            t = sim.sched.spawn(w.start(cluster.new_client()), name=f"wl:{w.name}:{w.ctx.client_id}")
+            (injector_tasks if w.anti_quiescence else main_tasks).append(t)
+        await all_of(main_tasks)
+        for t in injector_tasks:
+            t.cancel()
+        # check
+        for w in instances:
+            if w.ctx.client_id == 0:
+                if not await w.check(cluster.new_client()):
+                    ok = False
+        for w in instances:
+            metrics.update(w.ctx.metrics)
+
+    task = sim.sched.spawn(drive(), name=f"spec:{spec.title}")
+    try:
+        sim.run_until(task, until=spec.timeout)
+    finally:
+        set_scheduler(None)
+    return SpecResult(ok=ok, metrics=metrics, seed=seed, virtual_time=sim.sched.time)

@@ -1,0 +1,362 @@
+"""The core workload set (reference: fdbserver/workloads/, 84 files).
+
+Round-1 inventory, mirroring the reference's invariant checkers most
+relevant to the resolver north star (SURVEY.md §4.2):
+
+  CycleWorkload           Cycle.actor.cpp — ring permutation invariant
+  IncrementWorkload       Increment.actor.cpp — read-modify-write counters
+  AtomicOpsWorkload       AtomicOps.actor.cpp — commutative ops, exact totals
+  WriteDuringReadWorkload WriteDuringRead.actor.cpp — randomized op streams
+                          vs an in-transaction RYW model
+  ConflictRangeWorkload   ConflictRange.actor.cpp — randomized range reads
+                          vs a version-replayed model under deliberate
+                          conflicting writers (external consistency check)
+  RandomReadWriteWorkload ReadWrite.actor.cpp — the 90/10 metric workload
+  RandomCloggingWorkload  RandomClogging.actor.cpp — anti-quiescence network
+                          fault injector
+"""
+from __future__ import annotations
+
+import bisect
+from typing import Dict, List, Optional, Tuple
+
+from ..core import error
+from ..core.types import MutationType
+from ..client.database import Database
+from ..sim.loop import delay
+from .workload import TestWorkload
+
+# ---------------------------------------------------------------------------
+
+
+class CycleWorkload(TestWorkload):
+    """A ring permutation over `nodes` keys; each transaction rotates three
+    links; the ring must stay a single cycle (Cycle.actor.cpp cycleCheck)."""
+
+    name = "Cycle"
+
+    @property
+    def n(self) -> int:
+        return int(self.ctx.options.get("nodes", 12))
+
+    def key(self, i: int) -> bytes:
+        return b"cycle/%04d" % i
+
+    async def setup(self, db: Database) -> None:
+        tr = db.create_transaction()
+        for i in range(self.n):
+            tr.set(self.key(i), b"%04d" % ((i + 1) % self.n))
+        await tr.commit()
+
+    async def start(self, db: Database) -> None:
+        count = int(self.ctx.options.get("transactions", 20))
+        for _ in range(count):
+            async def body(tr):
+                r = self.ctx.rng.random_int(0, self.n)
+                p1 = int(await tr.get(self.key(r)))
+                p2 = int(await tr.get(self.key(p1)))
+                p3 = int(await tr.get(self.key(p2)))
+                tr.set(self.key(r), b"%04d" % p2)
+                tr.set(self.key(p1), b"%04d" % p3)
+                tr.set(self.key(p2), b"%04d" % p1)
+
+            await db.run(body)
+            self.ctx.count("cycle_txns")
+
+    async def check(self, db: Database) -> bool:
+        tr = db.create_transaction()
+        got = await tr.get_range(b"cycle/", b"cycle0")
+        if len(got) != self.n:
+            return False
+        nxt = {int(k[-4:]): int(v) for k, v in got}
+        seen, at = set(), 0
+        for _ in range(self.n):
+            if at in seen:
+                return False
+            seen.add(at)
+            at = nxt[at]
+        return at == 0
+
+
+class IncrementWorkload(TestWorkload):
+    """Read-modify-write counters under contention (Increment.actor.cpp):
+    the final sum must equal the number of committed increments."""
+
+    name = "Increment"
+
+    async def start(self, db: Database) -> None:
+        count = int(self.ctx.options.get("transactions", 15))
+        keys = int(self.ctx.options.get("keys", 4))
+        done = 0
+        for _ in range(count):
+            async def body(tr):
+                k = b"incr/%02d" % self.ctx.rng.random_int(0, keys)
+                cur = await tr.get(k)
+                n = int.from_bytes(cur or b"\0\0\0\0", "big")
+                tr.set(k, (n + 1).to_bytes(4, "big"))
+
+            await db.run(body)
+            done += 1
+        self.ctx.count("increments", done)
+
+    async def check(self, db: Database) -> bool:
+        tr = db.create_transaction()
+        got = await tr.get_range(b"incr/", b"incr0")
+        total = sum(int.from_bytes(v, "big") for _, v in got)
+        return total == int(self.ctx.shared.get("increments", 0))
+
+
+class AtomicOpsWorkload(TestWorkload):
+    """Blind atomic ADDs never conflict; totals must be exact
+    (AtomicOps.actor.cpp)."""
+
+    name = "AtomicOps"
+
+    async def start(self, db: Database) -> None:
+        count = int(self.ctx.options.get("transactions", 20))
+        keys = int(self.ctx.options.get("keys", 3))
+        added = 0
+        for _ in range(count):
+            tr = db.create_transaction()
+            k = b"atomic/%02d" % self.ctx.rng.random_int(0, keys)
+            amount = self.ctx.rng.random_int(1, 10)
+            tr.atomic_op(k, amount.to_bytes(8, "little"), MutationType.ADD_VALUE)
+            await tr.commit()
+            added += amount
+        self.ctx.count("atomic_added", added)
+
+    async def check(self, db: Database) -> bool:
+        tr = db.create_transaction()
+        got = await tr.get_range(b"atomic/", b"atomic0")
+        total = sum(int.from_bytes(v, "little") for _, v in got)
+        return total == int(self.ctx.shared.get("atomic_added", 0))
+
+
+# ---------------------------------------------------------------------------
+
+
+class MemoryKeyValueStore:
+    """In-memory model store (reference:
+    fdbserver/workloads/MemoryKeyValueStore.cpp)."""
+
+    def __init__(self) -> None:
+        self._d: Dict[bytes, bytes] = {}
+
+    def set(self, k: bytes, v: bytes) -> None:
+        self._d[k] = v
+
+    def clear_range(self, b: bytes, e: bytes) -> None:
+        for k in [k for k in self._d if b <= k < e]:
+            del self._d[k]
+
+    def get(self, k: bytes) -> Optional[bytes]:
+        return self._d.get(k)
+
+    def get_range(self, b: bytes, e: bytes) -> List[Tuple[bytes, bytes]]:
+        return sorted((k, v) for k, v in self._d.items() if b <= k < e)
+
+    def apply_mutation(self, m) -> None:
+        from ..core.types import SINGLE_KEY_MUTATIONS, apply_atomic_op
+
+        if m.type == MutationType.SET_VALUE:
+            self.set(m.param1, m.param2)
+        elif m.type == MutationType.CLEAR_RANGE:
+            self.clear_range(m.param1, m.param2)
+        elif m.type in SINGLE_KEY_MUTATIONS:
+            self.set(m.param1, apply_atomic_op(m.type, self.get(m.param1), m.param2))
+
+
+class WriteDuringReadWorkload(TestWorkload):
+    """Randomized op streams inside one transaction: every read must see the
+    RYW overlay exactly as an in-memory model predicts, and the committed
+    state must match the model (WriteDuringRead.actor.cpp strategy)."""
+
+    name = "WriteDuringRead"
+
+    @property
+    def _prefix(self) -> bytes:
+        return b"wdr%d/" % self.ctx.client_id
+
+    def _rand_key(self) -> bytes:
+        return self._prefix + b"%02d" % self.ctx.rng.random_int(0, 12)
+
+    async def start(self, db: Database) -> None:
+        rng = self.ctx.rng
+        committed = MemoryKeyValueStore()
+        rounds = int(self.ctx.options.get("rounds", 15))
+        pre = self._prefix
+        for _ in range(rounds):
+            tr = db.create_transaction()
+            model = MemoryKeyValueStore()
+            for k, v in committed.get_range(pre, pre + b"\xff"):
+                model.set(k, v)
+            ops = rng.random_int(3, 12)
+            for _ in range(ops):
+                o = rng.random01()
+                k = self._rand_key()
+                if o < 0.25:
+                    v = b"v%d" % rng.random_int(0, 1000)
+                    tr.set(k, v)
+                    model.set(k, v)
+                elif o < 0.4:
+                    k2 = self._rand_key()
+                    b, e = min(k, k2), max(k, k2) + b"\x00"
+                    tr.clear_range(b, e)
+                    model.clear_range(b, e)
+                elif o < 0.55:
+                    amt = rng.random_int(1, 100).to_bytes(8, "little")
+                    tr.atomic_op(k, amt, MutationType.ADD_VALUE)
+                    model.set(k, _le_add(model.get(k), amt))
+                elif o < 0.8:
+                    got = await tr.get(k)
+                    assert got == model.get(k), f"RYW get mismatch at {k}: {got} != {model.get(k)}"
+                else:
+                    k2 = self._rand_key()
+                    b, e = min(k, k2), max(k, k2) + b"\x00"
+                    got = await tr.get_range(b, e)
+                    want = model.get_range(b, e)
+                    assert got == want, f"RYW range mismatch: {got} != {want}"
+            await tr.commit()
+            committed = model
+            self.ctx.count("wdr_rounds")
+        self._final = committed
+
+    async def check(self, db: Database) -> bool:
+        tr = db.create_transaction()
+        pre = self._prefix
+        got = await tr.get_range(pre, pre + b"\xff")
+        return got == self._final.get_range(pre, pre + b"\xff")
+
+
+def _le_add(old: Optional[bytes], param: bytes) -> bytes:
+    from ..core.types import apply_atomic_op
+
+    return apply_atomic_op(MutationType.ADD_VALUE, old, param)
+
+
+class ConflictRangeWorkload(TestWorkload):
+    """External-consistency check under deliberate conflicts
+    (ConflictRange.actor.cpp re-thought for the version-replay model):
+
+    Writer clients commit random sets/clears recording (commit_version,
+    mutations); reader clients record (read_version, range, result). At
+    check time, committed writes are replayed in version order; every
+    read's result must equal the model at its read version."""
+
+    name = "ConflictRange"
+    PREFIX = b"cr/"
+
+    def _rand_key(self) -> bytes:
+        return self.PREFIX + b"%02d" % self.ctx.rng.random_int(0, 16)
+
+    async def start(self, db: Database) -> None:
+        rng = self.ctx.rng
+        self.writes: List[Tuple[int, List]] = []
+        self.reads: List[Tuple[int, bytes, bytes, List]] = []
+        rounds = int(self.ctx.options.get("rounds", 20))
+        for _ in range(rounds):
+            if self.ctx.client_id % 2 == 0:
+                # writer: random small txn of sets/clears
+                tr = db.create_transaction()
+                for _ in range(rng.random_int(1, 4)):
+                    if rng.random01() < 0.75:
+                        tr.set(self._rand_key(), b"w%d" % rng.random_int(0, 10_000))
+                    else:
+                        a, b = self._rand_key(), self._rand_key()
+                        tr.clear_range(min(a, b), max(a, b) + b"\x00")
+                muts = list(tr.mutations)
+                try:
+                    v = await tr.commit()
+                    self.writes.append((v, tr.committed_batch_index, muts))
+                except error.FDBError as e:
+                    if not e.is_retryable():
+                        raise
+            else:
+                # reader: snapshot of a random subrange at its read version
+                tr = db.create_transaction()
+                a, b = self._rand_key(), self._rand_key()
+                lo, hi = min(a, b), max(a, b) + b"\x00"
+                got = await tr.get_range(lo, hi, snapshot=True)
+                rv = await tr.get_read_version()
+                self.reads.append((rv, lo, hi, got))
+            await delay(0.001 * rng.random01())
+        # Shared registry so client 0's check sees every client's log.
+        self.ctx.shared.setdefault("writes", []).extend(self.writes)
+        self.ctx.shared.setdefault("reads", []).extend(self.reads)
+
+    async def check(self, db: Database) -> bool:
+        writes = self.ctx.shared.get("writes", [])
+        reads = self.ctx.shared.get("reads", [])
+        model = MemoryKeyValueStore()
+        # Commits sharing a version apply in txn_batch_index order; reads at
+        # version rv see every commit with version <= rv (kind 1 sorts last).
+        events: List[Tuple[int, int, int, object]] = []
+        for v, bi, muts in writes:
+            events.append((v, 0, bi, muts))
+        for rv, lo, hi, got in reads:
+            events.append((rv, 1, 0, (lo, hi, got)))
+        events.sort(key=lambda e: (e[0], e[1], e[2]))
+        for v, kind, _bi, payload in events:
+            if kind == 0:
+                for m in payload:
+                    model.apply_mutation(m)
+            else:
+                lo, hi, got = payload
+                want = model.get_range(lo, hi)
+                if got != want:
+                    return False
+        # Final DB state must match the fully-replayed model.
+        tr = db.create_transaction()
+        got = await tr.get_range(self.PREFIX, self.PREFIX + b"\xff")
+        return got == model.get_range(self.PREFIX, self.PREFIX + b"\xff")
+
+
+class RandomReadWriteWorkload(TestWorkload):
+    """The 90/10 metric workload (ReadWrite.actor.cpp, tests/RandomReadWrite.txt)."""
+
+    name = "RandomReadWrite"
+
+    async def start(self, db: Database) -> None:
+        rng = self.ctx.rng
+        txns = int(self.ctx.options.get("transactions", 25))
+        keys = int(self.ctx.options.get("keys", 64))
+        read_frac = float(self.ctx.options.get("read_fraction", 0.9))
+        ops_per_txn = int(self.ctx.options.get("ops_per_txn", 10))
+        committed = conflicts = 0
+        for _ in range(txns):
+            tr = db.create_transaction()
+            try:
+                for _ in range(ops_per_txn):
+                    # Zipf-ish: square the uniform draw to bias toward low keys
+                    k = b"rw/%04d" % int(rng.random01() ** 2 * keys)
+                    if rng.random01() < read_frac:
+                        await tr.get(k)
+                    else:
+                        tr.set(k, b"x" * 16)
+                await tr.commit()
+                committed += 1
+            except error.FDBError as e:
+                if e.code == error.not_committed("").code:
+                    conflicts += 1
+                elif not e.is_retryable():
+                    raise
+        self.ctx.count("rw_committed", committed)
+        self.ctx.count("rw_conflicts", conflicts)
+
+
+class RandomCloggingWorkload(TestWorkload):
+    """Anti-quiescence: randomly clog processes' links while others run
+    (RandomClogging.actor.cpp via g_simulator.clogInterface)."""
+
+    name = "RandomClogging"
+    anti_quiescence = True
+
+    async def start(self, db: Database) -> None:
+        sim = self.ctx.cluster.sim
+        rng = self.ctx.rng
+        scale = float(self.ctx.options.get("scale", 0.05))
+        while True:
+            await delay(rng.random01() * 10 * scale)
+            procs = list(sim.net.processes.values())
+            victim = procs[rng.random_int(0, len(procs))]
+            sim.clog_process(victim, rng.random01() * scale)

@@ -1,0 +1,36 @@
+"""Run every named spec under several seeds (the miniature of the
+reference's correctness-run strategy: each spec x thousands of random seeds;
+here a few seeds per spec keep CI fast while the runner CLI supports
+arbitrarily many)."""
+import pytest
+
+from foundationdb_tpu.testing.runner import main
+from foundationdb_tpu.testing.specs import SPECS
+from foundationdb_tpu.testing.workload import run_spec
+
+FAST_SPECS = [n for n in sorted(SPECS) if n != "CycleTestTPU"]
+
+
+@pytest.mark.parametrize("name", FAST_SPECS)
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_spec(name, seed):
+    res = run_spec(SPECS[name](), seed)
+    assert res.ok, f"replay: python -m foundationdb_tpu.testing.runner --spec {name} --seed {seed}"
+
+
+def test_spec_tpu_engine():
+    res = run_spec(SPECS["CycleTestTPU"](), 21)
+    assert res.ok
+
+
+def test_spec_metrics_deterministic():
+    a = run_spec(SPECS["RandomReadWrite"](), 5)
+    b = run_spec(SPECS["RandomReadWrite"](), 5)
+    assert (a.ok, a.metrics, a.virtual_time) == (b.ok, b.metrics, b.virtual_time)
+
+
+def test_runner_cli(capsys):
+    rc = main(["--spec", "IncrementTest", "--seed", "3"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "OK  IncrementTest seed=3" in out
+    assert main(["--list"]) == 0
