@@ -12,10 +12,11 @@ performance.rst setup), full device batches, GC horizon trailing by a few
 batches so the boundary table reaches a steady state.
 
 Throughput is measured with the batches device-resident and the step loop
-inside one lax.scan: this measures the device's sustained resolve rate, not
-the per-call dispatch overhead of the host link (the tunneled dev TPU adds
-~7ms per dispatch; production resolvers pipeline dispatches). p99 latency is
-reported separately from per-call timing and does include that link.
+inside one long lax.scan: this measures the device's sustained resolve rate,
+not the per-dispatch overhead of the host link (the tunneled dev TPU's
+round-trip is ~100ms per dispatch; production resolvers sit next to their
+chip). device_ms_per_batch is the amortized per-batch device time;
+p99_link_ms is per-call latency through the tunnel and is dominated by it.
 """
 import json
 import time
@@ -41,9 +42,10 @@ READS_PER_TXN = 2
 WRITES_PER_TXN = 2
 POOL = 8192               # hot-key pool; steady-state boundaries stay < capacity
 N_DISTINCT_BATCHES = 8
-SCAN_STEPS = 64           # one compiled program: scan of this many batches
-THROUGHPUT_SCANS = 4
-LATENCY_STEPS = 30
+SCAN_STEPS = 192          # one compiled program: scan of this many batches
+THROUGHPUT_SCANS = 2      # dispatch round-trip through the tunneled dev chip
+                          # is ~100ms; long scans amortize it away
+LATENCY_STEPS = 20
 VERSIONS_PER_BATCH = CFG.max_txns
 GC_LAG_BATCHES = 4
 
@@ -124,35 +126,43 @@ def main():
 
     # Warm both programs (compile + first run happen here). Starting at 1,
     # base-relative `now` stabilizes near (GC_LAG_BATCHES+1)*VERSIONS_PER_BATCH.
-    (state, now), _ = run(state, jnp.int32(1))
-    jax.block_until_ready(state["n"])
+    # Syncs use host transfers: block_until_ready returns before execution
+    # completes on the tunneled dev-chip platform.
+    (state, now), ns = run(state, jnp.int32(1))
+    _ = np.asarray(ns)
     state, out = single(state, now)
-    jax.block_until_ready(out["status"])
+    _ = np.asarray(out["status"])
     now = now + VERSIONS_PER_BATCH
 
     t0 = time.perf_counter()
     for _ in range(THROUGHPUT_SCANS):
         (state, now), ns = run(state, now)
-    jax.block_until_ready(ns)
+    _ = np.asarray(ns)
     dt = time.perf_counter() - t0
     txns_per_sec = THROUGHPUT_SCANS * SCAN_STEPS * CFG.max_txns / dt
 
-    # Per-call latency (includes host link / dispatch overhead).
+    # Per-call latency (includes host link / dispatch overhead — on the
+    # tunneled dev chip the link RTT alone is ~100ms; production resolvers
+    # sit next to their chip, so device time per batch is the honest
+    # latency number and is reported separately).
     lat = []
     for _ in range(LATENCY_STEPS):
         t1 = time.perf_counter()
         state, out = single(state, now)
-        jax.block_until_ready(out["status"])
+        out["status"].copy_to_host_async()
+        _ = np.asarray(out["status"])
         lat.append(time.perf_counter() - t1)
         now = now + VERSIONS_PER_BATCH - jnp.maximum(now - GC_LAG_BATCHES * VERSIONS_PER_BATCH, 0)
     p99_ms = float(np.percentile(np.asarray(lat) * 1e3, 99))
+    device_ms_per_batch = dt / (THROUGHPUT_SCANS * SCAN_STEPS) * 1e3
 
     print(json.dumps({
         "metric": "resolved_txns_per_sec_per_chip",
         "value": round(txns_per_sec, 1),
         "unit": "txn/s",
         "vs_baseline": round(txns_per_sec / BASELINE_TXNS_PER_SEC_PER_CHIP, 4),
-        "p99_resolve_ms": round(p99_ms, 3),
+        "device_ms_per_batch": round(device_ms_per_batch, 3),
+        "p99_link_ms": round(p99_ms, 3),
         "batch_txns": CFG.max_txns,
         "device": str(dev),
     }))

@@ -53,6 +53,10 @@ class KernelConfig:
         return self.key_words + 1
 
     @property
+    def write_words(self) -> int:  # W rounded up to whole uint32 bit-words
+        return (self.max_writes + 31) // 32
+
+    @property
     def search_steps(self) -> int:
         return int(math.ceil(math.log2(self.capacity))) + 1
 
@@ -75,12 +79,22 @@ def _key_eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return jnp.all(a == b, axis=-1)
 
 
-def _search(cfg: KernelConfig, table: jnp.ndarray, count: jnp.ndarray, q: jnp.ndarray, lower: bool) -> jnp.ndarray:
-    """Vectorized binary search over table[0:count] (sorted, [N,K]).
+def _bump(q: jnp.ndarray) -> jnp.ndarray:
+    """Successor of a packed key in packed order: (words, len) -> (words, len+1).
 
-    lower=True  -> first i with table[i] >= q   (lower_bound)
-    lower=False -> first i with table[i] >  q   (upper_bound)
+    No packable key sorts strictly between the two (lengths are integers), so
+    lower_bound(_bump(q)) == upper_bound(q). This keeps every search call
+    single-direction (a mixed-bound search would evaluate both lexicographic
+    compare directions per step — measured slower than three separate calls).
     """
+    return q.at[..., -1].add(1)
+
+
+def _search(cfg: KernelConfig, table: jnp.ndarray, count: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """Vectorized lower_bound over table[0:count] (sorted, [N,K]): first i
+    with table[i] >= q. For upper_bound, pass _bump(q). Call sites batch all
+    their queries into ONE call so the serialized 16-step gather loop runs
+    once per phase instead of once per query set."""
     nq = q.shape[0]
     lo = jnp.zeros((nq,), jnp.int32)
     hi = jnp.full((nq,), count, jnp.int32)
@@ -88,7 +102,7 @@ def _search(cfg: KernelConfig, table: jnp.ndarray, count: jnp.ndarray, q: jnp.nd
         m = lo < hi
         mid = (lo + hi) >> 1
         row = table[mid]
-        go_right = _key_less(row, q) if lower else ~_key_less(q, row)
+        go_right = _key_less(row, q)
         lo = jnp.where(m & go_right, mid + 1, lo)
         hi = jnp.where(m & ~go_right, mid, hi)
     return lo
@@ -121,23 +135,27 @@ def _range_max(cfg: KernelConfig, sparse: jnp.ndarray, lo: jnp.ndarray, hi: jnp.
     return jnp.maximum(m1, m2)
 
 
-def _compact_rows(keys: jnp.ndarray, vals: jnp.ndarray, keep: jnp.ndarray, out_rows: int) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Scatter kept rows to the front of a fresh [out_rows] table (stable)."""
-    pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
-    tgt = jnp.where(keep, pos, out_rows)  # dropped rows go out of bounds
-    ok = jnp.zeros((out_rows, keys.shape[1]), keys.dtype).at[tgt].set(keys, mode="drop")
-    ov = jnp.full((out_rows,), NEG_VERSION, vals.dtype).at[tgt].set(vals, mode="drop")
-    return ok, ov, jnp.sum(keep.astype(jnp.int32))
+def _i2u(x: jnp.ndarray) -> jnp.ndarray:
+    return lax.bitcast_convert_type(x, jnp.uint32)
+
+
+def _u2i(x: jnp.ndarray) -> jnp.ndarray:
+    return lax.bitcast_convert_type(x, jnp.int32)
 
 
 def local_phases(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[str, jnp.ndarray]) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Phases 1-2, shard-local: reads vs. history + intra-batch overlap graph.
+    """Phases 1-2, shard-local: reads vs. history + intra-batch overlap edges.
 
-    Returns (hist_hits int32 [T], o_cnt float32 [T, T]). Both are additive
-    across key-range shards (a hit/overlap occurs in >= 1 shard iff it occurs
-    globally), so the multi-shard engine psums them over the mesh axis — the
-    "conflict bitmaps allreduced over ICI" of the north star — before running
-    the order-dependent fixpoint identically on every shard.
+    Returns (hist_hits int32 [T], ovp uint32 [R, cfg.write_words]) where ovp
+    bit (r, w) = 1 iff read row r overlaps write row w AND w's txn is
+    strictly earlier in the batch than r's (the reference's
+    earlier-in-batch-wins edge direction, checkIntraBatchConflicts:1139-1152).
+    Hits/overlaps are additive across key-range shards (a hit/overlap occurs
+    in >= 1 shard iff it occurs globally); the multi-shard engine psums
+    hist_hits once and the fixpoint's per-iteration blocked-txn counts over
+    the mesh axis — the "conflict bitmaps allreduced over ICI" of the north
+    star. ovp itself never crosses the ICI: it stays shard-local and is
+    consumed only through bitwise-AND sweeps in commit_fixpoint.
 
     batch fields (fixed shapes; see build_batch_arrays):
       rb, re   uint32 [R, K]   read range begin/end (packed keys)
@@ -164,11 +182,16 @@ def local_phases(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[s
     r_valid, w_valid = batch["r_valid"], batch["w_valid"]
 
     # ---- Phase 1: reads vs. history (checkReadConflictRanges:1210) ----
+    # One fused 2R-query lower-bound search: non-empty reads need
+    # upper(rb) == lower(_bump(rb)); empty reads need lower(rb) — selected
+    # per row. The serialized 16-step gather loop runs once, not three times.
     sparse = _build_sparse_max(cfg, hvers, n)
     empty_r = ~_key_less(rb, re)
-    lo_ne = _search(cfg, hkeys, n, rb, lower=False) - 1      # interval containing rb
-    hi_ne = _search(cfg, hkeys, n, re, lower=True)           # first boundary >= re
-    lo_e = jnp.maximum(_search(cfg, hkeys, n, rb, lower=True) - 1, 0)
+    q_lo = jnp.where(empty_r[:, None], rb, _bump(rb))
+    s2 = _search(cfg, hkeys, n, jnp.concatenate([q_lo, re], axis=0))
+    lo_ne = s2[:R] - 1                                       # interval containing rb
+    hi_ne = s2[R:]                                           # first boundary >= re
+    lo_e = jnp.maximum(s2[:R] - 1, 0)
     lo = jnp.where(empty_r, lo_e, lo_ne)
     hi = jnp.where(empty_r, lo_e + 1, hi_ne)
     rmax = _range_max(cfg, sparse, lo, hi)
@@ -202,30 +225,80 @@ def local_phases(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[s
         (pos_rb[:, None] < pos_re[:, None])      # non-empty read
         & (pos_rb[:, None] < pos_we[None, :])    # rb < we
         & (pos_wb[None, :] < pos_re[:, None])    # wb < re
+        & (w_txn[None, :] < r_txn[:, None])      # strictly earlier writer txn
         & r_valid[:, None]
         & w_valid[None, :]
     )
-    # Reduce [R, W] -> per-transaction graph O[t, u] via one-hot matmuls (MXU).
-    tids = jnp.arange(T, dtype=jnp.int32)
-    a = (r_txn[:, None] == tids[None, :]) & r_valid[:, None]             # [R, T]
-    b = (w_txn[:, None] == tids[None, :]) & w_valid[:, None]             # [W, T]
-    ovb = jnp.dot(ov.astype(jnp.float32), b.astype(jnp.float32),
-                  precision=lax.Precision.HIGHEST)                        # [R, T]
-    o_cnt = jnp.dot(a.astype(jnp.float32).T, ovb,
-                    precision=lax.Precision.HIGHEST)                      # [T, T]
-    return hist_hits, o_cnt
+    # Bit-pack edges to [R, W/32] uint32 (MiniConflictSet's word trick,
+    # SkipList.cpp:1028-1130, transplanted to the VPU). The old path
+    # projected ov to a [T, T] txn graph via two one-hot matmuls
+    # (2*R*W*T + 2*R*T*T FLOPs ~ 1e11 per batch — the round-1 perf whale);
+    # the fixpoint now touches only these 2MB of packed words per iteration.
+    ovp = _pack_bits(ov, cfg.write_words)
+    return hist_hits, ovp
 
 
-def commit_fixpoint(cfg: KernelConfig, t_ok: jnp.ndarray, hist_hits: jnp.ndarray, o_cnt: jnp.ndarray) -> jnp.ndarray:
-    """Earlier-in-batch-wins verdicts from the (globally combined) conflict
-    inputs. Pure function of allreduced values, so every shard computes the
-    identical committed vector with no further communication."""
+def _pack_bits(bits: jnp.ndarray, n_words: int) -> jnp.ndarray:
+    """Pack a [..., W] bool array into [..., n_words] uint32 (W <= 32*n_words)."""
+    w = bits.shape[-1]
+    pad = 32 * n_words - w
+    if pad:
+        bits = jnp.concatenate(
+            [bits, jnp.zeros(bits.shape[:-1] + (pad,), bits.dtype)], axis=-1
+        )
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+    return jnp.sum(
+        bits.reshape(bits.shape[:-1] + (n_words, 32)).astype(jnp.uint32) * weights,
+        axis=-1, dtype=jnp.uint32,
+    )
+
+
+def commit_fixpoint(
+    cfg: KernelConfig,
+    t_ok: jnp.ndarray,
+    hist_hits: jnp.ndarray,
+    ovp: jnp.ndarray,
+    r_txn: jnp.ndarray,
+    r_valid: jnp.ndarray,
+    w_txn: jnp.ndarray,
+    allreduce=lambda x: x,
+) -> jnp.ndarray:
+    """Earlier-in-batch-wins verdicts via bit-packed fixpoint.
+
+    Each iteration over the packed edge words ovp [R, W/32]:
+      1. pack the committed mask to [W/32] words,
+      2. hit_r = any(ovp & mask) per read row — 2MB of uint32 traffic,
+      3. reduce reads -> txns with a cumsum over rows + two [T] gathers
+         (read rows are grouped by ascending owning txn — the layout
+         build_batch_arrays/_resolve_chunk produce),
+      4. `allreduce` the per-txn blocked counts ([T] int32; txn index space
+         is the only space shared across shards — read rows are shard-local
+         — and counts are additive across disjoint key shards; the sharded
+         engine psums this 8KB vector over ICI).
+    All inputs to the while condition are allreduced values, so every shard
+    runs the identical number of iterations in lockstep. All arithmetic is
+    integer, so >0 tests bit-match the oracle's set semantics.
+    """
     T = cfg.max_txns
-    tids = jnp.arange(T, dtype=jnp.int32)
-    o_strict = (o_cnt > 0) & (tids[None, :] < tids[:, None])             # u < t
-    o_f32 = o_strict.astype(jnp.float32)
+
+    # Row range [starts[t], ends[t]) of txn t's reads (valid rows are a
+    # prefix, grouped by ascending txn).
+    cnt_t = jnp.zeros((T,), jnp.int32).at[
+        jnp.where(r_valid, r_txn, T)
+    ].add(1, mode="drop")
+    ends = jnp.cumsum(cnt_t)
+    starts = ends - cnt_t
 
     base_commit = t_ok & ~(hist_hits > 0)
+
+    def blocked_of(c):
+        maskp = _pack_bits(c[w_txn], cfg.write_words)                    # [W/32]
+        hit_r = jnp.any(ovp & maskp[None, :], axis=-1)                   # [R]
+        csum = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                jnp.cumsum(hit_r.astype(jnp.int32))])    # [R+1]
+        blocked_t = csum[ends] - csum[starts]                            # [T]
+        return allreduce(blocked_t) > 0                                  # psum over shards
+
     # Earlier-in-batch-wins is a DAG over u < t edges; iterate to its unique
     # fixpoint (equivalent to the reference's in-order sweep).
     def fix_cond(carry):
@@ -234,12 +307,10 @@ def commit_fixpoint(cfg: KernelConfig, t_ok: jnp.ndarray, hist_hits: jnp.ndarray
 
     def fix_body(carry):
         c, _, it = carry
-        blocked = jnp.dot(o_f32, c.astype(jnp.float32),
-                          precision=lax.Precision.HIGHEST) > 0
-        return base_commit & ~blocked, c, it + 1
+        return base_commit & ~blocked_of(c), c, it + 1
 
     c0 = base_commit
-    c1 = base_commit & ~(jnp.dot(o_f32, c0.astype(jnp.float32), precision=lax.Precision.HIGHEST) > 0)
+    c1 = base_commit & ~blocked_of(c0)
     committed, _, _ = lax.while_loop(fix_cond, fix_body, (c1, c0, jnp.int32(0)))
     return committed
 
@@ -259,17 +330,17 @@ def apply_writes_and_gc(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch:
     # ---- Phase 3: committed-write union (combineWriteConflictRanges:1320) ----
     cw = w_valid & committed[w_txn]
     ekeys = jnp.concatenate([wb, we], axis=0)                             # [2W, K]
-    edelta = jnp.concatenate([jnp.ones((W,), jnp.int32), jnp.full((W,), -1, jnp.int32)])
     ecode = jnp.concatenate([jnp.zeros((W,), jnp.uint32), jnp.ones((W,), jnp.uint32)])
     evalid = jnp.concatenate([cw, cw])
     einv = (~evalid).astype(jnp.uint32)
-    eops = (einv,) + tuple(ekeys[:, c] for c in range(K)) + (ecode, edelta.astype(jnp.uint32),) + tuple(
-        ekeys[:, c] for c in range(K)
-    )
+    # All payload is derivable from the sort keys themselves (delta = +1 for
+    # code 0 / -1 for code 1; the key words are sort operands), so the sort
+    # carries no extra payload lanes.
+    eops = (einv,) + tuple(ekeys[:, c] for c in range(K)) + (ecode,)
     es = lax.sort(eops, num_keys=K + 2, is_stable=True)
     s_valid = es[0] == 0
-    s_delta = jnp.where(es[K + 2].astype(jnp.int32) == 1, 1, -1)
-    s_keys = jnp.stack(es[K + 3 :], axis=1)                               # [2W, K]
+    s_delta = jnp.where(es[K + 1] == 0, 1, -1)
+    s_keys = jnp.stack(es[1 : K + 1], axis=1)                             # [2W, K]
     d = jnp.where(s_valid, s_delta, 0)
     cum = jnp.cumsum(d)
     is_ub = s_valid & (s_delta > 0) & ((cum - d) == 0)
@@ -279,8 +350,13 @@ def apply_writes_and_gc(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch:
     u_count = jnp.sum(is_ub.astype(jnp.int32))
     ub_keys = jnp.zeros((W, K), jnp.uint32).at[jnp.where(is_ub, ubi, W)].set(s_keys, mode="drop")
     ue_keys = jnp.zeros((W, K), jnp.uint32).at[jnp.where(is_ue, uei, W)].set(s_keys, mode="drop")
+    # One fused 3W-query lower-bound search: upper(ue) == lower(_bump(ue))
+    # for the preserved-tail version, lower(ub)/lower(ue) for the
+    # covered-window sweep below.
+    q3 = jnp.concatenate([_bump(ue_keys), ub_keys, ue_keys], axis=0)
+    s3 = _search(cfg, hkeys, n, q3)
     # Version at each union end = pre-batch map value there (preserved tail).
-    ue_ver = hvers[_search(cfg, hkeys, n, ue_keys, lower=False) - 1]
+    ue_ver = hvers[s3[:W] - 1]
 
     # ---- Phase 4: merge union into the boundary table at version `now` ----
     # All searches below are W/2W-query (never H-query): positions of old
@@ -291,8 +367,8 @@ def apply_writes_and_gc(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch:
     valid_u = jnp.arange(W, dtype=jnp.int32) < u_count
     # covered[h] iff some union range [ub_i, ue_i) contains hkeys[h]:
     # delta sweep over [start_i, stop_i) index windows.
-    u_start = _search(cfg, hkeys, n, ub_keys, lower=True)                # [W]
-    u_stop = _search(cfg, hkeys, n, ue_keys, lower=True)                 # [W]
+    u_start = s3[W : 2 * W]                                              # [W]
+    u_stop = s3[2 * W :]                                                 # [W]
     cov_delta = (
         jnp.zeros((H + 1,), jnp.int32)
         .at[jnp.where(valid_u, u_start, H + 1)].add(1, mode="drop")
@@ -315,11 +391,19 @@ def apply_writes_and_gc(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch:
     eq_exists = (nb_lb < n) & _key_eq(hkeys[lbc], nb_keys) & ~covered[lbc]
     nb_keep = nb_valid & ~(is_end_row & eq_exists)
 
+    # Single combined compaction scatter: (keys | version | lower-bound) per
+    # row, instead of three scatters walking the same target indices.
     ncomp_pos = jnp.cumsum(nb_keep.astype(jnp.int32)) - 1
     nc = jnp.sum(nb_keep.astype(jnp.int32))
-    nck = jnp.zeros((2 * W, K), jnp.uint32).at[jnp.where(nb_keep, ncomp_pos, 2 * W)].set(nb_keys, mode="drop")
-    ncv = jnp.zeros((2 * W,), jnp.int32).at[jnp.where(nb_keep, ncomp_pos, 2 * W)].set(nb_vers, mode="drop")
-    lb_old = jnp.zeros((2 * W,), jnp.int32).at[jnp.where(nb_keep, ncomp_pos, 2 * W)].set(nb_lb, mode="drop")
+    nbc = jnp.concatenate(
+        [nb_keys, _i2u(nb_vers)[:, None], _i2u(nb_lb)[:, None]], axis=1
+    )                                                                     # [2W, K+2]
+    ncc = jnp.zeros((2 * W, K + 2), jnp.uint32).at[
+        jnp.where(nb_keep, ncomp_pos, 2 * W)
+    ].set(nbc, mode="drop")
+    nck = ncc[:, :K]
+    ncv = _u2i(ncc[:, K])
+    lb_old = _u2i(ncc[:, K + 1])
 
     cum_keep = jnp.cumsum(old_keep.astype(jnp.int32))
     # new_before_old[h] = # kept new rows whose insertion point <= h.
@@ -333,13 +417,19 @@ def apply_writes_and_gc(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch:
     cov_before = jnp.where(lb_old > 0, cum_cov[jnp.maximum(lb_old - 1, 0)], 0)
     pos_new = jnp.arange(2 * W, dtype=jnp.int32) + (lb_old - cov_before)
 
-    out_k = jnp.zeros((H, K), jnp.uint32)
-    out_v = jnp.full((H,), NEG_VERSION, jnp.int32)
-    out_k = out_k.at[jnp.where(old_keep, pos_old, H)].set(hkeys, mode="drop")
-    out_v = out_v.at[jnp.where(old_keep, pos_old, H)].set(hvers, mode="drop")
+    # Merge via two combined (keys | version) row scatters — old rows and new
+    # rows — instead of four key/version scatter pairs.
+    outc = jnp.concatenate(
+        [jnp.zeros((H, K), jnp.uint32), jnp.full((H, 1), _i2u(NEG_VERSION))], axis=1
+    )
+    outc = outc.at[jnp.where(old_keep, pos_old, H)].set(
+        jnp.concatenate([hkeys, _i2u(hvers)[:, None]], axis=1), mode="drop"
+    )
     nc_mask = jnp.arange(2 * W) < nc
-    out_k = out_k.at[jnp.where(nc_mask, pos_new, H)].set(nck, mode="drop")
-    out_v = out_v.at[jnp.where(nc_mask, pos_new, H)].set(ncv, mode="drop")
+    outc = outc.at[jnp.where(nc_mask, pos_new, H)].set(
+        jnp.concatenate([nck, _i2u(ncv)[:, None]], axis=1), mode="drop"
+    )
+    out_v = _u2i(outc[:, K])
     n1 = cum_keep[-1] + nc
     overflow = n1 > H
 
@@ -348,11 +438,16 @@ def apply_writes_and_gc(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch:
     do_gc = gc > 0
     prev_v = jnp.concatenate([jnp.array([2**30], jnp.int32), out_v[:-1]])
     keep = (jslot < n1) & (~do_gc | (jslot == 0) | (out_v >= gc) | (prev_v >= gc))
-    fin_k, fin_v, n2 = _compact_rows(out_k, out_v, keep, H)
+    cpos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    finc = jnp.concatenate(
+        [jnp.zeros((H, K), jnp.uint32), jnp.full((H, 1), _i2u(NEG_VERSION))], axis=1
+    ).at[jnp.where(keep, cpos, H)].set(outc, mode="drop")
+    n2 = jnp.sum(keep.astype(jnp.int32))
+    fin_v = _u2i(finc[:, K])
     delta = jnp.maximum(gc, 0)
     fin_v = jnp.where(jslot < n2, jnp.maximum(fin_v - delta, -1), NEG_VERSION)
 
-    new_state = {"hkeys": fin_k, "hvers": fin_v, "n": n2}
+    new_state = {"hkeys": finc[:, :K], "hvers": fin_v, "n": n2}
     return new_state, overflow
 
 
@@ -368,8 +463,11 @@ def status_of(t_too_old: jnp.ndarray, committed: jnp.ndarray) -> jnp.ndarray:
 def resolve_step(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[str, jnp.ndarray]) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
     """One single-shard resolver batch: (state, batch) -> (state', outputs).
     Pure; jit me. See local_phases for the batch layout."""
-    hist_hits, o_cnt = local_phases(cfg, state, batch)
-    committed = commit_fixpoint(cfg, batch["t_ok"], hist_hits, o_cnt)
+    hist_hits, ov = local_phases(cfg, state, batch)
+    committed = commit_fixpoint(
+        cfg, batch["t_ok"], hist_hits, ov,
+        batch["r_txn"], batch["r_valid"], batch["w_txn"],
+    )
     new_state, overflow = apply_writes_and_gc(cfg, state, batch, committed)
     out = {
         "status": status_of(batch["t_too_old"], committed),
@@ -400,7 +498,12 @@ def build_batch_arrays(
     t_ok: np.ndarray, t_too_old: np.ndarray,
     now_rel: int, gc_rel: int,
 ) -> Dict[str, np.ndarray]:
-    """Pad host-side range lists to the kernel's fixed shapes (numpy)."""
+    """Pad host-side range lists to the kernel's fixed shapes (numpy).
+
+    Layout invariant relied on by commit_fixpoint's segment reduce: valid
+    read/write rows are a contiguous prefix, grouped by ascending owning
+    transaction index (r_txn/w_txn non-decreasing over the valid prefix)."""
+    assert all(a <= b for a, b in zip(r_txn, r_txn[1:])), "read rows must be grouped by ascending txn"
     R, W, K = cfg.max_reads, cfg.max_writes, cfg.lanes
     nr, nw = len(r_txn), len(w_txn)
 

@@ -10,13 +10,15 @@ fdbserver/Resolver.actor.cpp + MasterProxyServer.actor.cpp:263-316):
     routes and *clips* every read/write conflict range to the shards it
     intersects (ResolutionRequestBuilder::addTransaction's splitting) — all
     shared with the single-chip engine via RoutedConflictEngineBase.
-  * One jitted shard_map step: each shard runs phases 1-2 locally, the
-    per-txn history-hit bitmap and the [T,T] intra-batch overlap-count
-    matrix are psum'd over ICI, then every shard runs the identical
-    earlier-in-batch-wins fixpoint and applies its own clipped committed
-    writes. One collective round per batch — the reference needs a full
-    RPC round-trip per resolver plus a proxy-side min-combine
-    (MasterProxyServer.actor.cpp:489-500).
+  * One jitted shard_map step: each shard runs phases 1-2 locally and
+    keeps its [R, W/32] bit-packed overlap edges shard-local; only [T]
+    txn-space vectors cross the ICI — one psum of history-hit bitmaps,
+    then one 8KB psum of blocked-txn counts per fixpoint iteration.
+    Every shard computes the identical earlier-in-batch-wins fixpoint
+    from the reduced values (lockstep while_loop) and applies its own
+    clipped committed writes. A handful of tiny collective rounds per
+    batch — the reference needs a full RPC round-trip per resolver plus
+    a proxy-side min-combine (MasterProxyServer.actor.cpp:489-500).
 
 Clipping is exact: shard spans are disjoint and cover the keyspace, so a
 read overlaps history (or a write) globally iff some shard observes the
@@ -56,15 +58,19 @@ def make_sharded_step(cfg: KernelConfig, mesh: Mesh, axis: str = "shard"):
     def step(state, batch):
         state = jax.tree.map(lambda x: x[0], state)
         batch = jax.tree.map(lambda x: x[0], batch)
-        hist_hits, o_cnt = ck.local_phases(cfg, state, batch)
-        # The ICI allreduce of the north star: per-shard conflict bitmaps ->
-        # global history-hit vector + intra-batch overlap flags. Only
-        # existence matters downstream, so reduce uint8 flags with pmax
-        # (4x less ICI traffic than f32 counts, and no wraparound at any
-        # shard count, unlike a psum of narrow ints).
+        hist_hits, ov = ck.local_phases(cfg, state, batch)
+        # The ICI allreduces of the north star: one [T] psum of per-shard
+        # history-hit bitmaps up front, then one [T] psum of blocked-txn
+        # counts per fixpoint iteration (8KB each; the [R,W] overlap edges
+        # never cross the ICI). Counts are additive across disjoint key
+        # shards, and every shard sees identical reduced values, so the
+        # while_loop runs in lockstep.
         hist_hits = lax.psum(hist_hits, axis)
-        o_cnt = lax.pmax((o_cnt > 0).astype(jnp.uint8), axis)
-        committed = ck.commit_fixpoint(cfg, batch["t_ok"], hist_hits, o_cnt)
+        committed = ck.commit_fixpoint(
+            cfg, batch["t_ok"], hist_hits, ov,
+            batch["r_txn"], batch["r_valid"], batch["w_txn"],
+            allreduce=lambda x: lax.psum(x, axis),
+        )
         new_state, overflow = ck.apply_writes_and_gc(cfg, state, batch, committed)
         out = {
             "status": ck.status_of(batch["t_too_old"], committed),
