@@ -34,9 +34,11 @@ BASELINE_TXNS_PER_SEC_PER_CHIP = 10_000_000 / 8
 CFG = ck.KernelConfig(
     key_words=5,          # 20-byte exact window: fits 16B keys + \x00 range ends
     capacity=1 << 15,
-    max_reads=4096,
-    max_writes=4096,
-    max_txns=2048,
+    max_point_reads=8192,
+    max_point_writes=8192,
+    max_reads=256,        # range rows: present but small (point-heavy config,
+    max_writes=256,       # like the reference's Cycle/RandomReadWrite shape)
+    max_txns=4096,
 )
 READS_PER_TXN = 2
 WRITES_PER_TXN = 2
@@ -51,9 +53,12 @@ GC_LAG_BATCHES = 4
 
 
 def synth_batches(rng: np.random.Generator):
-    """Device batches synthesized directly in packed form (no host bytes)."""
+    """Device batches synthesized directly in packed form (no host bytes).
+    Reads/writes are POINT rows ([k, k+'\\x00')), the Cycle/RandomReadWrite
+    shape; the range-row groups ride along empty."""
     K = CFG.lanes
-    R, W, T = CFG.max_reads, CFG.max_writes, CFG.max_txns
+    Rp, Wp, T = CFG.rp, CFG.wp, CFG.max_txns
+    Rr, Wr = CFG.max_reads, CFG.max_writes
     pool = np.zeros((POOL, K), np.uint32)
     pool[:, :4] = rng.integers(0, 2**32, size=(POOL, 4), dtype=np.uint32)
     pool[:, 4] = 0
@@ -62,21 +67,24 @@ def synth_batches(rng: np.random.Generator):
 
     batches = []
     for _ in range(N_DISTINCT_BATCHES):
-        r_idx = rng.integers(0, POOL, size=R)
-        w_idx = rng.integers(0, POOL, size=W)
-        rb = pool[r_idx].copy()
-        re = pool[r_idx].copy()
-        re[:, 5] = 17                    # key + \x00 => same words, length 17
-        wb = pool[w_idx].copy()
-        we = pool[w_idx].copy()
-        we[:, 5] = 17
+        r_idx = rng.integers(0, POOL, size=Rp)
+        w_idx = rng.integers(0, POOL, size=Wp)
         batches.append({
-            "rb": rb, "re": re,
-            "r_txn": np.repeat(np.arange(T, dtype=np.int32), READS_PER_TXN),
-            "r_valid": np.ones((R,), bool),
-            "wb": wb, "we": we,
-            "w_txn": np.repeat(np.arange(T, dtype=np.int32), WRITES_PER_TXN),
-            "w_valid": np.ones((W,), bool),
+            "rpb": pool[r_idx].copy(),
+            "rp_txn": np.repeat(np.arange(T, dtype=np.int32), READS_PER_TXN),
+            "rp_valid": np.ones((Rp,), bool),
+            "rb": np.zeros((Rr, K), np.uint32),
+            "re": np.zeros((Rr, K), np.uint32),
+            "r_snap": np.zeros((Rr,), np.int32),
+            "r_txn": np.zeros((Rr,), np.int32),
+            "r_valid": np.zeros((Rr,), bool),
+            "wpb": pool[w_idx].copy(),
+            "wp_txn": np.repeat(np.arange(T, dtype=np.int32), WRITES_PER_TXN),
+            "wp_valid": np.ones((Wp,), bool),
+            "wb": np.zeros((Wr, K), np.uint32),
+            "we": np.zeros((Wr, K), np.uint32),
+            "w_txn": np.zeros((Wr,), np.int32),
+            "w_valid": np.zeros((Wr,), bool),
             "t_ok": np.ones((T,), bool),
             "t_too_old": np.zeros((T,), bool),
         })
@@ -90,7 +98,7 @@ def versioned(batch, now):
     gc = jnp.maximum(now - GC_LAG_BATCHES * VERSIONS_PER_BATCH, 0)
     return dict(
         batch,
-        r_snap=jnp.full((CFG.max_reads,), snap, jnp.int32),
+        rp_snap=jnp.full((CFG.rp,), snap, jnp.int32),
         now=jnp.asarray(now, jnp.int32),
         gc=jnp.asarray(gc, jnp.int32),
     )
@@ -103,7 +111,7 @@ def step_fn(carry, i):
     # GC with gc > 0 rebases stored versions by gc (the host engine's `base`
     # bookkeeping); carry base-relative time so snapshots/GC stay in frame.
     gc_applied = jnp.maximum(now - GC_LAG_BATCHES * VERSIONS_PER_BATCH, 0)
-    return (state, now + VERSIONS_PER_BATCH - gc_applied), out["n"]
+    return (state, now + VERSIONS_PER_BATCH - gc_applied), (out["n"], out["overflow"])
 
 
 def main():
@@ -135,10 +143,15 @@ def main():
     now = now + VERSIONS_PER_BATCH
 
     t0 = time.perf_counter()
+    all_ns = []
     for _ in range(THROUGHPUT_SCANS):
         (state, now), ns = run(state, now)
-    _ = np.asarray(ns)
+        all_ns.append(ns)
+    ns_host = np.asarray(all_ns[-1][0])
     dt = time.perf_counter() - t0
+    for ns in all_ns:
+        assert not np.any(np.asarray(ns[1])), "boundary table overflowed mid-bench"
+    assert ns_host[-1] > 0
     txns_per_sec = THROUGHPUT_SCANS * SCAN_STEPS * CFG.max_txns / dt
 
     # Per-call latency (includes host link / dispatch overhead — on the

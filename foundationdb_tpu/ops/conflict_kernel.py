@@ -7,11 +7,19 @@ Re-design of the reference resolver's versioned skip list
   ---------                      -----------
   skip-list nodes                sorted boundary table hkeys[H, K] in HBM
   per-level maxVersion pyramid   sparse table (block-max) over hvers[H]
-  16-way pipelined CheckMax      vectorized binary search + range-max gather
+  16-way pipelined CheckMax      one fused vectorized binary search per step
   radix sortPoints (:227)        one lax.sort of all endpoints w/ tie codes
-  MiniConflictSet sweep (:1133)  overlap matrix + DAG fixpoint (while_loop)
+  MiniConflictSet sweep (:1133)  bit-packed overlap words + DAG fixpoint
   skip-list insert/remove        sort-free merge: searchsorted + scatter
   removeBefore GC (:665)         vectorized keep rule + compaction
+
+The batch schema splits conflict ranges into POINT rows (exactly
+[k, k+'\\x00') — the dominant shape in the reference's workloads) and RANGE
+rows. A point row costs one search query plus one equality gather; a range
+row costs two queries. In the packed-key domain pack(k + '\\x00') ==
+_bump(pack(k)), so point end keys are synthesized on device and never packed
+or searched. Binary-search volume is the kernel's dominant cost on TPU
+(per-row gathers), so this roughly halves step time on point-heavy batches.
 
 Exactness: verdicts are a pure function of the logical version-interval map
 (see ops/oracle.py); every op here (max, OR, integer compares) is
@@ -44,17 +52,35 @@ NEG_VERSION = jnp.int32(-(2**30))
 class KernelConfig:
     key_words: int = 4          # exact-compare width = 4*key_words bytes
     capacity: int = 1 << 16     # H: max boundaries in the interval table
-    max_reads: int = 1 << 12    # R: read conflict ranges per device batch
-    max_writes: int = 1 << 12   # W: write conflict ranges per device batch
+    max_reads: int = 1 << 12    # Rr: RANGE read rows per device batch
+    max_writes: int = 1 << 12   # Wr: RANGE write rows per device batch
     max_txns: int = 1 << 12     # T: transactions per device batch
+    max_point_reads: int = -1   # Rp: POINT read rows (-1: same as max_reads)
+    max_point_writes: int = -1  # Wp: POINT write rows (-1: same as max_writes)
 
     @property
     def lanes(self) -> int:     # K: words per packed key incl. length
         return self.key_words + 1
 
     @property
-    def write_words(self) -> int:  # W rounded up to whole uint32 bit-words
-        return (self.max_writes + 31) // 32
+    def rp(self) -> int:
+        return self.max_point_reads if self.max_point_reads >= 0 else self.max_reads
+
+    @property
+    def wp(self) -> int:
+        return self.max_point_writes if self.max_point_writes >= 0 else self.max_writes
+
+    @property
+    def r_all(self) -> int:     # total read rows (point ++ range)
+        return self.rp + self.max_reads
+
+    @property
+    def w_all(self) -> int:     # total write rows (point ++ range)
+        return self.wp + self.max_writes
+
+    @property
+    def write_words(self) -> int:  # w_all rounded up to whole uint32 bit-words
+        return (self.w_all + 31) // 32
 
     @property
     def search_steps(self) -> int:
@@ -83,18 +109,18 @@ def _bump(q: jnp.ndarray) -> jnp.ndarray:
     """Successor of a packed key in packed order: (words, len) -> (words, len+1).
 
     No packable key sorts strictly between the two (lengths are integers), so
-    lower_bound(_bump(q)) == upper_bound(q). This keeps every search call
-    single-direction (a mixed-bound search would evaluate both lexicographic
-    compare directions per step — measured slower than three separate calls).
+    lower_bound(_bump(q)) == upper_bound(q), and pack(k + '\\x00') ==
+    _bump(pack(k)) whenever k fits the exact window (appending a NUL byte
+    leaves the zero-padded words unchanged and adds one to the length lane).
     """
     return q.at[..., -1].add(1)
 
 
 def _search(cfg: KernelConfig, table: jnp.ndarray, count: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
     """Vectorized lower_bound over table[0:count] (sorted, [N,K]): first i
-    with table[i] >= q. For upper_bound, pass _bump(q). Call sites batch all
-    their queries into ONE call so the serialized 16-step gather loop runs
-    once per phase instead of once per query set."""
+    with table[i] >= q. For upper_bound, pass _bump(q) or add an equality
+    test (_present). The step fuses ALL its queries into one call so the
+    serialized 16-step gather loop runs once."""
     nq = q.shape[0]
     lo = jnp.zeros((nq,), jnp.int32)
     hi = jnp.full((nq,), count, jnp.int32)
@@ -106,6 +132,12 @@ def _search(cfg: KernelConfig, table: jnp.ndarray, count: jnp.ndarray, q: jnp.nd
         lo = jnp.where(m & go_right, mid + 1, lo)
         hi = jnp.where(m & ~go_right, mid, hi)
     return lo
+
+
+def _present(table: jnp.ndarray, q: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
+    """1 iff q occurs in the table, given s = lower_bound(q): one row gather.
+    upper_bound(q) == s + _present(table, q, s)."""
+    return _key_eq(table[s], q).astype(jnp.int32)
 
 
 def _build_sparse_max(cfg: KernelConfig, vers: jnp.ndarray, n: jnp.ndarray) -> jnp.ndarray:
@@ -143,101 +175,6 @@ def _u2i(x: jnp.ndarray) -> jnp.ndarray:
     return lax.bitcast_convert_type(x, jnp.int32)
 
 
-def local_phases(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[str, jnp.ndarray]) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Phases 1-2, shard-local: reads vs. history + intra-batch overlap edges.
-
-    Returns (hist_hits int32 [T], ovp uint32 [R, cfg.write_words]) where ovp
-    bit (r, w) = 1 iff read row r overlaps write row w AND w's txn is
-    strictly earlier in the batch than r's (the reference's
-    earlier-in-batch-wins edge direction, checkIntraBatchConflicts:1139-1152).
-    Hits/overlaps are additive across key-range shards (a hit/overlap occurs
-    in >= 1 shard iff it occurs globally); the multi-shard engine psums
-    hist_hits once and the fixpoint's per-iteration blocked-txn counts over
-    the mesh axis — the "conflict bitmaps allreduced over ICI" of the north
-    star. ovp itself never crosses the ICI: it stays shard-local and is
-    consumed only through bitwise-AND sweeps in commit_fixpoint.
-
-    batch fields (fixed shapes; see build_batch_arrays):
-      rb, re   uint32 [R, K]   read range begin/end (packed keys)
-      r_snap   int32  [R]      read snapshot, relative to base (>= 0)
-      r_txn    int32  [R]      owning transaction index
-      r_valid  bool   [R]
-      wb, we   uint32 [W, K]   write ranges (non-empty only)
-      w_txn    int32  [W]
-      w_valid  bool   [W]
-      t_ok     bool   [T]      valid txn, not too-old
-      t_too_old bool  [T]
-      now      int32  []       commit version - base
-      gc       int32  []       new_oldest - base (<=0: no GC/rebase)
-    """
-    hkeys, hvers, n = state["hkeys"], state["hvers"], state["n"]
-    R = cfg.max_reads
-    W = cfg.max_writes
-    T = cfg.max_txns
-    K = cfg.lanes
-
-    rb, re = batch["rb"], batch["re"]
-    wb, we = batch["wb"], batch["we"]
-    r_txn, w_txn = batch["r_txn"], batch["w_txn"]
-    r_valid, w_valid = batch["r_valid"], batch["w_valid"]
-
-    # ---- Phase 1: reads vs. history (checkReadConflictRanges:1210) ----
-    # One fused 2R-query lower-bound search: non-empty reads need
-    # upper(rb) == lower(_bump(rb)); empty reads need lower(rb) — selected
-    # per row. The serialized 16-step gather loop runs once, not three times.
-    sparse = _build_sparse_max(cfg, hvers, n)
-    empty_r = ~_key_less(rb, re)
-    q_lo = jnp.where(empty_r[:, None], rb, _bump(rb))
-    s2 = _search(cfg, hkeys, n, jnp.concatenate([q_lo, re], axis=0))
-    lo_ne = s2[:R] - 1                                       # interval containing rb
-    hi_ne = s2[R:]                                           # first boundary >= re
-    lo_e = jnp.maximum(s2[:R] - 1, 0)
-    lo = jnp.where(empty_r, lo_e, lo_ne)
-    hi = jnp.where(empty_r, lo_e + 1, hi_ne)
-    rmax = _range_max(cfg, sparse, lo, hi)
-    r_hit = r_valid & (rmax > batch["r_snap"])
-    hist_hits = jnp.zeros((T,), jnp.int32).at[r_txn].max(r_hit.astype(jnp.int32), mode="drop")
-
-    # ---- Phase 2: intra-batch (checkIntraBatchConflicts:1133) ----
-    # Endpoint order with the reference's tie codes (getCharacter,
-    # SkipList.cpp:147-177): at equal keys  end-read < end-write < begin-write
-    # < begin-read, which makes integer position compare == exact half-open
-    # overlap. Invalid rows sort last via a leading flag.
-    P = 2 * R + 2 * W
-    pkeys = jnp.concatenate([rb, re, wb, we], axis=0)                    # [P, K]
-    pcode = jnp.concatenate([
-        jnp.full((R,), 3, jnp.uint32),   # begin-read
-        jnp.full((R,), 0, jnp.uint32),   # end-read
-        jnp.full((W,), 2, jnp.uint32),   # begin-write
-        jnp.full((W,), 1, jnp.uint32),   # end-write
-    ])
-    pvalid = jnp.concatenate([r_valid, r_valid, w_valid, w_valid])
-    pinv = (~pvalid).astype(jnp.uint32)
-    pidx = jnp.arange(P, dtype=jnp.uint32)
-    ops = (pinv,) + tuple(pkeys[:, c] for c in range(K)) + (pcode, pidx)
-    sorted_ops = lax.sort(ops, num_keys=K + 2, is_stable=True)
-    sorted_idx = sorted_ops[-1]
-    pos = jnp.zeros((P,), jnp.int32).at[sorted_idx].set(jnp.arange(P, dtype=jnp.int32))
-    pos_rb, pos_re = pos[:R], pos[R : 2 * R]
-    pos_wb, pos_we = pos[2 * R : 2 * R + W], pos[2 * R + W :]
-
-    ov = (
-        (pos_rb[:, None] < pos_re[:, None])      # non-empty read
-        & (pos_rb[:, None] < pos_we[None, :])    # rb < we
-        & (pos_wb[None, :] < pos_re[:, None])    # wb < re
-        & (w_txn[None, :] < r_txn[:, None])      # strictly earlier writer txn
-        & r_valid[:, None]
-        & w_valid[None, :]
-    )
-    # Bit-pack edges to [R, W/32] uint32 (MiniConflictSet's word trick,
-    # SkipList.cpp:1028-1130, transplanted to the VPU). The old path
-    # projected ov to a [T, T] txn graph via two one-hot matmuls
-    # (2*R*W*T + 2*R*T*T FLOPs ~ 1e11 per batch — the round-1 perf whale);
-    # the fixpoint now touches only these 2MB of packed words per iteration.
-    ovp = _pack_bits(ov, cfg.write_words)
-    return hist_hits, ovp
-
-
 def _pack_bits(bits: jnp.ndarray, n_words: int) -> jnp.ndarray:
     """Pack a [..., W] bool array into [..., n_words] uint32 (W <= 32*n_words)."""
     w = bits.shape[-1]
@@ -253,24 +190,181 @@ def _pack_bits(bits: jnp.ndarray, n_words: int) -> jnp.ndarray:
     )
 
 
+def local_phases(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[str, jnp.ndarray]):
+    """Phases 1-2, shard-local: reads vs. history + intra-batch overlap edges.
+
+    Returns (hist_hits int32 [T], ovp uint32 [r_all, write_words], wpos) where
+    ovp bit (r, w) = 1 iff read row r overlaps write row w AND w's txn is
+    strictly earlier in the batch than r's (the reference's
+    earlier-in-batch-wins edge direction, checkIntraBatchConflicts:1139-1152),
+    and wpos carries the write-interval endpoint positions in the OLD
+    boundary table that apply_writes_and_gc needs (computed here so the whole
+    step runs ONE fused binary search). Hits/overlaps are additive across
+    key-range shards; the multi-shard engine psums hist_hits once and the
+    fixpoint's per-iteration blocked-txn counts over the mesh axis — the
+    "conflict bitmaps allreduced over ICI" of the north star. ovp and wpos
+    stay shard-local.
+
+    batch fields (fixed shapes; see build_batch_arrays). Read/write rows are
+    grouped by ascending owning txn within each group, valid rows first:
+      rpb     uint32 [Rp, K]   POINT read keys (range is [k, k+'\\x00'))
+      rp_snap int32  [Rp]      point-read snapshot, relative to base
+      rp_txn  int32  [Rp]
+      rp_valid bool  [Rp]
+      rb, re  uint32 [Rr, K]   RANGE read begin/end (may be empty ranges)
+      r_snap, r_txn, r_valid   as above, [Rr]
+      wpb     uint32 [Wp, K]   POINT write keys
+      wp_txn  int32  [Wp]
+      wp_valid bool  [Wp]
+      wb, we  uint32 [Wr, K]   RANGE write ranges (non-empty only)
+      w_txn   int32  [Wr]
+      w_valid bool   [Wr]
+      t_ok     bool  [T]       valid txn, not too-old
+      t_too_old bool [T]
+      now     int32  []        commit version - base
+      gc      int32  []        new_oldest - base (<=0: no GC/rebase)
+    """
+    hkeys, hvers, n = state["hkeys"], state["hvers"], state["n"]
+    Rp, Rr = cfg.rp, cfg.max_reads
+    Wp, Wr = cfg.wp, cfg.max_writes
+    T = cfg.max_txns
+    K = cfg.lanes
+
+    rpb = batch["rpb"]
+    rb, re = batch["rb"], batch["re"]
+    wpb = batch["wpb"]
+    wb, we = batch["wb"], batch["we"]
+
+    # ---- ONE fused lower-bound search for the whole step ----
+    empty_r = ~_key_less(rb, re)
+    q_lo = jnp.where(empty_r[:, None], rb, _bump(rb))
+    q = jnp.concatenate([rpb, q_lo, re, wpb, wb, we], axis=0)
+    s = _search(cfg, hkeys, n, q)
+    o = 0
+    s_rp = s[o:o + Rp]; o += Rp
+    s_qlo = s[o:o + Rr]; o += Rr
+    s_re = s[o:o + Rr]; o += Rr
+    s_wpb = s[o:o + Wp]; o += Wp
+    s_wb = s[o:o + Wr]; o += Wr
+    s_we = s[o:o + Wr]
+
+    # Equality gathers (one table row each) derive every upper bound:
+    eq_rp = _present(hkeys, rpb, s_rp)
+    eq_wpb = _present(hkeys, wpb, s_wpb)
+    eq_we = _present(hkeys, we, s_we)
+    eq_wpb2 = _present(hkeys, _bump(wpb), s_wpb + eq_wpb)
+
+    # Write-interval endpoint positions for apply_writes_and_gc. Interval i
+    # of the w_all = Wp ++ Wr layout has begin key (wpb | wb) and end key
+    # (_bump(wpb) | we).
+    wpos = {
+        "lo_b": jnp.concatenate([s_wpb, s_wb]),                       # lower(begin)
+        "lo_e": jnp.concatenate([s_wpb + eq_wpb, s_we]),              # lower(end)
+        "up_e": jnp.concatenate([s_wpb + eq_wpb + eq_wpb2, s_we + eq_we]),  # upper(end)
+    }
+
+    # ---- Phase 1: reads vs. history (checkReadConflictRanges:1210) ----
+    # Point read: its single covering interval starts at upper(rpb)-1, so the
+    # range-max is one version gather — no sparse table involved.
+    vmax_p = hvers[jnp.maximum(s_rp + eq_rp - 1, 0)]
+    hit_p = batch["rp_valid"] & (vmax_p > batch["rp_snap"])
+    hist_hits = jnp.zeros((T,), jnp.int32).at[batch["rp_txn"]].max(
+        hit_p.astype(jnp.int32), mode="drop")
+
+    if Rr > 0:
+        sparse = _build_sparse_max(cfg, hvers, n)
+        lo_e = jnp.maximum(s_qlo - 1, 0)
+        lo = jnp.where(empty_r, lo_e, s_qlo - 1)
+        hi = jnp.where(empty_r, lo_e + 1, s_re)
+        rmax = _range_max(cfg, sparse, lo, hi)
+        hit_rg = batch["r_valid"] & (rmax > batch["r_snap"])
+        hist_hits = hist_hits.at[batch["r_txn"]].max(hit_rg.astype(jnp.int32), mode="drop")
+
+    # ---- Phase 2: intra-batch (checkIntraBatchConflicts:1133) ----
+    # Endpoint order with the reference's tie codes (getCharacter,
+    # SkipList.cpp:147-177): at equal keys  end-read < end-write < begin-write
+    # < begin-read, which makes integer position compare == exact half-open
+    # overlap. Invalid rows sort last via a leading flag.
+    P = 2 * (Rp + Rr + Wp + Wr)
+    rp_valid, r_valid = batch["rp_valid"], batch["r_valid"]
+    wp_valid, w_valid = batch["wp_valid"], batch["w_valid"]
+    pkeys = jnp.concatenate(
+        [rpb, _bump(rpb), rb, re, wpb, _bump(wpb), wb, we], axis=0)
+    pcode = jnp.concatenate([
+        jnp.full((Rp,), 3, jnp.uint32),  # begin-read (point)
+        jnp.full((Rp,), 0, jnp.uint32),  # end-read (point)
+        jnp.full((Rr,), 3, jnp.uint32),  # begin-read (range)
+        jnp.full((Rr,), 0, jnp.uint32),  # end-read (range)
+        jnp.full((Wp,), 2, jnp.uint32),  # begin-write (point)
+        jnp.full((Wp,), 1, jnp.uint32),  # end-write (point)
+        jnp.full((Wr,), 2, jnp.uint32),  # begin-write (range)
+        jnp.full((Wr,), 1, jnp.uint32),  # end-write (range)
+    ])
+    pvalid = jnp.concatenate([rp_valid, rp_valid, r_valid, r_valid,
+                              wp_valid, wp_valid, w_valid, w_valid])
+    pinv = (~pvalid).astype(jnp.uint32)
+    pidx = jnp.arange(P, dtype=jnp.uint32)
+    ops = (pinv,) + tuple(pkeys[:, c] for c in range(K)) + (pcode, pidx)
+    sorted_ops = lax.sort(ops, num_keys=K + 2, is_stable=True)
+    sorted_idx = sorted_ops[-1]
+    pos = jnp.zeros((P,), jnp.int32).at[sorted_idx].set(jnp.arange(P, dtype=jnp.int32))
+
+    o = 0
+    pos_rpb = pos[o:o + Rp]; o += Rp
+    pos_rpe = pos[o:o + Rp]; o += Rp
+    pos_rb = pos[o:o + Rr]; o += Rr
+    pos_re = pos[o:o + Rr]; o += Rr
+    pos_wpb = pos[o:o + Wp]; o += Wp
+    pos_wpe = pos[o:o + Wp]; o += Wp
+    pos_wb = pos[o:o + Wr]; o += Wr
+    pos_we = pos[o:o + Wr]
+    pos_rb_all = jnp.concatenate([pos_rpb, pos_rb])
+    pos_re_all = jnp.concatenate([pos_rpe, pos_re])
+    pos_wb_all = jnp.concatenate([pos_wpb, pos_wb])
+    pos_we_all = jnp.concatenate([pos_wpe, pos_we])
+    r_txn_all = jnp.concatenate([batch["rp_txn"], batch["r_txn"]])
+    w_txn_all = jnp.concatenate([batch["wp_txn"], batch["w_txn"]])
+    r_valid_all = jnp.concatenate([rp_valid, r_valid])
+    w_valid_all = jnp.concatenate([wp_valid, w_valid])
+
+    ov = (
+        (pos_rb_all[:, None] < pos_re_all[:, None])   # non-empty read
+        & (pos_rb_all[:, None] < pos_we_all[None, :]) # rb < we
+        & (pos_wb_all[None, :] < pos_re_all[:, None]) # wb < re
+        & (w_txn_all[None, :] < r_txn_all[:, None])   # strictly earlier writer
+        & r_valid_all[:, None]
+        & w_valid_all[None, :]
+    )
+    # Bit-pack edges to [r_all, write_words] uint32 (MiniConflictSet's word
+    # trick, SkipList.cpp:1028-1130, transplanted to the VPU). The fixpoint
+    # touches only these packed words per iteration.
+    ovp = _pack_bits(ov, cfg.write_words)
+    return hist_hits, ovp, wpos
+
+
+def _group_bounds(txn: jnp.ndarray, valid: jnp.ndarray, T: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Row range [starts[t], ends[t]) of txn t's rows within one group
+    (valid rows are a prefix, grouped by ascending txn)."""
+    cnt = jnp.zeros((T,), jnp.int32).at[jnp.where(valid, txn, T)].add(1, mode="drop")
+    ends = jnp.cumsum(cnt)
+    return ends - cnt, ends
+
+
 def commit_fixpoint(
     cfg: KernelConfig,
     t_ok: jnp.ndarray,
     hist_hits: jnp.ndarray,
     ovp: jnp.ndarray,
-    r_txn: jnp.ndarray,
-    r_valid: jnp.ndarray,
-    w_txn: jnp.ndarray,
+    batch: Dict[str, jnp.ndarray],
     allreduce=lambda x: x,
 ) -> jnp.ndarray:
     """Earlier-in-batch-wins verdicts via bit-packed fixpoint.
 
-    Each iteration over the packed edge words ovp [R, W/32]:
-      1. pack the committed mask to [W/32] words,
-      2. hit_r = any(ovp & mask) per read row — 2MB of uint32 traffic,
-      3. reduce reads -> txns with a cumsum over rows + two [T] gathers
-         (read rows are grouped by ascending owning txn — the layout
-         build_batch_arrays/_resolve_chunk produce),
+    Each iteration over the packed edge words ovp [r_all, write_words]:
+      1. pack the committed mask over all write rows to [write_words] words,
+      2. hit_r = any(ovp & mask) per read row,
+      3. reduce reads -> txns with cumsums + [T] gathers per read group
+         (rows are grouped by ascending owning txn within each group),
       4. `allreduce` the per-txn blocked counts ([T] int32; txn index space
          is the only space shared across shards — read rows are shard-local
          — and counts are additive across disjoint key shards; the sharded
@@ -280,23 +374,23 @@ def commit_fixpoint(
     integer, so >0 tests bit-match the oracle's set semantics.
     """
     T = cfg.max_txns
-
-    # Row range [starts[t], ends[t]) of txn t's reads (valid rows are a
-    # prefix, grouped by ascending txn).
-    cnt_t = jnp.zeros((T,), jnp.int32).at[
-        jnp.where(r_valid, r_txn, T)
-    ].add(1, mode="drop")
-    ends = jnp.cumsum(cnt_t)
-    starts = ends - cnt_t
+    Rp = cfg.rp
+    w_txn_all = jnp.concatenate([batch["wp_txn"], batch["w_txn"]])
+    w_valid_all = jnp.concatenate([batch["wp_valid"], batch["w_valid"]])
+    ps, pe = _group_bounds(batch["rp_txn"], batch["rp_valid"], T)
+    rs, re_ = _group_bounds(batch["r_txn"], batch["r_valid"], T)
 
     base_commit = t_ok & ~(hist_hits > 0)
 
-    def blocked_of(c):
-        maskp = _pack_bits(c[w_txn], cfg.write_words)                    # [W/32]
-        hit_r = jnp.any(ovp & maskp[None, :], axis=-1)                   # [R]
+    def seg_count(hit, starts, ends):
         csum = jnp.concatenate([jnp.zeros((1,), jnp.int32),
-                                jnp.cumsum(hit_r.astype(jnp.int32))])    # [R+1]
-        blocked_t = csum[ends] - csum[starts]                            # [T]
+                                jnp.cumsum(hit.astype(jnp.int32))])
+        return csum[ends] - csum[starts]
+
+    def blocked_of(c):
+        maskp = _pack_bits(c[w_txn_all] & w_valid_all, cfg.write_words)
+        hit_r = jnp.any(ovp & maskp[None, :], axis=-1)                   # [r_all]
+        blocked_t = seg_count(hit_r[:Rp], ps, pe) + seg_count(hit_r[Rp:], rs, re_)
         return allreduce(blocked_t) > 0                                  # psum over shards
 
     # Earlier-in-batch-wins is a DAG over u < t edges; iterate to its unique
@@ -315,32 +409,42 @@ def commit_fixpoint(
     return committed
 
 
-def apply_writes_and_gc(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[str, jnp.ndarray], committed: jnp.ndarray) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+def apply_writes_and_gc(
+    cfg: KernelConfig,
+    state: Dict[str, jnp.ndarray],
+    batch: Dict[str, jnp.ndarray],
+    committed: jnp.ndarray,
+    wpos: Dict[str, jnp.ndarray],
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
     """Phases 3-5, shard-local: committed-write union, boundary-table merge,
-    GC/rebase. Returns (new_state, overflow)."""
+    GC/rebase. Returns (new_state, overflow). `wpos` carries the OLD-table
+    positions of every write-interval endpoint (precomputed by the step's
+    fused search in local_phases), so this phase performs NO binary search —
+    union rows recover their positions through the sort's pidx payload."""
     hkeys, hvers, n = state["hkeys"], state["hvers"], state["n"]
-    W = cfg.max_writes
+    Wa = cfg.w_all
     H = cfg.capacity
     K = cfg.lanes
-    wb, we = batch["wb"], batch["we"]
-    w_txn = batch["w_txn"]
-    w_valid = batch["w_valid"]
     now = batch["now"]
+    w_txn_all = jnp.concatenate([batch["wp_txn"], batch["w_txn"]])
+    w_valid_all = jnp.concatenate([batch["wp_valid"], batch["w_valid"]])
+    bkeys = jnp.concatenate([batch["wpb"], batch["wb"]], axis=0)          # [Wa, K]
+    ekeys = jnp.concatenate([_bump(batch["wpb"]), batch["we"]], axis=0)   # [Wa, K]
 
     # ---- Phase 3: committed-write union (combineWriteConflictRanges:1320) ----
-    cw = w_valid & committed[w_txn]
-    ekeys = jnp.concatenate([wb, we], axis=0)                             # [2W, K]
-    ecode = jnp.concatenate([jnp.zeros((W,), jnp.uint32), jnp.ones((W,), jnp.uint32)])
+    cw = w_valid_all & committed[w_txn_all]
+    allk = jnp.concatenate([bkeys, ekeys], axis=0)                        # [2Wa, K]
+    ecode = jnp.concatenate([jnp.zeros((Wa,), jnp.uint32), jnp.ones((Wa,), jnp.uint32)])
     evalid = jnp.concatenate([cw, cw])
     einv = (~evalid).astype(jnp.uint32)
-    # All payload is derivable from the sort keys themselves (delta = +1 for
-    # code 0 / -1 for code 1; the key words are sort operands), so the sort
-    # carries no extra payload lanes.
-    eops = (einv,) + tuple(ekeys[:, c] for c in range(K)) + (ecode,)
+    epidx = jnp.arange(2 * Wa, dtype=jnp.uint32)
+    eops = (einv,) + tuple(allk[:, c] for c in range(K)) + (ecode, epidx)
     es = lax.sort(eops, num_keys=K + 2, is_stable=True)
     s_valid = es[0] == 0
     s_delta = jnp.where(es[K + 1] == 0, 1, -1)
-    s_keys = jnp.stack(es[1 : K + 1], axis=1)                             # [2W, K]
+    s_keys = jnp.stack(es[1 : K + 1], axis=1)                             # [2Wa, K]
+    s_pidx = es[K + 2].astype(jnp.int32)
+
     d = jnp.where(s_valid, s_delta, 0)
     cum = jnp.cumsum(d)
     is_ub = s_valid & (s_delta > 0) & ((cum - d) == 0)
@@ -348,27 +452,29 @@ def apply_writes_and_gc(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch:
     ubi = jnp.cumsum(is_ub.astype(jnp.int32)) - 1
     uei = jnp.cumsum(is_ue.astype(jnp.int32)) - 1
     u_count = jnp.sum(is_ub.astype(jnp.int32))
-    ub_keys = jnp.zeros((W, K), jnp.uint32).at[jnp.where(is_ub, ubi, W)].set(s_keys, mode="drop")
-    ue_keys = jnp.zeros((W, K), jnp.uint32).at[jnp.where(is_ue, uei, W)].set(s_keys, mode="drop")
-    # One fused 3W-query lower-bound search: upper(ue) == lower(_bump(ue))
-    # for the preserved-tail version, lower(ub)/lower(ue) for the
-    # covered-window sweep below.
-    q3 = jnp.concatenate([_bump(ue_keys), ub_keys, ue_keys], axis=0)
-    s3 = _search(cfg, hkeys, n, q3)
-    # Version at each union end = pre-batch map value there (preserved tail).
-    ue_ver = hvers[s3[:W] - 1]
+    # Union rows: keys + the endpoint positions recovered via pidx (begin
+    # rows index wpos lower(begin); end rows index lower/upper(end)).
+    pe_lo = jnp.concatenate([wpos["lo_b"], wpos["lo_e"]])                 # [2Wa]
+    pe_up = jnp.concatenate([wpos["lo_b"], wpos["up_e"]])                 # begins unused
+    sc = jnp.concatenate(
+        [s_keys, _i2u(pe_lo[s_pidx])[:, None], _i2u(pe_up[s_pidx])[:, None]], axis=1)
+    ubc = jnp.zeros((Wa, K + 2), jnp.uint32).at[jnp.where(is_ub, ubi, Wa)].set(sc, mode="drop")
+    uec = jnp.zeros((Wa, K + 2), jnp.uint32).at[jnp.where(is_ue, uei, Wa)].set(sc, mode="drop")
+    ub_keys = ubc[:, :K]
+    ue_keys = uec[:, :K]
+    u_start = _u2i(ubc[:, K])                                             # lower(ub)
+    u_stop = _u2i(uec[:, K])                                              # lower(ue)
+    # Version at each union end = pre-batch map value there (preserved tail):
+    # hvers[upper(ue) - 1].
+    ue_ver = hvers[jnp.maximum(_u2i(uec[:, K + 1]) - 1, 0)]
 
     # ---- Phase 4: merge union into the boundary table at version `now` ----
-    # All searches below are W/2W-query (never H-query): positions of old
-    # rows relative to the union are recovered with scatter+cumsum sweeps
-    # over the table instead of per-old-row binary searches (H >> W made
-    # those the dominant cost on TPU).
+    # Positions of old rows relative to the union are recovered with
+    # scatter+cumsum sweeps over the table instead of per-old-row searches.
     jslot = jnp.arange(H, dtype=jnp.int32)
-    valid_u = jnp.arange(W, dtype=jnp.int32) < u_count
+    valid_u = jnp.arange(Wa, dtype=jnp.int32) < u_count
     # covered[h] iff some union range [ub_i, ue_i) contains hkeys[h]:
     # delta sweep over [start_i, stop_i) index windows.
-    u_start = s3[W : 2 * W]                                              # [W]
-    u_stop = s3[2 * W :]                                                 # [W]
     cov_delta = (
         jnp.zeros((H + 1,), jnp.int32)
         .at[jnp.where(valid_u, u_start, H + 1)].add(1, mode="drop")
@@ -379,11 +485,11 @@ def apply_writes_and_gc(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch:
 
     # New rows: interleave begins (version=now) and ends (version=ue_ver);
     # the interleaving [ub0, ue0, ub1, ue1, ...] is already key-sorted.
-    nb_keys = jnp.stack([ub_keys, ue_keys], axis=1).reshape(2 * W, K)
-    nb_vers = jnp.stack([jnp.full((W,), now, jnp.int32), ue_ver], axis=1).reshape(2 * W)
-    nb_lb = jnp.stack([u_start, u_stop], axis=1).reshape(2 * W)          # lower bound in hkeys
-    j_of = jnp.repeat(jnp.arange(W, dtype=jnp.int32), 2)
-    is_end_row = jnp.tile(jnp.array([False, True]), W)
+    nb_keys = jnp.stack([ub_keys, ue_keys], axis=1).reshape(2 * Wa, K)
+    nb_vers = jnp.stack([jnp.full((Wa,), now, jnp.int32), ue_ver], axis=1).reshape(2 * Wa)
+    nb_lb = jnp.stack([u_start, u_stop], axis=1).reshape(2 * Wa)          # lower bound in hkeys
+    j_of = jnp.repeat(jnp.arange(Wa, dtype=jnp.int32), 2)
+    is_end_row = jnp.tile(jnp.array([False, True]), Wa)
     nb_valid = j_of < u_count
     # Drop an end row when an equal, uncovered old boundary already exists
     # (same version by construction, so keeping the old row is exact).
@@ -397,9 +503,9 @@ def apply_writes_and_gc(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch:
     nc = jnp.sum(nb_keep.astype(jnp.int32))
     nbc = jnp.concatenate(
         [nb_keys, _i2u(nb_vers)[:, None], _i2u(nb_lb)[:, None]], axis=1
-    )                                                                     # [2W, K+2]
-    ncc = jnp.zeros((2 * W, K + 2), jnp.uint32).at[
-        jnp.where(nb_keep, ncomp_pos, 2 * W)
+    )                                                                     # [2Wa, K+2]
+    ncc = jnp.zeros((2 * Wa, K + 2), jnp.uint32).at[
+        jnp.where(nb_keep, ncomp_pos, 2 * Wa)
     ].set(nbc, mode="drop")
     nck = ncc[:, :K]
     ncv = _u2i(ncc[:, K])
@@ -409,13 +515,13 @@ def apply_writes_and_gc(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch:
     # new_before_old[h] = # kept new rows whose insertion point <= h.
     new_cnt = (
         jnp.zeros((H + 1,), jnp.int32)
-        .at[jnp.where(jnp.arange(2 * W) < nc, lb_old, H + 1)].add(1, mode="drop")
+        .at[jnp.where(jnp.arange(2 * Wa) < nc, lb_old, H + 1)].add(1, mode="drop")
     )
     new_before_old = jnp.cumsum(new_cnt[:H])
     pos_old = cum_keep - 1 + new_before_old
     cum_cov = jnp.cumsum(covered.astype(jnp.int32))
     cov_before = jnp.where(lb_old > 0, cum_cov[jnp.maximum(lb_old - 1, 0)], 0)
-    pos_new = jnp.arange(2 * W, dtype=jnp.int32) + (lb_old - cov_before)
+    pos_new = jnp.arange(2 * Wa, dtype=jnp.int32) + (lb_old - cov_before)
 
     # Merge via two combined (keys | version) row scatters — old rows and new
     # rows — instead of four key/version scatter pairs.
@@ -425,7 +531,7 @@ def apply_writes_and_gc(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch:
     outc = outc.at[jnp.where(old_keep, pos_old, H)].set(
         jnp.concatenate([hkeys, _i2u(hvers)[:, None]], axis=1), mode="drop"
     )
-    nc_mask = jnp.arange(2 * W) < nc
+    nc_mask = jnp.arange(2 * Wa) < nc
     outc = outc.at[jnp.where(nc_mask, pos_new, H)].set(
         jnp.concatenate([nck, _i2u(ncv)[:, None]], axis=1), mode="drop"
     )
@@ -463,12 +569,9 @@ def status_of(t_too_old: jnp.ndarray, committed: jnp.ndarray) -> jnp.ndarray:
 def resolve_step(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[str, jnp.ndarray]) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
     """One single-shard resolver batch: (state, batch) -> (state', outputs).
     Pure; jit me. See local_phases for the batch layout."""
-    hist_hits, ov = local_phases(cfg, state, batch)
-    committed = commit_fixpoint(
-        cfg, batch["t_ok"], hist_hits, ov,
-        batch["r_txn"], batch["r_valid"], batch["w_txn"],
-    )
-    new_state, overflow = apply_writes_and_gc(cfg, state, batch, committed)
+    hist_hits, ovp, wpos = local_phases(cfg, state, batch)
+    committed = commit_fixpoint(cfg, batch["t_ok"], hist_hits, ovp, batch)
+    new_state, overflow = apply_writes_and_gc(cfg, state, batch, committed, wpos)
     out = {
         "status": status_of(batch["t_too_old"], committed),
         "overflow": overflow,
@@ -493,19 +596,22 @@ def initial_state(cfg: KernelConfig, version_rel: int = 0, first_key: bytes = b"
 
 def build_batch_arrays(
     cfg: KernelConfig,
+    rp_keys: List[bytes], rp_snap: List[int], rp_txn: List[int],
     r_keys_b: List[bytes], r_keys_e: List[bytes], r_snap: List[int], r_txn: List[int],
+    wp_keys: List[bytes], wp_txn: List[int],
     w_keys_b: List[bytes], w_keys_e: List[bytes], w_txn: List[int],
     t_ok: np.ndarray, t_too_old: np.ndarray,
     now_rel: int, gc_rel: int,
 ) -> Dict[str, np.ndarray]:
     """Pad host-side range lists to the kernel's fixed shapes (numpy).
 
-    Layout invariant relied on by commit_fixpoint's segment reduce: valid
-    read/write rows are a contiguous prefix, grouped by ascending owning
-    transaction index (r_txn/w_txn non-decreasing over the valid prefix)."""
-    assert all(a <= b for a, b in zip(r_txn, r_txn[1:])), "read rows must be grouped by ascending txn"
-    R, W, K = cfg.max_reads, cfg.max_writes, cfg.lanes
-    nr, nw = len(r_txn), len(w_txn)
+    Point rows carry only their begin key (the end is the on-device
+    successor). Layout invariant relied on by commit_fixpoint's segment
+    reduce: within each group, valid rows are a contiguous prefix grouped by
+    ascending owning transaction index."""
+    for lst in (rp_txn, r_txn):
+        assert all(a <= b for a, b in zip(lst, lst[1:])), "read rows must be grouped by ascending txn"
+    Rp, Rr, Wp, Wr, K = cfg.rp, cfg.max_reads, cfg.wp, cfg.max_writes, cfg.lanes
 
     def padk(keys: List[bytes], cap: int) -> np.ndarray:
         arr = np.zeros((cap, K), np.uint32)
@@ -513,16 +619,26 @@ def build_batch_arrays(
             arr[: len(keys)] = keypack.pack_keys(keys, cfg.key_words)
         return arr
 
+    def padi(vals: List[int], cap: int) -> np.ndarray:
+        return np.pad(np.asarray(vals, np.int32), (0, cap - len(vals)))
+
     return {
-        "rb": padk(r_keys_b, R),
-        "re": padk(r_keys_e, R),
-        "r_snap": np.pad(np.asarray(r_snap, np.int32), (0, R - nr)),
-        "r_txn": np.pad(np.asarray(r_txn, np.int32), (0, R - nr)),
-        "r_valid": np.arange(R) < nr,
-        "wb": padk(w_keys_b, W),
-        "we": padk(w_keys_e, W),
-        "w_txn": np.pad(np.asarray(w_txn, np.int32), (0, W - nw)),
-        "w_valid": np.arange(W) < nw,
+        "rpb": padk(rp_keys, Rp),
+        "rp_snap": padi(rp_snap, Rp),
+        "rp_txn": padi(rp_txn, Rp),
+        "rp_valid": np.arange(Rp) < len(rp_txn),
+        "rb": padk(r_keys_b, Rr),
+        "re": padk(r_keys_e, Rr),
+        "r_snap": padi(r_snap, Rr),
+        "r_txn": padi(r_txn, Rr),
+        "r_valid": np.arange(Rr) < len(r_txn),
+        "wpb": padk(wp_keys, Wp),
+        "wp_txn": padi(wp_txn, Wp),
+        "wp_valid": np.arange(Wp) < len(wp_txn),
+        "wb": padk(w_keys_b, Wr),
+        "we": padk(w_keys_e, Wr),
+        "w_txn": padi(w_txn, Wr),
+        "w_valid": np.arange(Wr) < len(w_txn),
         "t_ok": np.asarray(t_ok, bool),
         "t_too_old": np.asarray(t_too_old, bool),
         "now": np.asarray(now_rel, np.int32),

@@ -82,15 +82,29 @@ class KeyShardMap:
         return out
 
 
+def _is_point(begin: Key, end: Key) -> bool:
+    """True iff the half-open range is exactly [k, k+'\\x00') — the kernel's
+    cheap POINT row shape (its end key is synthesized on device)."""
+    return len(end) == len(begin) + 1 and end[-1] == 0 and end[:-1] == begin
+
+
 @dataclass
 class _RoutedTxn:
-    """One transaction's conflict ranges, clipped per shard (computed once)."""
+    """One transaction's conflict ranges, clipped per shard (computed once).
+    Point rows ([k, k+'\\x00')) are classified here, carrying only the key."""
 
-    reads: List[Tuple[int, Key, Key]]   # (shard, begin, end) — may be empty ranges
-    writes: List[Tuple[int, Key, Key]]  # (shard, begin, end) — non-empty only
-    n_reads: List[int]                  # per-shard counts
-    n_writes: List[int]
+    preads: List[Tuple[int, Key]]       # (shard, key)
+    rreads: List[Tuple[int, Key, Key]]  # (shard, begin, end) — may be empty ranges
+    pwrites: List[Tuple[int, Key]]
+    rwrites: List[Tuple[int, Key, Key]] # non-empty only
+    n_preads: List[int]                 # per-shard counts
+    n_rreads: List[int]
+    n_pwrites: List[int]
+    n_rwrites: List[int]
     snapshot: Version
+
+    def has_reads(self) -> bool:
+        return bool(self.preads or self.rreads)
 
 
 class RoutedConflictEngineBase:
@@ -131,29 +145,42 @@ class RoutedConflictEngineBase:
 
     def _route_txn(self, tr: CommitTransaction) -> _RoutedTxn:
         S = self.n_shards
-        reads: List[Tuple[int, Key, Key]] = []
-        writes: List[Tuple[int, Key, Key]] = []
-        n_reads = [0] * S
-        n_writes = [0] * S
+        rt = _RoutedTxn([], [], [], [], [0] * S, [0] * S, [0] * S, [0] * S, tr.read_snapshot)
         for r in tr.read_conflict_ranges:
             if r.begin >= r.end:
                 s = self.shards.shard_of_point_below(r.begin)
-                reads.append((s, r.begin, r.end))
-                n_reads[s] += 1
+                rt.rreads.append((s, r.begin, r.end))
+                rt.n_rreads[s] += 1
             else:
+                # A point range never straddles a shard split (a split key
+                # strictly inside [k, k+'\x00') would have to equal k).
                 for s, cb, ce in self.shards.shards_of_range(r.begin, r.end):
-                    reads.append((s, cb, ce))
-                    n_reads[s] += 1
+                    if _is_point(cb, ce):
+                        rt.preads.append((s, cb))
+                        rt.n_preads[s] += 1
+                    else:
+                        rt.rreads.append((s, cb, ce))
+                        rt.n_rreads[s] += 1
         for w in tr.write_conflict_ranges:
             if w.begin < w.end:
                 for s, cb, ce in self.shards.shards_of_range(w.begin, w.end):
-                    writes.append((s, cb, ce))
-                    n_writes[s] += 1
-        if max(n_reads) > self.cfg.max_reads or max(n_writes) > self.cfg.max_writes:
+                    if _is_point(cb, ce):
+                        rt.pwrites.append((s, cb))
+                        rt.n_pwrites[s] += 1
+                    else:
+                        rt.rwrites.append((s, cb, ce))
+                        rt.n_rwrites[s] += 1
+        cfg = self.cfg
+        if (
+            max(rt.n_preads) > cfg.rp
+            or max(rt.n_rreads) > cfg.max_reads
+            or max(rt.n_pwrites) > cfg.wp
+            or max(rt.n_rwrites) > cfg.max_writes
+        ):
             raise error.client_invalid_operation(
                 "single transaction exceeds device conflict-range capacity"
             )
-        return _RoutedTxn(reads, writes, n_reads, n_writes, tr.read_snapshot)
+        return rt
 
     def resolve(
         self,
@@ -167,20 +194,27 @@ class RoutedConflictEngineBase:
         results: List[TransactionCommitResult] = []
         i = 0
         ntx = len(transactions)
+        caps = (
+            ("n_preads", cfg.rp),
+            ("n_rreads", cfg.max_reads),
+            ("n_pwrites", cfg.wp),
+            ("n_rwrites", cfg.max_writes),
+        )
         while True:
             # Greedy prefix respecting every shard's device caps.
             j = i
-            nr = [0] * S
-            nw = [0] * S
+            used = {f: [0] * S for f, _ in caps}
             while j < ntx and (j - i) < cfg.max_txns:
                 rt = routed[j]
-                if any(nr[s] + rt.n_reads[s] > cfg.max_reads for s in range(S)) or any(
-                    nw[s] + rt.n_writes[s] > cfg.max_writes for s in range(S)
+                if any(
+                    used[f][s] + getattr(rt, f)[s] > cap
+                    for f, cap in caps
+                    for s in range(S)
                 ):
                     break
-                for s in range(S):
-                    nr[s] += rt.n_reads[s]
-                    nw[s] += rt.n_writes[s]
+                for f, _ in caps:
+                    for s in range(S):
+                        used[f][s] += getattr(rt, f)[s]
                 j += 1
             last = j >= ntx
             results.extend(self._resolve_chunk(routed[i:j], now, new_oldest if last else 0))
@@ -202,26 +236,38 @@ class RoutedConflictEngineBase:
 
         too_old = np.zeros((cfg.max_txns,), bool)
         t_ok = np.zeros((cfg.max_txns,), bool)
+        rpk: List[List[bytes]] = [[] for _ in range(S)]
+        rps: List[List[int]] = [[] for _ in range(S)]
+        rpt: List[List[int]] = [[] for _ in range(S)]
         rb: List[List[bytes]] = [[] for _ in range(S)]
         re_: List[List[bytes]] = [[] for _ in range(S)]
         rs: List[List[int]] = [[] for _ in range(S)]
         rt_: List[List[int]] = [[] for _ in range(S)]
+        wpk: List[List[bytes]] = [[] for _ in range(S)]
+        wpt: List[List[int]] = [[] for _ in range(S)]
         wb: List[List[bytes]] = [[] for _ in range(S)]
         we: List[List[bytes]] = [[] for _ in range(S)]
         wt: List[List[int]] = [[] for _ in range(S)]
         for t, rt in enumerate(routed):
-            is_old = rt.snapshot < self.oldest_version and bool(rt.reads)
+            is_old = rt.snapshot < self.oldest_version and rt.has_reads()
             too_old[t] = is_old
             t_ok[t] = not is_old
             if is_old:
                 continue
             snap = self._rel(rt.snapshot)
-            for s, cb, ce in rt.reads:
+            for s, k in rt.preads:
+                rpk[s].append(k)
+                rps[s].append(snap)
+                rpt[s].append(t)
+            for s, cb, ce in rt.rreads:
                 rb[s].append(cb)
                 re_[s].append(ce)
                 rs[s].append(snap)
                 rt_[s].append(t)
-            for s, cb, ce in rt.writes:
+            for s, k in rt.pwrites:
+                wpk[s].append(k)
+                wpt[s].append(t)
+            for s, cb, ce in rt.rwrites:
                 wb[s].append(cb)
                 we[s].append(ce)
                 wt[s].append(t)
@@ -230,7 +276,11 @@ class RoutedConflictEngineBase:
         gc_rel = self._rel(new_oldest) if new_oldest > self.oldest_version else 0
         per = [
             build_batch_arrays(
-                cfg, rb[s], re_[s], rs[s], rt_[s], wb[s], we[s], wt[s],
+                cfg,
+                rpk[s], rps[s], rpt[s],
+                rb[s], re_[s], rs[s], rt_[s],
+                wpk[s], wpt[s],
+                wb[s], we[s], wt[s],
                 t_ok, too_old, now_rel, gc_rel,
             )
             for s in range(S)

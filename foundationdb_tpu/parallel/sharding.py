@@ -58,20 +58,19 @@ def make_sharded_step(cfg: KernelConfig, mesh: Mesh, axis: str = "shard"):
     def step(state, batch):
         state = jax.tree.map(lambda x: x[0], state)
         batch = jax.tree.map(lambda x: x[0], batch)
-        hist_hits, ov = ck.local_phases(cfg, state, batch)
+        hist_hits, ovp, wpos = ck.local_phases(cfg, state, batch)
         # The ICI allreduces of the north star: one [T] psum of per-shard
         # history-hit bitmaps up front, then one [T] psum of blocked-txn
-        # counts per fixpoint iteration (8KB each; the [R,W] overlap edges
-        # never cross the ICI). Counts are additive across disjoint key
-        # shards, and every shard sees identical reduced values, so the
+        # counts per fixpoint iteration (8KB each; the bit-packed overlap
+        # edges never cross the ICI). Counts are additive across disjoint
+        # key shards, and every shard sees identical reduced values, so the
         # while_loop runs in lockstep.
         hist_hits = lax.psum(hist_hits, axis)
         committed = ck.commit_fixpoint(
-            cfg, batch["t_ok"], hist_hits, ov,
-            batch["r_txn"], batch["r_valid"], batch["w_txn"],
+            cfg, batch["t_ok"], hist_hits, ovp, batch,
             allreduce=lambda x: lax.psum(x, axis),
         )
-        new_state, overflow = ck.apply_writes_and_gc(cfg, state, batch, committed)
+        new_state, overflow = ck.apply_writes_and_gc(cfg, state, batch, committed, wpos)
         out = {
             "status": ck.status_of(batch["t_too_old"], committed),
             "overflow": overflow,
