@@ -24,10 +24,12 @@ from ..core.types import (
     Mutation,
     MutationType,
     SINGLE_KEY_MUTATIONS,
+    VERSIONSTAMP_MUTATIONS,
     Value,
     Version,
     apply_atomic_op,
     key_after,
+    place_versionstamp,
     single_key_range,
 )
 from ..sim.loop import TaskPriority, current_scheduler, delay
@@ -153,6 +155,13 @@ class Transaction:
         of the storage value (WriteMap semantics, fdbclient/WriteMap.h)."""
         v = base
         for m in self.mutations:
+            if m.type in VERSIONSTAMP_MUTATIONS:
+                # The stamped bytes are unknown until commit; reading a key
+                # this transaction versionstamped is an error (reference:
+                # RYW marks these ranges unreadable, error 1036).
+                if m.param1 == key:
+                    raise error.accessed_unreadable()
+                continue
             if m.type == MutationType.SET_VALUE and m.param1 == key:
                 v = m.param2
             elif m.type == MutationType.CLEAR_RANGE and m.param1 <= key < m.param2:
@@ -191,7 +200,9 @@ class Transaction:
         # With buffered mutations the overlay may add/remove rows, so the
         # storage limit cannot be trusted; fetch the whole range (paged).
         fetch_limit = limit if not self.mutations else None
-        data = await self._storage_get_range(begin, end, version, fetch_limit, reverse)
+        data, server_truncated = await self._storage_get_range(
+            begin, end, version, fetch_limit, reverse
+        )
         merged = self._overlay_range(begin, end, data)
         if reverse:
             merged = sorted(merged, key=lambda kv: kv[0], reverse=True)
@@ -200,8 +211,10 @@ class Transaction:
             # When the limit truncates the read, narrow the conflict range to
             # the keys actually observed (reference: ReadYourWrites narrows
             # to the returned ranges) — a write past the last returned key
-            # was never read and must not abort us.
-            if len(merged) > limit and result:
+            # was never read and must not abort us. Truncation happens either
+            # in the overlay (len(merged) > limit) or at the storage server
+            # (server_truncated, via GetKeyValuesReply.more).
+            if (len(merged) > limit or server_truncated) and result:
                 if reverse:
                     self.read_conflict_ranges.append(KeyRange(result[-1][0], end))
                 else:
@@ -217,6 +230,10 @@ class Transaction:
             return list(data)
         result: Dict[Key, Optional[Value]] = dict(data)
         for m in self.mutations:
+            if m.type in VERSIONSTAMP_MUTATIONS:
+                if begin <= m.param1 < end:
+                    raise error.accessed_unreadable()
+                continue
             if m.type == MutationType.SET_VALUE:
                 if begin <= m.param1 < end:
                     result[m.param1] = m.param2
@@ -251,16 +268,17 @@ class Transaction:
 
     async def _storage_get_range(
         self, begin: Key, end: Key, version: Version, limit: Optional[int], reverse: bool
-    ) -> List[Tuple[Key, Value]]:
+    ) -> Tuple[List[Tuple[Key, Value]], bool]:
         """limit=None fetches the whole range, paging per shard until each
-        shard is exhausted."""
+        shard is exhausted. Returns (data, truncated): truncated means the
+        servers may hold more rows in [begin, end) past the returned ones."""
         out: List[Tuple[Key, Value]] = []
         while True:
             locs = await self.db.get_locations(begin, end)
             if reverse:
                 locs = list(reversed(locs))
             try:
-                for rng, addrs in locs:
+                for i, (rng, addrs) in enumerate(locs):
                     cb, ce = max(begin, rng.begin), min(end, rng.end)
                     while cb < ce:
                         want = 10_000 if limit is None else min(limit - len(out), 10_000)
@@ -272,14 +290,15 @@ class Transaction:
                         )
                         out.extend(reply.data)
                         if limit is not None and len(out) >= limit:
-                            return out
+                            truncated = bool(reply.more) or i + 1 < len(locs)
+                            return out, truncated
                         if not reply.more or not reply.data:
                             break
                         if reverse:
                             ce = reply.data[-1][0]
                         else:
                             cb = key_after(reply.data[-1][0])
-                return out
+                return out, False
             except error.FDBError as e:
                 if e.code == _WRONG_SHARD:
                     self.db.invalidate_cache()
@@ -310,6 +329,15 @@ class Transaction:
         self._check_writable(key)
         self.mutations.append(Mutation(op, key, param))
         self.write_conflict_ranges.append(single_key_range(key))
+
+    def get_versionstamp(self) -> bytes:
+        """The 10-byte versionstamp assigned at commit (reference:
+        Transaction::getVersionstamp, NativeAPI.actor.cpp:2785-2792; value
+        layout per fdb.options set_versionstamped_key). Only valid after a
+        successful commit."""
+        if self.committed_version is None:
+            raise error.client_invalid_operation("get_versionstamp before commit")
+        return place_versionstamp(self.committed_version, self.committed_batch_index)
 
     def add_read_conflict_range(self, begin: Key, end: Key) -> None:
         self.read_conflict_ranges.append(KeyRange(begin, end))

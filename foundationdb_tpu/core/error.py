@@ -67,6 +67,7 @@ file_not_found = _define(1511, "file_not_found", "File not found")
 key_outside_legal_range = _define(2004, "key_outside_legal_range", "Key outside legal range")
 inverted_range = _define(2005, "inverted_range", "Range begin key exceeds end key")
 used_during_commit = _define(2017, "used_during_commit", "Operation issued while a commit was outstanding")
+accessed_unreadable = _define(1036, "accessed_unreadable", "Read or wrote an unreadable key (versionstamped this transaction)")
 client_invalid_operation = _define(2000, "client_invalid_operation", "Invalid API operation")
 conflict_capacity_exceeded = _define(
     2101, "conflict_capacity_exceeded", "Device conflict table capacity exceeded"

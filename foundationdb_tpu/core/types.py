@@ -124,6 +124,43 @@ ATOMIC_MUTATIONS = frozenset(
 
 SINGLE_KEY_MUTATIONS = ATOMIC_MUTATIONS | {MutationType.SET_VALUE}
 
+#: Mutations the proxy rewrites into SET_VALUE at commit time; storage
+#: servers must never see them (fdbclient/Atomic.h:258-271).
+VERSIONSTAMP_MUTATIONS = frozenset(
+    {MutationType.SET_VERSIONSTAMPED_KEY, MutationType.SET_VERSIONSTAMPED_VALUE}
+)
+
+#: Atomic ops evaluable at a storage server (everything except versionstamps).
+STORAGE_ATOMIC_MUTATIONS = ATOMIC_MUTATIONS - VERSIONSTAMP_MUTATIONS
+
+VERSIONSTAMP_SIZE = 10
+
+
+def place_versionstamp(version: Version, batch_index: int) -> bytes:
+    """The 10-byte versionstamp: 8-byte big-endian commit version + 2-byte
+    big-endian transaction number within the batch (reference:
+    placeVersionstamp, fdbclient/Atomic.h:250-256)."""
+    return version.to_bytes(8, "big") + (batch_index & 0xFFFF).to_bytes(2, "big")
+
+
+def transform_versionstamp_mutation(m: "Mutation", version: Version, batch_index: int) -> "Mutation":
+    """Rewrite a SET_VERSIONSTAMPED_{KEY,VALUE} mutation into a plain
+    SET_VALUE with the stamp substituted, at the position named by the
+    little-endian int32 trailing the stamped param (reference:
+    transformVersionstampMutation, fdbclient/Atomic.h:258-271; applied by the
+    proxy at MasterProxyServer.actor.cpp:270-275)."""
+    stamped_key = m.type == MutationType.SET_VERSIONSTAMPED_KEY
+    param = m.param1 if stamped_key else m.param2
+    if len(param) >= 4:
+        pos = int.from_bytes(param[-4:], "little", signed=True)
+        param = param[:-4]
+        if 0 <= pos and pos + VERSIONSTAMP_SIZE <= len(param):
+            stamp = place_versionstamp(version, batch_index)
+            param = param[:pos] + stamp + param[pos + VERSIONSTAMP_SIZE:]
+    if stamped_key:
+        return Mutation(MutationType.SET_VALUE, param, m.param2)
+    return Mutation(MutationType.SET_VALUE, m.param1, param)
+
 
 @dataclass(frozen=True)
 class Mutation:

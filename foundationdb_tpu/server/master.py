@@ -9,7 +9,8 @@ round; the seed master starts its epoch at version 1.
 """
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from collections import OrderedDict
+from typing import Dict
 
 from ..core.types import VERSIONS_PER_SECOND, Version
 from ..sim.loop import TaskPriority, now
@@ -18,26 +19,34 @@ from .messages import GetCommitVersionRequest, GetCommitVersionReply
 
 GET_COMMIT_VERSION_TOKEN = "master.getCommitVersion"
 
+#: Replies kept per proxy so a lost-reply repair re-query (by request_num)
+#: replays the original version pair even after newer requests landed
+#: (reference: lastCommitProxyVersionReplies window, masterserver.actor.cpp).
+PROXY_REPLY_WINDOW = 64
+
 
 class Master:
     def __init__(self, proc: SimProcess, start_version: Version = 1):
         self.proc = proc
         self.version: Version = start_version
         self.last_version_time: float = now()
-        # proxy_id -> (request_num, reply) replay window
-        self._proxy_window: Dict[str, Tuple[int, GetCommitVersionReply]] = {}
+        # proxy_id -> {request_num: reply}, trimmed to PROXY_REPLY_WINDOW
+        self._proxy_window: Dict[str, "OrderedDict[int, GetCommitVersionReply]"] = {}
         proc.register(GET_COMMIT_VERSION_TOKEN, self.get_commit_version)
 
     async def get_commit_version(self, req: GetCommitVersionRequest) -> GetCommitVersionReply:
         """reference: getVersion, masterserver.actor.cpp:786-850."""
-        last = self._proxy_window.get(req.proxy_id)
-        if last is not None and last[0] == req.request_num:
-            return last[1]  # retried request: same version pair
+        window = self._proxy_window.setdefault(req.proxy_id, OrderedDict())
+        cached = window.get(req.request_num)
+        if cached is not None:
+            return cached  # retried request: same version pair
         t = now()
         advance = max(1, int((t - self.last_version_time) * VERSIONS_PER_SECOND))
         prev = self.version
         self.version = prev + advance
         self.last_version_time = t
         reply = GetCommitVersionReply(version=self.version, prev_version=prev)
-        self._proxy_window[req.proxy_id] = (req.request_num, reply)
+        window[req.request_num] = reply
+        while len(window) > PROXY_REPLY_WINDOW:
+            window.popitem(last=False)
         return reply

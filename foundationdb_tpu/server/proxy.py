@@ -33,7 +33,9 @@ from ..core.types import (
     Mutation,
     MutationType,
     TransactionCommitResult,
+    VERSIONSTAMP_MUTATIONS,
     Version,
+    transform_versionstamp_mutation,
 )
 from ..ops.host_engine import KeyShardMap
 from ..sim.actors import NotifiedVersion, PromiseStream, all_of, any_of
@@ -87,6 +89,11 @@ class Proxy:
         #: bn -> (prev_version, version) for batches whose version is taken
         #: from the master but not yet durably chained (crash repair)
         self._batch_versions: Dict[int, Tuple[Version, Version]] = {}
+        #: bn -> master request_num for batches whose GetCommitVersion request
+        #: is in flight; a lost reply may still have advanced the master's
+        #: chain, so repair must re-query by request_num (the master's
+        #: per-proxy dedup window replays the same version pair)
+        self._pending_master_req: Dict[int, int] = {}
         self._grv_waiters: List[Promise] = []
         self._commit_queue: PromiseStream = PromiseStream()
         proc.register(GRV_TOKEN, self.get_read_version)
@@ -99,7 +106,9 @@ class Proxy:
         p = Promise()
         self._grv_waiters.append(p)
         if len(self._grv_waiters) == 1:
-            spawn(self._grv_flush(), TaskPriority.PROXY_GRV_TIMER, name="grvBatch")
+            self.proc.actors.add(
+                spawn(self._grv_flush(), TaskPriority.PROXY_GRV_TIMER, name="grvBatch")
+            )
         await p.future
         return GetReadVersionReply(version=self.committed_version.get())
 
@@ -138,10 +147,12 @@ class Proxy:
                 batch.append(pending.get())
                 pending = self._commit_queue.stream.pop()
             self._batch_num += 1
-            spawn(
-                self.commit_batch(self._batch_num, batch),
-                TaskPriority.PROXY_COMMIT_DISPATCH,
-                name=f"commitBatch:{self._batch_num}",
+            self.proc.actors.add(
+                spawn(
+                    self.commit_batch(self._batch_num, batch),
+                    TaskPriority.PROXY_COMMIT_DISPATCH,
+                    name=f"commitBatch:{self._batch_num}",
+                )
             )
 
     async def commit_batch(self, bn: int, items: List[Tuple[CommitTransaction, Promise]]) -> None:
@@ -153,15 +164,49 @@ class Proxy:
             self.batch_resolving.advance(bn)
             self.batch_logging.advance(bn)
             versions = self._batch_versions.pop(bn, None)
+            pending_rn = self._pending_master_req.pop(bn, None)
             if versions is not None:
                 # Version v is in the master's chain but may never have
                 # reached the resolvers/tlog; plug the hole or every later
                 # batch waits on when_at_least(v) forever. Resolvers and the
                 # tlog dedupe versions, so repair is idempotent.
-                spawn(self._repair_chain(*versions), TaskPriority.PROXY_COMMIT, name=f"repair:{bn}")
+                self.proc.actors.add(
+                    spawn(self._repair_chain(*versions), TaskPriority.PROXY_COMMIT, name=f"repair:{bn}")
+                )
+            elif pending_rn is not None:
+                # The GetCommitVersion reply was lost (request_maybe_delivered)
+                # — the master may still have advanced its chain for us. Ask
+                # again with the same request_num: the dedup window replays the
+                # same (prev, version) pair if the original landed, or mints a
+                # fresh pair (which we immediately plug) if it never did.
+                self.proc.actors.add(
+                    spawn(
+                        self._repair_unknown_version(pending_rn),
+                        TaskPriority.PROXY_COMMIT,
+                        name=f"repairUnknown:{bn}",
+                    )
+                )
             for _, p in items:
                 if not p.is_set:
                     p.send_error(error.commit_unknown_result(e.name))
+
+    async def _repair_unknown_version(self, request_num: int) -> None:
+        """Recover the version pair for a lost GetCommitVersion exchange and
+        plug the resulting chain hole (ADVICE r1: a lost master reply after
+        the master advanced left an orphaned version that stalled every later
+        batch's when_at_least)."""
+        while True:
+            try:
+                vr = await self.net.request(
+                    self.proc.address,
+                    Endpoint(self.cfg.master_addr, GET_COMMIT_VERSION_TOKEN),
+                    GetCommitVersionRequest(request_num, self.proc.address),
+                    TaskPriority.PROXY_COMMIT,
+                )
+                break
+            except error.FDBError:
+                await delay(0.1)
+        await self._repair_chain(vr.prev_version, vr.version)
 
     async def _repair_chain(self, prev_v: Version, v: Version) -> None:
         """Push an empty batch for (prev_v, v) until every chained consumer
@@ -197,12 +242,14 @@ class Proxy:
         # ---- Phase 1: take a commit version, in batch order (:361) ----
         await self.batch_resolving.when_at_least(bn - 1)
         self._request_num += 1
+        self._pending_master_req[bn] = self._request_num
         vr = await self.net.request(
             self.proc.address,
             Endpoint(cfg.master_addr, GET_COMMIT_VERSION_TOKEN),
             GetCommitVersionRequest(self._request_num, self.proc.address),
             TaskPriority.PROXY_COMMIT,
         )
+        self._pending_master_req.pop(bn, None)
         prev_v, v = vr.prev_version, vr.version
         self._batch_versions[bn] = (prev_v, v)
 
@@ -263,11 +310,18 @@ class Proxy:
                 verdicts.append(min(int(replies[r].committed[i]) for r, i in placed))
 
         # Assign committed mutations to storage tags, preserving batch order.
+        # Versionstamped mutations become SET_VALUE here, stamped with
+        # (commit version, index in batch) — the reference does this while
+        # building resolver requests (MasterProxyServer.actor.cpp:270-275);
+        # doing it post-verdict is equivalent because only the mutation
+        # payload changes, never the conflict ranges.
         messages: Dict[int, List[Mutation]] = {}
         for t, (txn, _) in enumerate(items):
             if verdicts[t] != int(TransactionCommitResult.COMMITTED):
                 continue
             for m in txn.mutations:
+                if m.type in VERSIONSTAMP_MUTATIONS:
+                    m = transform_versionstamp_mutation(m, v, t)
                 if m.type == MutationType.CLEAR_RANGE:
                     for s, cb, ce in cfg.storage_shards.shards_of_range(m.param1, m.param2):
                         messages.setdefault(s, []).append(Mutation(m.type, cb, ce))

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from typing import Dict
 
+from ..core import error
 from ..core.types import MAX_WRITE_TRANSACTION_LIFE_VERSIONS, Version
 from ..sim.actors import NotifiedVersion
 from ..sim.network import SimProcess
@@ -40,15 +41,11 @@ class Resolver:
         """reference: resolveBatch, Resolver.actor.cpp:71-260."""
         if req.version <= self.version.get():
             # Already resolved (proxy retry): replay the recorded verdicts.
-            cached = self._recent.get(req.version)
-            assert cached is not None, "resolver asked to re-resolve a GC'd version"
-            return cached
+            return self._replay(req.version)
         await self.version.when_at_least(req.prev_version)
         if req.version <= self.version.get():
             # A duplicate delivery resolved this version while we waited.
-            cached = self._recent.get(req.version)
-            assert cached is not None, "resolver asked to re-resolve a GC'd version"
-            return cached
+            return self._replay(req.version)
         new_oldest = max(0, req.version - MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
         verdicts = self.engine.resolve(req.transactions, req.version, new_oldest)
         reply = ResolveTransactionBatchReply(committed=[int(v) for v in verdicts])
@@ -58,3 +55,12 @@ class Resolver:
             del self._recent[v]
         self.version.set(req.version)
         return reply
+
+    def _replay(self, version: Version) -> ResolveTransactionBatchReply:
+        """A sufficiently delayed duplicate may ask for a version already
+        GC'd from the replay window; that is a typed error the proxy's
+        commit_unknown_result path absorbs, never a process crash."""
+        cached = self._recent.get(version)
+        if cached is None:
+            raise error.please_reboot(f"resolve replay window GC'd version {version}")
+        return cached

@@ -19,7 +19,7 @@ from ..core.types import (
     KeyRange,
     Mutation,
     MutationType,
-    SINGLE_KEY_MUTATIONS,
+    STORAGE_ATOMIC_MUTATIONS,
     Value,
     Version,
     apply_atomic_op,
@@ -147,10 +147,12 @@ class StorageServer:
             self.store.set(m.param1, m.param2, version)
         elif m.type == MutationType.CLEAR_RANGE:
             self.store.clear_range(m.param1, m.param2, version)
-        elif m.type in SINGLE_KEY_MUTATIONS:
+        elif m.type in STORAGE_ATOMIC_MUTATIONS:
             existing = self.store.value_at(m.param1, version)
             self.store.set(m.param1, apply_atomic_op(m.type, existing, m.param2), version)
         else:
+            # Versionstamped mutations must have been rewritten to SET_VALUE
+            # by the proxy (transform_versionstamp_mutation) before logging.
             raise error.client_invalid_operation(f"unsupported mutation {m.type}")
 
     async def update_loop(self) -> None:

@@ -342,3 +342,82 @@ def test_determinism_of_whole_cluster_run():
 
     assert trace(42) == trace(42)
     assert trace(42) != trace(43)
+
+
+def test_versionstamped_key_and_value():
+    """SET_VERSIONSTAMPED_KEY/VALUE are rewritten by the proxy into stamped
+    SET_VALUEs (fdbclient/Atomic.h:258-271); the stamp is 8B BE commit
+    version + 2B BE batch index and matches tr.get_versionstamp()."""
+    c = build_cluster(seed=31)
+    db = c.new_client()
+
+    async def work():
+        import struct
+
+        tr = db.create_transaction()
+        # key = prefix + 10 placeholder bytes; offset of the stamp = 4.
+        raw_key = b"vs/k" + b"\x00" * 10 + struct.pack("<i", 4)
+        tr.atomic_op(raw_key, b"payload", MutationType.SET_VERSIONSTAMPED_KEY)
+        # value = 10 placeholder bytes + suffix; stamp at offset 0.
+        raw_val = b"\x00" * 10 + b"tail" + struct.pack("<i", 0)
+        tr.atomic_op(b"vs/v", raw_val, MutationType.SET_VERSIONSTAMPED_VALUE)
+        v = await tr.commit()
+        stamp = tr.get_versionstamp()
+        assert len(stamp) == 10
+        assert int.from_bytes(stamp[:8], "big") == v
+
+        tr2 = db.create_transaction()
+        got_key = await tr2.get(b"vs/k" + stamp)
+        assert got_key == b"payload"
+        got_val = await tr2.get(b"vs/v")
+        assert got_val == stamp + b"tail"
+        return True
+
+    assert run(c, work())
+
+
+def test_versionstamp_read_is_unreadable():
+    """Reading a key versionstamped by this transaction raises
+    accessed_unreadable (1036), not a crash."""
+    c = build_cluster(seed=32)
+    db = c.new_client()
+
+    async def work():
+        import struct
+
+        tr = db.create_transaction()
+        tr.atomic_op(b"u", b"\x00" * 10 + struct.pack("<i", 0), MutationType.SET_VERSIONSTAMPED_VALUE)
+        try:
+            await tr.get(b"u")
+            return False
+        except error.FDBError as e:
+            return e.code == 1036
+
+    assert run(c, work())
+
+
+def test_range_read_truncation_narrows_conflict():
+    """A limit-truncated range read (no buffered mutations) narrows its read
+    conflict range to the observed prefix, so a write past the last returned
+    key does not abort it (ADVICE r1, reference: RYW narrows via More flag)."""
+    c = build_cluster(seed=33)
+    db = c.new_client()
+
+    async def work():
+        setup = db.create_transaction()
+        for i in range(20):
+            setup.set(b"nr/%02d" % i, b"x")
+        await setup.commit()
+
+        tr = db.create_transaction()
+        rows = await tr.get_range(b"nr/", b"nr0", limit=5)
+        assert len(rows) == 5
+        # Concurrent writer touches a key past the observed prefix.
+        w = db.create_transaction()
+        w.set(b"nr/19", b"y")
+        await w.commit()
+        tr.set(b"nr/out", b"done")
+        await tr.commit()  # must NOT conflict
+        return True
+
+    assert run(c, work())
