@@ -31,6 +31,7 @@ from ..core.types import (
     key_after,
     place_versionstamp,
     single_key_range,
+    validate_versionstamp_param,
 )
 from ..sim.loop import TaskPriority, current_scheduler, delay
 from ..sim.network import Endpoint
@@ -47,6 +48,10 @@ from ..server.messages import (
 MAX_BACKOFF = 1.0
 INITIAL_BACKOFF = 0.01
 USER_KEYSPACE_END = b"\xff"
+#: per-request reply timeout (virtual seconds). A partition between client
+#: and a role must surface as a retryable error, never a hung future
+#: (reference: the failure monitor + connection break give the same bound).
+REQUEST_TIMEOUT = 5.0
 
 _WRONG_SHARD = error.wrong_shard_server("").code
 _MAYBE_DELIVERED = error.request_maybe_delivered("").code
@@ -97,12 +102,16 @@ class Database:
         covered = self._cached_locations(begin, end)
         if covered is not None:
             return covered
-        reply = await self.net.request(
-            self.client_addr,
-            Endpoint(self._proxy(), proxy_mod.LOCATIONS_TOKEN),
-            GetKeyServerLocationsRequest(begin=begin, end=end),
-            TaskPriority.DEFAULT_ENDPOINT,
-        )
+        try:
+            reply = await self.net.request(
+                self.client_addr,
+                Endpoint(self._proxy(), proxy_mod.LOCATIONS_TOKEN),
+                GetKeyServerLocationsRequest(begin=begin, end=end),
+                TaskPriority.DEFAULT_ENDPOINT,
+                timeout=REQUEST_TIMEOUT,
+            )
+        except error.FDBError as e:
+            raise _map_read_error(e)
         for rng, addrs in reply.results:
             self._insert_location(rng, addrs)
         return reply.results
@@ -140,12 +149,16 @@ class Transaction:
     # -- versions ------------------------------------------------------------
     async def get_read_version(self) -> Version:
         if self.read_version is None:
-            reply = await self.db.net.request(
-                self.db.client_addr,
-                Endpoint(self.db._proxy(), proxy_mod.GRV_TOKEN),
-                GetReadVersionRequest(),
-                TaskPriority.GET_CONSISTENT_READ_VERSION,
-            )
+            try:
+                reply = await self.db.net.request(
+                    self.db.client_addr,
+                    Endpoint(self.db._proxy(), proxy_mod.GRV_TOKEN),
+                    GetReadVersionRequest(),
+                    TaskPriority.GET_CONSISTENT_READ_VERSION,
+                    timeout=REQUEST_TIMEOUT,
+                )
+            except error.FDBError as e:
+                raise _map_read_error(e)
             self.read_version = reply.version
         return self.read_version
 
@@ -258,6 +271,7 @@ class Transaction:
                     Endpoint(addr, storage_mod.GET_VALUE_TOKEN),
                     GetValueRequest(key=key, version=version),
                     TaskPriority.DEFAULT_ENDPOINT,
+                    timeout=REQUEST_TIMEOUT,
                 )
                 return reply.value
             except error.FDBError as e:
@@ -287,6 +301,7 @@ class Transaction:
                             Endpoint(addrs[0], storage_mod.GET_KEY_VALUES_TOKEN),
                             GetKeyValuesRequest(begin=cb, end=ce, version=version, limit=want, reverse=reverse),
                             TaskPriority.DEFAULT_ENDPOINT,
+                            timeout=REQUEST_TIMEOUT,
                         )
                         out.extend(reply.data)
                         if limit is not None and len(out) >= limit:
@@ -327,6 +342,12 @@ class Transaction:
 
     def atomic_op(self, key: Key, param: Value, op: MutationType) -> None:
         self._check_writable(key)
+        if op in VERSIONSTAMP_MUTATIONS:
+            stamped = key if op == MutationType.SET_VERSIONSTAMPED_KEY else param
+            if not validate_versionstamp_param(stamped):
+                raise error.client_invalid_operation(
+                    "versionstamp offset out of range or param too short"
+                )
         self.mutations.append(Mutation(op, key, param))
         self.write_conflict_ranges.append(single_key_range(key))
 
@@ -371,6 +392,7 @@ class Transaction:
                 Endpoint(self.db._proxy(), proxy_mod.COMMIT_TOKEN),
                 CommitTransactionRequest(transaction=txn),
                 TaskPriority.PROXY_COMMIT,
+                timeout=2 * REQUEST_TIMEOUT,
             )
         except error.FDBError as e:
             if e.code in (_MAYBE_DELIVERED, _CONNECTION_FAILED):

@@ -143,6 +143,17 @@ def place_versionstamp(version: Version, batch_index: int) -> bytes:
     return version.to_bytes(8, "big") + (batch_index & 0xFFFF).to_bytes(2, "big")
 
 
+def validate_versionstamp_param(param: bytes) -> bool:
+    """True iff a SET_VERSIONSTAMPED_* param is well-formed: a trailing
+    little-endian int32 naming a stamp position fully inside the remaining
+    bytes (reference: the client rejects bad offsets in
+    ReadYourWrites.actor.cpp before the mutation ever reaches a proxy)."""
+    if len(param) < 4 + VERSIONSTAMP_SIZE:
+        return False
+    pos = int.from_bytes(param[-4:], "little", signed=True)
+    return 0 <= pos and pos + VERSIONSTAMP_SIZE <= len(param) - 4
+
+
 def transform_versionstamp_mutation(m: "Mutation", version: Version, batch_index: int) -> "Mutation":
     """Rewrite a SET_VERSIONSTAMPED_{KEY,VALUE} mutation into a plain
     SET_VALUE with the stamp substituted, at the position named by the

@@ -22,7 +22,12 @@ GET_COMMIT_VERSION_TOKEN = "master.getCommitVersion"
 #: Replies kept per proxy so a lost-reply repair re-query (by request_num)
 #: replays the original version pair even after newer requests landed
 #: (reference: lastCommitProxyVersionReplies window, masterserver.actor.cpp).
-PROXY_REPLY_WINDOW = 64
+#: Correctness requires the lost request_num to still be inside the window
+#: when the repair re-query lands; the proxy pipelines at most a handful of
+#: phase-1 exchanges, and repair fires promptly on failure, so 256 leaves
+#: orders of magnitude of headroom. Epoch recovery (which ends the whole
+#: chain) is the backstop for anything outside it.
+PROXY_REPLY_WINDOW = 256
 
 
 class Master:

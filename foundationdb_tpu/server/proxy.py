@@ -64,6 +64,10 @@ LOCATIONS_TOKEN = "proxy.getKeyServerLocations"
 GRV_BATCH_INTERVAL = 0.0005      # reference: START_TRANSACTION_BATCH_INTERVAL_MIN
 COMMIT_BATCH_INTERVAL = 0.001    # reference: COMMIT_TRANSACTION_BATCH_INTERVAL_MIN
 MAX_COMMIT_BATCH = 512
+#: reply timeout on proxy->master/resolver/tlog requests: an alive-but-
+#: partitioned peer must fail the batch (commit_unknown_result + repair)
+#: rather than wedge the pipeline forever (round-2 review finding).
+SERVER_REQUEST_TIMEOUT = 5.0
 
 
 @dataclass
@@ -202,6 +206,7 @@ class Proxy:
                     Endpoint(self.cfg.master_addr, GET_COMMIT_VERSION_TOKEN),
                     GetCommitVersionRequest(request_num, self.proc.address),
                     TaskPriority.PROXY_COMMIT,
+                    timeout=SERVER_REQUEST_TIMEOUT,
                 )
                 break
             except error.FDBError:
@@ -222,12 +227,14 @@ class Proxy:
                             last_received_version=prev_v, transactions=[],
                         ),
                         TaskPriority.PROXY_RESOLVER_REPLY,
+                        timeout=SERVER_REQUEST_TIMEOUT,
                     )
                 await self.net.request(
                     self.proc.address,
                     Endpoint(self.cfg.tlog_addr, TLOG_COMMIT_TOKEN),
                     TLogCommitRequest(prev_version=prev_v, version=v, messages={}),
                     TaskPriority.PROXY_COMMIT,
+                    timeout=SERVER_REQUEST_TIMEOUT,
                 )
                 if v > self.committed_version.get():
                     self.committed_version.set(v)
@@ -248,6 +255,7 @@ class Proxy:
             Endpoint(cfg.master_addr, GET_COMMIT_VERSION_TOKEN),
             GetCommitVersionRequest(self._request_num, self.proc.address),
             TaskPriority.PROXY_COMMIT,
+            timeout=SERVER_REQUEST_TIMEOUT,
         )
         self._pending_master_req.pop(bn, None)
         prev_v, v = vr.prev_version, vr.version
@@ -294,6 +302,7 @@ class Proxy:
                     transactions=per_res[r],
                 ),
                 TaskPriority.PROXY_RESOLVER_REPLY,
+                timeout=SERVER_REQUEST_TIMEOUT,
             )
             for r, addr in enumerate(cfg.resolver_addrs)
         ]
@@ -336,6 +345,7 @@ class Proxy:
             Endpoint(cfg.tlog_addr, TLOG_COMMIT_TOKEN),
             TLogCommitRequest(prev_version=prev_v, version=v, messages=messages),
             TaskPriority.PROXY_COMMIT,
+            timeout=SERVER_REQUEST_TIMEOUT,
         )
         self.batch_logging.advance(bn)
 

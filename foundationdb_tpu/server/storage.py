@@ -157,14 +157,22 @@ class StorageServer:
 
     async def update_loop(self) -> None:
         """Pull this server's tag from the tlog forever (update:2340 +
-        updateStorage:2585 merged: in-memory apply == durable here)."""
+        updateStorage:2585 merged: in-memory apply == durable here). Peeks
+        are idempotent, so transport loss (tlog death, partition, timeout)
+        just retries; a blocked peek is re-armed every few virtual seconds so
+        a partitioned-then-healed link recovers."""
         while True:
-            reply = await self.net.request(
-                self.proc.address,
-                self.peek_ep,
-                TLogPeekRequest(tag=self.tag, begin_version=self.version.get() + 1),
-                TaskPriority.TLOG_PEEK,
-            )
+            try:
+                reply = await self.net.request(
+                    self.proc.address,
+                    self.peek_ep,
+                    TLogPeekRequest(tag=self.tag, begin_version=self.version.get() + 1),
+                    TaskPriority.TLOG_PEEK,
+                    timeout=5.0,
+                )
+            except error.FDBError:
+                await delay(0.5, TaskPriority.TLOG_PEEK)
+                continue
             for v, muts in reply.messages:
                 if v <= self.version.get():
                     continue

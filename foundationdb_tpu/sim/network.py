@@ -15,6 +15,7 @@ from typing import Any, Awaitable, Callable, Dict, Optional, Set, Tuple
 
 from ..core import error
 from .actors import ActorCollection
+from .failmon import FailureMonitor
 from .loop import Future, Scheduler, TaskPriority
 
 
@@ -59,13 +60,12 @@ class SimNetwork:
     def __init__(self, sched: Scheduler, min_latency: float = 0.0001, max_latency: float = 0.001):
         self.sched = sched
         self.processes: Dict[str, SimProcess] = {}
+        self.monitor = FailureMonitor()
         self.min_latency = min_latency
         self.max_latency = max_latency
         # (src, dst) -> virtual time until which packets are held (SimClogging)
         self._clogged_until: Dict[Tuple[str, str], float] = {}
         self._partitioned: Set[Tuple[str, str]] = set()
-        # replies outstanding against each destination process
-        self._outstanding: Dict[str, Set[Future]] = {}
 
     # -- topology ------------------------------------------------------------
     def add_process(self, proc: SimProcess) -> None:
@@ -83,12 +83,6 @@ class SimNetwork:
     def heal_partition(self, a: str, b: str) -> None:
         self._partitioned.discard((a, b))
         self._partitioned.discard((b, a))
-
-    def kill_process_endpoints(self, address: str) -> None:
-        """Break every outstanding reply against a dying process."""
-        for f in self._outstanding.pop(address, set()):
-            if not f.is_ready:
-                f._set_error(error.request_maybe_delivered())
 
     # -- delivery ------------------------------------------------------------
     def _latency(self) -> float:
@@ -109,22 +103,32 @@ class SimNetwork:
         endpoint: Endpoint,
         payload: Any,
         priority: int = TaskPriority.DEFAULT_ENDPOINT,
+        timeout: Optional[float] = None,
     ) -> Future:
         """Send payload to endpoint; future of the handler's return value.
 
         reference: RequestStream<T>::getReply (fdbrpc/fdbrpc.h:229-249).
-        Errors: connection_failed if the destination is dead or unroutable;
-        request_maybe_delivered if it dies mid-flight; handler exceptions
-        propagate to the caller like serialized error replies.
+        Errors: connection_failed if the destination is dead, unroutable, or
+        marked failed by the failure monitor (fdbrpc/FailureMonitor.h:81);
+        request_maybe_delivered if it dies or is declared failed mid-flight,
+        or if `timeout` virtual seconds elapse without a reply. Handler
+        exceptions propagate to the caller like serialized error replies.
         """
         reply = Future()
+        if self.monitor.is_failed(endpoint.address):
+            reply._set_error(error.connection_failed(f"{endpoint.address} marked failed"))
+            return reply
         fwd = self._hop_delay(src, endpoint.address)
         if fwd is None:
-            # Partition: in the reference the packet just never arrives; the
-            # caller's own timeout/failure-monitor logic must fire.
+            # Partition: the packet never arrives. The failure monitor or the
+            # caller's timeout must fire — the future may not hang forever.
+            self._arm_watchdogs(reply, endpoint.address, timeout)
             return reply
-        self._outstanding.setdefault(endpoint.address, set()).add(reply)
-        reply.on_ready(lambda f: self._outstanding.get(endpoint.address, set()).discard(f))
+        # Outstanding-reply breakage on process death rides the failure
+        # monitor: kill marks the address failed, which errors every armed
+        # reply with request_maybe_delivered (the NetSAV broken-connection
+        # semantics, fdbrpc/fdbrpc.h:64-89).
+        self._arm_watchdogs(reply, endpoint.address, timeout)
 
         def deliver() -> None:
             proc = self.processes.get(endpoint.address)
@@ -150,6 +154,24 @@ class SimNetwork:
 
         self.sched.at(self.sched.time + fwd, deliver, priority)
         return reply
+
+    def _arm_watchdogs(self, reply: Future, dst: str, timeout: Optional[float]) -> None:
+        """Error the reply if the destination is declared failed while it is
+        outstanding, or after `timeout` virtual seconds (whichever first)."""
+        watch = self.monitor.on_failed(
+            dst,
+            lambda: (not reply.is_ready)
+            and reply._set_error(error.request_maybe_delivered(f"{dst} declared failed")),
+        )
+        if watch is not None:
+            reply.on_ready(lambda _: watch.cancel())
+        if timeout is not None:
+            self.sched.at(
+                self.sched.time + timeout,
+                lambda: (not reply.is_ready)
+                and reply._set_error(error.request_maybe_delivered(f"timeout to {dst}")),
+                TaskPriority.DEFAULT_DELAY,
+            )
 
     def _send_reply(
         self, src: str, dst: str, reply: Future, value: Any, err: Optional[BaseException], priority: int

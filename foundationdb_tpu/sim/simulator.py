@@ -72,7 +72,10 @@ class Simulator:
         proc.alive = False
         proc.handlers.clear()
         proc.actors.cancel_all()
-        self.net.kill_process_endpoints(proc.address)
+        # Peers learn of the death the way Sim2 peers do — broken connections
+        # (instant), mirrored here as failure-monitor state; marking the
+        # address failed also errors every outstanding reply against it.
+        self.net.monitor.set_status(proc.address, True)
         if kill_type in (KillType.REBOOT, KillType.REBOOT_AND_DELETE):
             if kill_type == KillType.REBOOT_AND_DELETE:
                 proc.globals.clear()
@@ -81,6 +84,7 @@ class Simulator:
             def do_boot() -> None:
                 proc.alive = True
                 proc.reboots += 1
+                self.net.monitor.set_status(proc.address, False)
                 self.boot(proc)
 
             self.sched.at(self.sched.time + reboot_delay, do_boot, TaskPriority.DEFAULT_DELAY)

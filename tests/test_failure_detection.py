@@ -1,0 +1,147 @@
+"""Failure monitor + RPC timeout semantics (round-2 VERDICT item #6).
+
+The round-1 hole: a partitioned request's future hung forever
+(sim/network.py). Now every request can carry a timeout, the failure
+monitor errors outstanding requests when an address is declared failed,
+and the wait-failure keepalive turns silence into detected role failure
+(reference: fdbrpc/FailureMonitor.h:81, fdbserver/WaitFailure.actor.cpp).
+"""
+import pytest
+
+from foundationdb_tpu.core import error
+from foundationdb_tpu.server.cluster import ClusterConfig, build_cluster
+from foundationdb_tpu.server.wait_failure import (
+    serve_wait_failure,
+    wait_failure_client,
+)
+from foundationdb_tpu.sim.network import Endpoint
+from foundationdb_tpu.sim.simulator import Simulator
+
+
+def _echo_process(sim, name="svc"):
+    proc = sim.new_process(name)
+
+    async def handler(payload):
+        return payload
+
+    proc.register("echo", handler)
+    return proc
+
+
+def test_partitioned_request_times_out():
+    sim = Simulator(seed=1)
+    a = sim.new_process("a")
+    b = _echo_process(sim, "b")
+    sim.net.partition(a.address, b.address)
+    f = sim.net.request(a.address, Endpoint(b.address, "echo"), 42, timeout=2.0)
+    with pytest.raises(error.FDBError) as ei:
+        sim.run_until(f, until=10.0)
+    assert ei.value.code == error.request_maybe_delivered("").code
+    assert sim.sched.time == pytest.approx(2.0)
+
+
+def test_request_to_failed_address_errors_immediately():
+    sim = Simulator(seed=2)
+    a = sim.new_process("a")
+    b = _echo_process(sim, "b")
+    sim.kill_process(b)
+    f = sim.net.request(a.address, Endpoint(b.address, "echo"), 1)
+    assert f.is_ready and f.is_error
+    with pytest.raises(error.FDBError) as ei:
+        f.get()
+    assert ei.value.code == error.connection_failed("").code
+
+
+def test_monitor_errors_stranded_request_on_declared_failure():
+    """A request stranded by a partition (no timeout) errors the moment the
+    destination is declared failed — the failure-detector integration."""
+    sim = Simulator(seed=3)
+    a = sim.new_process("a")
+    b = _echo_process(sim, "b")
+    sim.net.partition(a.address, b.address)
+    f = sim.net.request(a.address, Endpoint(b.address, "echo"), 1)
+    sim.run(until=1.0)
+    assert not f.is_ready
+    sim.net.monitor.set_status(b.address, True)
+    assert f.is_ready and f.is_error
+    with pytest.raises(error.FDBError) as ei:
+        f.get()
+    assert ei.value.code == error.request_maybe_delivered("").code
+
+
+def test_monitor_clears_on_reboot():
+    from foundationdb_tpu.sim.simulator import KillType
+
+    sim = Simulator(seed=4)
+    booted = []
+
+    async def boot(s, proc):
+        booted.append(s.sched.time)
+
+    a = sim.new_process("a")
+    b = sim.new_process("b", boot_fn=boot)
+    sim.run(until=0.1)  # let the initial boot actor run
+    sim.kill_process(b, KillType.REBOOT)
+    assert sim.net.monitor.is_failed(b.address)
+    sim.run(until=5.0)
+    assert not sim.net.monitor.is_failed(b.address)
+    assert len(booted) == 2  # initial boot + reboot
+
+
+def test_wait_failure_detects_kill():
+    sim = Simulator(seed=5)
+    watcher = sim.new_process("watcher")
+    role = sim.new_process("role")
+    ep = serve_wait_failure(role)
+    task = sim.sched.spawn(
+        wait_failure_client(sim.net, watcher.address, ep), name="wfc"
+    )
+    sim.run(until=3.0)
+    assert not task.is_ready  # healthy: keepalive keeps cycling
+    sim.kill_process(role)
+    sim.run(until=6.0)
+    assert task.is_ready and not task.is_error
+
+
+def test_wait_failure_detects_partition():
+    sim = Simulator(seed=6)
+    watcher = sim.new_process("watcher")
+    role = sim.new_process("role")
+    ep = serve_wait_failure(role)
+    task = sim.sched.spawn(
+        wait_failure_client(sim.net, watcher.address, ep), name="wfc"
+    )
+    sim.run(until=2.0)
+    assert not task.is_ready
+    sim.net.partition(watcher.address, role.address)
+    sim.run(until=10.0)
+    assert task.is_ready and not task.is_error
+
+
+def test_client_survives_proxy_partition():
+    """A client partitioned from the proxy mid-run sees retryable errors,
+    and its retry loop completes once the partition heals."""
+    cluster = build_cluster(seed=7, cfg=ClusterConfig(n_resolvers=1, n_storage=1))
+    sim = cluster.sim
+    db = cluster.new_client()
+
+    async def incr(tr):
+        v = await tr.get(b"ctr")
+        n = int(v or b"0") + 1
+        tr.set(b"ctr", str(n).encode())
+        return n
+
+    results = []
+
+    async def work():
+        for _ in range(3):
+            results.append(await db.run(incr))
+
+    task = sim.sched.spawn(work(), name="client")
+    # Let the first increment land, then partition client<->proxy for a while.
+    sim.run(until=0.5)
+    sim.net.partition(db.client_addr, cluster.proxy_proc.address)
+    sim.run(until=8.0)
+    sim.net.heal_partition(db.client_addr, cluster.proxy_proc.address)
+    sim.run_until(task, until=60.0)
+    assert results[-1] == 3

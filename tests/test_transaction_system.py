@@ -421,3 +421,24 @@ def test_range_read_truncation_narrows_conflict():
         return True
 
     assert run(c, work())
+
+
+def test_versionstamp_bad_offset_rejected_client_side():
+    """A malformed versionstamp param (offset out of range, or too short to
+    hold a stamp) is rejected at atomic_op time instead of travelling to the
+    proxy and corrupting data (round-2 review finding)."""
+    import struct
+
+    c = build_cluster(seed=33)
+    db = c.new_client()
+    tr = db.create_transaction()
+    with pytest.raises(error.FDBError):
+        # param shorter than 4-byte offset trailer + 10-byte stamp
+        tr.atomic_op(b"k", b"abcde", MutationType.SET_VERSIONSTAMPED_VALUE)
+    with pytest.raises(error.FDBError):
+        # offset points past the end of the stamped bytes
+        bad = b"\x00" * 10 + struct.pack("<i", 7)
+        tr.atomic_op(b"k", bad, MutationType.SET_VERSIONSTAMPED_VALUE)
+    with pytest.raises(error.FDBError):
+        bad_key = b"prefix" + b"\x00" * 10 + struct.pack("<i", -1)
+        tr.atomic_op(bad_key, b"v", MutationType.SET_VERSIONSTAMPED_KEY)
