@@ -68,16 +68,59 @@ def _map_read_error(e: error.FDBError) -> error.FDBError:
 
 
 class Database:
-    def __init__(self, net, client_addr: str, proxy_addrs: List[str]):
+    def __init__(self, net, client_addr: str, proxy_addrs: Optional[List[str]] = None,
+                 coordinator_addrs: Optional[List[str]] = None):
+        """Static mode (fixed proxy_addrs) or dynamic mode: given
+        coordinator addresses — the cluster file — the client elects its
+        view of the cluster controller by majority and fetches the proxy
+        list from it, re-fetching whenever proxies fail (reference:
+        MonitorLeader + openDatabase, NativeAPI/MonitorLeader.actor.cpp)."""
         self.net = net
         self.client_addr = client_addr
-        self.proxy_addrs = proxy_addrs
+        self.proxy_addrs = list(proxy_addrs or [])
+        self.coordinator_addrs = list(coordinator_addrs or [])
         # location cache: sorted [(range, [storage addrs])]
         self._locations: List[Tuple[KeyRange, List[str]]] = []
 
     def _proxy(self) -> str:
         rng = current_scheduler().rng
         return self.proxy_addrs[rng.random_int(0, len(self.proxy_addrs))]
+
+    async def _get_proxy(self) -> str:
+        while not self.proxy_addrs:
+            if not self.coordinator_addrs:
+                raise error.connection_failed("no proxies and no coordinators")
+            await self._refresh_proxies()
+        return self._proxy()
+
+    def note_proxy_failure(self) -> None:
+        """A proxy request failed at the transport level: in dynamic mode,
+        drop the cached proxy list so the next request re-discovers (the
+        generation may have turned over)."""
+        if self.coordinator_addrs:
+            self.proxy_addrs = []
+
+    async def _refresh_proxies(self) -> None:
+        from ..server.cluster_controller import (
+            CC_OPEN_DATABASE_TOKEN,
+            OpenDatabaseRequest,
+        )
+        from ..server.leader_election import tally_leader_once
+
+        leader = await tally_leader_once(self.net, self.client_addr,
+                                         self.coordinator_addrs)
+        if leader is not None:
+            try:
+                info = await self.net.request(
+                    self.client_addr, Endpoint(leader.address, CC_OPEN_DATABASE_TOKEN),
+                    OpenDatabaseRequest(), TaskPriority.DEFAULT_ENDPOINT, timeout=1.0,
+                )
+            except error.FDBError:
+                info = None
+            if info is not None and info.recovery_state == "fully_recovered" and info.proxy_addrs:
+                self.proxy_addrs = list(info.proxy_addrs)
+                return
+        await delay(0.25)
 
     def create_transaction(self) -> "Transaction":
         return Transaction(self)
@@ -105,12 +148,14 @@ class Database:
         try:
             reply = await self.net.request(
                 self.client_addr,
-                Endpoint(self._proxy(), proxy_mod.LOCATIONS_TOKEN),
+                Endpoint(await self._get_proxy(), proxy_mod.LOCATIONS_TOKEN),
                 GetKeyServerLocationsRequest(begin=begin, end=end),
                 TaskPriority.DEFAULT_ENDPOINT,
                 timeout=REQUEST_TIMEOUT,
             )
         except error.FDBError as e:
+            if e.code in (_MAYBE_DELIVERED, _CONNECTION_FAILED):
+                self.note_proxy_failure()
             raise _map_read_error(e)
         for rng, addrs in reply.results:
             self._insert_location(rng, addrs)
@@ -152,12 +197,14 @@ class Transaction:
             try:
                 reply = await self.db.net.request(
                     self.db.client_addr,
-                    Endpoint(self.db._proxy(), proxy_mod.GRV_TOKEN),
+                    Endpoint(await self.db._get_proxy(), proxy_mod.GRV_TOKEN),
                     GetReadVersionRequest(),
                     TaskPriority.GET_CONSISTENT_READ_VERSION,
                     timeout=REQUEST_TIMEOUT,
                 )
             except error.FDBError as e:
+                if e.code in (_MAYBE_DELIVERED, _CONNECTION_FAILED):
+                    self.db.note_proxy_failure()
                 raise _map_read_error(e)
             self.read_version = reply.version
         return self.read_version
@@ -389,13 +436,14 @@ class Transaction:
         try:
             reply = await self.db.net.request(
                 self.db.client_addr,
-                Endpoint(self.db._proxy(), proxy_mod.COMMIT_TOKEN),
+                Endpoint(await self.db._get_proxy(), proxy_mod.COMMIT_TOKEN),
                 CommitTransactionRequest(transaction=txn),
                 TaskPriority.PROXY_COMMIT,
                 timeout=2 * REQUEST_TIMEOUT,
             )
         except error.FDBError as e:
             if e.code in (_MAYBE_DELIVERED, _CONNECTION_FAILED):
+                self.db.note_proxy_failure()
                 # The commit may or may not have happened (reference:
                 # tryCommit maps transport loss to commit_unknown_result).
                 raise error.commit_unknown_result(e.name)
@@ -412,6 +460,12 @@ class Transaction:
         everything else re-raises."""
         if not isinstance(e, error.FDBError) or not e.is_retryable():
             raise e
+        if e.code == error.transaction_too_old("").code:
+            # Defense in depth for generation turnover: a deposed proxy can
+            # keep answering GRV with pre-jump versions that storage has
+            # already forgotten; re-resolve the proxy list so the retry
+            # reaches the live generation.
+            self.db.note_proxy_failure()
         rng = current_scheduler().rng
         await delay(self._backoff * rng.random01())
         self._backoff = min(self._backoff * 2, MAX_BACKOFF)

@@ -39,13 +39,35 @@ def _define(code: int, name: str, desc: str, retryable: bool = False, maybe_comm
     return make
 
 
+class OperationCancelled(BaseException):
+    """Actor cancellation (flow: actor_cancelled). Deliberately NOT an
+    FDBError/Exception subclass: the reference's actor compiler propagates
+    cancellation through user catch blocks automatically, and retry loops
+    written as `except FDBError` must never swallow a cancellation and keep
+    looping. Carries the same shape as FDBError for uniform reporting."""
+
+    def __init__(self, message: str = ""):
+        super().__init__("operation_cancelled (1101)" + (f": {message}" if message else ""))
+        self.code = 1101
+        self.name = "operation_cancelled"
+
+    def is_retryable(self) -> bool:
+        return False
+
+    def is_maybe_committed(self) -> bool:
+        return False
+
+
+def operation_cancelled(message: str = "") -> OperationCancelled:
+    return OperationCancelled(message)
+
+
 # Codes mirror flow/error_definitions.h where applicable.
 operation_failed = _define(1000, "operation_failed", "Operation failed")
 timed_out = _define(1004, "timed_out", "Operation timed out")
 transaction_too_old = _define(1007, "transaction_too_old", "Read version is too old", retryable=True)
 future_version = _define(1009, "future_version", "Version is ahead of storage", retryable=True)
 wrong_shard_server = _define(1001, "wrong_shard_server", "Shard is on another server", retryable=True)
-operation_cancelled = _define(1101, "operation_cancelled", "Operation cancelled")
 not_committed = _define(1020, "not_committed", "Transaction conflicted, not committed", retryable=True)
 commit_unknown_result = _define(
     1021, "commit_unknown_result", "Commit result unknown", retryable=True, maybe_committed=True

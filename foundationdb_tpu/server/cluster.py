@@ -14,13 +14,14 @@ from typing import Callable, List, Optional
 from ..core.types import KeyRange
 from ..ops.host_engine import KeyShardMap
 from ..ops.oracle import OracleConflictEngine
+from ..sim.actors import AsyncVar
 from ..sim.network import Endpoint
 from ..sim.simulator import Simulator
 from ..client.database import Database
-from . import tlog as tlog_mod
-from .master import Master
+from .log_system import LogSystemConfig
+from .master import GET_COMMIT_VERSION_TOKEN, Master
 from .proxy import Proxy, ProxyConfig
-from .resolver import Resolver
+from .resolver import RESOLVE_TOKEN, Resolver
 from .storage import StorageServer
 from .tlog import TLog
 
@@ -48,6 +49,10 @@ class Cluster:
 
         self.tlog_proc = sim.new_process("tlog")
         self.tlog = TLog(self.tlog_proc, start_version=sv)
+        self.log_config = LogSystemConfig(
+            gen_id=(0, 0), tlogs=((self.tlog_proc.address, ""),), start_version=sv
+        )
+        self.log_view = AsyncVar(self.log_config)
 
         self.resolver_shards = KeyShardMap.uniform(cfg.n_resolvers)
         self.resolver_procs = [sim.new_process(f"resolver{i}") for i in range(cfg.n_resolvers)]
@@ -66,9 +71,7 @@ class Cluster:
                     p,
                     tag=i,
                     shard=KeyRange(begin, end),
-                    tlog_commit_ep=Endpoint(self.tlog_proc.address, tlog_mod.COMMIT_TOKEN),
-                    tlog_peek_ep=Endpoint(self.tlog_proc.address, tlog_mod.PEEK_TOKEN),
-                    tlog_pop_ep=Endpoint(self.tlog_proc.address, tlog_mod.POP_TOKEN),
+                    log_view=self.log_view,
                     net=sim.net,
                     start_version=sv,
                 )
@@ -79,10 +82,10 @@ class Cluster:
             self.proxy_proc,
             sim.net,
             ProxyConfig(
-                master_addr=self.master_proc.address,
-                resolver_addrs=[p.address for p in self.resolver_procs],
+                master_ep=Endpoint(self.master_proc.address, GET_COMMIT_VERSION_TOKEN),
+                resolver_eps=[Endpoint(p.address, RESOLVE_TOKEN) for p in self.resolver_procs],
                 resolver_shards=self.resolver_shards,
-                tlog_addr=self.tlog_proc.address,
+                log_config=self.log_config,
                 storage_addrs=[p.address for p in self.storage_procs],
                 storage_shards=self.storage_shards,
             ),
@@ -99,3 +102,69 @@ class Cluster:
 def build_cluster(seed: int = 0, cfg: Optional[ClusterConfig] = None) -> Cluster:
     sim = Simulator(seed)
     return Cluster(sim, cfg or ClusterConfig())
+
+
+# -- dynamic cluster: coordinators + workers + recovery ----------------------
+
+
+@dataclass
+class DynamicClusterConfig:
+    """The recruitment-era cluster shape (reference: DatabaseConfiguration —
+    `configure proxies=1 resolvers=2 logs=2`)."""
+
+    n_coordinators: int = 3
+    n_workers: int = 5
+    n_tlogs: int = 2
+    n_resolvers: int = 2
+    n_storage: int = 2
+    engine_factory: Callable = OracleConflictEngine
+
+
+class DynamicCluster:
+    """A full bootable cluster: coordinator processes and worker processes
+    with boot functions, so kills + reboots re-run the real boot path
+    (simulatedFDBDRebooter, SimulatedCluster.actor.cpp:198). Everything
+    else — CC election, master recovery, role recruitment — happens through
+    the same protocols a live cluster would use."""
+
+    def __init__(self, sim: Simulator, cfg: Optional[DynamicClusterConfig] = None):
+        from .coordination import CoordinationServer
+        from .worker import Worker
+
+        self.sim = sim
+        self.cfg = cfg or DynamicClusterConfig()
+
+        def coord_boot(simu, proc):
+            async def go():
+                CoordinationServer(proc)
+            return go()
+
+        self.coord_procs = [
+            sim.new_process(f"coord{i}", boot_fn=coord_boot)
+            for i in range(self.cfg.n_coordinators)
+        ]
+        self.coordinators = [p.address for p in self.coord_procs]
+
+        def worker_boot(index):
+            def boot(simu, proc):
+                async def go():
+                    Worker(simu, proc, self.coordinators, self.cfg.engine_factory,
+                           cc_priority=index, cluster_cfg=self.cfg)
+                return go()
+            return boot
+
+        self.worker_procs = [
+            sim.new_process(f"worker{i}", boot_fn=worker_boot(i))
+            for i in range(self.cfg.n_workers)
+        ]
+        self._n_clients = 0
+
+    def new_client(self) -> Database:
+        self._n_clients += 1
+        proc = self.sim.new_process(f"client{self._n_clients}")
+        return Database(self.sim.net, proc.address, coordinator_addrs=self.coordinators)
+
+
+def build_dynamic_cluster(seed: int = 0, cfg: Optional[DynamicClusterConfig] = None) -> DynamicCluster:
+    sim = Simulator(seed)
+    return DynamicCluster(sim, cfg)

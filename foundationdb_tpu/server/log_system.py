@@ -1,0 +1,173 @@
+"""Log system: the proxy/storage-facing view of one tlog generation.
+
+Re-design of fdbserver/TagPartitionedLogSystem.actor.cpp round-2 scope:
+one team of K replicas per generation, all-ack pushes, KCV-clipped peeks,
+and the epoch-end lock + recovery-version math:
+
+  * push(): fan a version out to every replica; committed only when ALL
+    have fsynced (anti-quorum 0). After the ack, advance the KCV on every
+    replica so peeks (and therefore storage servers) may serve it.
+  * peek()/pop(): any single replica holds every served version (all-ack),
+    so peeks go to one replica chosen by tag; pops fan out to all.
+  * lock_generation(): lock every reachable replica. Because pushes need
+    all replicas, ONE locked replica freezes the generation forever. The
+    recovery version is min(end_version) over the locked set: every
+    client-acked version is durable on ALL replicas, hence <= every
+    replica's end; versions above the min were never fully acked and may
+    be discarded (commit_unknown_result semantics). Every version <= the
+    min is durable on every locked replica, so any one of them can seed
+    the successor generation (getDurableVersion, TagPartitionedLogSystem
+    .actor.cpp:61; the copy replaces old-generation peek cursors).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..core import error
+from ..core.types import Mutation, Version
+from ..sim.actors import all_of
+from ..sim.loop import Future, TaskPriority
+from ..sim.network import Endpoint
+from .messages import (
+    TLogCommitRequest,
+    TLogKnownCommittedRequest,
+    TLogLockRequest,
+    TLogPeekReply,
+    TLogPeekRequest,
+    TLogPopRequest,
+    TLogRecoveryDataRequest,
+)
+from . import tlog as tlog_mod
+
+LOCK_TIMEOUT = 2.0
+
+
+@dataclass(frozen=True)
+class LogSystemConfig:
+    """reference: LogSystemConfig (fdbserver/LogSystemConfig.h): the
+    current generation's identity, membership and version floor. Each
+    replica is (address, token_suffix): the suffix carries the generation
+    AND the replica index, so two replicas recruited onto one worker are
+    still distinct tlog instances (duplicate placement must degrade
+    replication, never correctness)."""
+
+    gen_id: Tuple[int, int] = (0, 0)       # (recovery_count, master_salt)
+    tlogs: tuple = ()                      # ((address, token_suffix), ...)
+    start_version: Version = 0
+
+    def ep(self, replica: Tuple[str, str], kind: str) -> Endpoint:
+        base = {
+            "commit": tlog_mod.COMMIT_TOKEN,
+            "peek": tlog_mod.PEEK_TOKEN,
+            "pop": tlog_mod.POP_TOKEN,
+            "lock": tlog_mod.LOCK_TOKEN,
+            "kcv": tlog_mod.KCV_TOKEN,
+            "recovery": tlog_mod.RECOVERY_DATA_TOKEN,
+        }[kind]
+        addr, suffix = replica
+        return Endpoint(addr, base + suffix)
+
+
+class LogSystemClient:
+    """Push/peek/pop against one generation (held by proxies and storage)."""
+
+    def __init__(self, net, src_addr: str, config: LogSystemConfig,
+                 push_timeout: float = 5.0):
+        self.net = net
+        self.src = src_addr
+        self.config = config
+        self.push_timeout = push_timeout
+
+    async def push(
+        self,
+        prev_version: Version,
+        version: Version,
+        messages: Dict[int, List[Mutation]],
+        known_committed: Version,
+    ) -> Version:
+        """All-ack push of one version (ILogSystem::push). Raises on any
+        replica failure/timeout — the commit outcome is then unknown."""
+        req = TLogCommitRequest(
+            prev_version=prev_version,
+            version=version,
+            messages=messages,
+            gen_id=self.config.gen_id,
+            known_committed=known_committed,
+        )
+        await all_of([
+            self.net.request(
+                self.src, self.config.ep(rep, "commit"), req,
+                TaskPriority.TLOG_COMMIT, timeout=self.push_timeout,
+            )
+            for rep in self.config.tlogs
+        ])
+        # Every replica is durable at `version`: advance the peek horizon.
+        # Unreliable one-ways — the next push carries the same KCV anyway.
+        for rep in self.config.tlogs:
+            self.net.one_way(
+                self.src, self.config.ep(rep, "kcv"),
+                TLogKnownCommittedRequest(version=version),
+                TaskPriority.TLOG_COMMIT,
+            )
+        return version
+
+    def peek_endpoint(self, tag: int) -> Endpoint:
+        reps = self.config.tlogs
+        return self.config.ep(reps[tag % len(reps)], "peek")
+
+    async def peek(self, tag: int, begin_version: Version, timeout: float = 5.0) -> TLogPeekReply:
+        return await self.net.request(
+            self.src, self.peek_endpoint(tag),
+            TLogPeekRequest(tag=tag, begin_version=begin_version),
+            TaskPriority.TLOG_PEEK, timeout=timeout,
+        )
+
+    def pop(self, tag: int, version: Version) -> None:
+        for rep in self.config.tlogs:
+            self.net.one_way(
+                self.src, self.config.ep(rep, "pop"),
+                TLogPopRequest(tag=tag, version=version),
+                TaskPriority.TLOG_POP,
+            )
+
+
+async def lock_generation(
+    net, src_addr: str, config: LogSystemConfig
+) -> Tuple[Version, str]:
+    """Lock every reachable replica of `config`; returns (recovery_version,
+    a locked replica to copy from). Raises master_recovery_failed
+    if no replica can be locked (retry later — a generation with zero
+    reachable replicas means the un-popped window is unrecoverable until
+    one comes back)."""
+    futures = [
+        (rep, net.request(
+            src_addr, config.ep(rep, "lock"), TLogLockRequest(),
+            TaskPriority.TLOG_COMMIT, timeout=LOCK_TIMEOUT,
+        ))
+        for rep in config.tlogs
+    ]
+    locked: List[Tuple[Tuple[str, str], Version]] = []
+    for rep, f in futures:
+        try:
+            reply = await f
+        except error.FDBError:
+            continue
+        locked.append((rep, reply.end_version))
+    if not locked:
+        raise error.master_recovery_failed("no old-generation tlog reachable to lock")
+    recovery_version = min(end for _, end in locked)
+    # Any locked replica serves: all have every version <= recovery_version.
+    return recovery_version, locked[0][0]
+
+
+async def fetch_recovery_data(
+    net, src_addr: str, config: LogSystemConfig, replica: Tuple[str, str],
+    end_version: Version
+):
+    """Un-popped data <= end_version from one locked replica."""
+    return await net.request(
+        src_addr, config.ep(replica, "recovery"),
+        TLogRecoveryDataRequest(end_version=end_version),
+        TaskPriority.TLOG_PEEK, timeout=LOCK_TIMEOUT,
+    )

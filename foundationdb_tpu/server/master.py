@@ -30,14 +30,27 @@ GET_COMMIT_VERSION_TOKEN = "master.getCommitVersion"
 PROXY_REPLY_WINDOW = 256
 
 
+#: a new epoch's recovery transaction jumps the version chain past the whole
+#: MVCC window, so every pre-recovery read snapshot resolves TOO_OLD at the
+#: fresh (empty) resolvers instead of silently missing lost conflict history
+#: (reference: recoveryTransactionVersion jump, masterserver.actor.cpp:330;
+#: applied by MasterServer's recovery transaction, masterserver.py).
+RECOVERY_VERSION_JUMP = 2 * 5_000_000
+
+
 class Master:
-    def __init__(self, proc: SimProcess, start_version: Version = 1):
+    def __init__(self, proc: SimProcess, start_version: Version = 1,
+                 token_suffix: str = ""):
         self.proc = proc
         self.version: Version = start_version
         self.last_version_time: float = now()
+        self.token = GET_COMMIT_VERSION_TOKEN + token_suffix
         # proxy_id -> {request_num: reply}, trimmed to PROXY_REPLY_WINDOW
         self._proxy_window: Dict[str, "OrderedDict[int, GetCommitVersionReply]"] = {}
-        proc.register(GET_COMMIT_VERSION_TOKEN, self.get_commit_version)
+        proc.register(self.token, self.get_commit_version)
+
+    def unregister(self) -> None:
+        self.proc.unregister(self.token)
 
     async def get_commit_version(self, req: GetCommitVersionRequest) -> GetCommitVersionReply:
         """reference: getVersion, masterserver.actor.cpp:786-850."""

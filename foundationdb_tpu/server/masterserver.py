@@ -1,0 +1,290 @@
+"""Master server: the epoch recovery state machine + version authority.
+
+Re-design of fdbserver/masterserver.actor.cpp (masterCore:1104,
+recoverFrom:728, readTransactionSystemState:586). One master owns one
+epoch; recovery is:
+
+  READING_CSTATE   read DBCoreState from a coordinator majority
+  LOCKING_CSTATE   write it back with a bumped recovery_count — the
+                   exclusive-generation write kills any straggling older
+                   master's future cstate writes (split-brain guard)
+  LOCKING_TLOGS    lock the previous tlog generation; recovery version =
+                   min(end) over the locked set (log_system.lock_generation)
+  RECRUITING       fetch the un-popped window from a locked replica, then
+                   construct the new generation on chosen workers: K tlogs
+                   (seeded with the copy), resolvers, the version authority,
+                   one proxy; on an empty cstate also seed storage servers
+                   (newSeedServers, masterserver.actor.cpp:325)
+  WRITING_CSTATE   write the new generation into the coordinated state —
+                   the durable hand-over; only after this may clients see
+                   the new proxies
+  FULLY_RECOVERED  announce ServerDBInfo to the CC, retire generations
+                   older than ours on all workers, then watch every
+                   recruited role host: any failure ends this master, and
+                   the CC recruits a successor (the whole transaction
+                   subsystem is disposable, SURVEY.md §5)
+
+The first commit version of a post-crash epoch jumps past the MVCC window
+(Master.first_jump) so pre-recovery read snapshots resolve TOO_OLD at the
+fresh resolvers instead of silently missing lost conflict history.
+"""
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Dict, List, Optional, Tuple
+
+from ..core import error
+from ..core.trace import TraceEvent
+from ..ops.host_engine import KeyShardMap
+from ..sim.actors import all_of, any_of
+from ..sim.loop import TaskPriority, delay, spawn
+from ..sim.network import Endpoint
+from .coordinated_state import CoordinatedState, DBCoreState, LogGenerationInfo
+from .log_system import LogSystemConfig, fetch_recovery_data, lock_generation
+from .master import GET_COMMIT_VERSION_TOKEN, Master, RECOVERY_VERSION_JUMP
+from .proxy import ProxyConfig
+from .resolver import RESOLVE_TOKEN
+from .wait_failure import WAIT_FAILURE_TOKEN, wait_failure_client
+from .worker import (
+    InitializeProxyRequest,
+    InitializeResolverRequest,
+    InitializeStorageRequest,
+    InitializeTLogRequest,
+    INIT_PROXY_TOKEN,
+    INIT_RESOLVER_TOKEN,
+    INIT_STORAGE_TOKEN,
+    INIT_TLOG_TOKEN,
+    RETIRE_TOKEN,
+    RetireGenerationsRequest,
+    ServerDBInfo,
+)
+
+RECRUIT_TIMEOUT = 2.0
+
+
+class MasterServer:
+    def __init__(self, worker, req):
+        self.worker = worker
+        self.net = worker.net
+        self.proc = worker.proc
+        self.coords = req.coordinator_addrs
+        self.workers = list(req.worker_addrs)
+        self.salt = req.salt
+        self.cc_addr = req.cc_addr
+        self.cfg = req.cluster_cfg
+        self.master: Optional[Master] = None
+
+    def _state(self, s: str, **details) -> None:
+        ev = TraceEvent("MasterRecoveryState", id=self.salt).detail("State", s)
+        for k, v in details.items():
+            ev.detail(k, v)
+        ev.log()
+
+    def _init_role(self, addr: str, token: str, req):
+        """Future of the role's Initialize reply (awaitable or all_of-able)."""
+        return self.net.request(
+            self.proc.address, Endpoint(addr, token), req,
+            TaskPriority.CLUSTER_CONTROLLER, timeout=RECRUIT_TIMEOUT,
+        )
+
+    async def run(self) -> None:
+        try:
+            await self._recover_and_serve()
+        except error.FDBError as e:
+            TraceEvent("MasterTerminated", id=self.salt).detail("Reason", e.name).log()
+            if self.master is not None:
+                self.master.unregister()
+            # Falling out ends the role; the worker unregisters our
+            # wait-failure token and the CC recruits a successor.
+
+    async def _recover_and_serve(self) -> None:
+        cfg = self.cfg
+        # -- READING_CSTATE / LOCKING_CSTATE ---------------------------------
+        self._state("reading_cstate")
+        cstate = CoordinatedState(self.net, self.proc.address, self.coords, self.salt)
+        prev: Optional[DBCoreState] = await cstate.read()
+        first_boot = prev is None
+        prev = prev or DBCoreState()
+        rc = prev.recovery_count + 1
+        self._state("locking_cstate", RecoveryCount=rc)
+        await cstate.set_exclusive(replace(prev, recovery_count=rc))
+
+        # -- LOCKING_TLOGS: end the previous epoch ---------------------------
+        preload: Dict[int, list] = {}
+        preload_popped: Dict[int, int] = {}
+        if prev.generations:
+            old_cfg: LogSystemConfig = prev.generations[-1].config
+            self._state("locking_tlogs", OldGen=str(old_cfg.gen_id))
+            while True:
+                try:
+                    recovery_version, src_addr = await lock_generation(
+                        self.net, self.proc.address, old_cfg
+                    )
+                    break
+                except error.FDBError:
+                    # Every replica unreachable: the un-popped window is
+                    # unrecoverable until one returns. Wait, not guess.
+                    await delay(1.0, TaskPriority.CLUSTER_CONTROLLER)
+            data = await fetch_recovery_data(
+                self.net, self.proc.address, old_cfg, src_addr, recovery_version
+            )
+            preload, preload_popped = data.tag_data, data.popped
+            first_jump = RECOVERY_VERSION_JUMP
+        else:
+            recovery_version = 1
+            first_jump = 0
+        self._state("recruiting", RecoveryVersion=recovery_version)
+
+        # -- RECRUITING ------------------------------------------------------
+        # Storage is stateful: keep it on dedicated workers and recruit the
+        # disposable transaction roles on the rest (the reference's
+        # process-class fitness, reduced to storage-vs-stateless).
+        alive = [w for w in self.workers if not self.net.monitor.is_failed(w)]
+        if first_boot:
+            storage_workers = sorted(alive)[-cfg.n_storage:]
+        else:
+            storage_workers = sorted({t[3] for t in prev.storage_tags})
+        workers = [w for w in alive if w not in storage_workers] or alive
+        if len(workers) < 1:
+            raise error.recruitment_failed("no live workers")
+        gen_id = (rc, self.salt)
+        suffix = f":{rc}.{self.salt}"
+
+        def pick(n: int, offset: int) -> List[str]:
+            return [workers[(offset + i) % len(workers)] for i in range(n)]
+
+        tlog_addrs = pick(cfg.n_tlogs, 0)
+        resolver_addrs = pick(cfg.n_resolvers, cfg.n_tlogs)
+        proxy_addr = pick(1, cfg.n_tlogs + cfg.n_resolvers)[0]
+
+        # Per-replica token suffixes: duplicate placement (a thin worker
+        # pool) degrades fault isolation but must never alias two role
+        # instances into one (that would split one version stream).
+        tlog_reps = tuple((a, f"{suffix}.{i}") for i, a in enumerate(tlog_addrs))
+        new_log = LogSystemConfig(
+            gen_id=gen_id, tlogs=tlog_reps, start_version=recovery_version,
+        )
+        await all_of([
+            self._init_role(a, INIT_TLOG_TOKEN, InitializeTLogRequest(
+                gen_id=gen_id, start_version=recovery_version,
+                token_suffix=rep_suffix, replica_index=i,
+                preload=preload, preload_popped=preload_popped,
+            ))
+            for i, (a, rep_suffix) in enumerate(tlog_reps)
+        ])
+        await all_of([
+            self._init_role(a, INIT_RESOLVER_TOKEN, InitializeResolverRequest(
+                gen_id=gen_id, start_version=recovery_version,
+                token_suffix=f"{suffix}.{i}", replica_index=i,
+            ))
+            for i, a in enumerate(resolver_addrs)
+        ])
+
+        # Seed storage servers on first boot (newSeedServers:325).
+        if first_boot:
+            storage_shards = KeyShardMap.uniform(cfg.n_storage)
+            storage_tags = []
+            for tag in range(cfg.n_storage):
+                begin = storage_shards.begins[tag]
+                end = storage_shards.span_end(tag) or b"\xff\xff\xff"
+                addr = storage_workers[tag % len(storage_workers)]
+                await self._init_role(addr, INIT_STORAGE_TOKEN,
+                                      InitializeStorageRequest(tag=tag, begin=begin, end=end))
+                storage_tags.append((tag, begin, end, addr))
+            storage_tags = tuple(storage_tags)
+        else:
+            storage_tags = prev.storage_tags
+
+        # -- RECOVERY_TRANSACTION (masterserver.actor.cpp:730-780) -----------
+        # The master itself commits the first (empty) transaction of the new
+        # epoch, at recovery_version + jump: it drives the version chain —
+        # and with it the tlog KCV horizon and the storage servers — past
+        # the MVCC-window jump. Without it, a post-recovery cluster
+        # deadlocks: reads need storage at the jumped GRV, storage advances
+        # only on commits, and every client transaction starts with a read.
+        recovery_txn_version = recovery_version + max(first_jump, 1)
+        from .log_system import LogSystemClient
+        from .messages import ResolveTransactionBatchRequest
+
+        log_client = LogSystemClient(self.net, self.proc.address, new_log)
+        self._state("recovery_transaction", Version=recovery_txn_version)
+        await all_of([
+            self.net.request(
+                self.proc.address, Endpoint(a, RESOLVE_TOKEN + f"{suffix}.{i}"),
+                ResolveTransactionBatchRequest(
+                    prev_version=recovery_version, version=recovery_txn_version,
+                    last_received_version=recovery_version, transactions=[],
+                ),
+                TaskPriority.PROXY_RESOLVER_REPLY, timeout=RECRUIT_TIMEOUT,
+            )
+            for i, a in enumerate(resolver_addrs)
+        ])
+        await log_client.push(recovery_version, recovery_txn_version, {},
+                              known_committed=recovery_version)
+
+        # Version authority for the new epoch, starting past the recovery
+        # transaction.
+        self.master = Master(self.proc, start_version=recovery_txn_version,
+                             token_suffix=suffix)
+
+        storage_shards = KeyShardMap.uniform(len(storage_tags))
+        proxy_cfg = ProxyConfig(
+            master_ep=Endpoint(self.proc.address, GET_COMMIT_VERSION_TOKEN + suffix),
+            resolver_eps=[Endpoint(a, RESOLVE_TOKEN + f"{suffix}.{i}")
+                          for i, a in enumerate(resolver_addrs)],
+            resolver_shards=KeyShardMap.uniform(cfg.n_resolvers),
+            log_config=new_log,
+            storage_addrs=[t[3] for t in storage_tags],
+            storage_shards=storage_shards,
+            master_wf_ep=Endpoint(self.proc.address, f"waitFailure:master:{self.salt}"),
+        )
+        await self._init_role(proxy_addr, INIT_PROXY_TOKEN, InitializeProxyRequest(
+            gen_id=gen_id, cfg=proxy_cfg, start_version=recovery_txn_version,
+        ))
+
+        # -- WRITING_CSTATE: the durable hand-over ---------------------------
+        self._state("writing_cstate")
+        await cstate.set_exclusive(DBCoreState(
+            recovery_count=rc,
+            generations=(LogGenerationInfo(config=new_log, end_version=None),),
+            storage_tags=storage_tags,
+        ))
+
+        # -- FULLY_RECOVERED -------------------------------------------------
+        info = ServerDBInfo(
+            recovery_count=rc, recovery_state="fully_recovered",
+            master_addr=self.proc.address, proxy_addrs=(proxy_addr,),
+            log_config=new_log, storage_tags=storage_tags,
+        )
+        from .cluster_controller import CC_MASTER_RECOVERED_TOKEN
+
+        self.net.one_way(self.proc.address,
+                         Endpoint(self.cc_addr, CC_MASTER_RECOVERED_TOKEN), info,
+                         TaskPriority.CLUSTER_CONTROLLER)
+        # Predecessor generations are now unreachable from the cstate:
+        # retire their roles everywhere (best-effort one-ways).
+        for a in self.workers:
+            self.net.one_way(self.proc.address, Endpoint(a, RETIRE_TOKEN),
+                             RetireGenerationsRequest(keep_min=rc),
+                             TaskPriority.CLUSTER_CONTROLLER)
+        self._state("fully_recovered", RecoveryCount=rc)
+
+        # Serve until any recruited role host dies (process-level watch;
+        # role death on a live worker only happens when a successor
+        # generation replaces us, in which case we are dead already).
+        watch_addrs = sorted(set(tlog_addrs + resolver_addrs + [proxy_addr]))
+        watchers = [
+            spawn(
+                wait_failure_client(self.net, self.proc.address,
+                                    Endpoint(a, WAIT_FAILURE_TOKEN)),
+                TaskPriority.FAILURE_MONITOR, name=f"masterWatch:{a}",
+            )
+            for a in watch_addrs
+        ]
+        try:
+            await any_of(watchers)
+        finally:
+            for w in watchers:
+                w.cancel()
+        self.master.unregister()
+        raise error.master_tlog_failed("a transaction-role host failed")

@@ -53,11 +53,50 @@ class ResolveTransactionBatchReply:
 @dataclass
 class TLogCommitRequest:
     """reference: TLogCommitRequest (TLogInterface.h); messages are
-    (tag -> mutations) for one commit version."""
+    (tag -> mutations) for one commit version. gen_id scopes the push to
+    one log generation; known_committed is the proxy's newest all-replica-
+    acked version (the KCV the peek horizon rides on)."""
 
     prev_version: Version
     version: Version
     messages: Dict[int, List[Mutation]] = field(default_factory=dict)
+    gen_id: Tuple[int, int] = (0, 0)
+    known_committed: Version = 0
+
+
+@dataclass
+class TLogKnownCommittedRequest:
+    """All replicas acked `version`; advance the peek horizon."""
+
+    version: Version
+
+
+@dataclass
+class TLogLockRequest:
+    """End this generation (reference: TLogLockResult via tLogLock:496)."""
+
+    pass
+
+
+@dataclass
+class TLogLockReply:
+    gen_id: Tuple[int, int]
+    known_committed: Version
+    end_version: Version
+
+
+@dataclass
+class TLogRecoveryDataRequest:
+    """Fetch all un-popped data <= end_version for seeding the successor
+    generation."""
+
+    end_version: Version
+
+
+@dataclass
+class TLogRecoveryDataReply:
+    tag_data: Dict[int, List[Tuple[Version, List[Mutation]]]] = field(default_factory=dict)
+    popped: Dict[int, Version] = field(default_factory=dict)
 
 
 @dataclass

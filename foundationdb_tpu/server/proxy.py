@@ -38,9 +38,10 @@ from ..core.types import (
     transform_versionstamp_mutation,
 )
 from ..ops.host_engine import KeyShardMap
-from ..sim.actors import NotifiedVersion, PromiseStream, all_of, any_of
+from ..sim.actors import ActorCollection, NotifiedVersion, PromiseStream, all_of, any_of
 from ..sim.loop import Future, Promise, TaskPriority, delay, spawn
 from ..sim.network import Endpoint, SimProcess
+from .log_system import LogSystemClient, LogSystemConfig
 from .messages import (
     CommitReply,
     CommitTransactionRequest,
@@ -51,11 +52,7 @@ from .messages import (
     GetReadVersionRequest,
     ResolveTransactionBatchReply,
     ResolveTransactionBatchRequest,
-    TLogCommitRequest,
 )
-from .master import GET_COMMIT_VERSION_TOKEN
-from .resolver import RESOLVE_TOKEN
-from .tlog import COMMIT_TOKEN as TLOG_COMMIT_TOKEN
 
 GRV_TOKEN = "proxy.getReadVersion"
 COMMIT_TOKEN = "proxy.commit"
@@ -69,15 +66,25 @@ MAX_COMMIT_BATCH = 512
 #: rather than wedge the pipeline forever (round-2 review finding).
 SERVER_REQUEST_TIMEOUT = 5.0
 
+_TLOG_STOPPED = error.tlog_stopped("").code
+
 
 @dataclass
 class ProxyConfig:
-    master_addr: str
-    resolver_addrs: List[str]
+    """Wiring for one proxy of one generation: the master and resolvers are
+    endpoint-addressed (tokens carry the generation suffix so a stale proxy
+    can never reach a newer generation's roles), and commits flow through
+    the replicated log system rather than a single tlog."""
+
+    master_ep: Endpoint
+    resolver_eps: List[Endpoint]
     resolver_shards: KeyShardMap
-    tlog_addr: str
+    log_config: LogSystemConfig
     storage_addrs: List[str]
     storage_shards: KeyShardMap
+    #: the master's role-scoped wait-failure endpoint; the proxy watches it
+    #: and shuts down when the master dies (its generation is over)
+    master_wf_ep: Optional[Endpoint] = None
 
 
 class Proxy:
@@ -85,6 +92,8 @@ class Proxy:
         self.proc = proc
         self.net = net
         self.cfg = cfg
+        self.log = LogSystemClient(net, proc.address, cfg.log_config,
+                                   push_timeout=SERVER_REQUEST_TIMEOUT)
         self.committed_version = NotifiedVersion(start_version)
         self.batch_resolving = NotifiedVersion(0)
         self.batch_logging = NotifiedVersion(0)
@@ -100,19 +109,50 @@ class Proxy:
         self._pending_master_req: Dict[int, int] = {}
         self._grv_waiters: List[Promise] = []
         self._commit_queue: PromiseStream = PromiseStream()
+        self._dead = False
+        #: proxy-owned tasks: cancelled on shutdown() without touching other
+        #: roles hosted by the same worker process
+        self.actors = ActorCollection()
         proc.register(GRV_TOKEN, self.get_read_version)
         proc.register(COMMIT_TOKEN, self.commit)
         proc.register(LOCATIONS_TOKEN, self.get_key_server_locations)
-        proc.actors.add(spawn(self.commit_batcher(), TaskPriority.PROXY_COMMIT_BATCHER, name="commitBatcher"))
+        self._spawn(self.commit_batcher(), TaskPriority.PROXY_COMMIT_BATCHER, "commitBatcher")
+        if cfg.master_wf_ep is not None:
+            self._spawn(self._watch_master(), TaskPriority.FAILURE_MONITOR, "watchMaster")
+
+    async def _watch_master(self) -> None:
+        """The master's death ends this generation: stop serving
+        (reference: proxies monitor masterLifetime through ServerDBInfo)."""
+        from .wait_failure import wait_failure_client
+
+        await wait_failure_client(self.net, self.proc.address, self.cfg.master_wf_ep)
+        self.shutdown()
+
+    def _spawn(self, coro, priority, name):
+        t = spawn(coro, priority, name=name)
+        self.proc.actors.add(t)
+        self.actors.add(t)
+        return t
+
+    def shutdown(self) -> None:
+        """This generation is over (epoch ended by a successor, or the role
+        was replaced): stop serving, cancel proxy-owned actors. In-flight
+        clients get commit_unknown_result via cancellation/broken futures —
+        the honest answer, since the successor generation decides which of
+        our versions survived."""
+        if self._dead:
+            return
+        self._dead = True
+        for tok in (GRV_TOKEN, COMMIT_TOKEN, LOCATIONS_TOKEN):
+            self.proc.unregister(tok)
+        self.actors.cancel_all()
 
     # -- GRV path ------------------------------------------------------------
     async def get_read_version(self, req: GetReadVersionRequest) -> GetReadVersionReply:
         p = Promise()
         self._grv_waiters.append(p)
         if len(self._grv_waiters) == 1:
-            self.proc.actors.add(
-                spawn(self._grv_flush(), TaskPriority.PROXY_GRV_TIMER, name="grvBatch")
-            )
+            self._spawn(self._grv_flush(), TaskPriority.PROXY_GRV_TIMER, "grvBatch")
         await p.future
         return GetReadVersionReply(version=self.committed_version.get())
 
@@ -151,12 +191,10 @@ class Proxy:
                 batch.append(pending.get())
                 pending = self._commit_queue.stream.pop()
             self._batch_num += 1
-            self.proc.actors.add(
-                spawn(
-                    self.commit_batch(self._batch_num, batch),
-                    TaskPriority.PROXY_COMMIT_DISPATCH,
-                    name=f"commitBatch:{self._batch_num}",
-                )
+            self._spawn(
+                self.commit_batch(self._batch_num, batch),
+                TaskPriority.PROXY_COMMIT_DISPATCH,
+                f"commitBatch:{self._batch_num}",
             )
 
     async def commit_batch(self, bn: int, items: List[Tuple[CommitTransaction, Promise]]) -> None:
@@ -164,31 +202,37 @@ class Proxy:
             await self._commit_batch_impl(bn, items)
         except error.FDBError as e:
             # A role failed mid-batch: clients must assume the worst
-            # (commit_unknown_result) until recovery rounds land.
+            # (commit_unknown_result); epoch-end recovery decides which
+            # in-flight versions survived.
             self.batch_resolving.advance(bn)
             self.batch_logging.advance(bn)
             versions = self._batch_versions.pop(bn, None)
             pending_rn = self._pending_master_req.pop(bn, None)
+            if e.code == _TLOG_STOPPED:
+                # Our generation has been locked by a successor: this proxy
+                # is permanently done. No repair — the successor owns the
+                # chain now.
+                for _, pr in items:
+                    if not pr.is_set:
+                        pr.send_error(error.commit_unknown_result(e.name))
+                self.shutdown()
+                return
             if versions is not None:
                 # Version v is in the master's chain but may never have
                 # reached the resolvers/tlog; plug the hole or every later
                 # batch waits on when_at_least(v) forever. Resolvers and the
                 # tlog dedupe versions, so repair is idempotent.
-                self.proc.actors.add(
-                    spawn(self._repair_chain(*versions), TaskPriority.PROXY_COMMIT, name=f"repair:{bn}")
-                )
+                self._spawn(self._repair_chain(*versions), TaskPriority.PROXY_COMMIT, f"repair:{bn}")
             elif pending_rn is not None:
                 # The GetCommitVersion reply was lost (request_maybe_delivered)
                 # — the master may still have advanced its chain for us. Ask
                 # again with the same request_num: the dedup window replays the
                 # same (prev, version) pair if the original landed, or mints a
                 # fresh pair (which we immediately plug) if it never did.
-                self.proc.actors.add(
-                    spawn(
-                        self._repair_unknown_version(pending_rn),
-                        TaskPriority.PROXY_COMMIT,
-                        name=f"repairUnknown:{bn}",
-                    )
+                self._spawn(
+                    self._repair_unknown_version(pending_rn),
+                    TaskPriority.PROXY_COMMIT,
+                    f"repairUnknown:{bn}",
                 )
             for _, p in items:
                 if not p.is_set:
@@ -199,29 +243,35 @@ class Proxy:
         plug the resulting chain hole (ADVICE r1: a lost master reply after
         the master advanced left an orphaned version that stalled every later
         batch's when_at_least)."""
-        while True:
+        while not self._dead:
             try:
                 vr = await self.net.request(
                     self.proc.address,
-                    Endpoint(self.cfg.master_addr, GET_COMMIT_VERSION_TOKEN),
+                    self.cfg.master_ep,
                     GetCommitVersionRequest(request_num, self.proc.address),
                     TaskPriority.PROXY_COMMIT,
                     timeout=SERVER_REQUEST_TIMEOUT,
                 )
                 break
-            except error.FDBError:
+            except error.FDBError as e:
+                if e.code == _TLOG_STOPPED:
+                    self.shutdown()
+                    return
                 await delay(0.1)
+        if self._dead:
+            return
         await self._repair_chain(vr.prev_version, vr.version)
 
     async def _repair_chain(self, prev_v: Version, v: Version) -> None:
         """Push an empty batch for (prev_v, v) until every chained consumer
-        has it (the stand-in for epoch-ending recovery this round)."""
-        while True:
+        has it; epoch-ending recovery supersedes it when this generation is
+        deposed (shutdown cancels the loop)."""
+        while not self._dead:
             try:
-                for r, addr in enumerate(self.cfg.resolver_addrs):
+                for ep in self.cfg.resolver_eps:
                     await self.net.request(
                         self.proc.address,
-                        Endpoint(addr, RESOLVE_TOKEN),
+                        ep,
                         ResolveTransactionBatchRequest(
                             prev_version=prev_v, version=v,
                             last_received_version=prev_v, transactions=[],
@@ -229,22 +279,19 @@ class Proxy:
                         TaskPriority.PROXY_RESOLVER_REPLY,
                         timeout=SERVER_REQUEST_TIMEOUT,
                     )
-                await self.net.request(
-                    self.proc.address,
-                    Endpoint(self.cfg.tlog_addr, TLOG_COMMIT_TOKEN),
-                    TLogCommitRequest(prev_version=prev_v, version=v, messages={}),
-                    TaskPriority.PROXY_COMMIT,
-                    timeout=SERVER_REQUEST_TIMEOUT,
-                )
+                await self.log.push(prev_v, v, {}, self.committed_version.get())
                 if v > self.committed_version.get():
                     self.committed_version.set(v)
                 return
-            except error.FDBError:
+            except error.FDBError as e:
+                if e.code == _TLOG_STOPPED:
+                    self.shutdown()
+                    return
                 await delay(0.1)
 
     async def _commit_batch_impl(self, bn: int, items: List[Tuple[CommitTransaction, Promise]]) -> None:
         cfg = self.cfg
-        n_res = len(cfg.resolver_addrs)
+        n_res = len(cfg.resolver_eps)
 
         # ---- Phase 1: take a commit version, in batch order (:361) ----
         await self.batch_resolving.when_at_least(bn - 1)
@@ -252,7 +299,7 @@ class Proxy:
         self._pending_master_req[bn] = self._request_num
         vr = await self.net.request(
             self.proc.address,
-            Endpoint(cfg.master_addr, GET_COMMIT_VERSION_TOKEN),
+            cfg.master_ep,
             GetCommitVersionRequest(self._request_num, self.proc.address),
             TaskPriority.PROXY_COMMIT,
             timeout=SERVER_REQUEST_TIMEOUT,
@@ -294,7 +341,7 @@ class Proxy:
         resolve_futures = [
             self.net.request(
                 self.proc.address,
-                Endpoint(addr, RESOLVE_TOKEN),
+                ep,
                 ResolveTransactionBatchRequest(
                     prev_version=prev_v,
                     version=v,
@@ -304,7 +351,7 @@ class Proxy:
                 TaskPriority.PROXY_RESOLVER_REPLY,
                 timeout=SERVER_REQUEST_TIMEOUT,
             )
-            for r, addr in enumerate(cfg.resolver_addrs)
+            for r, ep in enumerate(cfg.resolver_eps)
         ]
         self.batch_resolving.advance(bn)
         replies: List[ResolveTransactionBatchReply] = await all_of(resolve_futures)
@@ -340,13 +387,7 @@ class Proxy:
 
         # ---- Phase 4: log, in version order (:805) ----
         await self.batch_logging.when_at_least(bn - 1)
-        await self.net.request(
-            self.proc.address,
-            Endpoint(cfg.tlog_addr, TLOG_COMMIT_TOKEN),
-            TLogCommitRequest(prev_version=prev_v, version=v, messages=messages),
-            TaskPriority.PROXY_COMMIT,
-            timeout=SERVER_REQUEST_TIMEOUT,
-        )
+        await self.log.push(prev_v, v, messages, self.committed_version.get())
         self.batch_logging.advance(bn)
 
         # ---- Phase 5: report (:824-860) ----

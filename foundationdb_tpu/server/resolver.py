@@ -25,17 +25,23 @@ RESOLVE_TOKEN = "resolver.resolve"
 
 
 class Resolver:
-    def __init__(self, proc: SimProcess, engine, start_version: Version = 0):
+    def __init__(self, proc: SimProcess, engine, start_version: Version = 0,
+                 token_suffix: str = ""):
         """`engine` implements resolve(transactions, now, new_oldest) and
         clear(version) — OracleConflictEngine, JaxConflictEngine or
-        ShardedConflictEngine (ops/, parallel/)."""
+        ShardedConflictEngine (ops/, parallel/). token_suffix scopes the
+        endpoint to one recovery generation."""
         self.proc = proc
         self.engine = engine
         self.version = NotifiedVersion(start_version)
+        self.token = RESOLVE_TOKEN + token_suffix
         # replay window: version -> reply, for proxy retries after
         # request_maybe_delivered (reference keeps recentStateTransactions)
         self._recent: Dict[Version, ResolveTransactionBatchReply] = {}
-        proc.register(RESOLVE_TOKEN, self.resolve_batch)
+        proc.register(self.token, self.resolve_batch)
+
+    def unregister(self) -> None:
+        self.proc.unregister(self.token)
 
     async def resolve_batch(self, req: ResolveTransactionBatchRequest) -> ResolveTransactionBatchReply:
         """reference: resolveBatch, Resolver.actor.cpp:71-260."""

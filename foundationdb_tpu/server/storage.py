@@ -24,16 +24,15 @@ from ..core.types import (
     Version,
     apply_atomic_op,
 )
-from ..sim.actors import NotifiedVersion
+from ..sim.actors import AsyncVar, NotifiedVersion
 from ..sim.loop import TaskPriority, delay, spawn
 from ..sim.network import Endpoint, SimProcess
+from .log_system import LogSystemClient, LogSystemConfig
 from .messages import (
     GetKeyValuesReply,
     GetKeyValuesRequest,
     GetValueReply,
     GetValueRequest,
-    TLogPeekRequest,
-    TLogPopRequest,
 )
 
 GET_VALUE_TOKEN = "storage.getValue"
@@ -123,18 +122,20 @@ class StorageServer:
         proc: SimProcess,
         tag: int,
         shard: KeyRange,
-        tlog_commit_ep: Endpoint,
-        tlog_peek_ep: Endpoint,
-        tlog_pop_ep: Endpoint,
+        log_view: AsyncVar,
         net,
         start_version: Version = 0,
     ):
+        """`log_view` is an AsyncVar[LogSystemConfig | None]: the current
+        log generation to pull from. Recovery re-points it (the worker's
+        ServerDBInfo watch), and the update loop follows — the analog of
+        the reference storage server tracking the log system through
+        ServerDBInfo broadcasts (storageserver.actor.cpp update:2340)."""
         self.proc = proc
         self.tag = tag
         self.shard = shard
         self.net = net
-        self.peek_ep = tlog_peek_ep
-        self.pop_ep = tlog_pop_ep
+        self.log_view = log_view
         self.store = VersionedStore()
         self.version = NotifiedVersion(start_version)
         proc.register(GET_VALUE_TOKEN, self.get_value)
@@ -162,15 +163,16 @@ class StorageServer:
         just retries; a blocked peek is re-armed every few virtual seconds so
         a partitioned-then-healed link recovers."""
         while True:
+            cfg = self.log_view.get()
+            if cfg is None:
+                await self.log_view.on_change()
+                continue
+            client = LogSystemClient(self.net, self.proc.address, cfg)
             try:
-                reply = await self.net.request(
-                    self.proc.address,
-                    self.peek_ep,
-                    TLogPeekRequest(tag=self.tag, begin_version=self.version.get() + 1),
-                    TaskPriority.TLOG_PEEK,
-                    timeout=5.0,
-                )
+                reply = await client.peek(self.tag, self.version.get() + 1)
             except error.FDBError:
+                # tlog death / partition / generation turnover: re-read the
+                # view and retry (peeks are idempotent).
                 await delay(0.5, TaskPriority.TLOG_PEEK)
                 continue
             for v, muts in reply.messages:
@@ -183,12 +185,7 @@ class StorageServer:
                 window = self.version.get() - MAX_WRITE_TRANSACTION_LIFE_VERSIONS
                 if window > 0:
                     self.store.forget_before(window)
-                self.net.one_way(
-                    self.proc.address,
-                    self.pop_ep,
-                    TLogPopRequest(tag=self.tag, version=self.version.get()),
-                    TaskPriority.TLOG_POP,
-                )
+                client.pop(self.tag, self.version.get())
 
     # -- read path -----------------------------------------------------------
     async def _wait_for_version(self, version: Version) -> None:

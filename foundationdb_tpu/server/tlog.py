@@ -1,56 +1,113 @@
-"""TLog: tag-partitioned in-memory durable log.
+"""TLog: tag-partitioned replicated in-memory durable log, epoch-aware.
 
-Round-1 scope of fdbserver/TLogServer.actor.cpp: commits arrive per version
-with messages already bucketed by destination tag (tLogCommit:1158), are
-serialized by (prev_version -> version) chaining, indexed per tag, and
-served to storage servers via blocking peeks (tLogPeekMessages:950) with
-pops (tLogPop:898) trimming acknowledged prefixes. The DiskQueue + spill
-machinery arrives with the durable-storage round; in-memory plus a simulated
-fsync delay preserves the commit path's latency structure.
+Round-2 scope of fdbserver/TLogServer.actor.cpp: a log generation is K tlog
+replicas; the proxy pushes every commit to all of them and acks the client
+only when all have fsynced (all-ack = the reference's default quorum with
+anti-quorum 0). Each commit carries the proxy's known-committed version
+(KCV: the newest version already acked by every replica); peeks serve data
+only up to min(durable, KCV), so a storage server can never apply a version
+that epoch-end recovery might discard — which is what lets recovery skip
+storage rollbacks entirely.
+
+Epoch end (tLogLock:496): a recovering master locks the generation; a
+locked tlog rejects further commits (tlog_stopped) and reports
+(known_committed, durable end). Locking any single replica freezes the
+generation, because all-ack pushes can no longer complete. Commits carry
+the generation id; a tlog rejects pushes from any other generation, so an
+orphaned previous master's proxies cannot write into a newer generation.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..core.types import Mutation, Version
+from ..core import error
 from ..sim.actors import NotifiedVersion
 from ..sim.loop import TaskPriority, delay
 from ..sim.network import SimProcess
 from .messages import (
     TLogCommitRequest,
+    TLogKnownCommittedRequest,
+    TLogLockReply,
+    TLogLockRequest,
     TLogPeekReply,
     TLogPeekRequest,
     TLogPopRequest,
+    TLogRecoveryDataReply,
+    TLogRecoveryDataRequest,
 )
 
 COMMIT_TOKEN = "tlog.commit"
 PEEK_TOKEN = "tlog.peek"
 POP_TOKEN = "tlog.pop"
+LOCK_TOKEN = "tlog.lock"
+KCV_TOKEN = "tlog.knownCommitted"
+RECOVERY_DATA_TOKEN = "tlog.recoveryData"
 
 FSYNC_SECONDS = 0.0005
 
 
 class TLog:
-    def __init__(self, proc: SimProcess, start_version: Version = 0):
+    def __init__(
+        self,
+        proc: SimProcess,
+        start_version: Version = 0,
+        gen_id: Tuple[int, int] = (0, 0),
+        preload: Optional[Dict[int, List[Tuple[Version, List[Mutation]]]]] = None,
+        preload_popped: Optional[Dict[int, Version]] = None,
+        token_suffix: str = "",
+    ):
+        """gen_id = (recovery_count, master_salt): pushes from any other
+        generation are rejected. `preload` seeds the tag index with the
+        previous generation's un-popped data (the recovery copy), covering
+        versions <= start_version. token_suffix distinguishes multiple
+        generations hosted by one worker process."""
         self.proc = proc
+        self.gen_id = gen_id
         self.version = NotifiedVersion(start_version)
+        self.known_committed = NotifiedVersion(start_version)
+        self.stopped = False
         # tag -> ordered [(version, mutations)]
-        self.tag_data: Dict[int, List[Tuple[Version, List[Mutation]]]] = {}
-        self.popped: Dict[int, Version] = {}
+        self.tag_data: Dict[int, List[Tuple[Version, List[Mutation]]]] = dict(preload or {})
+        self.popped: Dict[int, Version] = dict(preload_popped or {})
         self._inflight: set = set()  # versions appended but not yet durable
-        proc.register(COMMIT_TOKEN, self.commit)
-        proc.register(PEEK_TOKEN, self.peek)
-        proc.register(POP_TOKEN, self.pop)
+        self.tokens = {
+            "commit": COMMIT_TOKEN + token_suffix,
+            "peek": PEEK_TOKEN + token_suffix,
+            "pop": POP_TOKEN + token_suffix,
+            "lock": LOCK_TOKEN + token_suffix,
+            "kcv": KCV_TOKEN + token_suffix,
+            "recovery": RECOVERY_DATA_TOKEN + token_suffix,
+        }
+        proc.register(self.tokens["commit"], self.commit)
+        proc.register(self.tokens["peek"], self.peek)
+        proc.register(self.tokens["pop"], self.pop)
+        proc.register(self.tokens["lock"], self.lock)
+        proc.register(self.tokens["kcv"], self.advance_known_committed)
+        proc.register(self.tokens["recovery"], self.recovery_data)
 
+    def unregister(self) -> None:
+        for tok in self.tokens.values():
+            self.proc.unregister(tok)
+
+    # -- write path ----------------------------------------------------------
     async def commit(self, req: TLogCommitRequest) -> Version:
         """Append one version; ack after (simulated) fsync. Returns the
-        durable version."""
+        durable version (reference: tLogCommit, TLogServer.actor.cpp:1158)."""
+        if req.gen_id != self.gen_id:
+            raise error.tlog_stopped(f"generation {req.gen_id} != {self.gen_id}")
+        if self.stopped:
+            raise error.tlog_stopped("locked by epoch end")
+        if req.known_committed > self.known_committed.get():
+            self.known_committed.set(min(req.known_committed, self.version.get()))
         if req.version <= self.version.get() or req.version in self._inflight:
             # Duplicate delivery (proxy retry) — possibly while the first
             # copy is mid-fsync; never append twice.
             await self.version.when_at_least(req.version)
             return self.version.get()
         await self.version.when_at_least(req.prev_version)
+        if self.stopped:
+            raise error.tlog_stopped("locked by epoch end")
         if req.version <= self.version.get() or req.version in self._inflight:
             await self.version.when_at_least(req.version)
             return self.version.get()
@@ -60,19 +117,36 @@ class TLog:
         await delay(FSYNC_SECONDS, TaskPriority.TLOG_COMMIT)
         # Chained waiters run only after this version is durable.
         self._inflight.discard(req.version)
+        if self.stopped:
+            # Locked mid-fsync: the append is durable locally but must not
+            # be acked — the epoch has ended and recovery's end-version math
+            # already treats it as maybe-committed.
+            raise error.tlog_stopped("locked during fsync")
         self.version.set(req.version)
+        if req.known_committed > self.known_committed.get():
+            self.known_committed.set(min(req.known_committed, self.version.get()))
         return req.version
 
+    async def advance_known_committed(self, req: TLogKnownCommittedRequest) -> None:
+        """The proxy reports all replicas acked `version` (the reference
+        piggybacks this on the next push; a dedicated message keeps peeks
+        moving on an idle system)."""
+        if self.stopped:
+            return
+        v = min(req.version, self.version.get())
+        if v > self.known_committed.get():
+            self.known_committed.set(v)
+
+    # -- read path -----------------------------------------------------------
     async def peek(self, req: TLogPeekRequest) -> TLogPeekReply:
-        """Messages for req.tag with version >= begin_version; blocks until
-        the tlog has seen begin_version so the peeker always advances."""
-        await self.version.when_at_least(req.begin_version)
+        """Messages for req.tag with version >= begin_version, clipped to
+        the known-committed horizon so nothing recovery could discard is
+        ever served (blocks until the horizon passes begin_version)."""
+        await self.known_committed.when_at_least(req.begin_version)
         data = self.tag_data.get(req.tag, [])
-        # Clip to the durable version: entries beyond it are mid-fsync and
-        # would be applied twice by a peeker that can't advance past them.
-        durable = self.version.get()
-        msgs = [(v, m) for (v, m) in data if req.begin_version <= v <= durable]
-        return TLogPeekReply(messages=msgs, end_version=durable)
+        horizon = min(self.version.get(), self.known_committed.get())
+        msgs = [(v, m) for (v, m) in data if req.begin_version <= v <= horizon]
+        return TLogPeekReply(messages=msgs, end_version=horizon)
 
     async def pop(self, req: TLogPopRequest) -> None:
         prev = self.popped.get(req.tag, 0)
@@ -82,3 +156,27 @@ class TLog:
         data = self.tag_data.get(req.tag)
         if data:
             self.tag_data[req.tag] = [(v, m) for (v, m) in data if v > req.version]
+
+    # -- epoch end -----------------------------------------------------------
+    async def lock(self, req: TLogLockRequest) -> TLogLockReply:
+        """reference: tLogLock (TLogServer.actor.cpp:496). Idempotent."""
+        self.stopped = True
+        return TLogLockReply(
+            gen_id=self.gen_id,
+            known_committed=self.known_committed.get(),
+            end_version=self.version.get(),
+        )
+
+    async def recovery_data(self, req: TLogRecoveryDataRequest) -> TLogRecoveryDataReply:
+        """All un-popped data up to the recovery version, for seeding the
+        next generation (the copy replaces the reference's old-generation
+        peek cursors; bounded by the 5s un-popped window)."""
+        clip = req.end_version
+        out = {
+            tag: [(v, m) for (v, m) in entries if v <= clip]
+            for tag, entries in self.tag_data.items()
+        }
+        return TLogRecoveryDataReply(
+            tag_data={t: e for t, e in out.items() if e},
+            popped=dict(self.popped),
+        )

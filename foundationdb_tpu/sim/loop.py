@@ -189,6 +189,17 @@ class Task(Future):
         if self._ready or self._cancelled:
             return
         self._cancelled = True
+        if _current is not self._sched:
+            # The world has been torn down (set_scheduler(None) after a
+            # finished simulation) or belongs to another simulation: drop
+            # the coroutine without running its cancellation path, which
+            # could touch the dead scheduler.
+            try:
+                self._coro.close()
+            except RuntimeError:
+                pass
+            self._finish_error(error.operation_cancelled())
+            return
         try:
             self._coro.throw(error.operation_cancelled())
             # The coroutine swallowed the cancellation and awaited again.
@@ -197,11 +208,16 @@ class Task(Future):
             self._coro.close()
         except StopIteration as stop:
             self._finish_value(stop.value)
+        except error.OperationCancelled as e:
+            self._finish_error(e)
         except FDBError as e:
             self._finish_error(e)
-        except RuntimeError:
-            # Coroutine already running (cancelled from within itself),
-            # already closed, or it ignored GeneratorExit.
+        except (RuntimeError, ValueError):
+            # RuntimeError: already closed, or ignored GeneratorExit.
+            # ValueError: "coroutine already executing" — an actor cancelled
+            # itself (e.g. a role's shutdown() cancelling its own actor
+            # collection mid-handler); it finishes its current synchronous
+            # stretch, then _step's _cancelled guard parks it forever.
             pass
         finally:
             # Whatever happened above, the task is finished now.
@@ -229,6 +245,9 @@ class Task(Future):
                 waited = self._coro.send(None)
         except StopIteration as stop:
             self._finish_value(stop.value)
+            return
+        except error.OperationCancelled as e:
+            self._finish_error(e)
             return
         except FDBError as e:
             self._finish_error(e)

@@ -11,13 +11,14 @@ from __future__ import annotations
 
 from typing import Callable, Dict
 
-from ..server.cluster import ClusterConfig
+from ..server.cluster import ClusterConfig, DynamicClusterConfig
 from .workload import Spec
 from .workloads import (
     AtomicOpsWorkload,
     ConflictRangeWorkload,
     CycleWorkload,
     IncrementWorkload,
+    MachineAttritionWorkload,
     RandomCloggingWorkload,
     RandomReadWriteWorkload,
     WriteDuringReadWorkload,
@@ -33,6 +34,31 @@ def _tpu_engine_factory():
 
 
 SPECS: Dict[str, Callable[[], Spec]] = {
+    # tests/fast/CycleTest.txt with Attrition: Cycle churn while workers
+    # hosting transaction roles are killed + rebooted — every kill forces a
+    # full epoch recovery (the reference's core correctness strategy)
+    "CycleTestAttrition": lambda: Spec(
+        title="CycleTestAttrition",
+        workloads=[
+            (CycleWorkload, {"nodes": 10, "transactions": 12, "think_time": 1.5}),
+            (MachineAttritionWorkload, {"interval": 6.0, "delay_before": 2.0}),
+            (RandomCloggingWorkload, {"scale": 0.02}),
+        ],
+        dynamic=DynamicClusterConfig(n_workers=5, n_tlogs=2, n_resolvers=2, n_storage=2),
+        client_count=2,
+        timeout=900.0,
+    ),
+    # recovery churn without clogging, heavier kill rate
+    "AttritionStress": lambda: Spec(
+        title="AttritionStress",
+        workloads=[
+            (CycleWorkload, {"nodes": 8, "transactions": 10, "think_time": 2.5}),
+            (MachineAttritionWorkload, {"interval": 4.0, "delay_before": 1.0}),
+        ],
+        dynamic=DynamicClusterConfig(n_workers=6, n_tlogs=2, n_resolvers=2, n_storage=2),
+        client_count=3,
+        timeout=900.0,
+    ),
     # tests/fast/CycleTest.txt: Cycle + RandomClogging ×2
     "CycleTest": lambda: Spec(
         title="CycleTest",

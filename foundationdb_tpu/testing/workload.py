@@ -18,7 +18,7 @@ from typing import Any, Dict, List, Optional, Type
 from ..core import error
 from ..core.rng import DeterministicRandom
 from ..client.database import Database
-from ..server.cluster import Cluster, ClusterConfig
+from ..server.cluster import Cluster, ClusterConfig, DynamicCluster, DynamicClusterConfig
 from ..sim.actors import all_of
 from ..sim.loop import Future, set_scheduler
 from ..sim.simulator import Simulator
@@ -78,6 +78,10 @@ class Spec:
     title: str
     workloads: List[tuple] = field(default_factory=list)  # (cls, options)
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    #: when set, the spec runs against a recruitment-era DynamicCluster
+    #: (coordinators + workers + recovery) instead of the static assembly —
+    #: required for attrition workloads
+    dynamic: Optional[DynamicClusterConfig] = None
     client_count: int = 1
     timeout: float = 3600.0
 
@@ -93,7 +97,10 @@ class SpecResult:
 def run_spec(spec: Spec, seed: int) -> SpecResult:
     """Deterministic: same spec+seed -> same result and metrics."""
     sim = Simulator(seed)
-    cluster = Cluster(sim, spec.cluster)
+    if spec.dynamic is not None:
+        cluster = DynamicCluster(sim, spec.dynamic)
+    else:
+        cluster = Cluster(sim, spec.cluster)
     instances: List[TestWorkload] = []
     for cls, options in spec.workloads:
         shared: Dict[str, Any] = {}

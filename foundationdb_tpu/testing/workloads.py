@@ -50,6 +50,10 @@ class CycleWorkload(TestWorkload):
 
     async def start(self, db: Database) -> None:
         count = int(self.ctx.options.get("transactions", 20))
+        # Pace transactions so the run overlaps with injected faults
+        # (reference: transactionsPerSecond paces Cycle.actor.cpp; without
+        # pacing the workload finishes before attrition ever fires).
+        think = float(self.ctx.options.get("think_time", 0.0))
         for _ in range(count):
             async def body(tr):
                 r = self.ctx.rng.random_int(0, self.n)
@@ -62,10 +66,14 @@ class CycleWorkload(TestWorkload):
 
             await db.run(body)
             self.ctx.count("cycle_txns")
+            if think > 0:
+                await delay(think * self.ctx.rng.random01() * 2)
 
     async def check(self, db: Database) -> bool:
-        tr = db.create_transaction()
-        got = await tr.get_range(b"cycle/", b"cycle0")
+        async def read_all(tr):
+            return await tr.get_range(b"cycle/", b"cycle0")
+
+        got = await db.run(read_all)
         if len(got) != self.n:
             return False
         nxt = {int(k[-4:]): int(v) for k, v in got}
@@ -100,8 +108,10 @@ class IncrementWorkload(TestWorkload):
         self.ctx.count("increments", done)
 
     async def check(self, db: Database) -> bool:
-        tr = db.create_transaction()
-        got = await tr.get_range(b"incr/", b"incr0")
+        async def read_all(tr):
+            return await tr.get_range(b"incr/", b"incr0")
+
+        got = await db.run(read_all)
         total = sum(int.from_bytes(v, "big") for _, v in got)
         return total == int(self.ctx.shared.get("increments", 0))
 
@@ -126,8 +136,10 @@ class AtomicOpsWorkload(TestWorkload):
         self.ctx.count("atomic_added", added)
 
     async def check(self, db: Database) -> bool:
-        tr = db.create_transaction()
-        got = await tr.get_range(b"atomic/", b"atomic0")
+        async def read_all(tr):
+            return await tr.get_range(b"atomic/", b"atomic0")
+
+        got = await db.run(read_all)
         total = sum(int.from_bytes(v, "little") for _, v in got)
         return total == int(self.ctx.shared.get("atomic_added", 0))
 
@@ -222,9 +234,12 @@ class WriteDuringReadWorkload(TestWorkload):
         self._final = committed
 
     async def check(self, db: Database) -> bool:
-        tr = db.create_transaction()
         pre = self._prefix
-        got = await tr.get_range(pre, pre + b"\xff")
+
+        async def read_all(tr):
+            return await tr.get_range(pre, pre + b"\xff")
+
+        got = await db.run(read_all)
         return got == self._final.get_range(pre, pre + b"\xff")
 
 
@@ -360,3 +375,62 @@ class RandomCloggingWorkload(TestWorkload):
             procs = list(sim.net.processes.values())
             victim = procs[rng.random_int(0, len(procs))]
             sim.clog_process(victim, rng.random01() * scale)
+
+
+class MachineAttritionWorkload(TestWorkload):
+    """Anti-quiescence: kill (and reboot) workers hosting transaction roles
+    while the other workloads run — the reference's core correctness
+    strategy (MachineAttrition.actor.cpp). Requires a DynamicCluster, whose
+    recovery machinery the kills exercise; storage-hosting workers are
+    spared until the durability round makes storage restartable."""
+
+    name = "MachineAttrition"
+    anti_quiescence = True
+
+    TXN_TOKENS = ("tlog.commit", "resolver.resolve", "proxy.commit",
+                  "master.getCommitVersion")
+
+    def _safe_victims(self, cluster):
+        """Kill-safety analysis (reference: ISimulator::canKillProcesses,
+        simulator.h:155): never kill the last live holder of logged data.
+        Until the durability round gives tlogs disks, a tlog host may die
+        only while every other tlog host is alive — so the un-popped window
+        always survives on at least one replica for the next recovery."""
+        tlog_hosts = [
+            p for p in cluster.worker_procs
+            if any(t.startswith("tlog.commit") for t in p.handlers)
+        ]
+        any_tlog_host_down = any(not p.alive for p in tlog_hosts)
+        out = []
+        for p in cluster.worker_procs:
+            if not p.alive:
+                continue
+            if not any(t.startswith(self.TXN_TOKENS) for t in p.handlers):
+                continue
+            if any(t.startswith("storage.") for t in p.handlers):
+                continue
+            hosts_tlog = any(t.startswith("tlog.commit") for t in p.handlers)
+            if hosts_tlog and (any_tlog_host_down or len(tlog_hosts) <= 1):
+                continue
+            out.append(p)
+        return out
+
+    async def start(self, db: Database) -> None:
+        from ..sim.simulator import KillType
+
+        # One killer only (reference MachineAttrition gates on clientId 0):
+        # concurrent independent killers defeat the safety analysis.
+        if self.ctx.client_id != 0:
+            return
+        cluster = self.ctx.cluster
+        sim = cluster.sim
+        rng = self.ctx.rng
+        interval = float(self.ctx.options.get("interval", 8.0))
+        await delay(float(self.ctx.options.get("delay_before", 4.0)))
+        while True:
+            victims = self._safe_victims(cluster)
+            if victims:
+                victim = victims[rng.random_int(0, len(victims))]
+                self.ctx.count("kills")
+                sim.kill_process(victim, KillType.REBOOT)
+            await delay(interval)

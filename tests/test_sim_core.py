@@ -117,7 +117,7 @@ def test_task_cancel_releases_waiters():
     assert not t.is_ready
     t.cancel()
     assert t.is_error
-    with pytest.raises(error.FDBError, match="operation_cancelled"):
+    with pytest.raises(error.OperationCancelled):
         t.get()
 
 
@@ -132,7 +132,7 @@ def test_cancel_forces_through_swallowed_cancellation():
     async def stubborn():
         try:
             await Future()  # never
-        except error.FDBError:
+        except error.OperationCancelled:
             cleaned.append("cleanup")
             await s.delay(1.0)  # forbidden wait during cancellation
             cleaned.append("unreachable")
