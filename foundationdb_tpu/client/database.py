@@ -17,6 +17,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Tuple
 
 from ..core import error
+from ..core.knobs import CLIENT_KNOBS
 from ..core.types import (
     CommitTransaction,
     Key,
@@ -468,7 +469,8 @@ class Transaction:
             self.db.note_proxy_failure()
         rng = current_scheduler().rng
         await delay(self._backoff * rng.random01())
-        self._backoff = min(self._backoff * 2, MAX_BACKOFF)
+        self._backoff = min(self._backoff * CLIENT_KNOBS.backoff_growth_rate,
+                            CLIENT_KNOBS.max_backoff)
         self.reset()
 
     def reset(self) -> None:

@@ -107,3 +107,19 @@ def _make_flow_knobs() -> Knobs:
 SERVER_KNOBS = _make_server_knobs()
 CLIENT_KNOBS = _make_client_knobs()
 FLOW_KNOBS = _make_flow_knobs()
+
+
+def reset_all() -> None:
+    """Restore every global registry to its defaults (undo per-simulation
+    BUGGIFY randomization; the reference re-inits knobs per process)."""
+    for live, make in ((SERVER_KNOBS, _make_server_knobs),
+                       (CLIENT_KNOBS, _make_client_knobs),
+                       (FLOW_KNOBS, _make_flow_knobs)):
+        live._values.update(make()._values)
+
+
+def randomize_all(rng, probability: float = 0.25) -> None:
+    """BUGGIFY-randomize every registry (fdbserver/Knobs.cpp pattern:
+    `init(KNOB, v); if(randomize && BUGGIFY) ...`)."""
+    for k in (SERVER_KNOBS, CLIENT_KNOBS, FLOW_KNOBS):
+        k.randomize(rng, probability)

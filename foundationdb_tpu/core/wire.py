@@ -1,0 +1,22 @@
+"""On-disk serialization for durable state.
+
+Stands in for the reference's byte-stable serializer (flow/serialize.h).
+The sim's durability contract only needs self-consistent bytes with
+checksums above them (disk_queue.py frames), so the stdlib pickle at a
+pinned protocol is sufficient and deterministic for identical inputs; a
+flat binary format becomes necessary only when real processes exchange
+files across versions.
+"""
+from __future__ import annotations
+
+import pickle
+
+PROTOCOL = 4
+
+
+def dumps(obj) -> bytes:
+    return pickle.dumps(obj, protocol=PROTOCOL)
+
+
+def loads(raw: bytes):
+    return pickle.loads(raw)

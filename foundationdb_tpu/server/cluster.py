@@ -136,7 +136,7 @@ class DynamicCluster:
 
         def coord_boot(simu, proc):
             async def go():
-                CoordinationServer(proc)
+                await CoordinationServer.create(proc, simu.disk_for(proc.address))
             return go()
 
         self.coord_procs = [

@@ -23,7 +23,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..core import error
+from ..core import error, wire
 from ..sim.loop import Promise, TaskPriority, delay, now, spawn
 from ..sim.network import Endpoint, SimProcess
 
@@ -186,12 +186,29 @@ class _LeaderRegister:
 
 
 class CoordinationServer:
-    """One coordinator process's servables (coordinationServer:413)."""
+    """One coordinator process's servables (coordinationServer:413).
 
-    def __init__(self, proc: SimProcess):
+    With a disk, generation registers are durable: every promise (read_gen
+    advance) and accept (write) is fsynced BEFORE the reply — the register
+    must never forget a promise it answered, or a rebooted coordinator
+    could accept a write its quorum already rejected (OnDemandStore,
+    Coordination.actor.cpp:86). Without a disk, registers live in
+    proc.globals (kept for protocol-level tests)."""
+
+    def __init__(self, proc: SimProcess, disk=None, regs=None):
         self.proc = proc
-        # Durable across REBOOT kills: live in proc.globals.
-        self.regs: Dict[str, _GenerationReg] = proc.globals.setdefault("coord.regs", {})
+        self.disk = disk
+        if regs is not None:
+            self.regs = regs
+        elif disk is None:
+            self.regs: Dict[str, _GenerationReg] = proc.globals.setdefault("coord.regs", {})
+        else:
+            self.regs = {}
+        from ..sim.actors import AsyncMutex
+
+        #: serializes register persists: interleaved write/sync cycles could
+        #: make an OLDER snapshot durable after a newer acked one
+        self._persist_mutex = AsyncMutex()
         self.leader = _LeaderRegister()   # leadership is NOT durable state
         proc.register(GENERATION_READ_TOKEN, self._gen_read)
         proc.register(GENERATION_WRITE_TOKEN, self._gen_write)
@@ -200,17 +217,63 @@ class CoordinationServer:
         proc.register(GET_LEADER_TOKEN, self._get_leader)
         proc.actors.add(spawn(self._sweeper(), TaskPriority.COORDINATION, name="coordSweep"))
 
+    @classmethod
+    async def create(cls, proc: SimProcess, disk) -> "CoordinationServer":
+        """Boot-time constructor restoring durable registers from disk.
+        State is read BEFORE any handler registers: a request served in the
+        restore window must never see an empty register (the promise would
+        be forgotten)."""
+        regs: Dict[str, _GenerationReg] = {}
+        f = disk.open("coord.regs")
+        raw = await f.read(0, f.size())
+        if raw:
+            try:
+                for key, (rg, wg, value) in wire.loads(raw).items():
+                    reg = _GenerationReg()
+                    reg.read_gen, reg.write_gen, reg.value = rg, wg, value
+                    regs[key] = reg
+            except Exception:
+                pass  # torn register file: recovered as empty (first boot)
+        return cls(proc, disk=disk, regs=regs)
+
     def _reg(self, key: str) -> _GenerationReg:
         r = self.regs.get(key)
         if r is None:
             r = self.regs[key] = _GenerationReg()
         return r
 
+    async def _persist_regs(self) -> None:
+        """Durable register snapshot, crash-safe and serialized: the
+        snapshot is taken under the persist lock (no older in-flight
+        snapshot can land after a newer acked one) and written to a fresh
+        file + rename (an in-place rewrite torn mid-crash would erase
+        previously synced promises)."""
+        if self.disk is None:
+            return
+        async with self._persist_mutex:
+            payload = wire.dumps({
+                k: (r.read_gen, r.write_gen, r.value) for k, r in self.regs.items()
+            })
+            tmp = self.disk.open("coord.regs.tmp")
+            await tmp.truncate(0)
+            await tmp.write(0, payload)
+            await tmp.sync()
+            self.disk.rename("coord.regs.tmp", "coord.regs")
+
     async def _gen_read(self, req: GenerationReadRequest) -> GenerationReadReply:
-        return self._reg(req.key).read(req.gen)
+        reg = self._reg(req.key)
+        before = reg.read_gen
+        reply = reg.read(req.gen)
+        if reg.read_gen != before:
+            # The promise must be durable before it is given.
+            await self._persist_regs()
+        return reply
 
     async def _gen_write(self, req: GenerationWriteRequest) -> GenerationWriteReply:
-        return self._reg(req.key).write(req.gen, req.value)
+        reply = self._reg(req.key).write(req.gen, req.value)
+        if reply.ok:
+            await self._persist_regs()
+        return reply
 
     async def _candidacy(self, req: CandidacyRequest) -> Optional[LeaderInfo]:
         t = now()

@@ -12,6 +12,7 @@ from __future__ import annotations
 from collections import OrderedDict
 from typing import Dict
 
+from ..core.knobs import SERVER_KNOBS
 from ..core.types import VERSIONS_PER_SECOND, Version
 from ..sim.loop import TaskPriority, now
 from ..sim.network import SimProcess
@@ -59,7 +60,7 @@ class Master:
         if cached is not None:
             return cached  # retried request: same version pair
         t = now()
-        advance = max(1, int((t - self.last_version_time) * VERSIONS_PER_SECOND))
+        advance = max(1, int((t - self.last_version_time) * SERVER_KNOBS.versions_per_second))
         prev = self.version
         self.version = prev + advance
         self.last_version_time = t

@@ -25,7 +25,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from ..core import error
+from ..core import buggify, error
+from ..core.knobs import SERVER_KNOBS
 from ..core.types import (
     CommitTransaction,
     Key,
@@ -58,8 +59,9 @@ GRV_TOKEN = "proxy.getReadVersion"
 COMMIT_TOKEN = "proxy.commit"
 LOCATIONS_TOKEN = "proxy.getKeyServerLocations"
 
-GRV_BATCH_INTERVAL = 0.0005      # reference: START_TRANSACTION_BATCH_INTERVAL_MIN
-COMMIT_BATCH_INTERVAL = 0.001    # reference: COMMIT_TRANSACTION_BATCH_INTERVAL_MIN
+#: batching intervals/caps come from the knob registry so BUGGIFY can
+#: randomize them per simulation (reference: START_TRANSACTION_BATCH_* /
+#: COMMIT_TRANSACTION_BATCH_* knobs, fdbserver/Knobs.cpp)
 MAX_COMMIT_BATCH = 512
 #: reply timeout on proxy->master/resolver/tlog requests: an alive-but-
 #: partitioned peer must fail the batch (commit_unknown_result + repair)
@@ -157,7 +159,7 @@ class Proxy:
         return GetReadVersionReply(version=self.committed_version.get())
 
     async def _grv_flush(self) -> None:
-        await delay(GRV_BATCH_INTERVAL, TaskPriority.PROXY_GRV_TIMER)
+        await delay(SERVER_KNOBS.grv_batch_interval, TaskPriority.PROXY_GRV_TIMER)
         waiters, self._grv_waiters = self._grv_waiters, []
         for p in waiters:
             p.send(None)
@@ -183,8 +185,12 @@ class Proxy:
             first = await pending
             pending = self._commit_queue.stream.pop()
             batch = [first]
-            deadline = delay(COMMIT_BATCH_INTERVAL, TaskPriority.PROXY_COMMIT_BATCHER)
-            while len(batch) < MAX_COMMIT_BATCH:
+            deadline = delay(SERVER_KNOBS.commit_transaction_batch_interval,
+                             TaskPriority.PROXY_COMMIT_BATCHER)
+            cap = min(MAX_COMMIT_BATCH, SERVER_KNOBS.commit_transaction_batch_count_max)
+            if buggify.buggify():
+                cap = 1  # force single-transaction batches: deep pipelines
+            while len(batch) < cap:
                 which, _ = await any_of([pending, deadline])
                 if which == 1:
                     break
@@ -336,6 +342,10 @@ class Proxy:
                 placed.append((r, len(per_res[r])))
                 per_res[r].append(vw)
             per_res_idx.append(placed)
+
+        if buggify.buggify():
+            # Stretch phase 1->2 so more batches pile into the pipeline.
+            await delay(0.01, TaskPriority.PROXY_COMMIT)
 
         # ---- Phase 2: resolve everywhere; next batch may start (:417) ----
         resolve_futures = [

@@ -21,10 +21,11 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Tuple
 
 from ..core.types import Mutation, Version
-from ..core import error
-from ..sim.actors import NotifiedVersion
+from ..core import buggify, error, wire
+from ..sim.actors import AsyncMutex, NotifiedVersion
 from ..sim.loop import TaskPriority, delay
 from ..sim.network import SimProcess
+from .disk_queue import DiskQueue
 from .messages import (
     TLogCommitRequest,
     TLogKnownCommittedRequest,
@@ -56,20 +57,32 @@ class TLog:
         preload: Optional[Dict[int, List[Tuple[Version, List[Mutation]]]]] = None,
         preload_popped: Optional[Dict[int, Version]] = None,
         token_suffix: str = "",
+        queue: Optional[DiskQueue] = None,
+        store_name: Optional[str] = None,
     ):
         """gen_id = (recovery_count, master_salt): pushes from any other
         generation are rejected. `preload` seeds the tag index with the
         previous generation's un-popped data (the recovery copy), covering
         versions <= start_version. token_suffix distinguishes multiple
-        generations hosted by one worker process."""
+        generations hosted by one worker process. With a DiskQueue the tlog
+        is durable: commits fsync through it (replacing the simulated-fsync
+        delay) and a rebooted worker restores the role from disk
+        (restorePersistentState, TLogServer.actor.cpp:1630)."""
         self.proc = proc
         self.gen_id = gen_id
         self.version = NotifiedVersion(start_version)
         self.known_committed = NotifiedVersion(start_version)
         self.stopped = False
+        self.queue = queue
+        self._store_name = store_name or f"tlog-{gen_id[0]}.{gen_id[1]}"
         # tag -> ordered [(version, mutations)]
         self.tag_data: Dict[int, List[Tuple[Version, List[Mutation]]]] = dict(preload or {})
         self.popped: Dict[int, Version] = dict(preload_popped or {})
+        self.tags_seen = set(self.tag_data) | set(self.popped)
+        #: append-order (version, queue end offset) for front-advance math
+        self._ver_offsets: List[Tuple[Version, int]] = []
+        self._pops_since_persist = 0
+        self._side_mutex = AsyncMutex()   # serializes side-state persists
         self._inflight: set = set()  # versions appended but not yet durable
         self.tokens = {
             "commit": COMMIT_TOKEN + token_suffix,
@@ -89,6 +102,143 @@ class TLog:
     def unregister(self) -> None:
         for tok in self.tokens.values():
             self.proc.unregister(tok)
+
+    # -- durability ----------------------------------------------------------
+    def _meta_name(self) -> str:
+        return self._store_name
+
+    def delete_files(self) -> None:
+        """Drop this retired generation's disk footprint."""
+        if self.queue is None:
+            return
+        disk = self.queue.disk
+        for suffix in (".meta", ".side", ".side.tmp", ".dq", ".dq.tmp"):
+            disk.delete(self._store_name + suffix)
+
+    async def persist_initial(self, token_suffix: str) -> None:
+        """Write role metadata + the recovery-copy preload durably, so the
+        seeded window survives a reboot of this worker."""
+        if self.queue is None:
+            return
+        disk = self.queue.disk
+        meta = disk.open(self._meta_name() + ".meta")
+        await meta.write(0, wire.dumps({
+            "gen_id": self.gen_id,
+            "start_version": self.version.get(),
+            "token_suffix": token_suffix,
+        }))
+        await meta.sync()
+        # Re-key the preload per version so restore replays it uniformly.
+        by_version: Dict[Version, Dict[int, List[Mutation]]] = {}
+        for tag, entries in self.tag_data.items():
+            for v, muts in entries:
+                by_version.setdefault(v, {})[tag] = muts
+        for v in sorted(by_version):
+            off = await self.queue.push(wire.dumps((v, by_version[v])))
+            self._ver_offsets.append((v, off))
+        await self.queue.commit()
+        await self._persist_side_state(force=True)
+
+    async def _persist_side_state(self, force: bool = False) -> None:
+        """Popped map + KCV + the version watermark. Mostly lazily durable
+        (stale popped/kcv after a crash only re-serves acknowledged
+        entries), but _advance_queue_front forces a sync BEFORE dropping
+        queue entries: the watermark is otherwise implied by the newest
+        queue entry, and restoring a fully-popped tlog at its start version
+        would poison the epoch-end min(end) math with a version below
+        already-acknowledged commits."""
+        if self.queue is None:
+            return
+        self._pops_since_persist += 1
+        if not force and self._pops_since_persist < 16:
+            return
+        self._pops_since_persist = 0
+        # Fresh file + rename (an in-place rewrite torn by a crash would
+        # destroy the version watermark this file exists to protect), under
+        # a lock (concurrent pop handlers must not interleave write/rename
+        # cycles on the shared tmp file). Snapshot taken inside the lock so
+        # an older state can never land after a newer one.
+        async with self._side_mutex:
+            disk = self.queue.disk
+            payload = wire.dumps({
+                "popped": dict(self.popped),
+                "kcv": self.known_committed.get(),
+                "version": self.version.get(),
+                "tags_seen": set(self.tags_seen),
+            })
+            tmp = disk.open(self._meta_name() + ".side.tmp")
+            await tmp.truncate(0)
+            await tmp.write(0, payload)
+            await tmp.sync()
+            disk.rename(self._meta_name() + ".side.tmp", self._meta_name() + ".side")
+
+    @classmethod
+    async def restore(cls, proc: SimProcess, disk, meta_name: str) -> Optional["TLog"]:
+        """Rebuild a tlog role from its disk files after a worker reboot."""
+        meta_file = disk.open(meta_name)
+        raw = await meta_file.read(0, meta_file.size())
+        try:
+            meta = wire.loads(raw)
+        except Exception:
+            return None  # torn metadata: role was never fully created
+        base = meta_name[: -len(".meta")]
+        queue = DiskQueue(disk, base)
+        entries = await queue.recover()
+        side = {}
+        side_file = disk.open(base + ".side")
+        raw = await side_file.read(0, side_file.size())
+        if raw:
+            try:
+                side = wire.loads(raw)
+            except Exception:
+                side = {}
+        tlog = cls(
+            proc,
+            start_version=meta["start_version"],
+            gen_id=tuple(meta["gen_id"]),
+            token_suffix=meta["token_suffix"],
+            queue=queue,
+            store_name=base,
+        )
+        tlog.popped = dict(side.get("popped", {}))
+        tlog.tags_seen = set(side.get("tags_seen", set())) | set(tlog.popped)
+        version = max(meta["start_version"], side.get("version", 0))
+        for off, payload in entries:
+            v, messages = wire.loads(payload)
+            version = max(version, v)
+            tlog._ver_offsets.append((v, off))
+            for tag, muts in messages.items():
+                tlog.tags_seen.add(tag)
+                if v > tlog.popped.get(tag, 0):
+                    tlog.tag_data.setdefault(tag, []).append((v, muts))
+        tlog.version = NotifiedVersion(version)
+        # Restored data is durable here but the KCV horizon must be
+        # re-learned; the stored floor keeps already-served data servable.
+        tlog.known_committed = NotifiedVersion(
+            max(side.get("kcv", 0), meta["start_version"])
+        )
+        return tlog
+
+    async def _advance_queue_front(self) -> None:
+        """Discard queue entries whose every tag has popped past them
+        (DiskQueue front = min pop location over tags, DiskQueue.actor.cpp
+        via tLogPop)."""
+        if self.queue is None or not self._ver_offsets:
+            return
+        floor = min((self.popped.get(t, 0) for t in self.tags_seen), default=0)
+        target = None
+        keep = []
+        for v, off in self._ver_offsets:
+            if v <= floor:
+                target = off
+            else:
+                keep.append((v, off))
+        if target is not None:
+            self._ver_offsets = keep
+            # Watermark first: the entries being dropped are the only other
+            # durable record of how far this replica's log reached.
+            await self._persist_side_state(force=True)
+            await self.queue.pop_to(target)
 
     # -- write path ----------------------------------------------------------
     async def commit(self, req: TLogCommitRequest) -> Version:
@@ -113,8 +263,17 @@ class TLog:
             return self.version.get()
         self._inflight.add(req.version)
         for tag, muts in req.messages.items():
+            self.tags_seen.add(tag)
             self.tag_data.setdefault(tag, []).append((req.version, muts))
-        await delay(FSYNC_SECONDS, TaskPriority.TLOG_COMMIT)
+        if buggify.buggify():
+            # Slow disk: stretches the fsync window other failures race with.
+            await delay(0.02, TaskPriority.TLOG_COMMIT)
+        if self.queue is not None:
+            off = await self.queue.push(wire.dumps((req.version, req.messages)))
+            self._ver_offsets.append((req.version, off))
+            await self.queue.commit()
+        else:
+            await delay(FSYNC_SECONDS, TaskPriority.TLOG_COMMIT)
         # Chained waiters run only after this version is durable.
         self._inflight.discard(req.version)
         if self.stopped:
@@ -153,9 +312,12 @@ class TLog:
         if req.version <= prev:
             return
         self.popped[req.tag] = req.version
+        self.tags_seen.add(req.tag)
         data = self.tag_data.get(req.tag)
         if data:
             self.tag_data[req.tag] = [(v, m) for (v, m) in data if v > req.version]
+        await self._advance_queue_front()
+        await self._persist_side_state()
 
     # -- epoch end -----------------------------------------------------------
     async def lock(self, req: TLogLockRequest) -> TLogLockReply:

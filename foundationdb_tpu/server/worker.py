@@ -23,6 +23,7 @@ from ..sim.loop import TaskPriority, delay, spawn
 from ..sim.network import Endpoint, SimProcess
 from .coordination import GET_LEADER_TOKEN, GetLeaderRequest, LeaderInfo
 from .leader_election import monitor_leader
+from .disk_queue import DiskQueue
 from .proxy import Proxy, ProxyConfig
 from .resolver import Resolver
 from .storage import StorageServer
@@ -130,6 +131,8 @@ class Worker:
         ))
         proc.actors.add(spawn(self.registration_loop(), TaskPriority.CLUSTER_CONTROLLER,
                               name=f"register:{proc.name}"))
+        proc.actors.add(spawn(self.restore_roles(), TaskPriority.CLUSTER_CONTROLLER,
+                              name=f"restore:{proc.name}"))
         if cc_priority is not None:
             proc.actors.add(spawn(self.cc_candidacy(cc_priority),
                                   TaskPriority.CLUSTER_CONTROLLER,
@@ -201,11 +204,16 @@ class Worker:
     async def init_tlog(self, req: InitializeTLogRequest) -> str:
         key = ("tlog", req.gen_id[0], req.gen_id[1], req.replica_index)
         if key not in self.roles:
-            self.roles[key] = TLog(
+            disk = self.sim.disk_for(self.proc.address)
+            store = f"tlog-{req.gen_id[0]}.{req.gen_id[1]}.{req.replica_index}"
+            tlog = TLog(
                 self.proc, start_version=req.start_version, gen_id=req.gen_id,
                 preload=req.preload, preload_popped=req.preload_popped,
                 token_suffix=req.token_suffix,
+                queue=DiskQueue(disk, store), store_name=store,
             )
+            await tlog.persist_initial(req.token_suffix)
+            self.roles[key] = tlog
         return self.proc.address
 
     async def init_resolver(self, req: InitializeResolverRequest) -> str:
@@ -233,10 +241,13 @@ class Worker:
 
         key = ("storage", 0, req.tag, 0)
         if key not in self.roles:
-            self.roles[key] = StorageServer(
+            ss = StorageServer(
                 self.proc, tag=req.tag, shard=KeyRange(req.begin, req.end),
                 log_view=self.log_view, net=self.net,
+                disk=self.sim.disk_for(self.proc.address),
             )
+            await ss.persist_initial()
+            self.roles[key] = ss
         return self.proc.address
 
     async def init_master(self, req: InitializeMasterRequest):
@@ -259,12 +270,53 @@ class Worker:
         task.on_ready(on_done)
         return Endpoint(self.proc.address, wf_token)
 
+    async def restore_roles(self) -> None:
+        """Re-create durable roles from disk after a reboot (the reference
+        worker's DiskStore scan + restorePersistentState,
+        worker.actor.cpp:208)."""
+        disk = self.sim.disk_for(self.proc.address)
+        for name in disk.list():
+            if not name.endswith(".meta"):
+                continue
+            # Identity comes from the FILENAME, checked against live roles
+            # BEFORE constructing: role constructors register tokens, so a
+            # duplicate would silently steal a live role's handlers and
+            # open a second writer on its files (round-2 review).
+            base = name[: -len(".meta")]
+            if name.startswith("tlog-"):
+                try:
+                    rc_s, salt_s, idx_s = base[len("tlog-"):].split(".")
+                    key = ("tlog", int(rc_s), int(salt_s), int(idx_s))
+                except ValueError:
+                    continue
+                if key in self.roles:
+                    continue
+                tlog = await TLog.restore(self.proc, disk, name)
+                if tlog is not None:
+                    self.roles[key] = tlog
+            elif name.startswith("storage-"):
+                try:
+                    key = ("storage", 0, int(base[len("storage-"):]), 0)
+                except ValueError:
+                    continue
+                if key in self.roles:
+                    continue
+                ss = await StorageServer.restore(
+                    self.proc, disk, name, self.log_view, self.net
+                )
+                if ss is not None:
+                    self.roles[key] = ss
+
     async def retire_generations(self, req: RetireGenerationsRequest) -> None:
         for key in list(self.roles):
             kind, rc, salt, idx = key
             if rc >= req.keep_min:
                 continue
-            if kind in ("tlog", "resolver"):
+            if kind == "tlog":
+                role = self.roles.pop(key)
+                role.unregister()
+                role.delete_files()
+            elif kind == "resolver":
                 self.roles.pop(key).unregister()
             elif kind == "proxy":
                 # A deposed generation's proxy must stop serving GRV, or a

@@ -314,3 +314,30 @@ async def recurring(fn: Callable[[], None], interval: float, priority: int = Tas
     while True:
         await delay(interval, priority)
         fn()
+
+
+class AsyncMutex:
+    """FIFO mutex for actors (flow: FlowLock with capacity 1): serializes
+    critical sections that span awaits, e.g. a durable file's
+    write-then-sync cycle against a concurrent compaction."""
+
+    def __init__(self) -> None:
+        self._locked = False
+        self._waiters: Deque[Promise] = deque()
+
+    async def __aenter__(self) -> "AsyncMutex":
+        if self._locked:
+            p = Promise()
+            self._waiters.append(p)
+            await p.future
+        self._locked = True
+        return self
+
+    async def __aexit__(self, *exc) -> bool:
+        self._locked = False
+        while self._waiters:
+            p = self._waiters.popleft()
+            if not p.is_set:
+                p.send(None)
+                break
+        return False

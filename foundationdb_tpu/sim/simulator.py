@@ -12,6 +12,7 @@ import enum
 from typing import Any, Callable, Coroutine, Dict, List, Optional
 
 from ..core import buggify
+from .disk import SimDisk
 from .loop import Scheduler, TaskPriority, set_scheduler
 from .network import SimNetwork, SimProcess
 
@@ -31,15 +32,26 @@ BootFn = Callable[["Simulator", SimProcess], Coroutine]
 class Simulator:
     """Deterministic world: everything hangs off one seed."""
 
-    def __init__(self, seed: int = 0):
+    def __init__(self, seed: int = 0, randomize_knobs: bool = False):
         self.seed = seed
         self.sched = Scheduler(seed)
         self.net = SimNetwork(self.sched)
         buggify.enable(self.sched.rng)
+        if randomize_knobs:
+            from ..core import knobs
+            knobs.randomize_all(self.sched.rng)
         self.machines: Dict[str, List[SimProcess]] = {}
+        #: address -> its disk; survives kills and reboots (the platters)
+        self.disks: Dict[str, SimDisk] = {}
         self._boot_fns: Dict[str, BootFn] = {}
         self._next_addr = 0
         set_scheduler(self.sched)
+
+    def disk_for(self, address: str) -> SimDisk:
+        d = self.disks.get(address)
+        if d is None:
+            d = self.disks[address] = SimDisk(self.sched)
+        return d
 
     # -- topology -------------------------------------------------------------
     def new_process(
@@ -76,9 +88,15 @@ class Simulator:
         # (instant), mirrored here as failure-monitor state; marking the
         # address failed also errors every outstanding reply against it.
         self.net.monitor.set_status(proc.address, True)
+        # The page cache dies with the process: un-synced writes are
+        # randomly applied / lost / torn (AsyncFileNonDurable semantics).
+        disk = self.disks.get(proc.address)
+        if disk is not None:
+            disk.crash(self.sched.rng)
         if kill_type in (KillType.REBOOT, KillType.REBOOT_AND_DELETE):
             if kill_type == KillType.REBOOT_AND_DELETE:
                 proc.globals.clear()
+                self.disks.pop(proc.address, None)
             reboot_delay = 0.5 + self.sched.rng.random01()
 
             def do_boot() -> None:

@@ -48,6 +48,18 @@ SPECS: Dict[str, Callable[[], Spec]] = {
         client_count=2,
         timeout=900.0,
     ),
+    # durability torture: any worker (storage included) can die and reboot;
+    # disks with torn un-fsynced writes must always re-form the database
+    "DiskAttrition": lambda: Spec(
+        title="DiskAttrition",
+        workloads=[
+            (CycleWorkload, {"nodes": 8, "transactions": 8, "think_time": 2.0}),
+            (MachineAttritionWorkload, {"interval": 5.0, "delay_before": 2.0}),
+        ],
+        dynamic=DynamicClusterConfig(n_workers=6, n_tlogs=2, n_resolvers=2, n_storage=2),
+        client_count=2,
+        timeout=900.0,
+    ),
     # recovery churn without clogging, heavier kill rate
     "AttritionStress": lambda: Spec(
         title="AttritionStress",

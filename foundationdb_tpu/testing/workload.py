@@ -84,6 +84,9 @@ class Spec:
     dynamic: Optional[DynamicClusterConfig] = None
     client_count: int = 1
     timeout: float = 3600.0
+    #: BUGGIFY-randomize the knob registries for this run (always reset
+    #: afterwards); the reference randomizes knobs in every sim run
+    randomize_knobs: bool = True
 
 
 @dataclass
@@ -96,7 +99,7 @@ class SpecResult:
 
 def run_spec(spec: Spec, seed: int) -> SpecResult:
     """Deterministic: same spec+seed -> same result and metrics."""
-    sim = Simulator(seed)
+    sim = Simulator(seed, randomize_knobs=spec.randomize_knobs)
     if spec.dynamic is not None:
         cluster = DynamicCluster(sim, spec.dynamic)
     else:
@@ -139,4 +142,7 @@ def run_spec(spec: Spec, seed: int) -> SpecResult:
         sim.run_until(task, until=spec.timeout)
     finally:
         set_scheduler(None)
+        if spec.randomize_knobs:
+            from ..core import knobs
+            knobs.reset_all()
     return SpecResult(ok=ok, metrics=metrics, seed=seed, virtual_time=sim.sched.time)

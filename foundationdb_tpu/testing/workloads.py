@@ -392,27 +392,22 @@ class MachineAttritionWorkload(TestWorkload):
 
     def _safe_victims(self, cluster):
         """Kill-safety analysis (reference: ISimulator::canKillProcesses,
-        simulator.h:155): never kill the last live holder of logged data.
-        Until the durability round gives tlogs disks, a tlog host may die
-        only while every other tlog host is alive — so the un-popped window
-        always survives on at least one replica for the next recovery."""
-        tlog_hosts = [
-            p for p in cluster.worker_procs
-            if any(t.startswith("tlog.commit") for t in p.handlers)
-        ]
-        any_tlog_host_down = any(not p.alive for p in tlog_hosts)
+        simulator.h:155). With durable tlogs/storage (DiskQueue + snapshot
+        WAL) and REBOOT-only kills, every role host recovers from its own
+        disk, so any worker hosting a role is a safe victim — including all
+        tlog replicas at once (recovery waits for one to reboot and
+        restore). Storage kills are gated by the spare_storage option for
+        specs that want to isolate transaction-subsystem churn."""
+        spare_storage = bool(self.ctx.options.get("spare_storage", False))
         out = []
         for p in cluster.worker_procs:
             if not p.alive:
                 continue
-            if not any(t.startswith(self.TXN_TOKENS) for t in p.handlers):
+            hosts_storage = any(t.startswith("storage.") for t in p.handlers)
+            if spare_storage and hosts_storage:
                 continue
-            if any(t.startswith("storage.") for t in p.handlers):
-                continue
-            hosts_tlog = any(t.startswith("tlog.commit") for t in p.handlers)
-            if hosts_tlog and (any_tlog_host_down or len(tlog_hosts) <= 1):
-                continue
-            out.append(p)
+            if hosts_storage or any(t.startswith(self.TXN_TOKENS) for t in p.handlers):
+                out.append(p)
         return out
 
     async def start(self, db: Database) -> None:
