@@ -44,7 +44,39 @@ from ..server.messages import (
     GetKeyServerLocationsRequest,
     GetReadVersionRequest,
     GetValueRequest,
+    WatchValueRequest,
 )
+
+
+class KeySelector:
+    """reference: KeySelectorRef (fdbclient/FDBTypes.h) — resolves to the
+    key at `offset` relative to the anchor position defined by (key,
+    or_equal): with i0 = the index of the first database key > key (if
+    or_equal) or >= key (if not), the selector resolves to the key at
+    index i0 + offset - 1, clamped to b"" / the end of the keyspace."""
+
+    __slots__ = ("key", "or_equal", "offset")
+
+    def __init__(self, key: Key, or_equal: bool, offset: int):
+        self.key = key
+        self.or_equal = or_equal
+        self.offset = offset
+
+    @classmethod
+    def first_greater_or_equal(cls, key: Key) -> "KeySelector":
+        return cls(key, False, 1)
+
+    @classmethod
+    def first_greater_than(cls, key: Key) -> "KeySelector":
+        return cls(key, True, 1)
+
+    @classmethod
+    def last_less_than(cls, key: Key) -> "KeySelector":
+        return cls(key, False, 0)
+
+    @classmethod
+    def last_less_or_equal(cls, key: Key) -> "KeySelector":
+        return cls(key, True, 0)
 
 MAX_BACKOFF = 1.0
 INITIAL_BACKOFF = 0.01
@@ -122,6 +154,23 @@ class Database:
                 self.proxy_addrs = list(info.proxy_addrs)
                 return
         await delay(0.25)
+
+    async def get_status(self) -> Optional[dict]:
+        """Fetch the cluster status document from the CC (StatusClient)."""
+        from ..server.cluster_controller import CC_STATUS_TOKEN
+        from ..server.leader_election import tally_leader_once
+
+        leader = await tally_leader_once(self.net, self.client_addr,
+                                         self.coordinator_addrs)
+        if leader is None:
+            return None
+        try:
+            return await self.net.request(
+                self.client_addr, Endpoint(leader.address, CC_STATUS_TOKEN),
+                None, TaskPriority.DEFAULT_ENDPOINT, timeout=2.0,
+            )
+        except error.FDBError:
+            return None
 
     def create_transaction(self) -> "Transaction":
         return Transaction(self)
@@ -407,6 +456,86 @@ class Transaction:
         if self.committed_version is None:
             raise error.client_invalid_operation("get_versionstamp before commit")
         return place_versionstamp(self.committed_version, self.committed_batch_index)
+
+    async def get_key(self, selector: KeySelector, snapshot: bool = False) -> Key:
+        """Resolve a key selector (reference: Transaction::getKey,
+        NativeAPI.actor.cpp:1234). Resolution scans through get_range, so
+        the scanned span lands in the read conflict set exactly like the
+        reference's selector reads (unless snapshot)."""
+        k, or_equal, offset = selector.key, selector.or_equal, selector.offset
+        if offset >= 1:
+            start = key_after(k) if or_equal else k
+            rows = await self.get_range(start, USER_KEYSPACE_END,
+                                        limit=offset, snapshot=snapshot)
+            if len(rows) >= offset:
+                return rows[offset - 1][0]
+            return USER_KEYSPACE_END
+        n = 1 - offset
+        end = key_after(k) if or_equal else k
+        rows = await self.get_range(b"", end, limit=n, reverse=True,
+                                    snapshot=snapshot)
+        if len(rows) >= n:
+            return rows[n - 1][0]
+        return b""
+
+    async def get_range_selector(self, begin: KeySelector, end: KeySelector,
+                                 limit: Optional[int] = None,
+                                 reverse: bool = False,
+                                 snapshot: bool = False):
+        """Range read with selector endpoints (getRange with selectors)."""
+        b = await self.get_key(begin, snapshot=snapshot)
+        e = await self.get_key(end, snapshot=snapshot)
+        if b >= e:
+            return []
+        return await self.get_range(b, e, limit=limit if limit is not None else 10_000,
+                                    reverse=reverse, snapshot=snapshot)
+
+    def watch(self, key: Key):
+        """Future firing when `key`'s value changes from what this
+        transaction reads now (reference: Transaction::watch,
+        NativeAPI.actor.cpp:1302). Survives storage failures by
+        re-registering with a fresh snapshot; cancel the returned task to
+        stop watching."""
+        from ..sim.loop import spawn
+
+        _UNSET = object()
+
+        async def read_current():
+            """Snapshot-read key with full retry (storage may be mid-reboot
+            or mid-recovery when the watch re-registers)."""
+            tr = self.db.create_transaction()
+            while True:
+                try:
+                    value = await tr.get(key, snapshot=True)
+                    return value, tr.read_version
+                except error.FDBError as e:
+                    await tr.on_error(e)
+
+        async def watch_actor():
+            expected, version = await read_current()
+            while True:
+                try:
+                    locs = await self.db.get_locations(key, key_after(key))
+                    return await self.db.net.request(
+                        self.db.client_addr,
+                        Endpoint(locs[0][1][0], storage_mod.WATCH_VALUE_TOKEN),
+                        WatchValueRequest(key=key, value=expected, version=version),
+                        TaskPriority.DEFAULT_ENDPOINT,
+                        timeout=30.0,
+                    )
+                except error.FDBError as e:
+                    if e.code == _WRONG_SHARD:
+                        self.db.invalidate_cache()
+                    elif not e.is_retryable() and e.code != _MAYBE_DELIVERED:
+                        raise
+                    # Transport loss or parked-too-long: re-read; if the
+                    # value moved while we were not watching, fire now.
+                    await delay(0.25)
+                    current, version = await read_current()
+                    if current != expected:
+                        return current
+
+        return spawn(watch_actor(), TaskPriority.DEFAULT_ENDPOINT, name=f"watch:{key!r}")
 
     def add_read_conflict_range(self, begin: Key, end: Key) -> None:
         self.read_conflict_ranges.append(KeyRange(begin, end))

@@ -31,6 +31,7 @@ from .worker import InitializeMasterRequest, ServerDBInfo
 CC_REGISTER_TOKEN = "cc.registerWorker"
 CC_OPEN_DATABASE_TOKEN = "cc.openDatabase"
 CC_MASTER_RECOVERED_TOKEN = "cc.masterRecovered"
+CC_STATUS_TOKEN = "cc.status"
 
 #: a worker silent this long is not considered for recruitment
 WORKER_STALE_SECONDS = 2.0
@@ -63,6 +64,7 @@ class ClusterController:
         self.proc.register(CC_REGISTER_TOKEN, self.register_worker)
         self.proc.register(CC_OPEN_DATABASE_TOKEN, self.open_database)
         self.proc.register(CC_MASTER_RECOVERED_TOKEN, self.master_recovered)
+        self.proc.register(CC_STATUS_TOKEN, self.get_status)
         self._spawn(self.cluster_watch_database(), "clusterWatchDatabase")
 
     def _spawn(self, coro, name):
@@ -76,7 +78,8 @@ class ClusterController:
         if self._dead:
             return
         self._dead = True
-        for tok in (CC_REGISTER_TOKEN, CC_OPEN_DATABASE_TOKEN, CC_MASTER_RECOVERED_TOKEN):
+        for tok in (CC_REGISTER_TOKEN, CC_OPEN_DATABASE_TOKEN,
+                    CC_MASTER_RECOVERED_TOKEN, CC_STATUS_TOKEN):
             self.proc.unregister(tok)
         self.actors.cancel_all()
 
@@ -97,6 +100,63 @@ class ClusterController:
     # -- client surface -------------------------------------------------------
     async def open_database(self, req: OpenDatabaseRequest) -> ServerDBInfo:
         return self.db_info
+
+    async def get_status(self, _req) -> dict:
+        """The machine-readable cluster status document (clusterGetStatus,
+        Status.actor.cpp:1759), aggregated live from the master's fragment
+        and the storage servers' queue info."""
+        from .ratekeeper import STORAGE_QUEUE_INFO_TOKEN
+
+        info = self.db_info
+        t = now()
+        doc = {
+            "cluster": {
+                "controller": self.proc.address,
+                "recovery_state": info.recovery_state,
+                "generation": info.recovery_count,
+                "master": info.master_addr,
+                "proxies": list(info.proxy_addrs),
+                "log_generation": (str(info.log_config.gen_id)
+                                   if info.log_config is not None else None),
+                "workers": {
+                    addr: {"seconds_since_heartbeat": round(t - seen, 3)}
+                    for addr, seen in sorted(self.workers.items())
+                },
+            },
+            "qos": {},
+            "storage": [],
+        }
+        if info.master_status_ep is not None:
+            try:
+                frag = await self.net.request(
+                    self.proc.address, info.master_status_ep, None,
+                    TaskPriority.CLUSTER_CONTROLLER, timeout=1.0,
+                )
+                doc["cluster"]["version"] = frag["version"]
+                doc["cluster"]["roles"] = {
+                    "tlogs": frag["tlogs"], "resolvers": frag["resolvers"],
+                    "proxy": frag["proxy"],
+                }
+                doc["qos"] = {
+                    "transactions_per_second_limit": frag["tps_limit"],
+                    "worst_storage_lag_versions": frag["worst_storage_lag_versions"],
+                }
+            except error.FDBError:
+                doc["cluster"]["version"] = None
+        for tag, b, e, addr in info.storage_tags:
+            entry = {"tag": tag, "address": addr,
+                     "shard_begin": b.hex(), "shard_end": e.hex()}
+            try:
+                qi = await self.net.request(
+                    self.proc.address, Endpoint(addr, STORAGE_QUEUE_INFO_TOKEN),
+                    None, TaskPriority.CLUSTER_CONTROLLER, timeout=1.0,
+                )
+                entry["version"] = qi.version
+                entry["durable_version"] = qi.durable_version
+            except error.FDBError:
+                entry["unreachable"] = True
+            doc["storage"].append(entry)
+        return doc
 
     # -- database watch -------------------------------------------------------
     async def master_recovered(self, info: ServerDBInfo) -> None:

@@ -43,6 +43,7 @@ from .coordinated_state import CoordinatedState, DBCoreState, LogGenerationInfo
 from .log_system import LogSystemConfig, fetch_recovery_data, lock_generation
 from .master import GET_COMMIT_VERSION_TOKEN, Master, RECOVERY_VERSION_JUMP
 from .proxy import ProxyConfig
+from .ratekeeper import GET_RATE_INFO_TOKEN, Ratekeeper
 from .resolver import RESOLVE_TOKEN
 from .wait_failure import WAIT_FAILURE_TOKEN, wait_failure_client
 from .worker import (
@@ -227,6 +228,35 @@ class MasterServer:
         self.master = Master(self.proc, start_version=recovery_txn_version,
                              token_suffix=suffix)
 
+        # Admission control for the epoch (the reference's ratekeeper runs
+        # under the master's data distribution in 6.0).
+        ratekeeper = Ratekeeper(
+            self.net, self.proc.address, storage_tags,
+            lambda: self.master.version,
+        )
+        rate_token = GET_RATE_INFO_TOKEN + suffix
+        self.proc.register(rate_token, ratekeeper.get_rate_info)
+        rk_task = spawn(ratekeeper.run(), TaskPriority.RATEKEEPER,
+                        name=f"ratekeeper:{self.salt}")
+        self.proc.actors.add(rk_task)
+
+        # Status fragment for the CC's status document (Status.actor.cpp).
+        status_token = f"master.status{suffix}"
+
+        async def master_status(_req):
+            return {
+                "version": self.master.version,
+                "recovery_count": rc,
+                "recovery_version": recovery_version,
+                "tps_limit": ratekeeper.tps_limit,
+                "worst_storage_lag_versions": ratekeeper.worst_lag,
+                "tlogs": list(tlog_addrs),
+                "resolvers": list(resolver_addrs),
+                "proxy": proxy_addr,
+            }
+
+        self.proc.register(status_token, master_status)
+
         storage_shards = KeyShardMap.uniform(len(storage_tags))
         proxy_cfg = ProxyConfig(
             master_ep=Endpoint(self.proc.address, GET_COMMIT_VERSION_TOKEN + suffix),
@@ -237,6 +267,7 @@ class MasterServer:
             storage_addrs=[t[3] for t in storage_tags],
             storage_shards=storage_shards,
             master_wf_ep=Endpoint(self.proc.address, f"waitFailure:master:{self.salt}"),
+            rate_ep=Endpoint(self.proc.address, rate_token),
         )
         await self._init_role(proxy_addr, INIT_PROXY_TOKEN, InitializeProxyRequest(
             gen_id=gen_id, cfg=proxy_cfg, start_version=recovery_txn_version,
@@ -255,6 +286,7 @@ class MasterServer:
             recovery_count=rc, recovery_state="fully_recovered",
             master_addr=self.proc.address, proxy_addrs=(proxy_addr,),
             log_config=new_log, storage_tags=storage_tags,
+            master_status_ep=Endpoint(self.proc.address, status_token),
         )
         from .cluster_controller import CC_MASTER_RECOVERED_TOKEN
 
@@ -286,5 +318,8 @@ class MasterServer:
         finally:
             for w in watchers:
                 w.cancel()
+            rk_task.cancel()
+            self.proc.unregister(rate_token)
+            self.proc.unregister(status_token)
         self.master.unregister()
         raise error.master_tlog_failed("a transaction-role host failed")

@@ -182,6 +182,15 @@ class GetValueReply:
 
 
 @dataclass
+class WatchValueRequest:
+    """Fires when key's value differs from `value` (watchValue:773)."""
+
+    key: Key
+    value: Optional[bytes]
+    version: Version
+
+
+@dataclass
 class GetKeyValuesRequest:
     """Range read [begin, end) at version, up to `limit` pairs
     (reference: GetKeyValuesRequest, StorageServerInterface.h)."""

@@ -87,6 +87,8 @@ class ProxyConfig:
     #: the master's role-scoped wait-failure endpoint; the proxy watches it
     #: and shuts down when the master dies (its generation is over)
     master_wf_ep: Optional[Endpoint] = None
+    #: ratekeeper endpoint (GetRateInfo); None = unthrottled
+    rate_ep: Optional[Endpoint] = None
 
 
 class Proxy:
@@ -111,6 +113,11 @@ class Proxy:
         self._pending_master_req: Dict[int, int] = {}
         self._grv_waiters: List[Promise] = []
         self._commit_queue: PromiseStream = PromiseStream()
+        #: ratekeeper admission (transactionStarter:947): GRVs are released
+        #: from a budget replenished at tps_limit per second
+        self._tps_limit: float = float("inf")
+        self._grv_budget: float = 0.0
+        self._grv_budget_t: float = 0.0
         self._dead = False
         #: proxy-owned tasks: cancelled on shutdown() without touching other
         #: roles hosted by the same worker process
@@ -121,6 +128,41 @@ class Proxy:
         self._spawn(self.commit_batcher(), TaskPriority.PROXY_COMMIT_BATCHER, "commitBatcher")
         if cfg.master_wf_ep is not None:
             self._spawn(self._watch_master(), TaskPriority.FAILURE_MONITOR, "watchMaster")
+        if cfg.rate_ep is not None:
+            self._spawn(self._rate_fetcher(), TaskPriority.RATEKEEPER, "rateFetcher")
+
+    async def _rate_fetcher(self) -> None:
+        """Fetch the admission rate (getRate loop,
+        MasterProxyServer.actor.cpp:86); a stale limit is kept on errors."""
+        from .ratekeeper import GetRateInfoRequest
+
+        while True:
+            try:
+                reply = await self.net.request(
+                    self.proc.address, self.cfg.rate_ep,
+                    GetRateInfoRequest(self.proc.address),
+                    TaskPriority.RATEKEEPER, timeout=1.0,
+                )
+                self._tps_limit = reply.tps_limit
+            except error.FDBError:
+                pass
+            await delay(SERVER_KNOBS.ratekeeper_update_interval, TaskPriority.RATEKEEPER)
+
+    def _replenish_grv_budget(self) -> None:
+        from ..sim.loop import now
+
+        t = now()
+        if self._tps_limit == float("inf"):
+            self._grv_budget = float("inf")
+        else:
+            dt = max(0.0, t - self._grv_budget_t)
+            if self._grv_budget == float("inf"):
+                self._grv_budget = 0.0
+            # cap the burst at ~100ms of budget (reference: the smoothed
+            # release window in transactionStarter)
+            self._grv_budget = min(self._grv_budget + self._tps_limit * dt,
+                                   max(1.0, self._tps_limit * 0.1))
+        self._grv_budget_t = t
 
     async def _watch_master(self) -> None:
         """The master's death ends this generation: stop serving
@@ -159,10 +201,21 @@ class Proxy:
         return GetReadVersionReply(version=self.committed_version.get())
 
     async def _grv_flush(self) -> None:
-        await delay(SERVER_KNOBS.grv_batch_interval, TaskPriority.PROXY_GRV_TIMER)
-        waiters, self._grv_waiters = self._grv_waiters, []
-        for p in waiters:
-            p.send(None)
+        """Release queued GRVs within the ratekeeper budget; leftovers wait
+        for the next interval's replenishment (back-pressure surfaces as
+        start-transaction latency, never an error)."""
+        while True:
+            await delay(SERVER_KNOBS.grv_batch_interval, TaskPriority.PROXY_GRV_TIMER)
+            self._replenish_grv_budget()
+            n = len(self._grv_waiters)
+            if self._grv_budget != float("inf"):
+                n = min(n, int(self._grv_budget))
+                self._grv_budget -= n
+            release, self._grv_waiters = self._grv_waiters[:n], self._grv_waiters[n:]
+            for p in release:
+                p.send(None)
+            if not self._grv_waiters:
+                return
 
     # -- locations -----------------------------------------------------------
     async def get_key_server_locations(self, req: GetKeyServerLocationsRequest) -> GetKeyServerLocationsReply:

@@ -1,0 +1,100 @@
+"""Ratekeeper: cluster-wide admission control.
+
+Re-design of fdbserver/Ratekeeper.actor.cpp (updateRate:251-430): poll
+every storage server's queue state, translate the worst lag into a
+transactions-per-second limit, and meter GRV release at the proxies
+(getRate loop, MasterProxyServer.actor.cpp:86). The sim analog of the
+reference's storage-queue-bytes signal is the MVCC version lag (how far a
+storage server trails the committed version) plus its un-snapshotted WAL
+depth — both directly bound crash-recovery work and window health.
+
+Runs as an actor inside the master's epoch (the reference's 6.0 ratekeeper
+lives under the master's data distribution); proxies fetch the limit on a
+short interval and release that many GRVs per second, queueing the rest —
+back-pressure reaches clients as start-transaction latency, exactly like
+the reference.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ..core import error
+from ..core.knobs import SERVER_KNOBS
+from ..sim.loop import TaskPriority, delay
+from ..sim.network import Endpoint
+
+STORAGE_QUEUE_INFO_TOKEN = "storage.queueInfo"
+GET_RATE_INFO_TOKEN = "master.getRateInfo"
+
+#: version lag at which throttling reaches zero admission (the MVCC window
+#: itself is 5e6; throttle to a halt well before readable versions fall out)
+MAX_STORAGE_LAG_VERSIONS = 4_000_000
+#: lag at which throttling begins
+TARGET_STORAGE_LAG_VERSIONS = 1_000_000
+
+
+@dataclass
+class StorageQueueInfo:
+    tag: int
+    version: int
+    durable_version: int
+
+
+@dataclass
+class GetRateInfoRequest:
+    proxy_id: str
+
+
+@dataclass
+class GetRateInfoReply:
+    tps_limit: float
+
+
+class Ratekeeper:
+    """Polls storage; computes the cluster TPS limit (rateKeeper:509)."""
+
+    def __init__(self, net, src_addr: str, storage_tags, committed_version_fn):
+        self.net = net
+        self.src = src_addr
+        self.storage_tags = storage_tags            # (tag, begin, end, addr)
+        self.committed_version_fn = committed_version_fn
+        self.tps_limit: float = float(SERVER_KNOBS.max_transactions_per_second)
+        self.worst_lag: int = 0
+
+    async def run(self) -> None:
+        interval = SERVER_KNOBS.ratekeeper_update_interval
+        while True:
+            await delay(interval, TaskPriority.RATEKEEPER)
+            infos: List[StorageQueueInfo] = []
+            for tag, _b, _e, addr in self.storage_tags:
+                try:
+                    info = await self.net.request(
+                        self.src, Endpoint(addr, STORAGE_QUEUE_INFO_TOKEN), None,
+                        TaskPriority.RATEKEEPER, timeout=interval * 2,
+                    )
+                except error.FDBError:
+                    continue  # an unreachable storage doesn't stall the loop
+                infos.append(info)
+            self.tps_limit = self._update_rate(infos)
+
+    def _update_rate(self, infos: List[StorageQueueInfo]) -> float:
+        """The core of updateRate: worst storage lag -> TPS limit, linear
+        between the target and max lag (the reference's smoother + spring
+        reduced to its proportional core)."""
+        max_tps = float(SERVER_KNOBS.max_transactions_per_second)
+        if not infos:
+            return max_tps
+        committed = self.committed_version_fn()
+        self.worst_lag = max(max(0, committed - i.durable_version) for i in infos)
+        if self.worst_lag <= TARGET_STORAGE_LAG_VERSIONS:
+            return max_tps
+        if self.worst_lag >= MAX_STORAGE_LAG_VERSIONS:
+            return 1.0   # never fully zero: progress lets the lag drain
+        frac = (MAX_STORAGE_LAG_VERSIONS - self.worst_lag) / (
+            MAX_STORAGE_LAG_VERSIONS - TARGET_STORAGE_LAG_VERSIONS
+        )
+        return max(1.0, max_tps * frac)
+
+    async def get_rate_info(self, req: GetRateInfoRequest) -> GetRateInfoReply:
+        return GetRateInfoReply(tps_limit=self.tps_limit)

@@ -38,6 +38,7 @@ from .messages import (
 
 GET_VALUE_TOKEN = "storage.getValue"
 GET_KEY_VALUES_TOKEN = "storage.getKeyValues"
+WATCH_VALUE_TOKEN = "storage.watchValue"
 
 #: how far ahead of the storage version a read may wait before future_version
 #: (reference: storageserver waitForVersion MVCC window)
@@ -164,6 +165,18 @@ class StorageServer:
         self._disk = disk
         proc.register(GET_VALUE_TOKEN, self.get_value)
         proc.register(GET_KEY_VALUES_TOKEN, self.get_key_values)
+        #: parked watches: key -> [(expected value, Promise)]
+        self._watches: Dict[Key, List] = {}
+        proc.register(WATCH_VALUE_TOKEN, self.watch_value)
+        from .ratekeeper import STORAGE_QUEUE_INFO_TOKEN, StorageQueueInfo
+
+        async def queue_info(_req):
+            return StorageQueueInfo(
+                tag=self.tag, version=self.version.get(),
+                durable_version=self.durable_version,
+            )
+
+        proc.register(STORAGE_QUEUE_INFO_TOKEN, queue_info)
         if not defer_update_loop:
             self.start_update_loop()
 
@@ -236,14 +249,37 @@ class StorageServer:
         return ss
 
     # -- write path ----------------------------------------------------------
+    def _fire_watches(self, key: Key, new_value: Optional[Value]) -> None:
+        """Wake watchers whose expected value no longer matches
+        (watchValue:773 triggers on change)."""
+        parked = self._watches.get(key)
+        if not parked:
+            return
+        still = []
+        for expected, promise in parked:
+            if expected != new_value:
+                if not promise.is_set:
+                    promise.send(new_value)
+            else:
+                still.append((expected, promise))
+        if still:
+            self._watches[key] = still
+        else:
+            del self._watches[key]
+
     def _apply(self, m: Mutation, version: Version) -> None:
         if m.type == MutationType.SET_VALUE:
             self.store.set(m.param1, m.param2, version)
+            self._fire_watches(m.param1, m.param2)
         elif m.type == MutationType.CLEAR_RANGE:
             self.store.clear_range(m.param1, m.param2, version)
+            for k in [k for k in self._watches if m.param1 <= k < m.param2]:
+                self._fire_watches(k, None)
         elif m.type in STORAGE_ATOMIC_MUTATIONS:
             existing = self.store.value_at(m.param1, version)
-            self.store.set(m.param1, apply_atomic_op(m.type, existing, m.param2), version)
+            new = apply_atomic_op(m.type, existing, m.param2)
+            self.store.set(m.param1, new, version)
+            self._fire_watches(m.param1, new)
         else:
             # Versionstamped mutations must have been rewritten to SET_VALUE
             # by the proxy (transform_versionstamp_mutation) before logging.
@@ -314,6 +350,23 @@ class StorageServer:
             raise error.wrong_shard_server()
         await self._wait_for_version(req.version)
         return GetValueReply(value=self.store.value_at(req.key, req.version))
+
+    async def watch_value(self, req) -> Optional[Value]:
+        """Park until key's value differs from req.value; returns the new
+        value (reference: watchValue, storageserver.actor.cpp:773). If the
+        value already differs at this server's version, fires immediately —
+        the client races with writers, exactly like the reference."""
+        from ..sim.loop import Promise
+
+        if not self.shard.contains(req.key):
+            raise error.wrong_shard_server()
+        await self._wait_for_version(req.version)
+        current = self.store.value_at(req.key, self.version.get())
+        if current != req.value:
+            return current
+        p = Promise()
+        self._watches.setdefault(req.key, []).append((req.value, p))
+        return await p.future
 
     async def get_key_values(self, req: GetKeyValuesRequest) -> GetKeyValuesReply:
         self._check_shard(req.begin, req.end)

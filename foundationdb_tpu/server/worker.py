@@ -52,6 +52,7 @@ class ServerDBInfo:
     proxy_addrs: tuple = ()
     log_config: Any = None                 # LogSystemConfig
     storage_tags: tuple = ()               # (tag, begin, end, address)
+    master_status_ep: Any = None           # Endpoint of the master's status
 
 
 @dataclass

@@ -1,0 +1,1 @@
+"""Operator tooling (the fdbcli/fdbbackup analog surface)."""

@@ -1,0 +1,194 @@
+"""Interactive CLI over a cluster — the fdbcli analog.
+
+Re-design of fdbcli/fdbcli.actor.cpp round-2 scope: status + the
+get/set/clear/getrange transaction commands, driven against a simulated
+cluster (each command spawns its coroutine and drives the deterministic
+sim until it resolves — the CLI is the only wall-clock actor, exactly like
+an operator at a terminal).
+
+Run interactively:  python -m foundationdb_tpu.tools.cli [--seed N]
+Scripted:           echo "set k v\nget k\nstatus" | python -m ...
+"""
+from __future__ import annotations
+
+import json
+import shlex
+import sys
+from typing import List, Optional
+
+from ..core import error
+from ..server.cluster import DynamicClusterConfig, build_dynamic_cluster
+
+HELP = """\
+commands:
+  status [json]        cluster status (summary, or the full document)
+  get KEY              read a key
+  set KEY VALUE        write a key
+  clear KEY            clear a key
+  clearrange BEGIN END clear a key range
+  getrange BEGIN END [LIMIT]   read a range
+  help                 this text
+  exit                 quit
+Keys/values are text; prefix with 0x for hex bytes."""
+
+
+def _arg_bytes(tok: str) -> bytes:
+    if tok.startswith("0x"):
+        return bytes.fromhex(tok[2:])
+    return tok.encode()
+
+
+def _fmt(b: Optional[bytes]) -> str:
+    if b is None:
+        return "<not found>"
+    try:
+        s = b.decode()
+        if s.isascii() and s.isprintable():
+            return f"'{s}'"
+    except UnicodeDecodeError:
+        pass
+    return "0x" + b.hex()
+
+
+class Cli:
+    def __init__(self, cluster, out=sys.stdout):
+        self.cluster = cluster
+        self.sim = cluster.sim
+        self.db = cluster.new_client()
+        self.out = out
+
+    def _drive(self, coro, timeout: float = 60.0):
+        return self.sim.run_until(self.sim.sched.spawn(coro, name="cli"),
+                                  until=self.sim.sched.time + timeout)
+
+    def _print(self, s: str) -> None:
+        print(s, file=self.out)
+
+    # -- commands -------------------------------------------------------------
+    def do_status(self, args: List[str]) -> None:
+        doc = self._drive(self.db.get_status())
+        if doc is None:
+            self._print("status unavailable (no cluster controller reachable)")
+            return
+        if args and args[0] == "json":
+            self._print(json.dumps(doc, indent=2, sort_keys=True))
+            return
+        c = doc["cluster"]
+        self._print(f"  recovery state     - {c['recovery_state']}")
+        self._print(f"  generation         - {c['generation']}")
+        self._print(f"  cluster controller - {c['controller']}")
+        self._print(f"  master             - {c.get('master')}")
+        self._print(f"  proxies            - {', '.join(c.get('proxies', [])) or '-'}")
+        if "version" in c and c["version"] is not None:
+            self._print(f"  version            - {c['version']}")
+        if doc.get("qos"):
+            self._print(f"  tps limit          - {doc['qos'].get('transactions_per_second_limit')}")
+            self._print(f"  worst storage lag  - {doc['qos'].get('worst_storage_lag_versions')} versions")
+        for s in doc.get("storage", []):
+            state = "unreachable" if s.get("unreachable") else f"v={s.get('durable_version')}"
+            self._print(f"  storage tag {s['tag']}      - {s['address']} ({state})")
+        self._print(f"  workers            - {len(c.get('workers', {}))}")
+
+    def do_get(self, args: List[str]) -> None:
+        (key,) = args
+
+        async def go(tr):
+            return await tr.get(_arg_bytes(key))
+
+        self._print(f"`{key}' is {_fmt(self._drive(self.db.run(go)))}")
+
+    def do_set(self, args: List[str]) -> None:
+        key, value = args
+
+        async def go(tr):
+            tr.set(_arg_bytes(key), _arg_bytes(value))
+
+        self._drive(self.db.run(go))
+        self._print("committed")
+
+    def do_clear(self, args: List[str]) -> None:
+        (key,) = args
+
+        async def go(tr):
+            tr.clear(_arg_bytes(key))
+
+        self._drive(self.db.run(go))
+        self._print("committed")
+
+    def do_clearrange(self, args: List[str]) -> None:
+        begin, end = args
+
+        async def go(tr):
+            tr.clear_range(_arg_bytes(begin), _arg_bytes(end))
+
+        self._drive(self.db.run(go))
+        self._print("committed")
+
+    def do_getrange(self, args: List[str]) -> None:
+        begin, end = args[0], args[1]
+        limit = int(args[2]) if len(args) > 2 else 25
+
+        async def go(tr):
+            return await tr.get_range(_arg_bytes(begin), _arg_bytes(end), limit=limit)
+
+        rows = self._drive(self.db.run(go))
+        for k, v in rows:
+            self._print(f"  {_fmt(k)} -> {_fmt(v)}")
+        self._print(f"{len(rows)} row(s)")
+
+    # -- loop -----------------------------------------------------------------
+    def run_command(self, line: str) -> bool:
+        """Returns False on exit. Errors print, never crash the shell."""
+        try:
+            parts = shlex.split(line)
+        except ValueError as e:
+            self._print(f"parse error: {e}")
+            return True
+        if not parts:
+            return True
+        cmd, args = parts[0].lower(), parts[1:]
+        if cmd in ("exit", "quit"):
+            return False
+        if cmd == "help":
+            self._print(HELP)
+            return True
+        fn = getattr(self, f"do_{cmd}", None)
+        if fn is None:
+            self._print(f"unknown command `{cmd}' (try help)")
+            return True
+        try:
+            fn(args)
+        except (ValueError, TypeError):
+            self._print(f"usage error (try help)")
+        except error.FDBError as e:
+            self._print(f"error: {e}")
+        return True
+
+    def repl(self, stream=sys.stdin) -> None:
+        interactive = stream.isatty()
+        while True:
+            if interactive:
+                print("fdb> ", end="", flush=True)
+            line = stream.readline()
+            if not line:
+                break
+            if not self.run_command(line.strip()):
+                break
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="cli over a simulated cluster")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    cluster = build_dynamic_cluster(seed=args.seed, cfg=DynamicClusterConfig())
+    cli = Cli(cluster)
+    cli.sim.run(until=3.0)   # let the cluster bootstrap
+    print("connected to simulated cluster (seed %d); `help' for commands" % args.seed)
+    cli.repl()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
