@@ -23,22 +23,25 @@ def max_key_bytes(key_words: int) -> int:
 
 
 def pack_keys(keys: Sequence[bytes], key_words: int) -> np.ndarray:
-    """Pack N keys -> uint32 [N, key_words + 1] (words..., length)."""
+    """Pack N keys -> uint32 [N, key_words + 1] (words..., length).
+
+    Fully vectorized: one join + one scatter + a big-endian uint32 view.
+    This sits on the resolver's host hot path (every conflict range of
+    every transaction passes through here), where a per-key Python loop
+    measured ~10x the device's whole resolve time."""
     n = len(keys)
     kb = max_key_bytes(key_words)
-    out_bytes = np.zeros((n, kb), dtype=np.uint8)
-    lens = np.empty((n,), dtype=np.uint32)
-    for i, k in enumerate(keys):
-        lk = len(k)
-        if lk > kb:
-            raise error.key_too_large(f"key of {lk} bytes > engine width {kb}")
-        out_bytes[i, :lk] = np.frombuffer(k, dtype=np.uint8)
-        lens[i] = lk
-    words = out_bytes.reshape(n, key_words, 4).astype(np.uint32)
-    packed = (
-        (words[:, :, 0] << 24) | (words[:, :, 1] << 16) | (words[:, :, 2] << 8) | words[:, :, 3]
-    )
-    return np.concatenate([packed, lens[:, None]], axis=1)
+    if n == 0:
+        return np.zeros((0, key_words + 1), np.uint32)
+    lens = np.fromiter((len(k) for k in keys), np.int64, count=n)
+    if int(lens.max()) > kb:
+        raise error.key_too_large(
+            f"key of {int(lens.max())} bytes > engine width {kb}")
+    flat = np.frombuffer(
+        b"".join(k.ljust(kb, b"\0") for k in keys), dtype=np.uint8
+    ).reshape(n, kb)
+    packed = flat.view(">u4").astype(np.uint32)
+    return np.concatenate([packed, lens[:, None].astype(np.uint32)], axis=1)
 
 
 def pack_key(key: bytes, key_words: int) -> np.ndarray:
