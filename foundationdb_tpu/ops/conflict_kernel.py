@@ -83,10 +83,6 @@ class KernelConfig:
         return (self.w_all + 31) // 32
 
     @property
-    def search_steps(self) -> int:
-        return int(math.ceil(math.log2(self.capacity))) + 1
-
-    @property
     def levels(self) -> int:    # sparse-table levels
         return int(math.ceil(math.log2(self.capacity))) + 1
 
@@ -572,6 +568,28 @@ def apply_writes_and_gc(
     return new_state, overflow
 
 
+def detect_step(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[str, jnp.ndarray]):
+    """Phases 1-2 only (no fixpoint, no writes): for the host long-key tier,
+    which must combine global verdicts across device + host tiers BEFORE any
+    tier applies writes. Returns (hist_hits, ovp, wpos) — device-resident."""
+    return local_phases(cfg, state, batch)
+
+
+def fix_step(cfg: KernelConfig, t_ok: jnp.ndarray, hist_hits: jnp.ndarray,
+             ovp: jnp.ndarray, batch: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+    """Re-run the earlier-in-batch-wins fixpoint with an updated t_ok mask
+    (host-tier aborts folded in); cheap relative to detect_step."""
+    return commit_fixpoint(cfg, t_ok, hist_hits, ovp, batch)
+
+
+def apply_step(cfg: KernelConfig, state: Dict[str, jnp.ndarray],
+               batch: Dict[str, jnp.ndarray], committed: jnp.ndarray,
+               wpos: Dict[str, jnp.ndarray]):
+    """Apply the globally-agreed committed writes (+GC). Returns
+    (new_state, overflow)."""
+    return apply_writes_and_gc(cfg, state, batch, committed, wpos)
+
+
 def status_of(t_too_old: jnp.ndarray, committed: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(
         t_too_old,
@@ -628,10 +646,11 @@ def build_batch_arrays(
         assert all(a <= b for a, b in zip(lst, lst[1:])), "read rows must be grouped by ascending txn"
     Rp, Rr, Wp, Wr, K = cfg.rp, cfg.max_reads, cfg.wp, cfg.max_writes, cfg.lanes
 
-    def padk(keys: List[bytes], cap: int) -> np.ndarray:
+    def padk(keys: List[bytes], cap: int, endpoint: bool = False) -> np.ndarray:
         arr = np.zeros((cap, K), np.uint32)
         if keys:
-            arr[: len(keys)] = keypack.pack_keys(keys, cfg.key_words)
+            pack = keypack.pack_endpoint_keys if endpoint else keypack.pack_keys
+            arr[: len(keys)] = pack(keys, cfg.key_words)
         return arr
 
     def padi(vals: List[int], cap: int) -> np.ndarray:
@@ -642,16 +661,16 @@ def build_batch_arrays(
         "rp_snap": padi(rp_snap, Rp),
         "rp_txn": padi(rp_txn, Rp),
         "rp_valid": np.arange(Rp) < len(rp_txn),
-        "rb": padk(r_keys_b, Rr),
-        "re": padk(r_keys_e, Rr),
+        "rb": padk(r_keys_b, Rr, endpoint=True),
+        "re": padk(r_keys_e, Rr, endpoint=True),
         "r_snap": padi(r_snap, Rr),
         "r_txn": padi(r_txn, Rr),
         "r_valid": np.arange(Rr) < len(r_txn),
         "wpb": padk(wp_keys, Wp),
         "wp_txn": padi(wp_txn, Wp),
         "wp_valid": np.arange(Wp) < len(wp_txn),
-        "wb": padk(w_keys_b, Wr),
-        "we": padk(w_keys_e, Wr),
+        "wb": padk(w_keys_b, Wr, endpoint=True),
+        "we": padk(w_keys_e, Wr, endpoint=True),
         "w_txn": padi(w_txn, Wr),
         "w_valid": np.arange(Wr) < len(w_txn),
         "t_ok": np.asarray(t_ok, bool),

@@ -28,7 +28,9 @@ import jax.numpy as jnp
 from ..core import error
 from ..core.types import CommitTransaction, Key, TransactionCommitResult, Version
 from . import conflict_kernel as ck
+from . import keypack
 from .conflict_kernel import KernelConfig, build_batch_arrays
+from .oracle import VersionIntervalMap
 
 
 class KeyShardMap:
@@ -91,7 +93,13 @@ def _is_point(begin: Key, end: Key) -> bool:
 @dataclass
 class _RoutedTxn:
     """One transaction's conflict ranges, clipped per shard (computed once).
-    Point rows ([k, k+'\\x00')) are classified here, carrying only the key."""
+    Point rows ([k, k+'\\x00')) are classified here, carrying only the key.
+
+    Rows involving keys beyond the device's exact-compare window go to the
+    host long-key tier (tier_*): long points exclusively; range rows
+    additionally (membership of long keys in any range is tier-owned, while
+    the device answers the same range for in-window keys via truncated
+    endpoints — an exact disjoint decomposition of the keyspace)."""
 
     preads: List[Tuple[int, Key]]       # (shard, key)
     rreads: List[Tuple[int, Key, Key]]  # (shard, begin, end) — may be empty ranges
@@ -102,9 +110,17 @@ class _RoutedTxn:
     n_pwrites: List[int]
     n_rwrites: List[int]
     snapshot: Version
+    #: host-tier rows (byte keys, unclipped)
+    tier_preads: List[Key]              # long point reads
+    tier_ereads: List[Key]              # long empty reads [k, k)
+    tier_rreads: List[Tuple[Key, Key]]  # non-empty range reads (all)
+    tier_pwrites: List[Key]             # long point writes
+    tier_rwrites: List[Tuple[Key, Key]] # non-empty range writes (all)
+    has_long: bool = False              # any long-key row in this txn
 
     def has_reads(self) -> bool:
-        return bool(self.preads or self.rreads)
+        return bool(self.preads or self.rreads or self.tier_preads
+                    or self.tier_ereads or self.tier_rreads)
 
 
 class RoutedConflictEngineBase:
@@ -122,9 +138,27 @@ class RoutedConflictEngineBase:
         self.n_shards = shards.n_shards
         self.base: Version = 0
         self.oldest_version: Version = 0
+        self._window = keypack.max_key_bytes(cfg.key_words)
+        #: exact host tier for out-of-window keys (absolute versions);
+        #: short-key-only workloads never touch it
+        self.tier_map = VersionIntervalMap(0)
+        self._tier_has_writes = False
 
     # -- subclass interface -------------------------------------------------
     def _run_step(self, per_shard: List[Dict[str, np.ndarray]]) -> Tuple[np.ndarray, bool]:
+        """Fused detect+fix+apply (the fast path; no host tier involved)."""
+        raise NotImplementedError
+
+    def _run_detect(self, per_shard: List[Dict[str, np.ndarray]]):
+        """Phases 1-2; returns an opaque device context for _run_fix/_run_apply."""
+        raise NotImplementedError
+
+    def _run_fix(self, ctx, per_shard, t_ok: np.ndarray) -> np.ndarray:
+        """Earlier-in-batch-wins fixpoint under an updated t_ok; committed[T]."""
+        raise NotImplementedError
+
+    def _run_apply(self, ctx, per_shard, committed: np.ndarray) -> Tuple[np.ndarray, bool]:
+        """Apply globally-agreed writes; returns (status[T], overflow)."""
         raise NotImplementedError
 
     def _reset_device_state(self, version_rel: int) -> None:
@@ -134,6 +168,8 @@ class RoutedConflictEngineBase:
     def clear(self, version: Version) -> None:
         """reference: clearConflictSet (SkipList.cpp:957-959)."""
         self._reset_device_state(self._rel(version))
+        self.tier_map = VersionIntervalMap(version)
+        self._tier_has_writes = False
 
     def _rel(self, v: Version) -> int:
         r = v - self.base
@@ -143,31 +179,90 @@ class RoutedConflictEngineBase:
             )
         return max(r, -1)
 
+    def _packed_empty(self, begin: Key, end: Key) -> bool:
+        """True iff a truly non-empty [begin, end) becomes empty under
+        endpoint truncation (both endpoints share the window prefix): the
+        device would mis-evaluate it as an empty read, so it is tier-only."""
+        w = self._window
+        a = (begin[:w], min(len(begin), w + 1))
+        b = (end[:w], min(len(end), w + 1))
+        return a >= b
+
     def _route_txn(self, tr: CommitTransaction) -> _RoutedTxn:
         S = self.n_shards
-        rt = _RoutedTxn([], [], [], [], [0] * S, [0] * S, [0] * S, [0] * S, tr.read_snapshot)
+        rt = _RoutedTxn([], [], [], [], [0] * S, [0] * S, [0] * S, [0] * S,
+                        tr.read_snapshot, [], [], [], [], [])
+        w_cap = self._window
         for r in tr.read_conflict_ranges:
             if r.begin >= r.end:
-                s = self.shards.shard_of_point_below(r.begin)
-                rt.rreads.append((s, r.begin, r.end))
+                k = r.begin
+                if len(k) > w_cap and not (len(k) == w_cap + 1 and k[-1] == 0):
+                    # Long empty read [k, k): the interval strictly below k
+                    # borders long keys, whose values only tier-visible
+                    # writes (range writes, long points) can set — the tier
+                    # answer is exact. The ONE exception is k = s+'\x00'
+                    # with a window-sized s: there the below-interval is
+                    # {s}, owned by device-side point writes, and packing k
+                    # (length window+1) is exact — so that shape routes to
+                    # the device below.
+                    rt.tier_ereads.append(k)
+                    rt.has_long = True
+                    continue
+                s = self.shards.shard_of_point_below(k)
+                rt.rreads.append((s, k, r.end))
                 rt.n_rreads[s] += 1
+            elif _is_point(r.begin, r.end) and len(r.begin) > w_cap:
+                rt.tier_preads.append(r.begin)
+                rt.has_long = True
+            elif self._packed_empty(r.begin, r.end):
+                rt.tier_rreads.append((r.begin, r.end))
+                rt.has_long = True
             else:
+                # Every non-point range may contain out-of-window keys: the
+                # tier answers for those, the device for the in-window rest.
+                if not _is_point(r.begin, r.end):
+                    rt.tier_rreads.append((r.begin, r.end))
+                    if len(r.begin) > w_cap or len(r.end) > w_cap:
+                        rt.has_long = True
                 # A point range never straddles a shard split (a split key
                 # strictly inside [k, k+'\x00') would have to equal k).
                 for s, cb, ce in self.shards.shards_of_range(r.begin, r.end):
                     if _is_point(cb, ce):
+                        if len(cb) > w_cap:
+                            # long split key carved a long point zone:
+                            # tier-owned (the full range is in tier_rreads)
+                            rt.has_long = True
+                            continue
                         rt.preads.append((s, cb))
                         rt.n_preads[s] += 1
                     else:
+                        if self._packed_empty(cb, ce):
+                            rt.has_long = True
+                            continue
                         rt.rreads.append((s, cb, ce))
                         rt.n_rreads[s] += 1
         for w in tr.write_conflict_ranges:
             if w.begin < w.end:
+                if _is_point(w.begin, w.end) and len(w.begin) > w_cap:
+                    rt.tier_pwrites.append(w.begin)
+                    rt.has_long = True
+                    continue
+                if not _is_point(w.begin, w.end):
+                    rt.tier_rwrites.append((w.begin, w.end))
+                    if len(w.begin) > w_cap or len(w.end) > w_cap:
+                        rt.has_long = True
                 for s, cb, ce in self.shards.shards_of_range(w.begin, w.end):
                     if _is_point(cb, ce):
+                        if len(cb) > w_cap:
+                            rt.has_long = True
+                            continue
                         rt.pwrites.append((s, cb))
                         rt.n_pwrites[s] += 1
                     else:
+                        if self._packed_empty(cb, ce):
+                            # collapses to nothing on device; tier-owned
+                            rt.has_long = True
+                            continue
                         rt.rwrites.append((s, cb, ce))
                         rt.n_rwrites[s] += 1
         cfg = self.cfg
@@ -285,12 +380,127 @@ class RoutedConflictEngineBase:
             )
             for s in range(S)
         ]
-        status, overflow = self._run_step(per)
+
+        chunk_has_long = any(rt.has_long for rt in routed)
+        chunk_has_rreads = any(rt.tier_rreads for rt in routed)
+        chunk_has_rwrites = any(rt.tier_rwrites for rt in routed)
+        # Slow (split-step) path only when verdicts can couple across tiers:
+        # long rows present, or range reads that tier-held write history
+        # could hit. Range-write-only chunks stay fused and just record.
+        slow = chunk_has_long or (self._tier_has_writes and chunk_has_rreads)
+
+        if not slow:
+            status, overflow = self._run_step(per)
+            if overflow:
+                raise error.conflict_capacity_exceeded(
+                    f"a shard's boundary table needs > {cfg.capacity} rows"
+                )
+            results = [TransactionCommitResult(int(v)) for v in status[:n]]
+            if chunk_has_rwrites:
+                self._tier_record(routed, results, now, new_oldest)
+            elif new_oldest > self.oldest_version:
+                self.tier_map.gc(new_oldest)
+            return results
+
+        # ---- split-step path: global verdicts BEFORE any writes ----------
+        # Tier history hits are t_ok-level aborts; tier intra-batch edges
+        # join the device fixpoint through an outer iteration that converges
+        # to the oracle's sequential-sweep verdicts (all edges point earlier
+        # txn -> later txn, so each round finalizes a growing prefix).
+        tier_hist = np.zeros((cfg.max_txns,), bool)
+        for t, rt in enumerate(routed):
+            if not t_ok[t]:
+                continue
+            snap = rt.snapshot
+            hit = False
+            for k in rt.tier_preads:
+                if self.tier_map.range_max(k, k + b"\x00") > snap:
+                    hit = True
+                    break
+            if not hit:
+                for k in rt.tier_ereads:
+                    if self.tier_map.version_strictly_below(k) > snap:
+                        hit = True
+                        break
+            if not hit:
+                for b, e in rt.tier_rreads:
+                    if self.tier_map.range_max(b, e) > snap:
+                        hit = True
+                        break
+            tier_hist[t] = hit
+
+        # Unconditional tier intra-batch edges (u writes, t reads, u < t);
+        # whether an edge blocks depends on u's GLOBAL verdict each round.
+        edges: List[Tuple[int, int]] = []
+        writes_by_txn: List[List[Tuple[Key, Key]]] = []
+        for u, ru in enumerate(routed):
+            ws = [(k, k + b"\x00") for k in ru.tier_pwrites] + list(ru.tier_rwrites)
+            writes_by_txn.append(ws)
+        for t, rt in enumerate(routed):
+            if not t_ok[t]:
+                continue
+            reads = [(k, k + b"\x00") for k in rt.tier_preads] + list(rt.tier_rreads)
+            if not reads:
+                continue
+            for u in range(t):
+                if any(rb_ < we_ and wb_ < re__
+                       for (rb_, re__) in reads
+                       for (wb_, we_) in writes_by_txn[u]):
+                    edges.append((u, t))
+
+        ctx = self._run_detect(per)
+        cur_abort = tier_hist.copy()
+        committed = self._run_fix(ctx, per, t_ok & ~cur_abort)
+        for _ in range(n + 1):
+            blocked = np.zeros((cfg.max_txns,), bool)
+            for u, t in edges:
+                if committed[u]:
+                    blocked[t] = True
+            new_abort = tier_hist | blocked
+            if np.array_equal(new_abort, cur_abort):
+                break
+            cur_abort = new_abort
+            committed = self._run_fix(ctx, per, t_ok & ~cur_abort)
+
+        status, overflow = self._run_apply(ctx, per, committed)
         if overflow:
             raise error.conflict_capacity_exceeded(
                 f"a shard's boundary table needs > {cfg.capacity} rows"
             )
-        return [TransactionCommitResult(int(v)) for v in status[:n]]
+        results = [TransactionCommitResult(int(v)) for v in status[:n]]
+        self._tier_record(routed, results, now, new_oldest)
+        return results
+
+    def _write_lossy_on_device(self, b: Key, e: Key) -> bool:
+        """True iff the device's truncated image of write [b, e) loses
+        coverage somewhere — only such writes force later range reads onto
+        the split-step path (a short-endpoint range write is fully visible
+        on device, so device range-maxes already include it)."""
+        w = self._window
+        if len(b) > w or len(e) > w or self._packed_empty(b, e):
+            return True
+        for s, cb, ce in self.shards.shards_of_range(b, e):
+            if _is_point(cb, ce):
+                if len(cb) > w:
+                    return True
+            elif self._packed_empty(cb, ce):
+                return True
+        return False
+
+    def _tier_record(self, routed, results, now: Version, new_oldest: Version) -> None:
+        """Record COMMITTED tier writes into the host tier map + GC."""
+        for t, rt in enumerate(routed):
+            if results[t] != TransactionCommitResult.COMMITTED:
+                continue
+            for k in rt.tier_pwrites:
+                self.tier_map.write(k, k + b"\x00", now)
+                self._tier_has_writes = True
+            for b, e in rt.tier_rwrites:
+                self.tier_map.write(b, e, now)
+                if not self._tier_has_writes and self._write_lossy_on_device(b, e):
+                    self._tier_has_writes = True
+        if new_oldest > self.oldest_version:
+            self.tier_map.gc(new_oldest)
 
 
 class JaxConflictEngine(RoutedConflictEngineBase):
@@ -302,10 +512,16 @@ class JaxConflictEngine(RoutedConflictEngineBase):
     def __init__(self, cfg: KernelConfig = KernelConfig(), initial_version: Version = 0):
         super().__init__(cfg, KeyShardMap([]))
         self.state = ck.initial_state(cfg, version_rel=initial_version)
+        self.tier_map = VersionIntervalMap(initial_version)
         self._step = jax.jit(
             functools.partial(ck.resolve_step, cfg),
             donate_argnums=(0,),
         )
+        # Split-step programs for the long-key tier path, compiled lazily
+        # (short-key-only workloads never pay for them).
+        self._detect = jax.jit(functools.partial(ck.detect_step, cfg))
+        self._fix = jax.jit(functools.partial(ck.fix_step, cfg))
+        self._apply = jax.jit(functools.partial(ck.apply_step, cfg), donate_argnums=(0,))
 
     def _reset_device_state(self, version_rel: int) -> None:
         self.state = ck.initial_state(self.cfg, version_rel=version_rel)
@@ -315,3 +531,20 @@ class JaxConflictEngine(RoutedConflictEngineBase):
         batch = {k: jnp.asarray(v) for k, v in arrays.items()}
         self.state, out = self._step(self.state, batch)
         return np.asarray(out["status"]), bool(out["overflow"])
+
+    def _run_detect(self, per_shard):
+        (arrays,) = per_shard
+        batch = {k: jnp.asarray(v) for k, v in arrays.items()}
+        hist, ovp, wpos = self._detect(self.state, batch)
+        return {"batch": batch, "hist": hist, "ovp": ovp, "wpos": wpos}
+
+    def _run_fix(self, ctx, per_shard, t_ok: np.ndarray) -> np.ndarray:
+        committed = self._fix(jnp.asarray(t_ok), ctx["hist"], ctx["ovp"], ctx["batch"])
+        return np.asarray(committed)
+
+    def _run_apply(self, ctx, per_shard, committed: np.ndarray) -> Tuple[np.ndarray, bool]:
+        batch = ctx["batch"]
+        cm = jnp.asarray(committed)
+        self.state, overflow = self._apply(self.state, batch, cm, ctx["wpos"])
+        status = ck.status_of(np.asarray(batch["t_too_old"]), committed)
+        return np.asarray(status), bool(overflow)

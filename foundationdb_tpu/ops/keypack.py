@@ -4,10 +4,18 @@ A key (bytes) is packed into ``key_words`` big-endian uint32 words (zero
 padded) plus a final length word. Lexicographic comparison of the resulting
 (words..., length) tuple is *exactly* the reference's key order — bytewise,
 shorter-is-less on equal prefix (fdbserver/SkipList.cpp:113-120) — for all
-keys of length <= 4*key_words. Longer keys raise; the engine's exact-compare
-width is a configuration knob (production configs size it to the schema's
-conflict-key width; a digest+host-verify tier for unbounded keys is a later
-milestone, cf. SURVEY.md §7 hard parts).
+keys of length <= 4*key_words.
+
+Longer keys never reach pack_keys: the routed host engine sends long POINT
+rows to its exact host tier (host_engine.py), and long RANGE ENDPOINTS are
+packed by pack_endpoint_keys, which truncates to the window with length
+window+1. The truncated form compares identically to the original against
+every in-window key q: any byte difference inside the window decides both,
+and when q is a prefix of the long key the length lane (len(q) <= window <
+window+1) gives q < key either way — so device-side interval membership of
+short keys is exact under truncation, and long-key membership is owned by
+the host tier (SURVEY.md §7's digest/host-verify hard part, solved by
+exact tiering instead of digests).
 """
 from __future__ import annotations
 
@@ -42,6 +50,20 @@ def pack_keys(keys: Sequence[bytes], key_words: int) -> np.ndarray:
     ).reshape(n, kb)
     packed = flat.view(">u4").astype(np.uint32)
     return np.concatenate([packed, lens[:, None].astype(np.uint32)], axis=1)
+
+
+def pack_endpoint_keys(keys: Sequence[bytes], key_words: int) -> np.ndarray:
+    """pack_keys for RANGE ENDPOINTS: keys longer than the window are
+    truncated to (first window bytes, length=window+1) — see module
+    docstring for why this is exact for in-window membership."""
+    kb = max_key_bytes(key_words)
+    if all(len(k) <= kb for k in keys):
+        return pack_keys(keys, key_words)
+    out = pack_keys([k[:kb] for k in keys], key_words)
+    for i, k in enumerate(keys):
+        if len(k) > kb:
+            out[i, key_words] = kb + 1
+    return out
 
 
 def pack_key(key: bytes, key_words: int) -> np.ndarray:

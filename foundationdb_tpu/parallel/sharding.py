@@ -82,6 +82,50 @@ def make_sharded_step(cfg: KernelConfig, mesh: Mesh, axis: str = "shard"):
     return jax.jit(mapped, donate_argnums=(0,))
 
 
+def make_sharded_split_steps(cfg: KernelConfig, mesh: Mesh, axis: str = "shard"):
+    """Detect / fix / apply as separate shard_map programs, for the host
+    long-key tier: the outer host fixpoint needs global verdicts BEFORE any
+    tier (device shards included) applies writes. Same stacking conventions
+    as make_sharded_step; committed is replicated across shards."""
+
+    def detect(state, batch):
+        state = jax.tree.map(lambda x: x[0], state)
+        batch = jax.tree.map(lambda x: x[0], batch)
+        hist_hits, ovp, wpos = ck.local_phases(cfg, state, batch)
+        return jax.tree.map(lambda x: jnp.asarray(x)[None], (hist_hits, ovp, wpos))
+
+    def fix(t_ok, hist_local, ovp, batch):
+        t_ok = t_ok[0]
+        hist_local = hist_local[0]
+        ovp = ovp[0]
+        batch = jax.tree.map(lambda x: x[0], batch)
+        hist = lax.psum(hist_local, axis)
+        committed = ck.commit_fixpoint(
+            cfg, t_ok, hist, ovp, batch,
+            allreduce=lambda x: lax.psum(x, axis),
+        )
+        return committed[None]
+
+    def apply(state, batch, committed, wpos):
+        state = jax.tree.map(lambda x: x[0], state)
+        batch = jax.tree.map(lambda x: x[0], batch)
+        committed = committed[0]
+        wpos = jax.tree.map(lambda x: x[0], wpos)
+        new_state, overflow = ck.apply_writes_and_gc(cfg, state, batch, committed, wpos)
+        return jax.tree.map(lambda x: jnp.asarray(x)[None], (new_state, overflow))
+
+    detect_m = jax.jit(jax.shard_map(
+        detect, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P(axis)))
+    fix_m = jax.jit(jax.shard_map(
+        fix, mesh=mesh, in_specs=(P(axis), P(axis), P(axis), P(axis)),
+        out_specs=P(axis)))
+    apply_m = jax.jit(jax.shard_map(
+        apply, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis)), out_specs=P(axis)),
+        donate_argnums=(0,))
+    return detect_m, fix_m, apply_m
+
+
 class ShardedConflictEngine(RoutedConflictEngineBase):
     """Multi-device ConflictSet engine: same resolve() contract as
     OracleConflictEngine/JaxConflictEngine, state sharded over a Mesh."""
@@ -105,7 +149,11 @@ class ShardedConflictEngine(RoutedConflictEngineBase):
         self.mesh = mesh
         self._sharding = NamedSharding(mesh, P("shard"))
         self._step = make_sharded_step(cfg, mesh)
+        self._detect_m, self._fix_m, self._apply_m = make_sharded_split_steps(cfg, mesh)
         self._reset_device_state(self._rel(initial_version))
+        from ..ops.oracle import VersionIntervalMap
+
+        self.tier_map = VersionIntervalMap(initial_version)
 
     def _stack_shards(self, per_shard: List[Dict]):
         stacked = jax.tree.map(lambda *xs: np.stack([np.asarray(x) for x in xs]), *per_shard)
@@ -123,3 +171,27 @@ class ShardedConflictEngine(RoutedConflictEngineBase):
         self.state, out = self._step(self.state, batch)
         status = np.asarray(out["status"])[0]
         return status, bool(np.any(np.asarray(out["overflow"])))
+
+    # -- split-step path (host long-key tier) --------------------------------
+    def _run_detect(self, per_shard):
+        batch = self._stack_shards(per_shard)
+        hist, ovp, wpos = self._detect_m(self.state, batch)
+        return {"batch": batch, "hist": hist, "ovp": ovp, "wpos": wpos}
+
+    def _run_fix(self, ctx, per_shard, t_ok: np.ndarray) -> np.ndarray:
+        t_ok_stacked = jax.device_put(
+            np.broadcast_to(t_ok, (self.n_shards,) + t_ok.shape).copy(),
+            self._sharding,
+        )
+        committed = self._fix_m(t_ok_stacked, ctx["hist"], ctx["ovp"], ctx["batch"])
+        return np.asarray(committed)[0]
+
+    def _run_apply(self, ctx, per_shard, committed: np.ndarray) -> Tuple[np.ndarray, bool]:
+        cm = jax.device_put(
+            np.broadcast_to(committed, (self.n_shards,) + committed.shape).copy(),
+            self._sharding,
+        )
+        self.state, overflow = self._apply_m(self.state, ctx["batch"], cm, ctx["wpos"])
+        t_too_old = np.asarray(ctx["batch"]["t_too_old"])[0]
+        status = np.asarray(ck.status_of(t_too_old, committed))
+        return status, bool(np.any(np.asarray(overflow)))
