@@ -490,11 +490,15 @@ class Transaction:
         return await self.get_range(b, e, limit=limit if limit is not None else 10_000,
                                     reverse=reverse, snapshot=snapshot)
 
-    def watch(self, key: Key):
-        """Future firing when `key`'s value changes from what this
-        transaction reads now (reference: Transaction::watch,
-        NativeAPI.actor.cpp:1302). Survives storage failures by
-        re-registering with a fresh snapshot; cancel the returned task to
+    def watch(self, key: Key, expected: object = ...,
+              expected_version: Optional[Version] = None):
+        """Future firing when `key`'s value changes from `expected`
+        (reference: Transaction::watch, NativeAPI.actor.cpp:1302). With no
+        `expected`, the watch snapshot-reads the current value first; pass
+        the value your transaction already read (plus its read version) to
+        close the read-then-watch race — the reference gets that atomicity
+        from registering the watch inside the reading transaction. Survives
+        storage failures by re-registering; cancel the returned task to
         stop watching."""
         from ..sim.loop import spawn
 
@@ -512,14 +516,18 @@ class Transaction:
                     await tr.on_error(e)
 
         async def watch_actor():
-            expected, version = await read_current()
+            if expected is ...:
+                exp, version = await read_current()
+            else:
+                exp = expected
+                version = expected_version or self.read_version or 0
             while True:
                 try:
                     locs = await self.db.get_locations(key, key_after(key))
                     return await self.db.net.request(
                         self.db.client_addr,
                         Endpoint(locs[0][1][0], storage_mod.WATCH_VALUE_TOKEN),
-                        WatchValueRequest(key=key, value=expected, version=version),
+                        WatchValueRequest(key=key, value=exp, version=version),
                         TaskPriority.DEFAULT_ENDPOINT,
                         timeout=30.0,
                     )
@@ -532,7 +540,7 @@ class Transaction:
                     # value moved while we were not watching, fire now.
                     await delay(0.25)
                     current, version = await read_current()
-                    if current != expected:
+                    if current != exp:
                         return current
 
         return spawn(watch_actor(), TaskPriority.DEFAULT_ENDPOINT, name=f"watch:{key!r}")

@@ -21,6 +21,8 @@ from .workloads import (
     MachineAttritionWorkload,
     RandomCloggingWorkload,
     RandomReadWriteWorkload,
+    SelectorCorrectnessWorkload,
+    WatchesWorkload,
     WriteDuringReadWorkload,
 )
 
@@ -70,6 +72,17 @@ SPECS: Dict[str, Callable[[], Spec]] = {
         dynamic=DynamicClusterConfig(n_workers=6, n_tlogs=2, n_resolvers=2, n_storage=2),
         client_count=3,
         timeout=900.0,
+    ),
+    # fast/Watches.txt + rare/SelectorCorrectness
+    "WatchesAndSelectors": lambda: Spec(
+        title="WatchesAndSelectors",
+        workloads=[
+            (WatchesWorkload, {"rounds": 5}),
+            (SelectorCorrectnessWorkload, {"checks": 25}),
+        ],
+        cluster=ClusterConfig(n_resolvers=2, n_storage=2),
+        client_count=2,
+        timeout=600.0,
     ),
     # tests/fast/CycleTest.txt: Cycle + RandomClogging ×2
     "CycleTest": lambda: Spec(

@@ -429,3 +429,103 @@ class MachineAttritionWorkload(TestWorkload):
                 self.ctx.count("kills")
                 sim.kill_process(victim, KillType.REBOOT)
             await delay(interval)
+
+
+class WatchesWorkload(TestWorkload):
+    """Watch/trigger ping-pong (Watches.actor.cpp): client pairs bounce a
+    counter; every bounce is driven by a watch firing with the new value."""
+
+    name = "Watches"
+
+    async def start(self, db: Database) -> None:
+        rounds = int(self.ctx.options.get("rounds", 6))
+        me = self.ctx.client_id
+        peer = (me + 1) % self.ctx.client_count
+        key_me = b"watch/%02d" % me
+        key_peer = b"watch/%02d" % peer
+
+        async def write_my(tr, n):
+            tr.set(key_me, b"%06d" % n)
+
+        async def read_peer(tr):
+            return await tr.get(key_peer, snapshot=True), tr.read_version
+
+        async def wait_peer_at_least(n):
+            """Race-free wait: watch registered against the value THIS read
+            observed (the reference registers watches inside the reading
+            transaction for the same atomicity)."""
+            while True:
+                cur, rv = await db.run(read_peer)
+                if cur is not None and int(cur) >= n:
+                    return cur
+                await db.create_transaction().watch(
+                    key_peer, expected=cur, expected_version=rv)
+
+        # client 0 serves: write mine, wait for peer's echo via watch
+        for n in range(rounds):
+            if me == 0:
+                await db.run(write_my, n)
+                got = await wait_peer_at_least(n)
+                if int(got) == n:
+                    self.ctx.count("watch_bounces")
+            else:
+                if n > 0:
+                    await wait_peer_at_least(n)
+                await db.run(write_my, n)
+
+    async def check(self, db: Database) -> bool:
+        # liveness is the check: every round required a watch to fire
+        return self.ctx.shared.get("watch_bounces", 0) >= 1
+
+
+class SelectorCorrectnessWorkload(TestWorkload):
+    """Key-selector resolution vs a host model (SelectorCorrectness
+    .actor.cpp): random selectors over a known key set must resolve to the
+    model's answer."""
+
+    name = "SelectorCorrectness"
+
+    async def setup(self, db: Database) -> None:
+        async def w(tr):
+            for i in range(20):
+                tr.set(b"sel/%03d" % (i * 5), b"v")
+        await db.run(w)
+
+    async def start(self, db: Database) -> None:
+        from ..client.database import KeySelector
+
+        rng = self.ctx.rng
+        keys = [b"sel/%03d" % (i * 5) for i in range(20)]
+        checks = int(self.ctx.options.get("checks", 30))
+
+        def model(anchor, or_equal, offset):
+            """Resolution index within this workload's key set; None when it
+            would walk outside sel/ (other workloads' keys live there, so
+            the database's answer is out of this model's scope)."""
+            i0 = (bisect.bisect_right(keys, anchor) if or_equal
+                  else bisect.bisect_left(keys, anchor))
+            i = i0 + offset - 1
+            if 0 <= i < len(keys):
+                return keys[i]
+            return None
+
+        for _ in range(checks):
+            anchor = b"sel/%03d" % rng.random_int(0, 100)
+            or_equal = rng.coinflip()
+            offset = rng.random_int(-3, 4)
+            want = model(anchor, or_equal, offset)
+            if want is None:
+                continue
+            sel = KeySelector(anchor, or_equal, offset)
+
+            async def resolve(tr):
+                return await tr.get_key(sel)
+
+            got = await db.run(resolve)
+            if got != want:
+                self.ctx.count("selector_mismatches")
+            self.ctx.count("selector_checks")
+
+    async def check(self, db: Database) -> bool:
+        return (self.ctx.shared.get("selector_mismatches", 0) == 0
+                and self.ctx.shared.get("selector_checks", 0) > 0)

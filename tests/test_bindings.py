@@ -1,0 +1,126 @@
+"""Binding surface: tuple layer ordering, Subspace, @transactional.
+
+reference: design/tuple.md spec + bindings/python/fdb (tuple.py, impl.py,
+subspace_impl.py); the bindingtester's core property is order preservation.
+"""
+import random
+import uuid
+
+import pytest
+
+from foundationdb_tpu.bindings import Subspace, fdb_tuple, transactional
+from foundationdb_tpu.server.cluster import ClusterConfig, build_cluster
+
+
+def test_tuple_roundtrip():
+    cases = [
+        (),
+        (None,),
+        (b"bytes", "string", 0, 1, -1, 255, -255, 2**40, -(2**40)),
+        (3.14, -2.5, 0.0, float("inf")),
+        (True, False),
+        (uuid.UUID(int=0x1234567890ABCDEF1234567890ABCDEF),),
+        (b"with\x00nul", "uniécode"),
+        ((1, (b"nested", None)), "after"),
+        (None, (None, None), b""),
+    ]
+    for t in cases:
+        packed = fdb_tuple.pack(t)
+        assert fdb_tuple.unpack(packed) == t, t
+
+
+def _rand_elem(rng, depth=0):
+    kind = rng.randrange(0, 8 if depth < 2 else 7)
+    if kind == 0:
+        return None
+    if kind == 1:
+        return bytes(rng.randrange(0, 256) for _ in range(rng.randrange(0, 6)))
+    if kind == 2:
+        return "".join(chr(rng.randrange(32, 1000)) for _ in range(rng.randrange(0, 5)))
+    if kind == 3:
+        return rng.randrange(-(2**32), 2**32)
+    if kind == 4:
+        return rng.choice([True, False])
+    if kind == 5:
+        return rng.uniform(-1e6, 1e6)
+    if kind == 6:
+        return uuid.UUID(int=rng.getrandbits(128))
+    return tuple(_rand_elem(rng, depth + 1) for _ in range(rng.randrange(0, 3)))
+
+
+def test_tuple_order_preservation():
+    """Packed byte order equals typed order for same-type comparisons —
+    the property every layer depends on (bindingtester's core check)."""
+    rng = random.Random(5)
+    # same-shape tuples of comparable scalars
+    for _ in range(300):
+        kind = rng.randrange(3)
+        if kind == 0:
+            a = (rng.randrange(-(2**32), 2**32), rng.randrange(0, 100))
+            b = (rng.randrange(-(2**32), 2**32), rng.randrange(0, 100))
+        elif kind == 1:
+            a = (bytes(rng.randrange(0, 256) for _ in range(rng.randrange(0, 5))),)
+            b = (bytes(rng.randrange(0, 256) for _ in range(rng.randrange(0, 5))),)
+        else:
+            a = (rng.uniform(-1e9, 1e9),)
+            b = (rng.uniform(-1e9, 1e9),)
+        pa, pb = fdb_tuple.pack(a), fdb_tuple.pack(b)
+        assert (a < b) == (pa < pb) and (a == b) == (pa == pb), (a, b)
+
+
+def test_tuple_prefix_extension_sorts_inside_range():
+    rng = random.Random(7)
+    for _ in range(100):
+        base = (rng.randrange(0, 1000), "cat")
+        ext = base + (rng.randrange(0, 1000),)
+        lo, hi = fdb_tuple.range_of(base)
+        p = fdb_tuple.pack(ext)
+        assert lo <= p < hi
+
+
+def test_subspace():
+    s = Subspace(("app", 7))
+    key = s.pack(("user", 42))
+    assert s.contains(key)
+    assert s.unpack(key) == ("user", 42)
+    nested = s["user"]
+    assert nested.pack((42,)) == key
+    lo, hi = s.range(("user",))
+    assert lo <= key < hi
+    assert not Subspace(("other",)).contains(key)
+
+
+def test_transactional_decorator_end_to_end():
+    c = build_cluster(seed=81, cfg=ClusterConfig(n_resolvers=1, n_storage=2))
+    db = c.new_client()
+    users = Subspace(("users",))
+
+    @transactional
+    async def add_user(tr, uid, name):
+        tr.set(users.pack((uid,)), name.encode())
+
+    @transactional
+    async def rename_all(tr, suffix):
+        lo, hi = users.range()
+        rows = await tr.get_range(lo, hi)
+        for k, v in rows:
+            tr.set(k, v + suffix.encode())
+        return len(rows)
+
+    async def work():
+        await add_user(db, 1, "ada")
+        await add_user(db, 2, "grace")
+        n = await rename_all(db, "!")
+        assert n == 2
+
+        @transactional
+        async def read(tr):
+            return await tr.get(users.pack((2,)))
+
+        # composes into an existing transaction too
+        tr = db.create_transaction()
+        v = await read(tr)
+        return v
+
+    got = c.sim.run_until(c.sim.sched.spawn(work(), name="w"), until=60.0)
+    assert got == b"grace!"
