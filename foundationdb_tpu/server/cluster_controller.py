@@ -143,6 +143,14 @@ class ClusterController:
                 }
             except error.FDBError:
                 doc["cluster"]["version"] = None
+        for addr in info.proxy_addrs:
+            try:
+                doc.setdefault("proxy_stats", {})[addr] = await self.net.request(
+                    self.proc.address, Endpoint(addr, "proxy.stats"), None,
+                    TaskPriority.CLUSTER_CONTROLLER, timeout=1.0,
+                )
+            except error.FDBError:
+                pass
         for tag, b, e, addr in info.storage_tags:
             entry = {"tag": tag, "address": addr,
                      "shard_begin": b.hex(), "shard_end": e.hex()}
@@ -153,6 +161,10 @@ class ClusterController:
                 )
                 entry["version"] = qi.version
                 entry["durable_version"] = qi.durable_version
+                entry["counters"] = await self.net.request(
+                    self.proc.address, Endpoint(addr, "storage.stats"), None,
+                    TaskPriority.CLUSTER_CONTROLLER, timeout=1.0,
+                )
             except error.FDBError:
                 entry["unreachable"] = True
             doc["storage"].append(entry)

@@ -27,6 +27,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..core import buggify, error
 from ..core.knobs import SERVER_KNOBS
+from ..core.stats import CounterCollection
 from ..core.types import (
     CommitTransaction,
     Key,
@@ -58,6 +59,7 @@ from .messages import (
 GRV_TOKEN = "proxy.getReadVersion"
 COMMIT_TOKEN = "proxy.commit"
 LOCATIONS_TOKEN = "proxy.getKeyServerLocations"
+STATS_TOKEN = "proxy.stats"
 
 #: batching intervals/caps come from the knob registry so BUGGIFY can
 #: randomize them per simulation (reference: START_TRANSACTION_BATCH_* /
@@ -113,6 +115,8 @@ class Proxy:
         self._pending_master_req: Dict[int, int] = {}
         self._grv_waiters: List[Promise] = []
         self._commit_queue: PromiseStream = PromiseStream()
+        #: reference: ProxyStats (MasterProxyServer.actor.cpp:48-80)
+        self.stats = CounterCollection("Proxy", proc.address)
         #: ratekeeper admission (transactionStarter:947): GRVs are released
         #: from a budget replenished at tps_limit per second
         self._tps_limit: float = float("inf")
@@ -125,7 +129,9 @@ class Proxy:
         proc.register(GRV_TOKEN, self.get_read_version)
         proc.register(COMMIT_TOKEN, self.commit)
         proc.register(LOCATIONS_TOKEN, self.get_key_server_locations)
+        proc.register(STATS_TOKEN, self._stats_req)
         self._spawn(self.commit_batcher(), TaskPriority.PROXY_COMMIT_BATCHER, "commitBatcher")
+        self._spawn(self.stats.run_logger(), TaskPriority.PROXY_GRV_TIMER, "proxyStats")
         if cfg.master_wf_ep is not None:
             self._spawn(self._watch_master(), TaskPriority.FAILURE_MONITOR, "watchMaster")
         if cfg.rate_ep is not None:
@@ -187,9 +193,12 @@ class Proxy:
         if self._dead:
             return
         self._dead = True
-        for tok in (GRV_TOKEN, COMMIT_TOKEN, LOCATIONS_TOKEN):
+        for tok in (GRV_TOKEN, COMMIT_TOKEN, LOCATIONS_TOKEN, STATS_TOKEN):
             self.proc.unregister(tok)
         self.actors.cancel_all()
+
+    async def _stats_req(self, _req):
+        return self.stats.as_dict()
 
     # -- GRV path ------------------------------------------------------------
     async def get_read_version(self, req: GetReadVersionRequest) -> GetReadVersionReply:
@@ -198,6 +207,7 @@ class Proxy:
         if len(self._grv_waiters) == 1:
             self._spawn(self._grv_flush(), TaskPriority.PROXY_GRV_TIMER, "grvBatch")
         await p.future
+        self.stats.add("txn_start_out")
         return GetReadVersionReply(version=self.committed_version.get())
 
     async def _grv_flush(self) -> None:
@@ -226,6 +236,7 @@ class Proxy:
 
     # -- commit path -----------------------------------------------------------
     async def commit(self, req: CommitTransactionRequest) -> CommitReply:
+        self.stats.add("txn_commit_in")
         p = Promise()
         self._commit_queue.send((req.transaction, p))
         return await p.future
@@ -460,8 +471,11 @@ class Proxy:
         for t, (_, p) in enumerate(items):
             verdict = verdicts[t]
             if verdict == int(TransactionCommitResult.COMMITTED):
+                self.stats.add("txn_committed")
                 p.send(CommitReply(version=v, txn_batch_index=t))
             elif verdict == int(TransactionCommitResult.TOO_OLD):
+                self.stats.add("txn_too_old")
                 p.send_error(error.transaction_too_old())
             else:
+                self.stats.add("txn_conflicted")
                 p.send_error(error.not_committed())

@@ -13,6 +13,7 @@ import bisect
 from typing import Dict, List, Optional, Tuple
 
 from ..core import buggify, error, wire
+from ..core.stats import CounterCollection
 from ..core.types import (
     MAX_WRITE_TRANSACTION_LIFE_VERSIONS,
     Key,
@@ -158,6 +159,8 @@ class StorageServer:
         self.net = net
         self.log_view = log_view
         self.store = VersionedStore()
+        #: reference: StorageServer::Counters (storageserver.actor.cpp)
+        self.stats = CounterCollection("Storage", f"tag{tag}")
         self.version = NotifiedVersion(start_version)
         #: durable (synced) version: the tlog may only be popped to here
         self.durable_version: Version = start_version
@@ -175,6 +178,11 @@ class StorageServer:
                 tag=self.tag, version=self.version.get(),
                 durable_version=self.durable_version,
             )
+
+        async def stats_req(_req):
+            return self.stats.as_dict()
+
+        proc.register("storage.stats", stats_req)
 
         proc.register(STORAGE_QUEUE_INFO_TOKEN, queue_info)
         if not defer_update_loop:
@@ -310,6 +318,7 @@ class StorageServer:
                     continue
                 for m in muts:
                     self._apply(m, v)
+                self.stats.add("mutations", len(muts))
                 if self.queue is not None:
                     await self.queue.push(wire.dumps((v, muts)))
                 applied_any = True
@@ -349,6 +358,7 @@ class StorageServer:
         if not self.shard.contains(req.key):
             raise error.wrong_shard_server()
         await self._wait_for_version(req.version)
+        self.stats.add("get_value")
         return GetValueReply(value=self.store.value_at(req.key, req.version))
 
     async def watch_value(self, req) -> Optional[Value]:
@@ -371,5 +381,7 @@ class StorageServer:
     async def get_key_values(self, req: GetKeyValuesRequest) -> GetKeyValuesReply:
         self._check_shard(req.begin, req.end)
         await self._wait_for_version(req.version)
+        self.stats.add("get_range")
         data, more = self.store.range_at(req.begin, req.end, req.version, req.limit, req.reverse)
+        self.stats.add("rows_read", len(data))
         return GetKeyValuesReply(data=data, more=more)

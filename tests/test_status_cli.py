@@ -91,3 +91,30 @@ def test_cli_commands():
     assert "recovery state     - fully_recovered" in text
     assert "unknown command" in text
     assert "1 row(s)" in text
+
+
+def test_counters_in_status():
+    """Per-role counters (flow/Stats.h analog) flow into the status doc."""
+    c = build_dynamic_cluster(seed=74, cfg=DynamicClusterConfig())
+    sim = c.sim
+    db = c.new_client()
+
+    async def work():
+        from foundationdb_tpu.sim.loop import delay
+
+        for i in range(5):
+            async def w(tr, i=i):
+                tr.set(b"k%d" % i, b"v")
+                await tr.get(b"k0")
+            await db.run(w)
+        await delay(1.0)
+        return await db.get_status()
+
+    doc = sim.run_until(sim.sched.spawn(work(), name="w"), until=60.0)
+    (proxy_stats,) = doc["proxy_stats"].values()
+    assert proxy_stats["txn_committed"] >= 5
+    assert proxy_stats["txn_start_out"] >= 5
+    total_mutations = sum(
+        s.get("counters", {}).get("mutations", 0) for s in doc["storage"]
+    )
+    assert total_mutations >= 5
