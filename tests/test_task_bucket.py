@@ -1,0 +1,94 @@
+"""TaskBucket: exactly-once claims, finish, timeout requeue.
+
+reference: fdbclient/TaskBucket.actor.cpp + the TaskBucketCorrectness
+workload (exactly-once execution under concurrent executors).
+"""
+from foundationdb_tpu.bindings import Subspace
+from foundationdb_tpu.bindings.task_bucket import TaskBucket
+from foundationdb_tpu.core import error
+from foundationdb_tpu.server.cluster import ClusterConfig, build_cluster
+
+
+def drive(c, coro, until=120.0):
+    return c.sim.run_until(c.sim.sched.spawn(coro, name="t"), until=until)
+
+
+def test_exactly_once_under_concurrent_executors():
+    c = build_cluster(seed=51, cfg=ClusterConfig(n_resolvers=2, n_storage=2))
+    db = c.new_client()
+    tb = TaskBucket(Subspace(("tb",)))
+    N = 12
+    executed = []
+
+    async def produce():
+        async def add_all(tr):
+            for i in range(N):
+                tb.add(tr, i, {"op": "work", "n": i})
+        await db.run(add_all)
+
+    async def executor(eid):
+        mydb = c.new_client()
+        idle = 0
+        while idle < 3:
+            async def claim(tr):
+                return await tb.get_one(tr)
+            task = await mydb.run(claim)
+            if task is None:
+                idle += 1
+                from foundationdb_tpu.sim.loop import delay
+                await delay(0.05)
+                continue
+            idle = 0
+            executed.append((eid, task.id))
+
+            async def fin(tr):
+                tb.finish(tr, task)
+            await mydb.run(fin)
+
+    async def main():
+        await produce()
+        from foundationdb_tpu.sim.actors import all_of
+        from foundationdb_tpu.sim.loop import spawn
+        workers = [spawn(executor(e), name=f"exec{e}") for e in range(3)]
+        await all_of(workers)
+
+        async def empty(tr):
+            return await tb.is_empty(tr)
+        return await db.run(empty)
+
+    assert drive(c, main())
+    ids = sorted(t for _, t in executed)
+    assert ids == list(range(N)), ids  # every task exactly once
+
+
+def test_timeout_requeue():
+    c = build_cluster(seed=52, cfg=ClusterConfig(n_resolvers=1, n_storage=1))
+    db = c.new_client()
+    tb = TaskBucket(Subspace(("tb2",)), timeout_seconds=1.0)
+
+    async def main():
+        from foundationdb_tpu.sim.loop import delay
+
+        async def add(tr):
+            tb.add(tr, 7, {"op": "x"})
+        await db.run(add)
+
+        # claim it, then "die" (never finish)
+        async def claim(tr):
+            return await tb.get_one(tr)
+        task = await db.run(claim)
+        assert task is not None and task.id == 7
+
+        # nothing available while the claim is live
+        assert (await db.run(claim)) is None
+
+        await delay(1.5)
+        async def sweep(tr):
+            return await tb.check_timeouts(tr)
+        moved = await db.run(sweep)
+        assert moved == 1
+
+        task2 = await db.run(claim)
+        return task2 is not None and task2.id == 7
+
+    assert drive(c, main())
