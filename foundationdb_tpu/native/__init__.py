@@ -1,0 +1,10 @@
+"""Native (C) components, loaded via ctypes with Python fallbacks.
+
+The reference's host data plane is C++; this package holds the analogous
+native pieces. Everything here is OPTIONAL at runtime: importers fall back
+to the numpy implementations when the shared object is missing or the
+toolchain is absent, so no environment ever fails to run.
+"""
+from .build import load_fastpack
+
+__all__ = ["load_fastpack"]

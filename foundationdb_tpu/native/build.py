@@ -1,0 +1,60 @@
+"""Build + load the native components (cc -O2 -shared, cached).
+
+pybind11 is not available in this environment, so the binding is plain
+ctypes over a C ABI — the same pattern works for any future native piece
+(DiskQueue frame scanning, wire codecs). The build is lazy, cached next to
+the source, and every failure path returns None so callers fall back to
+their Python implementations.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "fastpack.c")
+_SO = os.path.join(_DIR, "_fastpack.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    for cc in ("cc", "gcc", "clang"):
+        try:
+            r = subprocess.run(
+                [cc, "-O2", "-fPIC", "-shared", "-o", _SO, _SRC],
+                capture_output=True, timeout=60,
+            )
+            if r.returncode == 0:
+                return True
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+    return False
+
+
+def load_fastpack() -> Optional[ctypes.CDLL]:
+    """The fastpack library, building it on first use; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    try:
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            if not _build():
+                return None
+        lib = ctypes.CDLL(_SO)
+        lib.pack_keys.restype = ctypes.c_int
+        lib.pack_keys.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
+        _lib = lib
+    except OSError:
+        _lib = None
+    return _lib

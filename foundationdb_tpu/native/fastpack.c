@@ -1,0 +1,48 @@
+/* Native key packer: the resolver's host hot path.
+ *
+ * The analog of the reference's C++ host data plane (its resolver packs
+ * and sorts keys in native code; SkipList.cpp operates on raw bytes). One
+ * call packs N variable-length keys into fixed-width big-endian uint32
+ * words + a length lane, the exact layout ops/keypack.py produces. The
+ * Python caller concatenates the key bytes and passes offsets, so the
+ * native side is a single tight loop with no allocator traffic.
+ *
+ * Built by foundationdb_tpu/native/build.py with the toolchain cc; loaded
+ * through ctypes. keypack falls back to the vectorized numpy path when the
+ * shared object is unavailable, so the framework runs everywhere and runs
+ * FASTER where a compiler exists.
+ */
+#include <stdint.h>
+#include <string.h>
+
+/* keys: concatenated key bytes; offs[i]..offs[i+1]: key i's byte range.
+ * out: n rows of (key_words + 1) uint32: big-endian words, then length.
+ * Returns 0, or 1 if any key exceeds 4*key_words bytes (caller raises). */
+int pack_keys(const uint8_t *keys, const int64_t *offs, int64_t n,
+              int64_t key_words, uint32_t *out) {
+    const int64_t kb = 4 * key_words;
+    const int64_t stride = key_words + 1;
+    for (int64_t i = 0; i < n; i++) {
+        const int64_t len = offs[i + 1] - offs[i];
+        if (len > kb) {
+            return 1;
+        }
+        const uint8_t *k = keys + offs[i];
+        uint32_t *row = out + i * stride;
+        int64_t full = len / 4;
+        for (int64_t w = 0; w < full; w++) {
+            row[w] = ((uint32_t)k[4 * w] << 24) | ((uint32_t)k[4 * w + 1] << 16)
+                   | ((uint32_t)k[4 * w + 2] << 8) | (uint32_t)k[4 * w + 3];
+        }
+        for (int64_t w = full; w < key_words; w++) {
+            uint32_t v = 0;
+            for (int64_t b = 0; b < 4; b++) {
+                int64_t idx = 4 * w + b;
+                v = (v << 8) | (idx < len ? k[idx] : 0);
+            }
+            row[w] = v;
+        }
+        row[key_words] = (uint32_t)len;
+    }
+    return 0;
+}

@@ -142,3 +142,27 @@ def test_disk_queue_compaction_preserves_logical_offsets():
 
     got = drive(sim, work())
     assert got == [b"%04d" % i for i in range(152, 200)]
+
+
+def test_native_fastpack_matches_numpy():
+    """The C packer (native/fastpack.c) and the numpy fallback must produce
+    byte-identical layouts; skipped only where no C toolchain exists."""
+    import numpy as np
+    import pytest as _pytest
+
+    from foundationdb_tpu.native import load_fastpack
+    from foundationdb_tpu.ops import keypack
+
+    lib = load_fastpack()
+    if lib is None:
+        _pytest.skip("no C toolchain available")
+    rng = np.random.default_rng(0)
+    keys = [bytes(rng.integers(0, 256, size=int(n), dtype=np.uint8))
+            for n in rng.integers(0, 21, size=500)]
+    # force both paths
+    keypack._FASTPACK, keypack._FASTPACK_TRIED = lib, True
+    native = keypack.pack_keys(keys, 5)
+    keypack._FASTPACK, keypack._FASTPACK_TRIED = None, True
+    fallback = keypack.pack_keys(keys, 5)
+    keypack._FASTPACK_TRIED = False
+    assert np.array_equal(native, fallback)
