@@ -22,6 +22,7 @@ from .workloads import (
     RandomCloggingWorkload,
     RandomReadWriteWorkload,
     SelectorCorrectnessWorkload,
+    VersionStampWorkload,
     WatchesWorkload,
     WriteDuringReadWorkload,
 )
@@ -73,12 +74,13 @@ SPECS: Dict[str, Callable[[], Spec]] = {
         client_count=3,
         timeout=900.0,
     ),
-    # fast/Watches.txt + rare/SelectorCorrectness
+    # fast/Watches.txt + rare/SelectorCorrectness + VersionStamp
     "WatchesAndSelectors": lambda: Spec(
         title="WatchesAndSelectors",
         workloads=[
             (WatchesWorkload, {"rounds": 5}),
             (SelectorCorrectnessWorkload, {"checks": 25}),
+            (VersionStampWorkload, {"rounds": 6}),
         ],
         cluster=ClusterConfig(n_resolvers=2, n_storage=2),
         client_count=2,

@@ -529,3 +529,64 @@ class SelectorCorrectnessWorkload(TestWorkload):
     async def check(self, db: Database) -> bool:
         return (self.ctx.shared.get("selector_mismatches", 0) == 0
                 and self.ctx.shared.get("selector_checks", 0) > 0)
+
+
+class VersionStampWorkload(TestWorkload):
+    """Versionstamped keys/values (VersionStamp.actor.cpp): every committed
+    stamp must equal the commit's (version, batch index), stamps must be
+    unique and monotone in commit order, and stamped keys must land in the
+    keyspace exactly where the stamp says."""
+
+    name = "VersionStamp"
+
+    async def start(self, db: Database) -> None:
+        import struct
+
+        rounds = int(self.ctx.options.get("rounds", 8))
+        me = self.ctx.client_id
+        committed: List[Tuple[int, bytes]] = []
+        for n in range(rounds):
+            tr = db.create_transaction()
+            prefix = b"vsw/%02d/" % me
+            raw_key = prefix + b"\x00" * 10 + struct.pack("<i", len(prefix))
+            tr.atomic_op(raw_key, b"%04d" % n, MutationType.SET_VERSIONSTAMPED_KEY)
+            raw_val = b"\x00" * 10 + b"|%02d|%04d" % (me, n) + struct.pack("<i", 0)
+            tr.atomic_op(b"vsv/%02d" % me, raw_val, MutationType.SET_VERSIONSTAMPED_VALUE)
+            try:
+                v = await tr.commit()
+            except error.FDBError as e:
+                if e.is_retryable():
+                    continue
+                raise
+            stamp = tr.get_versionstamp()
+            assert int.from_bytes(stamp[:8], "big") == v
+            committed.append((v, stamp))
+            self.ctx.count("stamps")
+        # monotone + unique within this client
+        stamps = [s for _, s in committed]
+        assert stamps == sorted(stamps) and len(set(stamps)) == len(stamps)
+        self.ctx.shared.setdefault("by_client", {})[me] = committed
+
+    async def check(self, db: Database) -> bool:
+        async def read_all(tr):
+            return await tr.get_range(b"vsw/", b"vsw0"), await tr.get_range(b"vsv/", b"vsv0")
+
+        keyed, valued = await db.run(read_all)
+        by_client = self.ctx.shared.get("by_client", {})
+        # every committed stamped KEY exists exactly where the stamp says
+        expect_keys = set()
+        for me, committed in by_client.items():
+            for _v, stamp in committed:
+                expect_keys.add(b"vsw/%02d/" % me + stamp)
+        got_keys = {k for k, _ in keyed}
+        if not expect_keys <= got_keys:
+            return False
+        # each client's stamped VALUE carries that client's newest stamp
+        for me, committed in by_client.items():
+            if not committed:
+                continue
+            newest = committed[-1][1]
+            row = dict(valued).get(b"vsv/%02d" % me)
+            if row is None or row[:10] != newest:
+                return False
+        return True
