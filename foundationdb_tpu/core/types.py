@@ -34,6 +34,13 @@ USER_KEY_END: Key = b"\xff"
 SYSTEM_KEY_PREFIX: Key = b"\xff"
 
 
+def is_point_range(begin: Key, end: Key) -> bool:
+    """True iff the half-open range is exactly [k, k+'\\x00') — the conflict
+    kernel's cheap POINT row shape (its end key is synthesized on device).
+    The single definition shared by the wire encoder and the host router."""
+    return len(end) == len(begin) + 1 and end[-1] == 0 and end[:-1] == begin
+
+
 def key_after(key: Key) -> Key:
     """Smallest key strictly greater than ``key`` (reference: keyAfter, FDBTypes.h)."""
     return key + b"\x00"
@@ -214,6 +221,26 @@ class CommitTransaction:
         n += sum(len(r.begin) + len(r.end) for r in self.write_conflict_ranges)
         n += sum(m.expected_size() for m in self.mutations)
         return n
+
+    def conflict_wire_info(self) -> Tuple[bytes, bool, int]:
+        """This transaction's conflict ranges as one columnar wire block
+        (core/wire.py) plus (all_point, max_key_len) classification computed
+        during the encode. Client-side work, cached against the range tuples
+        themselves (tuple compare is identity-shortcut pointer checks, so a
+        cache hit is O(ranges) pointer compares — in-place range replacement
+        invalidates correctly)."""
+        from . import wire
+
+        key = (tuple(self.read_conflict_ranges), tuple(self.write_conflict_ranges))
+        cached = getattr(self, "_wire_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        info = wire.conflict_wire_ex(key[0], key[1])
+        self._wire_cache = (key, info)
+        return info
+
+    def conflict_wire_block(self) -> bytes:
+        return self.conflict_wire_info()[0]
 
 
 class TransactionCommitResult(enum.IntEnum):

@@ -46,13 +46,22 @@ def load_fastpack() -> Optional[ctypes.CDLL]:
             if not _build():
                 return None
         lib = ctypes.CDLL(_SO)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
         lib.pack_keys.restype = ctypes.c_int
         lib.pack_keys.argtypes = [
-            ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.c_int64,
-            ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_char_p, i64p, ctypes.c_int64, ctypes.c_int64, u32p,
+        ]
+        lib.conflict_counts.restype = ctypes.c_int
+        lib.conflict_counts.argtypes = [
+            ctypes.c_char_p, i64p, ctypes.c_int64, ctypes.c_int64, i32p, i32p,
+        ]
+        lib.build_point_rows.restype = None
+        lib.build_point_rows.argtypes = [
+            ctypes.c_char_p, i64p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_int64,
+            u32p, i32p, u32p, i32p, i64p,
         ]
         _lib = lib
     except OSError:

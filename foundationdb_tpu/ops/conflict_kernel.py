@@ -204,15 +204,25 @@ def _pack_bits(bits: jnp.ndarray, n_words: int) -> jnp.ndarray:
 def local_phases(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[str, jnp.ndarray]):
     """Phases 1-2, shard-local: reads vs. history + intra-batch overlap edges.
 
+    ONE fused lax.sort serves the entire step: the boundary table and every
+    batch row sort together, so a single pass yields (a) every lower bound
+    into the table (count of table rows preceding a row's sorted position),
+    (b) endpoint order for range-row overlap tests, and (c) per-key group
+    ids that decide point-vs-point overlap by integer equality — the
+    dominant row class needs no synthesized end rows at all. Tie codes at
+    equal keys (end-read < end-write < begin-write < {begin-read, point} <
+    point-write < table) make position compares exact half-open interval
+    logic, the getCharacter trick (SkipList.cpp:147-177) extended with a
+    point-write level so `range-begin <= point` resolves positionally.
+
     Returns (hist_hits int32 [T], ovp uint32 [r_all, write_words], wpos) where
     ovp bit (r, w) = 1 iff read row r overlaps write row w AND w's txn is
     strictly earlier in the batch than r's (the reference's
     earlier-in-batch-wins edge direction, checkIntraBatchConflicts:1139-1152),
     and wpos carries the write-interval endpoint positions in the OLD
-    boundary table that apply_writes_and_gc needs (computed here so the whole
-    step runs ONE fused binary search). Hits/overlaps are additive across
-    key-range shards; the multi-shard engine psums hist_hits once and the
-    fixpoint's per-iteration blocked-txn counts over the mesh axis — the
+    boundary table that apply_writes_and_gc needs. Hits/overlaps are additive
+    across key-range shards; the multi-shard engine psums hist_hits once and
+    the fixpoint's per-iteration blocked-txn counts over the mesh axis — the
     "conflict bitmaps allreduced over ICI" of the north star. ovp and wpos
     stay shard-local.
 
@@ -245,19 +255,67 @@ def local_phases(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[s
     rb, re = batch["rb"], batch["re"]
     wpb = batch["wpb"]
     wb, we = batch["wb"], batch["we"]
-
-    # ---- ONE fused lower-bound search for the whole step ----
+    rp_valid, r_valid = batch["rp_valid"], batch["r_valid"]
+    wp_valid, w_valid = batch["wp_valid"], batch["w_valid"]
+    H = cfg.capacity
     empty_r = ~_key_less(rb, re)
-    q_lo = jnp.where(empty_r[:, None], rb, _bump(rb))
-    q = jnp.concatenate([rpb, q_lo, re, wpb, wb, we], axis=0)
-    s = _search(cfg, hkeys, n, q)
+
+    # ---- THE fused sort: table ++ batch rows, one pass ----
+    # Tie codes at equal keys (ascending): end-read 0, end-write 1,
+    # begin-write 2, begin-read/point-read 3, point-write 4, table 5.
+    # Table rows sort after every equal batch key, so
+    #   lower_bound(row) = # valid table rows before row's sorted position
+    # for every batch row at once. bump(rb) rows ride along only to provide
+    # upper_bound(rb) for non-empty range reads' history query.
+    groups = (
+        (rpb, 3, rp_valid),       # point reads
+        (rb, 3, r_valid),         # range-read begins
+        (re, 0, r_valid),         # range-read ends
+        (_bump(rb), 0, r_valid),  # upper-bound probes for range reads
+        (wpb, 4, wp_valid),       # point writes
+        (wb, 2, w_valid),         # range-write begins
+        (we, 1, w_valid),         # range-write ends
+    )
+    bkeys = jnp.concatenate([g[0] for g in groups], axis=0)
+    B = bkeys.shape[0]
+    bcode = jnp.concatenate(
+        [jnp.full((g[0].shape[0],), g[1], jnp.uint32) for g in groups])
+    bvalid = jnp.concatenate([g[2] for g in groups])
+    N = H + B
+    keys_all = jnp.concatenate([hkeys, bkeys], axis=0)
+    code_all = jnp.concatenate([jnp.full((H,), 5, jnp.uint32), bcode])
+    valid_all = jnp.concatenate([jnp.arange(H) < n, bvalid])
+    inv = (~valid_all).astype(jnp.uint32)
+    idx = jnp.arange(N, dtype=jnp.uint32)
+    ops = (inv,) + tuple(keys_all[:, c] for c in range(K)) + (code_all, idx)
+    s = lax.sort(ops, num_keys=K + 2, is_stable=True)
+    sidx = s[-1]
+    skeys = jnp.stack(s[1 : K + 1], axis=1)
+    pos = jnp.zeros((N,), jnp.int32).at[sidx].set(jnp.arange(N, dtype=jnp.int32))
+
+    # Lower bounds: inclusive cumsum of valid-table rows in sorted order;
+    # a batch row contributes 0, so gathering at its position counts exactly
+    # the table rows before it.
+    is_tab = (sidx < H) & (sidx.astype(jnp.int32) < n)
+    cum_tab = jnp.cumsum(is_tab.astype(jnp.int32))
+    # Per-key group ids: a new group starts where the sorted key differs
+    # from its predecessor. Point-point overlap is gid equality — no end
+    # rows, no position algebra, for the dominant row class.
+    prev = jnp.concatenate([skeys[:1] + 1, skeys[:-1]], axis=0)
+    gid_sorted = jnp.cumsum(jnp.any(skeys != prev, axis=-1).astype(jnp.int32))
+
+    bpos = pos[H:]
+    lb = cum_tab[bpos]
+    gid = gid_sorted[bpos]
     o = 0
-    s_rp = s[o:o + Rp]; o += Rp
-    s_qlo = s[o:o + Rr]; o += Rr
-    s_re = s[o:o + Rr]; o += Rr
-    s_wpb = s[o:o + Wp]; o += Wp
-    s_wb = s[o:o + Wr]; o += Wr
-    s_we = s[o:o + Wr]
+    pos_rpb, lb_rp, gid_rp = bpos[o:o + Rp], lb[o:o + Rp], gid[o:o + Rp]; o += Rp
+    pos_rb, lb_rb = bpos[o:o + Rr], lb[o:o + Rr]; o += Rr
+    pos_re, s_re = bpos[o:o + Rr], lb[o:o + Rr]; o += Rr
+    lb_rbb = lb[o:o + Rr]; o += Rr                     # lower(bump(rb))
+    pos_wpb, s_wpb, gid_wp = bpos[o:o + Wp], lb[o:o + Wp], gid[o:o + Wp]; o += Wp
+    pos_wb, s_wb = bpos[o:o + Wr], lb[o:o + Wr]; o += Wr
+    pos_we, s_we = bpos[o:o + Wr], lb[o:o + Wr]
+    s_rp = lb_rp
 
     # Equality gathers (one table row each) derive every upper bound:
     eq_rp = _present(hkeys, rpb, s_rp)
@@ -284,6 +342,7 @@ def local_phases(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[s
 
     if Rr > 0:
         sparse = _build_sparse_max(cfg, hvers, n)
+        s_qlo = jnp.where(empty_r, lb_rb, lb_rbb)
         lo_e = jnp.maximum(s_qlo - 1, 0)
         lo = jnp.where(empty_r, lo_e, s_qlo - 1)
         hi = jnp.where(empty_r, lo_e + 1, s_re)
@@ -292,60 +351,43 @@ def local_phases(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[s
         hist_hits = hist_hits.at[batch["r_txn"]].max(hit_rg.astype(jnp.int32), mode="drop")
 
     # ---- Phase 2: intra-batch (checkIntraBatchConflicts:1133) ----
-    # Endpoint order with the reference's tie codes (getCharacter,
-    # SkipList.cpp:147-177): at equal keys  end-read < end-write < begin-write
-    # < begin-read, which makes integer position compare == exact half-open
-    # overlap. Invalid rows sort last via a leading flag.
-    P = 2 * (Rp + Rr + Wp + Wr)
-    rp_valid, r_valid = batch["rp_valid"], batch["r_valid"]
-    wp_valid, w_valid = batch["wp_valid"], batch["w_valid"]
-    pkeys = jnp.concatenate(
-        [rpb, _bump(rpb), rb, re, wpb, _bump(wpb), wb, we], axis=0)
-    pcode = jnp.concatenate([
-        jnp.full((Rp,), 3, jnp.uint32),  # begin-read (point)
-        jnp.full((Rp,), 0, jnp.uint32),  # end-read (point)
-        jnp.full((Rr,), 3, jnp.uint32),  # begin-read (range)
-        jnp.full((Rr,), 0, jnp.uint32),  # end-read (range)
-        jnp.full((Wp,), 2, jnp.uint32),  # begin-write (point)
-        jnp.full((Wp,), 1, jnp.uint32),  # end-write (point)
-        jnp.full((Wr,), 2, jnp.uint32),  # begin-write (range)
-        jnp.full((Wr,), 1, jnp.uint32),  # end-write (range)
-    ])
-    pvalid = jnp.concatenate([rp_valid, rp_valid, r_valid, r_valid,
-                              wp_valid, wp_valid, w_valid, w_valid])
-    pinv = (~pvalid).astype(jnp.uint32)
-    pidx = jnp.arange(P, dtype=jnp.uint32)
-    ops = (pinv,) + tuple(pkeys[:, c] for c in range(K)) + (pcode, pidx)
-    sorted_ops = lax.sort(ops, num_keys=K + 2, is_stable=True)
-    sorted_idx = sorted_ops[-1]
-    pos = jnp.zeros((P,), jnp.int32).at[sorted_idx].set(jnp.arange(P, dtype=jnp.int32))
-
-    o = 0
-    pos_rpb = pos[o:o + Rp]; o += Rp
-    pos_rpe = pos[o:o + Rp]; o += Rp
-    pos_rb = pos[o:o + Rr]; o += Rr
-    pos_re = pos[o:o + Rr]; o += Rr
-    pos_wpb = pos[o:o + Wp]; o += Wp
-    pos_wpe = pos[o:o + Wp]; o += Wp
-    pos_wb = pos[o:o + Wr]; o += Wr
-    pos_we = pos[o:o + Wr]
-    pos_rb_all = jnp.concatenate([pos_rpb, pos_rb])
-    pos_re_all = jnp.concatenate([pos_rpe, pos_re])
-    pos_wb_all = jnp.concatenate([pos_wpb, pos_wb])
-    pos_we_all = jnp.concatenate([pos_wpe, pos_we])
-    r_txn_all = jnp.concatenate([batch["rp_txn"], batch["r_txn"]])
-    w_txn_all = jnp.concatenate([batch["wp_txn"], batch["w_txn"]])
-    r_valid_all = jnp.concatenate([rp_valid, r_valid])
-    w_valid_all = jnp.concatenate([wp_valid, w_valid])
-
-    ov = (
-        (pos_rb_all[:, None] < pos_re_all[:, None])   # non-empty read
-        & (pos_rb_all[:, None] < pos_we_all[None, :]) # rb < we
-        & (pos_wb_all[None, :] < pos_re_all[:, None]) # wb < re
-        & (w_txn_all[None, :] < r_txn_all[:, None])   # strictly earlier writer
-        & r_valid_all[:, None]
-        & w_valid_all[None, :]
+    # Four blocks of the [r_all, w_all] overlap matrix, each with the
+    # cheapest exact test available (all positions come from the fused sort):
+    #   point-point:  key equality == gid equality
+    #   point-range:  [k,k+'\0') hits [wb,we) iff wb <= k < we; both compares
+    #                 are positional under the code ladder (wb@2 < k@3 <=>
+    #                 wb <= k; k@3 < we@1 <=> k < we)
+    #   range-point:  [rb,re) hits [k,k+'\0') iff rb <= k < re (rb@3 < k@4
+    #                 <=> rb <= k; k@4 < re@0 <=> k < re)
+    #   range-range:  the classic endpoint-order compares
+    earlier_pp = batch["wp_txn"][None, :] < batch["rp_txn"][:, None]
+    ov_pp = (
+        (gid_rp[:, None] == gid_wp[None, :])
+        & earlier_pp & rp_valid[:, None] & wp_valid[None, :]
     )
+    ov_pr = (
+        (pos_wb[None, :] < pos_rpb[:, None])          # wb <= k
+        & (pos_rpb[:, None] < pos_we[None, :])        # k < we
+        & (batch["w_txn"][None, :] < batch["rp_txn"][:, None])
+        & rp_valid[:, None] & w_valid[None, :]
+    )
+    nonempty = ~empty_r
+    ov_rp = (
+        (pos_rb[:, None] < pos_wpb[None, :])          # rb <= k
+        & (pos_wpb[None, :] < pos_re[:, None])        # k < re
+        & (batch["wp_txn"][None, :] < batch["r_txn"][:, None])
+        & (nonempty & r_valid)[:, None] & wp_valid[None, :]
+    )
+    ov_rr = (
+        (pos_rb[:, None] < pos_we[None, :])
+        & (pos_wb[None, :] < pos_re[:, None])
+        & (batch["w_txn"][None, :] < batch["r_txn"][:, None])
+        & (nonempty & r_valid)[:, None] & w_valid[None, :]
+    )
+    ov = jnp.concatenate([
+        jnp.concatenate([ov_pp, ov_pr], axis=1),
+        jnp.concatenate([ov_rp, ov_rr], axis=1),
+    ], axis=0)
     # Bit-pack edges to [r_all, write_words] uint32 (MiniConflictSet's word
     # trick, SkipList.cpp:1028-1130, transplanted to the VPU). The fixpoint
     # touches only these packed words per iteration.

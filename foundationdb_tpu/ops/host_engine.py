@@ -84,10 +84,7 @@ class KeyShardMap:
         return out
 
 
-def _is_point(begin: Key, end: Key) -> bool:
-    """True iff the half-open range is exactly [k, k+'\\x00') — the kernel's
-    cheap POINT row shape (its end key is synthesized on device)."""
-    return len(end) == len(begin) + 1 and end[-1] == 0 and end[:-1] == begin
+from ..core.types import is_point_range as _is_point
 
 
 @dataclass
@@ -121,6 +118,103 @@ class _RoutedTxn:
     def has_reads(self) -> bool:
         return bool(self.preads or self.rreads or self.tier_preads
                     or self.tier_ereads or self.tier_rreads)
+
+
+def wire_pass1(window: int, blocks: List[bytes]):
+    """Native pass 1 over concatenated conflict-wire blocks: per-txn POINT
+    row counts. Returns (blob, offs, rp_cnt, wp_cnt) or None when the batch
+    has any range/empty/long-key row (general router handles it) or no
+    native library is available."""
+    lib = keypack._fastpack()
+    if lib is None or not blocks:
+        return None
+    import ctypes
+
+    n = len(blocks)
+    blob = b"".join(blocks)
+    offs = np.zeros((n + 1,), np.int64)
+    np.cumsum(np.fromiter((len(b) for b in blocks), np.int64, count=n), out=offs[1:])
+    rp_cnt = np.zeros((n,), np.int32)
+    wp_cnt = np.zeros((n,), np.int32)
+    rc = lib.conflict_counts(
+        blob,
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n, window,
+        rp_cnt.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        wp_cnt.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if rc != 0:
+        return None
+    return blob, offs, rp_cnt, wp_cnt
+
+
+def wire_chunk_arrays(
+    cfg: KernelConfig,
+    blob: bytes,
+    offs: np.ndarray,
+    t0: int,
+    t1: int,
+    skip: np.ndarray,          # uint8 [ntx], 1 = contribute no rows (too old)
+    snap_rel: np.ndarray,      # int32 [ntx]
+    eff_r: np.ndarray,         # int32 [ntx] read counts with skipped txns zeroed
+    now_rel: int,
+    gc_rel: int,
+) -> Dict[str, np.ndarray]:
+    """Native pass 2: kernel batch dict for txns [t0, t1) straight from wire
+    bytes — the row groups are written into their padded arrays by C, the
+    int lanes by vectorized numpy. The per-range Python of build_batch_arrays
+    never runs on this path."""
+    import ctypes
+
+    lib = keypack._fastpack()
+    K = cfg.lanes
+    n = t1 - t0
+    rpb = np.zeros((cfg.rp, K), np.uint32)
+    rp_txn = np.zeros((cfg.rp,), np.int32)
+    wpb = np.zeros((cfg.wp, K), np.uint32)
+    wp_txn = np.zeros((cfg.wp,), np.int32)
+    out_n = np.zeros((2,), np.int64)
+    lib.build_point_rows(
+        blob,
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        t0, t1, bytes(skip),
+        cfg.key_words,
+        rpb.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        rp_txn.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        wpb.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        wp_txn.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        out_n.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    n_rp, n_wp = int(out_n[0]), int(out_n[1])
+    rp_snap = np.zeros((cfg.rp,), np.int32)
+    rp_snap[:n_rp] = np.repeat(snap_rel[t0:t1], eff_r[t0:t1])
+    t_ok = np.zeros((cfg.max_txns,), bool)
+    t_too_old = np.zeros((cfg.max_txns,), bool)
+    t_too_old[:n] = skip[t0:t1] != 0
+    t_ok[:n] = ~t_too_old[:n]
+    Rr, Wr = cfg.max_reads, cfg.max_writes
+    return {
+        "rpb": rpb,
+        "rp_snap": rp_snap,
+        "rp_txn": rp_txn,
+        "rp_valid": np.arange(cfg.rp) < n_rp,
+        "rb": np.zeros((Rr, K), np.uint32),
+        "re": np.zeros((Rr, K), np.uint32),
+        "r_snap": np.zeros((Rr,), np.int32),
+        "r_txn": np.zeros((Rr,), np.int32),
+        "r_valid": np.zeros((Rr,), bool),
+        "wpb": wpb,
+        "wp_txn": wp_txn,
+        "wp_valid": np.arange(cfg.wp) < n_wp,
+        "wb": np.zeros((Wr, K), np.uint32),
+        "we": np.zeros((Wr, K), np.uint32),
+        "w_txn": np.zeros((Wr,), np.int32),
+        "w_valid": np.zeros((Wr,), bool),
+        "t_ok": t_ok,
+        "t_too_old": t_too_old,
+        "now": np.asarray(now_rel, np.int32),
+        "gc": np.asarray(gc_rel, np.int32),
+    }
 
 
 class RoutedConflictEngineBase:
@@ -283,6 +377,10 @@ class RoutedConflictEngineBase:
         now: Version,
         new_oldest: Version,
     ) -> List[TransactionCommitResult]:
+        if self.n_shards == 1 and transactions:
+            res = self._resolve_columnar(transactions, now, new_oldest)
+            if res is not None:
+                return res
         cfg = self.cfg
         S = self.n_shards
         routed = [self._route_txn(tr) for tr in transactions]
@@ -317,6 +415,84 @@ class RoutedConflictEngineBase:
                 break
             i = j
         if new_oldest > self.oldest_version:
+            self.oldest_version = new_oldest
+            self.base += max(0, new_oldest - self.base)
+        return results
+
+    def _resolve_columnar(
+        self,
+        transactions: Sequence[CommitTransaction],
+        now: Version,
+        new_oldest: Version,
+    ) -> Optional[List[TransactionCommitResult]]:
+        """Single-shard fast path over conflict-wire blocks: when every range
+        is a short-key POINT row, batch assembly is two native passes + numpy
+        (no per-range Python). Point reads of in-window keys never couple
+        with the host long-key tier (keypack.py: short-key membership is
+        device-exact), so the fused device step is always safe here.
+        Returns None (before any state change) when preconditions fail."""
+        cfg = self.cfg
+        ntx = len(transactions)
+        blocks = []
+        for tr in transactions:
+            blk, all_point, max_len = tr.conflict_wire_info()
+            if not all_point or max_len > self._window:
+                return None  # early out: later txns are not even encoded
+            blocks.append(blk)
+        p1 = wire_pass1(self._window, blocks)
+        if p1 is None:
+            return None
+        blob, offs, rp_cnt, wp_cnt = p1
+        if int(rp_cnt.max()) > cfg.rp or int(wp_cnt.max()) > cfg.wp:
+            raise error.client_invalid_operation(
+                "single transaction exceeds device conflict-range capacity"
+            )
+        snaps = np.fromiter(
+            (tr.read_snapshot for tr in transactions), np.int64, count=ntx)
+        rel = snaps - self.base
+        if int(rel.max()) >= 2**30 or now - self.base >= 2**30:
+            raise error.client_invalid_operation(
+                f"version too far beyond base {self.base} for int32 device window"
+            )
+        snap_rel = np.maximum(rel, -1).astype(np.int32)
+        too_old = (snaps < self.oldest_version) & (rp_cnt > 0)
+        skip = too_old.astype(np.uint8)
+        eff_r = np.where(too_old, 0, rp_cnt).astype(np.int32)
+        eff_w = np.where(too_old, 0, wp_cnt).astype(np.int32)
+        cr = np.cumsum(eff_r)
+        cw = np.cumsum(eff_w)
+
+        now_rel = self._rel(now)
+        results: List[TransactionCommitResult] = []
+        i = 0
+        while i < ntx:
+            r0 = int(cr[i - 1]) if i else 0
+            w0 = int(cw[i - 1]) if i else 0
+            j = min(
+                int(np.searchsorted(cr, r0 + cfg.rp, side="right")),
+                int(np.searchsorted(cw, w0 + cfg.wp, side="right")),
+                i + cfg.max_txns,
+                ntx,
+            )
+            j = max(j, i + 1)  # a single txn always fits (checked above)
+            last = j >= ntx
+            gc_rel = (
+                self._rel(new_oldest)
+                if last and new_oldest > self.oldest_version
+                else 0
+            )
+            batch = wire_chunk_arrays(
+                cfg, blob, offs, i, j, skip, snap_rel, eff_r, now_rel, gc_rel,
+            )
+            status, overflow = self._run_step([batch])
+            if overflow:
+                raise error.conflict_capacity_exceeded(
+                    f"a shard's boundary table needs > {cfg.capacity} rows"
+                )
+            results.extend(TransactionCommitResult(int(v)) for v in status[: j - i])
+            i = j
+        if new_oldest > self.oldest_version:
+            self.tier_map.gc(new_oldest)
             self.oldest_version = new_oldest
             self.base += max(0, new_oldest - self.base)
         return results

@@ -1,0 +1,144 @@
+"""Parity of the columnar (wire-block) resolver fast path vs the general
+router and the reference-exact oracle.
+
+The fast path (host_engine._resolve_columnar) takes over when every conflict
+range is a short-key POINT row on a single-shard engine; these tests drive
+both paths over identical transaction streams and assert bit-identical
+verdicts, including too-old gating and capacity chunking.
+Reference: fdbserver/Resolver.actor.cpp (serialized batch walk),
+fdbserver/SkipList.cpp:1412-1502 (verdict semantics).
+"""
+import random
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.core import wire
+from foundationdb_tpu.core.types import CommitTransaction, KeyRange
+from foundationdb_tpu.ops import host_engine
+from foundationdb_tpu.ops.conflict_kernel import KernelConfig
+from foundationdb_tpu.ops.host_engine import JaxConflictEngine
+from foundationdb_tpu.ops.oracle import OracleConflictEngine
+
+CFG = KernelConfig(key_words=4, capacity=4096, max_txns=32,
+                   max_point_reads=64, max_point_writes=64,
+                   max_reads=16, max_writes=16)
+
+
+def _point_txn(rng, pool, v, nr=2, nw=2, stale=False):
+    t = CommitTransaction(read_snapshot=(v - 10_000_000 if stale else
+                                         max(0, v - rng.randrange(1, 3000))))
+    for _ in range(nr):
+        k = b"k/%05d" % rng.randrange(pool)
+        t.read_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+    for _ in range(nw):
+        k = b"k/%05d" % rng.randrange(pool)
+        t.write_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+    return t
+
+
+def test_wire_roundtrip():
+    t = CommitTransaction()
+    t.read_conflict_ranges = [KeyRange(b"a", b"a\x00"), KeyRange(b"b", b"c"),
+                              KeyRange(b"d", b"d")]
+    t.write_conflict_ranges = [KeyRange(b"e", b"e\x00")]
+    blk = wire.conflict_wire(t.read_conflict_ranges, t.write_conflict_ranges)
+    rr, wr = wire.conflict_unwire(blk)
+    assert rr == [(b"a", b"a\x00"), (b"b", b"c"), (b"d", b"d")]
+    assert wr == [(b"e", b"e\x00")]
+
+
+def test_columnar_taken_and_matches_general(monkeypatch):
+    rng = random.Random(11)
+    fast = JaxConflictEngine(CFG)
+    slow = JaxConflictEngine(CFG)
+    oracle = OracleConflictEngine()
+    # Force the general router on `slow` by disabling the native pass.
+    taken = {"n": 0}
+    orig = host_engine.wire_pass1
+
+    def counting(window, blocks):
+        taken["n"] += 1
+        return orig(window, blocks)
+
+    monkeypatch.setattr(host_engine, "wire_pass1", counting)
+    v = 1000
+    for _ in range(12):
+        txns = [_point_txn(rng, 64, v, nr=rng.randrange(0, 4),
+                           nw=rng.randrange(0, 4),
+                           stale=rng.random() < 0.15)
+                for _ in range(rng.randrange(1, 24))]
+        v += rng.randrange(200, 1500)
+        oldest = max(0, v - 4000)
+        got = fast.resolve(txns, v, oldest)
+        monkeypatch.setattr(host_engine, "wire_pass1", lambda w, b: None)
+        want_slow = slow.resolve(txns, v, oldest)
+        monkeypatch.setattr(host_engine, "wire_pass1", counting)
+        want = oracle.resolve(txns, v, oldest)
+        assert [int(x) for x in got] == [int(x) for x in want_slow]
+        assert [int(x) for x in got] == [int(x) for x in want]
+    assert taken["n"] > 0, "fast path never attempted"
+
+
+def test_columnar_chunking_parity():
+    """Batches larger than the device caps split into chunks on both paths."""
+    rng = random.Random(7)
+    fast = JaxConflictEngine(CFG)
+    oracle = OracleConflictEngine()
+    v = 1000
+    for _ in range(4):
+        # 48 txns x 2/2 rows > rp cap 64 -> multiple chunks.
+        txns = [_point_txn(rng, 32, v) for _ in range(48)]
+        v += 500
+        got = fast.resolve(txns, v, 0)
+        want = oracle.resolve(txns, v, 0)
+        assert [int(x) for x in got] == [int(x) for x in want]
+
+
+def test_range_rows_fall_back():
+    """A batch containing a real range row resolves via the general router
+    (wire pass 1 rejects) with identical verdicts."""
+    rng = random.Random(5)
+    eng = JaxConflictEngine(CFG)
+    oracle = OracleConflictEngine()
+    v = 1000
+    for _ in range(6):
+        txns = [_point_txn(rng, 64, v) for _ in range(6)]
+        t = CommitTransaction(read_snapshot=max(0, v - 100))
+        a, b = sorted([b"k/%05d" % rng.randrange(64), b"k/%05d" % rng.randrange(64)])
+        t.read_conflict_ranges.append(KeyRange(a, b + b"\x00"))
+        t.write_conflict_ranges.append(KeyRange(b"k/00001", b"k/00001\x00"))
+        txns.append(t)
+        v += 700
+        got = eng.resolve(txns, v, 0)
+        want = oracle.resolve(txns, v, 0)
+        assert [int(x) for x in got] == [int(x) for x in want]
+
+
+def test_long_keys_fall_back():
+    eng = JaxConflictEngine(CFG)
+    oracle = OracleConflictEngine()
+    long_key = b"L" * 40
+    t1 = CommitTransaction(read_snapshot=0)
+    t1.write_conflict_ranges.append(KeyRange(long_key, long_key + b"\x00"))
+    t2 = CommitTransaction(read_snapshot=0)
+    t2.read_conflict_ranges.append(KeyRange(long_key, long_key + b"\x00"))
+    assert [int(x) for x in eng.resolve([t1], 100, 0)] == \
+        [int(x) for x in oracle.resolve([t1], 100, 0)]
+    assert [int(x) for x in eng.resolve([t2], 200, 0)] == \
+        [int(x) for x in oracle.resolve([t2], 200, 0)]
+
+
+def test_wire_cache_invalidation():
+    t = CommitTransaction()
+    t.set(b"a", b"1")
+    b1 = t.conflict_wire_block()
+    t.set(b"b", b"2")
+    b2 = t.conflict_wire_block()
+    assert b1 != b2
+    rr, wr = wire.conflict_unwire(b2)
+    assert wr == [(b"a", b"a\x00"), (b"b", b"b\x00")]
+    # In-place element replacement with unchanged counts must invalidate too.
+    t.write_conflict_ranges[0] = KeyRange(b"z", b"z\x00")
+    rr, wr = wire.conflict_unwire(t.conflict_wire_block())
+    assert wr == [(b"z", b"z\x00"), (b"b", b"b\x00")]
