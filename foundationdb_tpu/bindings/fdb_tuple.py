@@ -21,7 +21,9 @@ NULL_CODE = 0x00
 BYTES_CODE = 0x01
 STRING_CODE = 0x02
 NESTED_CODE = 0x05
+NEG_INT_START = 0x0B      # arbitrary-precision negative: length byte is complemented
 INT_ZERO_CODE = 0x14      # ints: 0x14 - 8 .. 0x14 + 8 by byte length
+POS_INT_END = 0x1D        # arbitrary-precision positive: explicit length byte
 DOUBLE_CODE = 0x21
 FALSE_CODE = 0x26
 TRUE_CODE = 0x27
@@ -54,11 +56,17 @@ def _encode_int(v: int) -> bytes:
     if v > 0:
         n = (v.bit_length() + 7) // 8
         if n > 8:
-            raise ValueError("tuple layer ints are limited to 8 bytes")
+            # arbitrary precision: explicit length byte keeps longer ints sorting later
+            if n > 255:
+                raise ValueError("tuple layer big ints are limited to 255 bytes")
+            return bytes([POS_INT_END, n]) + v.to_bytes(n, "big")
         return bytes([INT_ZERO_CODE + n]) + v.to_bytes(n, "big")
     n = ((-v).bit_length() + 7) // 8
     if n > 8:
-        raise ValueError("tuple layer ints are limited to 8 bytes")
+        # negative big int: complemented length byte so longer (more negative) sorts first
+        if n > 255:
+            raise ValueError("tuple layer big ints are limited to 255 bytes")
+        return bytes([NEG_INT_START, n ^ 0xFF]) + ((1 << (8 * n)) - 1 + v).to_bytes(n, "big")
     # negative: offset by the max so bigger magnitudes sort first
     return bytes([INT_ZERO_CODE - n]) + ((1 << (8 * n)) - 1 + v).to_bytes(n, "big")
 
@@ -136,6 +144,13 @@ def _decode_one(data: bytes, pos: int, nested: bool) -> Tuple[Any, int]:
         return True, pos
     if code == UUID_CODE:
         return uuid.UUID(bytes=data[pos:pos + 16]), pos + 16
+    if code == POS_INT_END:
+        n = data[pos]
+        return int.from_bytes(data[pos + 1:pos + 1 + n], "big"), pos + 1 + n
+    if code == NEG_INT_START:
+        n = data[pos] ^ 0xFF
+        return (int.from_bytes(data[pos + 1:pos + 1 + n], "big")
+                - ((1 << (8 * n)) - 1)), pos + 1 + n
     if INT_ZERO_CODE - 8 <= code <= INT_ZERO_CODE + 8:
         n = code - INT_ZERO_CODE
         if n == 0:

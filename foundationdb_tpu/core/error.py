@@ -65,6 +65,7 @@ def operation_cancelled(message: str = "") -> OperationCancelled:
 # Codes mirror flow/error_definitions.h where applicable.
 operation_failed = _define(1000, "operation_failed", "Operation failed")
 timed_out = _define(1004, "timed_out", "Operation timed out")
+watch_cancelled = _define(1101, "watch_cancelled", "Watch expired by the server", retryable=True)
 transaction_too_old = _define(1007, "transaction_too_old", "Read version is too old", retryable=True)
 future_version = _define(1009, "future_version", "Version is ahead of storage", retryable=True)
 wrong_shard_server = _define(1001, "wrong_shard_server", "Shard is on another server", retryable=True)

@@ -112,39 +112,6 @@ def _bump(q: jnp.ndarray) -> jnp.ndarray:
     return q.at[..., -1].add(1)
 
 
-def _search(cfg: KernelConfig, table: jnp.ndarray, count: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
-    """Vectorized lower_bound over table[0:count] (sorted, [N,K]): first i
-    with table[i] >= q, computed by MERGE-RANK rather than binary search.
-
-    The log2(H) binary-search steps serialize H-sized random gathers, which
-    the TPU executes on the scalar pipeline — measured ~6ms per batch at
-    the bench shape, the single hottest thing in the step. A stable sort of
-    (table ++ queries) runs on the sort network in ~2.5ms and yields every
-    lower bound at once: with queries ordered BEFORE equal table rows,
-        lower_bound(q) = combined_position(q) - (queries before q)
-    (the radix sortPoints spirit, SkipList.cpp:227, turned on the table).
-    Invalid table rows (index >= count) carry a leading flag that sorts
-    them after everything, so they never land before a query."""
-    h = table.shape[0]
-    nq = q.shape[0]
-    k = table.shape[1]
-    inv = jnp.concatenate([
-        (jnp.arange(h, dtype=jnp.int32) >= count).astype(jnp.uint32),
-        jnp.zeros((nq,), jnp.uint32),
-    ])
-    keys = jnp.concatenate([table, q], axis=0)
-    flag = jnp.concatenate([jnp.ones((h,), jnp.uint32), jnp.zeros((nq,), jnp.uint32)])
-    idx = jnp.arange(h + nq, dtype=jnp.uint32)
-    s = lax.sort((inv,) + tuple(keys[:, c] for c in range(k)) + (flag, idx),
-                 num_keys=k + 2, is_stable=True)
-    sflag, sidx = s[-2], s[-1]
-    cumq = jnp.cumsum((sflag == 0).astype(jnp.int32))      # queries <= pos
-    pos = jnp.zeros((h + nq,), jnp.int32).at[sidx].set(
-        jnp.arange(h + nq, dtype=jnp.int32))
-    qpos = pos[h:]
-    return qpos - (cumq[qpos] - 1)
-
-
 def _present(table: jnp.ndarray, q: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
     """1 iff q occurs in the table, given s = lower_bound(q): one row gather.
     upper_bound(q) == s + _present(table, q, s)."""

@@ -44,6 +44,9 @@ WATCH_VALUE_TOKEN = "storage.watchValue"
 #: how far ahead of the storage version a read may wait before future_version
 #: (reference: storageserver waitForVersion MVCC window)
 MAX_READ_AHEAD_VERSIONS = MAX_WRITE_TRANSACTION_LIFE_VERSIONS
+#: parked watches expire server-side after this long; below the client's 30s
+#: request timeout so a live client re-registers before its entry dies here
+WATCH_EXPIRE_SECONDS = 25.0
 
 
 class VersionedStore:
@@ -375,8 +378,30 @@ class StorageServer:
         if current != req.value:
             return current
         p = Promise()
-        self._watches.setdefault(req.key, []).append((req.value, p))
-        return await p.future
+        entry = (req.value, p)
+        self._watches.setdefault(req.key, []).append(entry)
+        # Server-side expiry (reference: watchValue timeout / MAX_WATCHES):
+        # a parked watch whose client timed out or died would otherwise sit
+        # in _watches forever on a never-changing key.
+        from ..sim.actors import any_of
+
+        expiry = delay(WATCH_EXPIRE_SECONDS, TaskPriority.DEFAULT_ENDPOINT)
+        idx, _ = await any_of([p.future, expiry])
+        if idx == 0:
+            # Fire the expiry future now so its callbacks drop; the stale
+            # scheduler event retains only the (now ready) future itself.
+            if not expiry.is_ready:
+                expiry._set(None)
+            return p.future.get()
+        parked = self._watches.get(req.key)
+        if parked is not None:
+            try:
+                parked.remove(entry)
+            except ValueError:
+                pass
+            if not parked:
+                del self._watches[req.key]
+        raise error.watch_cancelled()
 
     async def get_key_values(self, req: GetKeyValuesRequest) -> GetKeyValuesReply:
         self._check_shard(req.begin, req.end)

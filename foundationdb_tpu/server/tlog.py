@@ -23,7 +23,7 @@ from typing import Dict, List, Optional, Tuple
 from ..core.types import Mutation, Version
 from ..core import buggify, error, wire
 from ..sim.actors import AsyncMutex, NotifiedVersion
-from ..sim.loop import TaskPriority, delay
+from ..sim.loop import Promise, TaskPriority, delay
 from ..sim.network import SimProcess
 from .disk_queue import DiskQueue
 from .messages import (
@@ -73,6 +73,7 @@ class TLog:
         self.version = NotifiedVersion(start_version)
         self.known_committed = NotifiedVersion(start_version)
         self.stopped = False
+        self._stop_promise = Promise()  # fires when the generation is locked
         self.queue = queue
         self._store_name = store_name or f"tlog-{gen_id[0]}.{gen_id[1]}"
         # tag -> ordered [(version, mutations)]
@@ -253,13 +254,13 @@ class TLog:
         if req.version <= self.version.get() or req.version in self._inflight:
             # Duplicate delivery (proxy retry) — possibly while the first
             # copy is mid-fsync; never append twice.
-            await self.version.when_at_least(req.version)
+            await self._wait_version_or_stop(req.version)
             return self.version.get()
-        await self.version.when_at_least(req.prev_version)
+        await self._wait_version_or_stop(req.prev_version)
         if self.stopped:
             raise error.tlog_stopped("locked by epoch end")
         if req.version <= self.version.get() or req.version in self._inflight:
-            await self.version.when_at_least(req.version)
+            await self._wait_version_or_stop(req.version)
             return self.version.get()
         self._inflight.add(req.version)
         for tag, muts in req.messages.items():
@@ -285,6 +286,34 @@ class TLog:
         if req.known_committed > self.known_committed.get():
             self.known_committed.set(min(req.known_committed, self.version.get()))
         return req.version
+
+    async def _wait_version_or_stop(self, version: Version) -> None:
+        """when_at_least raced against the epoch lock: a waiter chained
+        behind an append that the lock aborted mid-fsync would otherwise
+        park forever (the aborted copy never sets the version). The loser's
+        callback is detached from the long-lived stop future so the hot
+        commit path does not accumulate one closure per commit."""
+        if self.version.get() >= version:
+            return
+        if self.stopped:
+            raise error.tlog_stopped("locked while awaiting version")
+        from ..sim.loop import Future
+
+        out = Future()
+
+        def wake(_f) -> None:
+            if not out._ready:
+                out._set(None)
+
+        self.version.when_at_least(version).on_ready(wake)
+        stop_f = self._stop_promise.future
+        stop_f.on_ready(wake)
+        try:
+            await out
+        finally:
+            stop_f.remove_callback(wake)
+        if self.version.get() < version:
+            raise error.tlog_stopped("locked while awaiting version")
 
     async def advance_known_committed(self, req: TLogKnownCommittedRequest) -> None:
         """The proxy reports all replicas acked `version` (the reference
@@ -323,6 +352,8 @@ class TLog:
     async def lock(self, req: TLogLockRequest) -> TLogLockReply:
         """reference: tLogLock (TLogServer.actor.cpp:496). Idempotent."""
         self.stopped = True
+        if not self._stop_promise.is_set:
+            self._stop_promise.send(None)
         return TLogLockReply(
             gen_id=self.gen_id,
             known_committed=self.known_committed.get(),

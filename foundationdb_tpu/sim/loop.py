@@ -125,6 +125,14 @@ class Future:
         else:
             self._callbacks.append(cb)
 
+    def remove_callback(self, cb: Callable[["Future"], None]) -> None:
+        """Deregister a pending callback (flow's Callback::remove) — lets a
+        race loser detach from a long-lived future instead of leaking."""
+        try:
+            self._callbacks.remove(cb)
+        except ValueError:
+            pass
+
     # -- await protocol -----------------------------------------------------
     def __await__(self):
         if not self._ready:

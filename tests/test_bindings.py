@@ -29,6 +29,19 @@ def test_tuple_roundtrip():
         assert fdb_tuple.unpack(packed) == t, t
 
 
+def test_tuple_big_ints():
+    """Arbitrary-precision ints use the 0x0B/0x1D codes and keep ordering."""
+    vals = sorted(
+        [0, 1, -1, 2**63, -(2**63), 2**64, -(2**64), 2**200 + 17, -(2**200), 2**2000, -(2**2000) + 5]
+    )
+    for v in vals:
+        assert fdb_tuple.unpack(fdb_tuple.pack((v,))) == (v,)
+    packed = [fdb_tuple.pack((v,)) for v in vals]
+    assert packed == sorted(packed)
+    with pytest.raises(ValueError):
+        fdb_tuple.pack((1 << (8 * 256),))
+
+
 def _rand_elem(rng, depth=0):
     kind = rng.randrange(0, 8 if depth < 2 else 7)
     if kind == 0:
