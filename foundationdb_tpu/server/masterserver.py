@@ -42,7 +42,7 @@ from ..sim.network import Endpoint
 from .coordinated_state import CoordinatedState, DBCoreState, LogGenerationInfo
 from .log_system import LogSystemConfig, fetch_recovery_data, lock_generation
 from .master import GET_COMMIT_VERSION_TOKEN, Master, RECOVERY_VERSION_JUMP
-from .proxy import ProxyConfig
+from .proxy import ProxyConfig, teams_from_storage_tags
 from .ratekeeper import GET_RATE_INFO_TOKEN, Ratekeeper
 from .resolver import RESOLVE_TOKEN
 from .wait_failure import WAIT_FAILURE_TOKEN, wait_failure_client
@@ -118,18 +118,19 @@ class MasterServer:
             self._state("locking_tlogs", OldGen=str(old_cfg.gen_id))
             while True:
                 try:
-                    recovery_version, src_addr = await lock_generation(
+                    recovery_version, locked_reps = await lock_generation(
                         self.net, self.proc.address, old_cfg
+                    )
+                    preload, preload_popped = await fetch_recovery_data(
+                        self.net, self.proc.address, old_cfg, locked_reps,
+                        recovery_version,
                     )
                     break
                 except error.FDBError:
-                    # Every replica unreachable: the un-popped window is
-                    # unrecoverable until one returns. Wait, not guess.
+                    # Below the tag-coverage lock quorum: some tag's
+                    # un-popped window is unrecoverable until a subset
+                    # member returns. Wait, not guess.
                     await delay(1.0, TaskPriority.CLUSTER_CONTROLLER)
-            data = await fetch_recovery_data(
-                self.net, self.proc.address, old_cfg, src_addr, recovery_version
-            )
-            preload, preload_popped = data.tag_data, data.popped
             first_jump = RECOVERY_VERSION_JUMP
         else:
             recovery_version = 1
@@ -141,8 +142,9 @@ class MasterServer:
         # disposable transaction roles on the rest (the reference's
         # process-class fitness, reduced to storage-vs-stateless).
         alive = [w for w in self.workers if not self.net.monitor.is_failed(w)]
+        n_storage_workers = cfg.n_storage * max(1, getattr(cfg, "storage_replication", 1))
         if first_boot:
-            storage_workers = sorted(alive)[-cfg.n_storage:]
+            storage_workers = sorted(alive)[-n_storage_workers:]
         else:
             storage_workers = sorted({t[3] for t in prev.storage_tags})
         workers = [w for w in alive if w not in storage_workers] or alive
@@ -164,12 +166,18 @@ class MasterServer:
         tlog_reps = tuple((a, f"{suffix}.{i}") for i, a in enumerate(tlog_addrs))
         new_log = LogSystemConfig(
             gen_id=gen_id, tlogs=tlog_reps, start_version=recovery_version,
+            replication_factor=getattr(cfg, "log_replication_factor", 0),
         )
+        # Seed each new replica with only the tags it will hold (per-tag
+        # subsets): the recovery copy routes exactly like future pushes.
         await all_of([
             self._init_role(a, INIT_TLOG_TOKEN, InitializeTLogRequest(
                 gen_id=gen_id, start_version=recovery_version,
                 token_suffix=rep_suffix, replica_index=i,
-                preload=preload, preload_popped=preload_popped,
+                preload={t: e for t, e in preload.items()
+                         if i in new_log.tag_subset(t)},
+                preload_popped={t: v for t, v in preload_popped.items()
+                                if i in new_log.tag_subset(t)},
             ))
             for i, (a, rep_suffix) in enumerate(tlog_reps)
         ])
@@ -181,17 +189,29 @@ class MasterServer:
             for i, a in enumerate(resolver_addrs)
         ])
 
-        # Seed storage servers on first boot (newSeedServers:325).
+        # Seed storage servers on first boot (newSeedServers:325): each
+        # shard gets a team of `storage_replication` replicas on distinct
+        # workers (storage tokens are per-process, and same-worker replicas
+        # would share a fault domain anyway).
+        repl = max(1, getattr(cfg, "storage_replication", 1))
         if first_boot:
             storage_shards = KeyShardMap.uniform(cfg.n_storage)
+            if len(storage_workers) < cfg.n_storage * repl:
+                raise error.recruitment_failed(
+                    f"need {cfg.n_storage * repl} storage workers for "
+                    f"{cfg.n_storage} shards x {repl} replicas, have {len(storage_workers)}"
+                )
             storage_tags = []
-            for tag in range(cfg.n_storage):
-                begin = storage_shards.begins[tag]
-                end = storage_shards.span_end(tag) or b"\xff\xff\xff"
-                addr = storage_workers[tag % len(storage_workers)]
-                await self._init_role(addr, INIT_STORAGE_TOKEN,
-                                      InitializeStorageRequest(tag=tag, begin=begin, end=end))
-                storage_tags.append((tag, begin, end, addr))
+            tag = 0
+            for s in range(cfg.n_storage):
+                begin = storage_shards.begins[s]
+                end = storage_shards.span_end(s) or b"\xff\xff\xff"
+                for r in range(repl):
+                    addr = storage_workers[(s * repl + r) % len(storage_workers)]
+                    await self._init_role(addr, INIT_STORAGE_TOKEN,
+                                          InitializeStorageRequest(tag=tag, begin=begin, end=end))
+                    storage_tags.append((tag, begin, end, addr))
+                    tag += 1
             storage_tags = tuple(storage_tags)
         else:
             storage_tags = prev.storage_tags
@@ -257,14 +277,14 @@ class MasterServer:
 
         self.proc.register(status_token, master_status)
 
-        storage_shards = KeyShardMap.uniform(len(storage_tags))
+        storage_shards, storage_teams = teams_from_storage_tags(storage_tags)
         proxy_cfg = ProxyConfig(
             master_ep=Endpoint(self.proc.address, GET_COMMIT_VERSION_TOKEN + suffix),
             resolver_eps=[Endpoint(a, RESOLVE_TOKEN + f"{suffix}.{i}")
                           for i, a in enumerate(resolver_addrs)],
             resolver_shards=KeyShardMap.uniform(cfg.n_resolvers),
             log_config=new_log,
-            storage_addrs=[t[3] for t in storage_tags],
+            storage_teams=storage_teams,
             storage_shards=storage_shards,
             master_wf_ep=Endpoint(self.proc.address, f"waitFailure:master:{self.salt}"),
             rate_ep=Endpoint(self.proc.address, rate_token),
