@@ -114,6 +114,8 @@ class Database:
         self.coordinator_addrs = list(coordinator_addrs or [])
         # location cache: sorted [(range, [storage addrs])]
         self._locations: List[Tuple[KeyRange, List[str]]] = []
+        # rotates reads across a shard's replica team (loadBalance)
+        self._lb_counter: int = 0
 
     def _proxy(self) -> str:
         rng = current_scheduler().rng
@@ -227,6 +229,33 @@ class Database:
         kept.append((rng, addrs))
         kept.sort(key=lambda x: x[0].begin)
         self._locations = kept
+
+    # -- replica load balancing ---------------------------------------------
+    async def storage_request(self, addrs: List[str], token: str, req,
+                              priority: int = TaskPriority.DEFAULT_ENDPOINT,
+                              timeout: float = 0.0):
+        """loadBalance (fdbrpc/LoadBalance.actor.h:158) reduced to
+        rotate-and-failover: reads spread across a shard's replica team and
+        fail over to the next member on transport loss. Reads are
+        idempotent, so a maybe-delivered first attempt is safely reissued.
+        Non-transport errors (wrong_shard, future_version, ...) surface
+        immediately — they come from a live replica and would repeat."""
+        self._lb_counter += 1
+        start = self._lb_counter % len(addrs)
+        last: Optional[error.FDBError] = None
+        for i in range(len(addrs)):
+            addr = addrs[(start + i) % len(addrs)]
+            try:
+                return await self.net.request(
+                    self.client_addr, Endpoint(addr, token), req,
+                    priority, timeout=timeout or REQUEST_TIMEOUT,
+                )
+            except error.FDBError as e:
+                if e.code in (_MAYBE_DELIVERED, _CONNECTION_FAILED):
+                    last = e
+                    continue
+                raise
+        raise last if last is not None else error.connection_failed()
 
 
 class Transaction:
@@ -361,14 +390,10 @@ class Transaction:
     async def _storage_get(self, key: Key, version: Version) -> Optional[Value]:
         while True:
             locs = await self.db.get_locations(key, key_after(key))
-            addr = locs[0][1][0]
             try:
-                reply = await self.db.net.request(
-                    self.db.client_addr,
-                    Endpoint(addr, storage_mod.GET_VALUE_TOKEN),
+                reply = await self.db.storage_request(
+                    locs[0][1], storage_mod.GET_VALUE_TOKEN,
                     GetValueRequest(key=key, version=version),
-                    TaskPriority.DEFAULT_ENDPOINT,
-                    timeout=REQUEST_TIMEOUT,
                 )
                 return reply.value
             except error.FDBError as e:
@@ -393,12 +418,9 @@ class Transaction:
                     cb, ce = max(begin, rng.begin), min(end, rng.end)
                     while cb < ce:
                         want = 10_000 if limit is None else min(limit - len(out), 10_000)
-                        reply = await self.db.net.request(
-                            self.db.client_addr,
-                            Endpoint(addrs[0], storage_mod.GET_KEY_VALUES_TOKEN),
+                        reply = await self.db.storage_request(
+                            addrs, storage_mod.GET_KEY_VALUES_TOKEN,
                             GetKeyValuesRequest(begin=cb, end=ce, version=version, limit=want, reverse=reverse),
-                            TaskPriority.DEFAULT_ENDPOINT,
-                            timeout=REQUEST_TIMEOUT,
                         )
                         out.extend(reply.data)
                         if limit is not None and len(out) >= limit:
@@ -524,11 +546,9 @@ class Transaction:
             while True:
                 try:
                     locs = await self.db.get_locations(key, key_after(key))
-                    return await self.db.net.request(
-                        self.db.client_addr,
-                        Endpoint(locs[0][1][0], storage_mod.WATCH_VALUE_TOKEN),
+                    return await self.db.storage_request(
+                        locs[0][1], storage_mod.WATCH_VALUE_TOKEN,
                         WatchValueRequest(key=key, value=exp, version=version),
-                        TaskPriority.DEFAULT_ENDPOINT,
                         timeout=30.0,
                     )
                 except error.FDBError as e:

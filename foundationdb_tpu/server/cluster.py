@@ -29,7 +29,8 @@ from .tlog import TLog
 @dataclass
 class ClusterConfig:
     n_resolvers: int = 1
-    n_storage: int = 2
+    n_storage: int = 2          # number of key-range shards
+    storage_replication: int = 1  # replicas per shard (the team size K)
     #: () -> conflict engine; default is the reference-exact oracle. Pass
     #: lambda: JaxConflictEngine(...) for the TPU path.
     engine_factory: Callable = OracleConflictEngine
@@ -61,21 +62,33 @@ class Cluster:
         ]
 
         self.storage_shards = KeyShardMap.uniform(cfg.n_storage)
-        self.storage_procs = [sim.new_process(f"storage{i}") for i in range(cfg.n_storage)]
+        # Teams: shard s is stored by `storage_replication` replicas, each
+        # its own process + tag (DataDistribution's replica teams reduced
+        # to a static seed assignment).
+        self.storage_procs = []
         self.storages: List[StorageServer] = []
-        for i, p in enumerate(self.storage_procs):
-            begin = self.storage_shards.begins[i]
-            end = self.storage_shards.span_end(i) or b"\xff\xff\xff"
-            self.storages.append(
-                StorageServer(
-                    p,
-                    tag=i,
-                    shard=KeyRange(begin, end),
-                    log_view=self.log_view,
-                    net=sim.net,
-                    start_version=sv,
+        self.storage_teams: List[List[tuple]] = []
+        tag = 0
+        for s in range(cfg.n_storage):
+            begin = self.storage_shards.begins[s]
+            end = self.storage_shards.span_end(s) or b"\xff\xff\xff"
+            team = []
+            for r in range(cfg.storage_replication):
+                p = sim.new_process(f"storage{s}.{r}")
+                self.storage_procs.append(p)
+                self.storages.append(
+                    StorageServer(
+                        p,
+                        tag=tag,
+                        shard=KeyRange(begin, end),
+                        log_view=self.log_view,
+                        net=sim.net,
+                        start_version=sv,
+                    )
                 )
-            )
+                team.append((tag, p.address))
+                tag += 1
+            self.storage_teams.append(team)
 
         self.proxy_proc = sim.new_process("proxy")
         self.proxy = Proxy(
@@ -86,7 +99,7 @@ class Cluster:
                 resolver_eps=[Endpoint(p.address, RESOLVE_TOKEN) for p in self.resolver_procs],
                 resolver_shards=self.resolver_shards,
                 log_config=self.log_config,
-                storage_addrs=[p.address for p in self.storage_procs],
+                storage_teams=self.storage_teams,
                 storage_shards=self.storage_shards,
             ),
             start_version=sv,
@@ -116,7 +129,10 @@ class DynamicClusterConfig:
     n_workers: int = 5
     n_tlogs: int = 2
     n_resolvers: int = 2
-    n_storage: int = 2
+    n_storage: int = 2          # number of key-range shards
+    storage_replication: int = 1  # replicas per shard (team size)
+    #: per-tag tlog replication factor; 0 = every replica holds every tag
+    log_replication_factor: int = 0
     engine_factory: Callable = OracleConflictEngine
 
 

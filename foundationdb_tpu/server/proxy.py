@@ -73,6 +73,21 @@ SERVER_REQUEST_TIMEOUT = 5.0
 _TLOG_STOPPED = error.tlog_stopped("").code
 
 
+def teams_from_storage_tags(storage_tags):
+    """Group flat (tag, begin, end, addr) server records into the shard map
+    + per-shard replica teams (servers with an identical range form a
+    team). The inverse of the master's seed loop; also used wherever a
+    persisted DBCoreState.storage_tags must become routing state."""
+    by_range: Dict[Tuple[Key, Key], List[Tuple[int, str]]] = {}
+    for tag, b, e, addr in storage_tags:
+        by_range.setdefault((b, e), []).append((tag, addr))
+    ordered = sorted(by_range.items(), key=lambda kv: kv[0][0])
+    assert ordered and ordered[0][0][0] == b"", "shard map must start at ''"
+    shard_map = KeyShardMap([b for (b, _e), _m in ordered[1:]])
+    teams = [sorted(members) for (_rng, members) in ordered]
+    return shard_map, teams
+
+
 @dataclass
 class ProxyConfig:
     """Wiring for one proxy of one generation: the master and resolvers are
@@ -84,7 +99,10 @@ class ProxyConfig:
     resolver_eps: List[Endpoint]
     resolver_shards: KeyShardMap
     log_config: LogSystemConfig
-    storage_addrs: List[str]
+    #: per shard: the replica team [(tag, address), ...] — every member
+    #: stores the shard (DataDistribution's keyServers reduced to a static
+    #: team map; tags address tlog streams, one per storage server)
+    storage_teams: List[List[Tuple[int, str]]]
     storage_shards: KeyShardMap
     #: the master's role-scoped wait-failure endpoint; the proxy watches it
     #: and shuts down when the master dies (its generation is over)
@@ -231,7 +249,7 @@ class Proxy:
     async def get_key_server_locations(self, req: GetKeyServerLocationsRequest) -> GetKeyServerLocationsReply:
         out: List[Tuple[KeyRange, List[str]]] = []
         for s, cb, ce in self.cfg.storage_shards.shards_of_range(req.begin, req.end):
-            out.append((KeyRange(cb, ce), [self.cfg.storage_addrs[s]]))
+            out.append((KeyRange(cb, ce), [a for _t, a in self.cfg.storage_teams[s]]))
         return GetKeyServerLocationsReply(results=out)
 
     # -- commit path -----------------------------------------------------------
@@ -452,12 +470,18 @@ class Proxy:
             for m in txn.mutations:
                 if m.type in VERSIONSTAMP_MUTATIONS:
                     m = transform_versionstamp_mutation(m, v, t)
+                # Every team member's tag receives the mutation (the
+                # reference tags each mutation for all replicas of its
+                # shard, MasterProxyServer.actor.cpp:516-756).
                 if m.type == MutationType.CLEAR_RANGE:
                     for s, cb, ce in cfg.storage_shards.shards_of_range(m.param1, m.param2):
-                        messages.setdefault(s, []).append(Mutation(m.type, cb, ce))
+                        clipped = Mutation(m.type, cb, ce)
+                        for tag, _addr in cfg.storage_teams[s]:
+                            messages.setdefault(tag, []).append(clipped)
                 else:
                     s = cfg.storage_shards.shard_of_key(m.param1)
-                    messages.setdefault(s, []).append(m)
+                    for tag, _addr in cfg.storage_teams[s]:
+                        messages.setdefault(tag, []).append(m)
 
         # ---- Phase 4: log, in version order (:805) ----
         await self.batch_logging.when_at_least(bn - 1)

@@ -590,3 +590,77 @@ class VersionStampWorkload(TestWorkload):
             if row is None or row[:10] != newest:
                 return False
         return True
+
+
+class ConsistencyCheckWorkload(TestWorkload):
+    """Quiescent replica consistency check (ConsistencyCheck.actor.cpp,
+    run by tester.actor.cpp:740 after most specs): at one read version,
+    read every shard's full contents directly from EVERY replica of its
+    team and require bit-identical results. Replicas that stay unreachable
+    across retries are skipped (a killed-and-never-restored replica must
+    not fail the check — that is the scenario replication exists for), but
+    at least one replica per shard must serve."""
+
+    name = "ConsistencyCheck"
+    END = b"\xff\xff\xff"
+
+    async def check(self, db: Database) -> bool:
+        from ..server import storage as storage_mod
+        from ..server.messages import GetKeyValuesRequest
+        from ..sim.network import Endpoint
+        from ..sim.loop import TaskPriority
+
+        tr = db.create_transaction()
+        while True:
+            try:
+                rv = await tr.get_read_version()
+                locs = await db.get_locations(b"", self.END)
+                break
+            except error.FDBError as e:
+                await tr.on_error(e)
+                tr = db.create_transaction()
+
+        async def read_replica(addr, rng):
+            """Full clipped shard contents from one replica at rv, or None
+            if the replica stays unreachable."""
+            rows, cb, ce = [], rng.begin, min(rng.end, self.END)
+            attempts = 0
+            while cb < ce:
+                try:
+                    reply = await db.net.request(
+                        db.client_addr,
+                        Endpoint(addr, storage_mod.GET_KEY_VALUES_TOKEN),
+                        GetKeyValuesRequest(begin=cb, end=ce, version=rv,
+                                            limit=10_000),
+                        TaskPriority.DEFAULT_ENDPOINT, timeout=5.0,
+                    )
+                except error.FDBError:
+                    attempts += 1
+                    if attempts >= 10:
+                        return None
+                    await delay(0.5)
+                    continue
+                rows.extend(reply.data)
+                if not reply.more or not reply.data:
+                    break
+                from ..core.types import key_after
+
+                cb = key_after(reply.data[-1][0])
+            return rows
+
+        for rng, addrs in locs:
+            views = []
+            for addr in addrs:
+                rows = await read_replica(addr, rng)
+                if rows is not None:
+                    views.append((addr, rows))
+            if not views:
+                self.ctx.count("shards_with_no_replica")
+                return False
+            self.ctx.count("replicas_checked", len(views))
+            baseline = views[0][1]
+            for addr, rows in views[1:]:
+                if rows != baseline:
+                    self.ctx.count("replica_mismatches")
+                    return False
+        return True

@@ -8,7 +8,8 @@ from foundationdb_tpu.testing.runner import main
 from foundationdb_tpu.testing.specs import SPECS
 from foundationdb_tpu.testing.workload import run_spec
 
-FAST_SPECS = [n for n in sorted(SPECS) if n != "CycleTestTPU"]
+KERNEL_SPECS = {"CycleTestTPU", "CycleTestTPU8", "RandomReadWriteTPU8"}
+FAST_SPECS = [n for n in sorted(SPECS) if n not in KERNEL_SPECS]
 
 
 @pytest.mark.parametrize("name", FAST_SPECS)
@@ -20,6 +21,18 @@ def test_spec(name, seed):
 
 def test_spec_tpu_engine():
     res = run_spec(SPECS["CycleTestTPU"](), 21)
+    assert res.ok
+
+
+def test_spec_sharded_engine_8():
+    """The north-star config: the 8-device-mesh sharded resolver engine
+    running inside the simulated cluster under cycle churn."""
+    res = run_spec(SPECS["CycleTestTPU8"](), 22)
+    assert res.ok
+
+
+def test_spec_sharded_engine_high_inflight():
+    res = run_spec(SPECS["RandomReadWriteTPU8"](), 23)
     assert res.ok
 
 
