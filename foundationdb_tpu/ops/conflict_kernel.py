@@ -79,8 +79,20 @@ class KernelConfig:
         return self.wp + self.max_writes
 
     @property
-    def write_words(self) -> int:  # w_all rounded up to whole uint32 bit-words
-        return (self.w_all + 31) // 32
+    def wr_words(self) -> int:  # RANGE write rows as uint32 bit-words
+        return (self.max_writes + 31) // 32
+
+    @property
+    def wp_words(self) -> int:  # POINT write rows as uint32 bit-words
+        return (self.wp + 31) // 32
+
+    @property
+    def batch_rows(self) -> int:  # rows the fused sort adds to the table
+        return self.rp + 3 * self.max_reads + self.wp + 2 * self.max_writes
+
+    @property
+    def gid_space(self) -> int:  # upper bound on per-key group ids
+        return self.capacity + self.batch_rows
 
     @property
     def levels(self) -> int:    # sparse-table levels
@@ -182,16 +194,20 @@ def local_phases(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[s
     logic, the getCharacter trick (SkipList.cpp:147-177) extended with a
     point-write level so `range-begin <= point` resolves positionally.
 
-    Returns (hist_hits int32 [T], ovp uint32 [r_all, write_words], wpos) where
-    ovp bit (r, w) = 1 iff read row r overlaps write row w AND w's txn is
-    strictly earlier in the batch than r's (the reference's
-    earlier-in-batch-wins edge direction, checkIntraBatchConflicts:1139-1152),
-    and wpos carries the write-interval endpoint positions in the OLD
-    boundary table that apply_writes_and_gc needs. Hits/overlaps are additive
-    across key-range shards; the multi-shard engine psums hist_hits once and
-    the fixpoint's per-iteration blocked-txn counts over the mesh axis — the
-    "conflict bitmaps allreduced over ICI" of the north star. ovp and wpos
-    stay shard-local.
+    Returns (hist_hits int32 [T], edges, wpos) where edges holds the
+    intra-batch overlap structure — "ovw" uint32 [r_all, wr_words] (reads
+    vs RANGE writes, bit (r, w) = 1 iff read row r overlaps range-write
+    row w AND w's txn is strictly earlier in the batch, the reference's
+    earlier-in-batch-wins edge direction checkIntraBatchConflicts:1139-
+    1152), "ovrp" uint32 [Rr, wp_words] (range reads vs point writes),
+    and "gid_rp"/"gid_wp" per-key group ids through which the fixpoint
+    resolves the dominant point-vs-point block without a matrix — and
+    wpos carries the write-interval endpoint positions in the OLD
+    boundary table that apply_writes_and_gc needs. Hits/overlaps are
+    additive across key-range shards; the multi-shard engine psums
+    hist_hits once and the fixpoint's per-iteration blocked-txn counts
+    over the mesh axis — the "conflict bitmaps allreduced over ICI" of
+    the north star. edges and wpos stay shard-local.
 
     batch fields (fixed shapes; see build_batch_arrays). Read/write rows are
     grouped by ascending owning txn within each group, valid rows first:
@@ -234,6 +250,13 @@ def local_phases(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[s
     #   lower_bound(row) = # valid table rows before row's sorted position
     # for every batch row at once. bump(rb) rows ride along only to provide
     # upper_bound(rb) for non-empty range reads' history query.
+    #
+    # Operand packing: invalid rows carry all-ones key words (no real key
+    # reaches length 2^32-1, so they sort after everything), and the tie
+    # code + original index share one word (code in the high bits; the
+    # composite is unique per row, so the order is total and no separate
+    # stability payload is needed). 6 sort operands instead of 8 — the
+    # sort is the step's dominant cost and scales with operand width.
     groups = (
         (rpb, 3, rp_valid),       # point reads
         (rb, 3, r_valid),         # range-read begins
@@ -249,15 +272,17 @@ def local_phases(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[s
         [jnp.full((g[0].shape[0],), g[1], jnp.uint32) for g in groups])
     bvalid = jnp.concatenate([g[2] for g in groups])
     N = H + B
+    idx_bits = max(1, (N - 1).bit_length())
     keys_all = jnp.concatenate([hkeys, bkeys], axis=0)
     code_all = jnp.concatenate([jnp.full((H,), 5, jnp.uint32), bcode])
     valid_all = jnp.concatenate([jnp.arange(H) < n, bvalid])
-    inv = (~valid_all).astype(jnp.uint32)
+    keys_eff = jnp.where(valid_all[:, None], keys_all, jnp.uint32(0xFFFFFFFF))
     idx = jnp.arange(N, dtype=jnp.uint32)
-    ops = (inv,) + tuple(keys_all[:, c] for c in range(K)) + (code_all, idx)
-    s = lax.sort(ops, num_keys=K + 2, is_stable=True)
-    sidx = s[-1]
-    skeys = jnp.stack(s[1 : K + 1], axis=1)
+    codeidx = (jnp.where(valid_all, code_all, jnp.uint32(7)) << idx_bits) | idx
+    ops = tuple(keys_eff[:, c] for c in range(K)) + (codeidx,)
+    s = lax.sort(ops, num_keys=K + 1)
+    sidx = s[K] & jnp.uint32((1 << idx_bits) - 1)
+    skeys = jnp.stack(s[:K], axis=1)
     pos = jnp.zeros((N,), jnp.int32).at[sidx].set(jnp.arange(N, dtype=jnp.int32))
 
     # Lower bounds: inclusive cumsum of valid-table rows in sorted order;
@@ -318,20 +343,19 @@ def local_phases(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[s
         hist_hits = hist_hits.at[batch["r_txn"]].max(hit_rg.astype(jnp.int32), mode="drop")
 
     # ---- Phase 2: intra-batch (checkIntraBatchConflicts:1133) ----
-    # Four blocks of the [r_all, w_all] overlap matrix, each with the
-    # cheapest exact test available (all positions come from the fused sort):
-    #   point-point:  key equality == gid equality
+    # Overlap edges, split by row class (all positions come from the fused
+    # sort). The dominant point-vs-point block is NOT materialized as a
+    # matrix: key equality == gid equality, so the fixpoint resolves it
+    # with a per-gid min over committed point-write txn indices (a [Wp]
+    # scatter-min + [Rp] gather per iteration) instead of an [Rp, Wp]
+    # dense block (~67M lanes at the bench shape). Only the range-row
+    # blocks — orders of magnitude smaller — are bit-packed:
     #   point-range:  [k,k+'\0') hits [wb,we) iff wb <= k < we; both compares
     #                 are positional under the code ladder (wb@2 < k@3 <=>
     #                 wb <= k; k@3 < we@1 <=> k < we)
     #   range-point:  [rb,re) hits [k,k+'\0') iff rb <= k < re (rb@3 < k@4
     #                 <=> rb <= k; k@4 < re@0 <=> k < re)
     #   range-range:  the classic endpoint-order compares
-    earlier_pp = batch["wp_txn"][None, :] < batch["rp_txn"][:, None]
-    ov_pp = (
-        (gid_rp[:, None] == gid_wp[None, :])
-        & earlier_pp & rp_valid[:, None] & wp_valid[None, :]
-    )
     ov_pr = (
         (pos_wb[None, :] < pos_rpb[:, None])          # wb <= k
         & (pos_rpb[:, None] < pos_we[None, :])        # k < we
@@ -351,15 +375,19 @@ def local_phases(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[s
         & (batch["w_txn"][None, :] < batch["r_txn"][:, None])
         & (nonempty & r_valid)[:, None] & w_valid[None, :]
     )
-    ov = jnp.concatenate([
-        jnp.concatenate([ov_pp, ov_pr], axis=1),
-        jnp.concatenate([ov_rp, ov_rr], axis=1),
-    ], axis=0)
-    # Bit-pack edges to [r_all, write_words] uint32 (MiniConflictSet's word
-    # trick, SkipList.cpp:1028-1130, transplanted to the VPU). The fixpoint
-    # touches only these packed words per iteration.
-    ovp = _pack_bits(ov, cfg.write_words)
-    return hist_hits, ovp, wpos
+    # Bit-pack edges (MiniConflictSet's word trick, SkipList.cpp:1028-1130,
+    # transplanted to the VPU). The fixpoint touches only these packed
+    # words plus the gid vectors per iteration.
+    edges = {
+        # all reads x RANGE writes: [r_all, wr_words]
+        "ovw": _pack_bits(jnp.concatenate([ov_pr, ov_rr], axis=0), cfg.wr_words),
+        # RANGE reads x point writes: [Rr, wp_words]
+        "ovrp": _pack_bits(ov_rp, cfg.wp_words),
+        # per-key group ids of point rows (equal gid == equal key)
+        "gid_rp": gid_rp,
+        "gid_wp": gid_wp,
+    }
+    return hist_hits, edges, wpos
 
 
 def _group_bounds(txn: jnp.ndarray, valid: jnp.ndarray, T: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -374,15 +402,20 @@ def commit_fixpoint(
     cfg: KernelConfig,
     t_ok: jnp.ndarray,
     hist_hits: jnp.ndarray,
-    ovp: jnp.ndarray,
+    edges: Dict[str, jnp.ndarray],
     batch: Dict[str, jnp.ndarray],
     allreduce=lambda x: x,
 ) -> jnp.ndarray:
-    """Earlier-in-batch-wins verdicts via bit-packed fixpoint.
+    """Earlier-in-batch-wins verdicts via bit-packed + segment-min fixpoint.
 
-    Each iteration over the packed edge words ovp [r_all, write_words]:
-      1. pack the committed mask over all write rows to [write_words] words,
-      2. hit_r = any(ovp & mask) per read row,
+    Each iteration:
+      1. point reads vs point writes (the dominant block): scatter the
+         committed point-write txn indices to a per-gid min, gather per
+         point read — a read is hit iff the min committed writer txn of
+         its key group is strictly earlier in the batch. No [Rp, Wp]
+         matrix exists anywhere.
+      2. reads vs range writes / range reads vs point writes: AND the
+         packed edge words against the iteration's committed masks,
       3. reduce reads -> txns with cumsums + [T] gathers per read group
          (rows are grouped by ascending owning txn within each group),
       4. `allreduce` the per-txn blocked counts ([T] int32; txn index space
@@ -395,8 +428,7 @@ def commit_fixpoint(
     """
     T = cfg.max_txns
     Rp = cfg.rp
-    w_txn_all = jnp.concatenate([batch["wp_txn"], batch["w_txn"]])
-    w_valid_all = jnp.concatenate([batch["wp_valid"], batch["w_valid"]])
+    G = cfg.gid_space
     ps, pe = _group_bounds(batch["rp_txn"], batch["rp_valid"], T)
     rs, re_ = _group_bounds(batch["r_txn"], batch["r_valid"], T)
 
@@ -408,9 +440,23 @@ def commit_fixpoint(
         return csum[ends] - csum[starts]
 
     def blocked_of(c):
-        maskp = _pack_bits(c[w_txn_all] & w_valid_all, cfg.write_words)
-        hit_r = jnp.any(ovp & maskp[None, :], axis=-1)                   # [r_all]
-        blocked_t = seg_count(hit_r[:Rp], ps, pe) + seg_count(hit_r[Rp:], rs, re_)
+        cwp = c[batch["wp_txn"]] & batch["wp_valid"]                     # [Wp]
+        cwr = c[batch["w_txn"]] & batch["w_valid"]                       # [Wr]
+        maskw = _pack_bits(cwr, cfg.wr_words)
+        hit_w = jnp.any(edges["ovw"] & maskw[None, :], axis=-1)          # [r_all]
+        maskp = _pack_bits(cwp, cfg.wp_words)
+        hit_rp = jnp.any(edges["ovrp"] & maskp[None, :], axis=-1)        # [Rr]
+        # point-point per-gid min of committed writer txns (T = +inf).
+        # gids are a 1-based cumsum over the N sorted rows, so G+1 (== N+1)
+        # is a safe dustbin slot for uncommitted rows.
+        mn = jnp.full((G + 2,), T, jnp.int32).at[
+            jnp.where(cwp, edges["gid_wp"], G + 1)
+        ].min(batch["wp_txn"], mode="drop")
+        hit_pp = mn[edges["gid_rp"]] < batch["rp_txn"]                   # [Rp]
+        blocked_t = (
+            seg_count(hit_w[:Rp] | hit_pp, ps, pe)
+            + seg_count(hit_w[Rp:] | hit_rp, rs, re_)
+        )
         return allreduce(blocked_t) > 0                                  # psum over shards
 
     # Earlier-in-batch-wins is a DAG over u < t edges; iterate to its unique
@@ -452,18 +498,24 @@ def apply_writes_and_gc(
     ekeys = jnp.concatenate([_bump(batch["wpb"]), batch["we"]], axis=0)   # [Wa, K]
 
     # ---- Phase 3: committed-write union (combineWriteConflictRanges:1320) ----
+    # Same operand packing as the fused sort: all-ones keys push
+    # uncommitted rows past every real key, and (code | original index)
+    # share one word — 6 sort operands instead of 8.
     cw = w_valid_all & committed[w_txn_all]
     allk = jnp.concatenate([bkeys, ekeys], axis=0)                        # [2Wa, K]
     ecode = jnp.concatenate([jnp.zeros((Wa,), jnp.uint32), jnp.ones((Wa,), jnp.uint32)])
     evalid = jnp.concatenate([cw, cw])
-    einv = (~evalid).astype(jnp.uint32)
+    eidx_bits = max(1, (2 * Wa - 1).bit_length())
+    ekeys_eff = jnp.where(evalid[:, None], allk, jnp.uint32(0xFFFFFFFF))
     epidx = jnp.arange(2 * Wa, dtype=jnp.uint32)
-    eops = (einv,) + tuple(allk[:, c] for c in range(K)) + (ecode, epidx)
-    es = lax.sort(eops, num_keys=K + 2, is_stable=True)
-    s_valid = es[0] == 0
-    s_delta = jnp.where(es[K + 1] == 0, 1, -1)
-    s_keys = jnp.stack(es[1 : K + 1], axis=1)                             # [2Wa, K]
-    s_pidx = es[K + 2].astype(jnp.int32)
+    ecodeidx = (jnp.where(evalid, ecode, jnp.uint32(3)) << eidx_bits) | epidx
+    eops = tuple(ekeys_eff[:, c] for c in range(K)) + (ecodeidx,)
+    es = lax.sort(eops, num_keys=K + 1)
+    s_code = es[K] >> eidx_bits
+    s_valid = s_code < 2
+    s_delta = jnp.where(s_code == 0, 1, -1)
+    s_keys = jnp.stack(es[:K], axis=1)                                    # [2Wa, K]
+    s_pidx = (es[K] & jnp.uint32((1 << eidx_bits) - 1)).astype(jnp.int32)
 
     d = jnp.where(s_valid, s_delta, 0)
     cum = jnp.cumsum(d)
@@ -580,15 +632,15 @@ def apply_writes_and_gc(
 def detect_step(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[str, jnp.ndarray]):
     """Phases 1-2 only (no fixpoint, no writes): for the host long-key tier,
     which must combine global verdicts across device + host tiers BEFORE any
-    tier applies writes. Returns (hist_hits, ovp, wpos) — device-resident."""
+    tier applies writes. Returns (hist_hits, edges, wpos) — device-resident."""
     return local_phases(cfg, state, batch)
 
 
 def fix_step(cfg: KernelConfig, t_ok: jnp.ndarray, hist_hits: jnp.ndarray,
-             ovp: jnp.ndarray, batch: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+             edges: Dict[str, jnp.ndarray], batch: Dict[str, jnp.ndarray]) -> jnp.ndarray:
     """Re-run the earlier-in-batch-wins fixpoint with an updated t_ok mask
     (host-tier aborts folded in); cheap relative to detect_step."""
-    return commit_fixpoint(cfg, t_ok, hist_hits, ovp, batch)
+    return commit_fixpoint(cfg, t_ok, hist_hits, edges, batch)
 
 
 def apply_step(cfg: KernelConfig, state: Dict[str, jnp.ndarray],
@@ -611,8 +663,8 @@ def status_of(t_too_old: jnp.ndarray, committed: jnp.ndarray) -> jnp.ndarray:
 def resolve_step(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[str, jnp.ndarray]) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
     """One single-shard resolver batch: (state, batch) -> (state', outputs).
     Pure; jit me. See local_phases for the batch layout."""
-    hist_hits, ovp, wpos = local_phases(cfg, state, batch)
-    committed = commit_fixpoint(cfg, batch["t_ok"], hist_hits, ovp, batch)
+    hist_hits, edges, wpos = local_phases(cfg, state, batch)
+    committed = commit_fixpoint(cfg, batch["t_ok"], hist_hits, edges, batch)
     new_state, overflow = apply_writes_and_gc(cfg, state, batch, committed, wpos)
     out = {
         "status": status_of(batch["t_too_old"], committed),

@@ -11,9 +11,10 @@ fdbserver/Resolver.actor.cpp + MasterProxyServer.actor.cpp:263-316):
     intersects (ResolutionRequestBuilder::addTransaction's splitting) — all
     shared with the single-chip engine via RoutedConflictEngineBase.
   * One jitted shard_map step: each shard runs phases 1-2 locally and
-    keeps its [R, W/32] bit-packed overlap edges shard-local; only [T]
-    txn-space vectors cross the ICI — one psum of history-hit bitmaps,
-    then one 8KB psum of blocked-txn counts per fixpoint iteration.
+    keeps its bit-packed overlap edges + per-key group ids shard-local;
+    only [T] txn-space vectors cross the ICI — one psum of history-hit
+    bitmaps, then one 8KB psum of blocked-txn counts per fixpoint
+    iteration.
     Every shard computes the identical earlier-in-batch-wins fixpoint
     from the reduced values (lockstep while_loop) and applies its own
     clipped committed writes. A handful of tiny collective rounds per
@@ -97,7 +98,7 @@ def make_sharded_split_steps(cfg: KernelConfig, mesh: Mesh, axis: str = "shard")
     def fix(t_ok, hist_local, ovp, batch):
         t_ok = t_ok[0]
         hist_local = hist_local[0]
-        ovp = ovp[0]
+        ovp = jax.tree.map(lambda x: x[0], ovp)
         batch = jax.tree.map(lambda x: x[0], batch)
         hist = lax.psum(hist_local, axis)
         committed = ck.commit_fixpoint(
