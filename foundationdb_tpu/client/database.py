@@ -546,9 +546,17 @@ class Transaction:
             while True:
                 try:
                     locs = await self.db.get_locations(key, key_after(key))
-                    return await self.db.storage_request(
-                        locs[0][1], storage_mod.WATCH_VALUE_TOKEN,
+                    # One rotated replica, NO failover: a watch is a long
+                    # poll, and chaining 30s parks across the team would
+                    # multiply the re-check interval by the team size.
+                    addrs = locs[0][1]
+                    self.db._lb_counter += 1
+                    addr = addrs[self.db._lb_counter % len(addrs)]
+                    return await self.db.net.request(
+                        self.db.client_addr,
+                        Endpoint(addr, storage_mod.WATCH_VALUE_TOKEN),
                         WatchValueRequest(key=key, value=exp, version=version),
+                        TaskPriority.DEFAULT_ENDPOINT,
                         timeout=30.0,
                     )
                 except error.FDBError as e:

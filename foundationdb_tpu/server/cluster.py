@@ -29,6 +29,7 @@ from .tlog import TLog
 @dataclass
 class ClusterConfig:
     n_resolvers: int = 1
+    n_proxies: int = 1
     n_storage: int = 2          # number of key-range shards
     storage_replication: int = 1  # replicas per shard (the team size K)
     #: () -> conflict engine; default is the reference-exact oracle. Pass
@@ -90,26 +91,38 @@ class Cluster:
                 tag += 1
             self.storage_teams.append(team)
 
-        self.proxy_proc = sim.new_process("proxy")
-        self.proxy = Proxy(
-            self.proxy_proc,
-            sim.net,
-            ProxyConfig(
-                master_ep=Endpoint(self.master_proc.address, GET_COMMIT_VERSION_TOKEN),
-                resolver_eps=[Endpoint(p.address, RESOLVE_TOKEN) for p in self.resolver_procs],
-                resolver_shards=self.resolver_shards,
-                log_config=self.log_config,
-                storage_teams=self.storage_teams,
-                storage_shards=self.storage_shards,
-            ),
-            start_version=sv,
-        )
+        from .proxy import COMMITTED_VERSION_TOKEN
+
+        self.proxy_procs = [sim.new_process(f"proxy{i}")
+                            for i in range(max(1, cfg.n_proxies))]
+        peer_grv_eps = [Endpoint(p.address, COMMITTED_VERSION_TOKEN)
+                        for p in self.proxy_procs]
+        self.proxies = [
+            Proxy(
+                p,
+                sim.net,
+                ProxyConfig(
+                    master_ep=Endpoint(self.master_proc.address, GET_COMMIT_VERSION_TOKEN),
+                    resolver_eps=[Endpoint(q.address, RESOLVE_TOKEN) for q in self.resolver_procs],
+                    resolver_shards=self.resolver_shards,
+                    log_config=self.log_config,
+                    storage_teams=self.storage_teams,
+                    storage_shards=self.storage_shards,
+                    peer_grv_eps=peer_grv_eps,
+                ),
+                start_version=sv,
+            )
+            for p in self.proxy_procs
+        ]
+        self.proxy_proc = self.proxy_procs[0]
+        self.proxy = self.proxies[0]
         self._n_clients = 0
 
     def new_client(self) -> Database:
         self._n_clients += 1
         proc = self.sim.new_process(f"client{self._n_clients}")
-        return Database(self.sim.net, proc.address, [self.proxy_proc.address])
+        return Database(self.sim.net, proc.address,
+                        [p.address for p in self.proxy_procs])
 
 
 def build_cluster(seed: int = 0, cfg: Optional[ClusterConfig] = None) -> Cluster:
@@ -129,6 +142,7 @@ class DynamicClusterConfig:
     n_workers: int = 5
     n_tlogs: int = 2
     n_resolvers: int = 2
+    n_proxies: int = 1
     n_storage: int = 2          # number of key-range shards
     storage_replication: int = 1  # replicas per shard (team size)
     #: per-tag tlog replication factor; 0 = every replica holds every tag

@@ -135,7 +135,7 @@ class ClusterController:
                 doc["cluster"]["version"] = frag["version"]
                 doc["cluster"]["roles"] = {
                     "tlogs": frag["tlogs"], "resolvers": frag["resolvers"],
-                    "proxy": frag["proxy"],
+                    "proxies": frag["proxies"],
                 }
                 doc["qos"] = {
                     "transactions_per_second_limit": frag["tps_limit"],

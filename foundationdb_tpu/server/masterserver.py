@@ -158,7 +158,11 @@ class MasterServer:
 
         tlog_addrs = pick(cfg.n_tlogs, 0)
         resolver_addrs = pick(cfg.n_resolvers, cfg.n_tlogs)
-        proxy_addr = pick(1, cfg.n_tlogs + cfg.n_resolvers)[0]
+        n_proxies = max(1, getattr(cfg, "n_proxies", 1))
+        proxy_addrs = pick(n_proxies, cfg.n_tlogs + cfg.n_resolvers)
+        if len(set(proxy_addrs)) < n_proxies:
+            # proxy tokens are per-process: never co-locate two proxies
+            proxy_addrs = list(dict.fromkeys(proxy_addrs))
 
         # Per-replica token suffixes: duplicate placement (a thin worker
         # pool) degrades fault isolation but must never alias two role
@@ -272,12 +276,15 @@ class MasterServer:
                 "worst_storage_lag_versions": ratekeeper.worst_lag,
                 "tlogs": list(tlog_addrs),
                 "resolvers": list(resolver_addrs),
-                "proxy": proxy_addr,
+                "proxies": list(proxy_addrs),
             }
 
         self.proc.register(status_token, master_status)
 
+        from .proxy import COMMITTED_VERSION_TOKEN
+
         storage_shards, storage_teams = teams_from_storage_tags(storage_tags)
+        peer_grv_eps = [Endpoint(a, COMMITTED_VERSION_TOKEN) for a in proxy_addrs]
         proxy_cfg = ProxyConfig(
             master_ep=Endpoint(self.proc.address, GET_COMMIT_VERSION_TOKEN + suffix),
             resolver_eps=[Endpoint(a, RESOLVE_TOKEN + f"{suffix}.{i}")
@@ -288,10 +295,14 @@ class MasterServer:
             storage_shards=storage_shards,
             master_wf_ep=Endpoint(self.proc.address, f"waitFailure:master:{self.salt}"),
             rate_ep=Endpoint(self.proc.address, rate_token),
+            peer_grv_eps=peer_grv_eps,
         )
-        await self._init_role(proxy_addr, INIT_PROXY_TOKEN, InitializeProxyRequest(
-            gen_id=gen_id, cfg=proxy_cfg, start_version=recovery_txn_version,
-        ))
+        await all_of([
+            self._init_role(a, INIT_PROXY_TOKEN, InitializeProxyRequest(
+                gen_id=gen_id, cfg=proxy_cfg, start_version=recovery_txn_version,
+            ))
+            for a in proxy_addrs
+        ])
 
         # -- WRITING_CSTATE: the durable hand-over ---------------------------
         self._state("writing_cstate")
@@ -304,7 +315,7 @@ class MasterServer:
         # -- FULLY_RECOVERED -------------------------------------------------
         info = ServerDBInfo(
             recovery_count=rc, recovery_state="fully_recovered",
-            master_addr=self.proc.address, proxy_addrs=(proxy_addr,),
+            master_addr=self.proc.address, proxy_addrs=tuple(proxy_addrs),
             log_config=new_log, storage_tags=storage_tags,
             master_status_ep=Endpoint(self.proc.address, status_token),
         )
@@ -324,7 +335,7 @@ class MasterServer:
         # Serve until any recruited role host dies (process-level watch;
         # role death on a live worker only happens when a successor
         # generation replaces us, in which case we are dead already).
-        watch_addrs = sorted(set(tlog_addrs + resolver_addrs + [proxy_addr]))
+        watch_addrs = sorted(set(tlog_addrs + resolver_addrs + list(proxy_addrs)))
         watchers = [
             spawn(
                 wait_failure_client(self.net, self.proc.address,

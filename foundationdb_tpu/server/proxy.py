@@ -60,6 +60,7 @@ GRV_TOKEN = "proxy.getReadVersion"
 COMMIT_TOKEN = "proxy.commit"
 LOCATIONS_TOKEN = "proxy.getKeyServerLocations"
 STATS_TOKEN = "proxy.stats"
+COMMITTED_VERSION_TOKEN = "proxy.committedVersion"
 
 #: batching intervals/caps come from the knob registry so BUGGIFY can
 #: randomize them per simulation (reference: START_TRANSACTION_BATCH_* /
@@ -109,6 +110,10 @@ class ProxyConfig:
     master_wf_ep: Optional[Endpoint] = None
     #: ratekeeper endpoint (GetRateInfo); None = unthrottled
     rate_ep: Optional[Endpoint] = None
+    #: committed-version endpoints of EVERY proxy in this generation
+    #: (including this one); GRVs confirm the max committed version across
+    #: all of them (getLiveCommittedVersion, MasterProxyServer.actor.cpp:897)
+    peer_grv_eps: List[Endpoint] = field(default_factory=list)
 
 
 class Proxy:
@@ -132,6 +137,7 @@ class Proxy:
         #: per-proxy dedup window replays the same version pair)
         self._pending_master_req: Dict[int, int] = {}
         self._grv_waiters: List[Promise] = []
+        self._grv_flush_active = False
         self._commit_queue: PromiseStream = PromiseStream()
         #: reference: ProxyStats (MasterProxyServer.actor.cpp:48-80)
         self.stats = CounterCollection("Proxy", proc.address)
@@ -148,6 +154,7 @@ class Proxy:
         proc.register(COMMIT_TOKEN, self.commit)
         proc.register(LOCATIONS_TOKEN, self.get_key_server_locations)
         proc.register(STATS_TOKEN, self._stats_req)
+        proc.register(COMMITTED_VERSION_TOKEN, self._committed_version_req)
         self._spawn(self.commit_batcher(), TaskPriority.PROXY_COMMIT_BATCHER, "commitBatcher")
         self._spawn(self.stats.run_logger(), TaskPriority.PROXY_GRV_TIMER, "proxyStats")
         if cfg.master_wf_ep is not None:
@@ -211,39 +218,82 @@ class Proxy:
         if self._dead:
             return
         self._dead = True
-        for tok in (GRV_TOKEN, COMMIT_TOKEN, LOCATIONS_TOKEN, STATS_TOKEN):
+        for tok in (GRV_TOKEN, COMMIT_TOKEN, LOCATIONS_TOKEN, STATS_TOKEN,
+                    COMMITTED_VERSION_TOKEN):
             self.proc.unregister(tok)
         self.actors.cancel_all()
 
     async def _stats_req(self, _req):
         return self.stats.as_dict()
 
+    async def _committed_version_req(self, _req) -> Version:
+        return self.committed_version.get()
+
     # -- GRV path ------------------------------------------------------------
     async def get_read_version(self, req: GetReadVersionRequest) -> GetReadVersionReply:
         p = Promise()
         self._grv_waiters.append(p)
-        if len(self._grv_waiters) == 1:
+        if not self._grv_flush_active:
+            # explicit flag, not len()==1: the flusher empties the list and
+            # then awaits the peer quorum, during which a new arrival would
+            # otherwise spawn a second concurrent flusher
+            self._grv_flush_active = True
             self._spawn(self._grv_flush(), TaskPriority.PROXY_GRV_TIMER, "grvBatch")
-        await p.future
+        version = await p.future
         self.stats.add("txn_start_out")
-        return GetReadVersionReply(version=self.committed_version.get())
+        return GetReadVersionReply(version=max(version, self.committed_version.get()))
+
+    async def _live_committed_version(self) -> Version:
+        """Max committed version across EVERY proxy of the generation
+        (getLiveCommittedVersion:897): a commit acked by a peer proxy must
+        be visible to reads started here afterwards. All peers must reply —
+        an unreachable peer may hold the newest acks, so GRVs fail (clients
+        retry) until it answers or recovery replaces the generation, exactly
+        the reference's confirm-epoch-live stall."""
+        own = self.committed_version.get()
+        others = [ep for ep in self.cfg.peer_grv_eps
+                  if ep.address != self.proc.address]
+        if not others:
+            return own
+        replies = await all_of([
+            self.net.request(self.proc.address, ep, None,
+                             TaskPriority.PROXY_GRV_TIMER,
+                             timeout=SERVER_REQUEST_TIMEOUT)
+            for ep in others
+        ])
+        return max(own, *replies)
 
     async def _grv_flush(self) -> None:
         """Release queued GRVs within the ratekeeper budget; leftovers wait
         for the next interval's replenishment (back-pressure surfaces as
         start-transaction latency, never an error)."""
-        while True:
-            await delay(SERVER_KNOBS.grv_batch_interval, TaskPriority.PROXY_GRV_TIMER)
-            self._replenish_grv_budget()
-            n = len(self._grv_waiters)
-            if self._grv_budget != float("inf"):
-                n = min(n, int(self._grv_budget))
-                self._grv_budget -= n
-            release, self._grv_waiters = self._grv_waiters[:n], self._grv_waiters[n:]
-            for p in release:
-                p.send(None)
-            if not self._grv_waiters:
-                return
+        try:
+            while True:
+                await delay(SERVER_KNOBS.grv_batch_interval, TaskPriority.PROXY_GRV_TIMER)
+                self._replenish_grv_budget()
+                n = len(self._grv_waiters)
+                if self._grv_budget != float("inf"):
+                    n = min(n, int(self._grv_budget))
+                    self._grv_budget -= n
+                release, self._grv_waiters = self._grv_waiters[:n], self._grv_waiters[n:]
+                try:
+                    version = await self._live_committed_version()
+                except error.FDBError as e:
+                    # A peer proxy is unreachable: these starts cannot be
+                    # causally confirmed. Fail them retryably.
+                    for p in release:
+                        if not p.is_set:
+                            p.send_error(error.connection_failed(
+                                f"proxy liveness quorum failed: {e.name}"))
+                    if not self._grv_waiters:
+                        return
+                    continue
+                for p in release:
+                    p.send(version)
+                if not self._grv_waiters:
+                    return
+        finally:
+            self._grv_flush_active = False
 
     # -- locations -----------------------------------------------------------
     async def get_key_server_locations(self, req: GetKeyServerLocationsRequest) -> GetKeyServerLocationsReply:

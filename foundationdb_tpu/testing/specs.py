@@ -83,6 +83,19 @@ SPECS: Dict[str, Callable[[], Spec]] = {
         client_count=2,
         timeout=900.0,
     ),
+    # three proxies + GRV causality quorum under kill/reboot churn
+    "MultiProxyAttrition": lambda: Spec(
+        title="MultiProxyAttrition",
+        workloads=[
+            (CycleWorkload, {"nodes": 8, "transactions": 10, "think_time": 2.0}),
+            (MachineAttritionWorkload, {"interval": 6.0, "delay_before": 2.0}),
+            (ConsistencyCheckWorkload, {}),
+        ],
+        dynamic=DynamicClusterConfig(n_workers=8, n_tlogs=2, n_resolvers=2,
+                                     n_proxies=3, n_storage=2),
+        client_count=3,
+        timeout=900.0,
+    ),
     # per-tag tlog subsets (R=2 of K=3) under kill/reboot churn: every
     # recovery exercises the lock-coverage quorum + merged per-tag fetch
     "CycleLogSubsets": lambda: Spec(

@@ -620,11 +620,21 @@ class ConsistencyCheckWorkload(TestWorkload):
                 await tr.on_error(e)
                 tr = db.create_transaction()
 
+        _transport = {
+            error.connection_failed("").code,
+            error.request_maybe_delivered("").code,
+            error.timed_out("").code,
+        }
+
         async def read_replica(addr, rng):
-            """Full clipped shard contents from one replica at rv, or None
-            if the replica stays unreachable."""
+            """Full clipped shard contents from one replica at rv. Returns
+            None only for a replica that stays UNREACHABLE (transport
+            errors) — a live replica that keeps erroring (future_version,
+            wrong_shard: lagging or divergent state) must fail the check,
+            not be skipped, or the workload would excuse exactly the bug
+            class it exists to catch."""
             rows, cb, ce = [], rng.begin, min(rng.end, self.END)
-            attempts = 0
+            transport_errs = live_errs = 0
             while cb < ce:
                 try:
                     reply = await db.net.request(
@@ -634,10 +644,16 @@ class ConsistencyCheckWorkload(TestWorkload):
                                             limit=10_000),
                         TaskPriority.DEFAULT_ENDPOINT, timeout=5.0,
                     )
-                except error.FDBError:
-                    attempts += 1
-                    if attempts >= 10:
-                        return None
+                except error.FDBError as e:
+                    if e.code in _transport:
+                        transport_errs += 1
+                        if transport_errs >= 10:
+                            return None
+                    else:
+                        live_errs += 1
+                        if live_errs >= 60:
+                            self.ctx.count("replica_stuck_erroring")
+                            return "stuck:%s" % e.name
                     await delay(0.5)
                     continue
                 rows.extend(reply.data)
@@ -652,6 +668,8 @@ class ConsistencyCheckWorkload(TestWorkload):
             views = []
             for addr in addrs:
                 rows = await read_replica(addr, rng)
+                if isinstance(rows, str):
+                    return False  # live replica stuck erroring: never skip
                 if rows is not None:
                     views.append((addr, rows))
             if not views:

