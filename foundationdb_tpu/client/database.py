@@ -269,6 +269,7 @@ class Transaction:
         self.committed_batch_index: int = 0
         self._backoff = INITIAL_BACKOFF
         self._committing = False
+        self._access_system_keys = False
 
     # -- versions ------------------------------------------------------------
     async def get_read_version(self) -> Version:
@@ -388,6 +389,7 @@ class Transaction:
 
     # -- storage rpc with location cache + retry -----------------------------
     async def _storage_get(self, key: Key, version: Version) -> Optional[Value]:
+        fresh_tries = 0
         while True:
             locs = await self.db.get_locations(key, key_after(key))
             try:
@@ -400,6 +402,15 @@ class Transaction:
                 if e.code == _WRONG_SHARD:
                     self.db.invalidate_cache()
                     continue
+                if e.code in (_CONNECTION_FAILED, _MAYBE_DELIVERED) and fresh_tries < 2:
+                    # The whole cached team is unreachable — it may have
+                    # been moved away (MoveKeys retired the old replicas).
+                    # Re-resolve locations before giving up (loadBalance's
+                    # allAlternativesFailed -> re-fetch).
+                    fresh_tries += 1
+                    self.db.invalidate_cache()
+                    await delay(0.1)
+                    continue
                 raise _map_read_error(e)
 
     async def _storage_get_range(
@@ -409,6 +420,7 @@ class Transaction:
         shard is exhausted. Returns (data, truncated): truncated means the
         servers may hold more rows in [begin, end) past the returned ones."""
         out: List[Tuple[Key, Value]] = []
+        fresh_tries = 0
         while True:
             locs = await self.db.get_locations(begin, end)
             if reverse:
@@ -437,6 +449,13 @@ class Transaction:
                 if e.code == _WRONG_SHARD:
                     self.db.invalidate_cache()
                     out = []
+                    continue
+                if e.code in (_CONNECTION_FAILED, _MAYBE_DELIVERED) and fresh_tries < 2:
+                    # dead cached team: the shard may have moved (MoveKeys)
+                    fresh_tries += 1
+                    self.db.invalidate_cache()
+                    out = []
+                    await delay(0.1)
                     continue
                 raise _map_read_error(e)
 
@@ -579,10 +598,16 @@ class Transaction:
     def add_write_conflict_range(self, begin: Key, end: Key) -> None:
         self.write_conflict_ranges.append(KeyRange(begin, end))
 
+    def set_access_system_keys(self) -> None:
+        """Allow writes to the `\\xff` system keyspace (the reference's
+        ACCESS_SYSTEM_KEYS transaction option; used by ManagementAPI-class
+        callers like the master's DD-lite)."""
+        self._access_system_keys = True
+
     def _check_writable(self, key: Key) -> None:
         if self._committing:
             raise error.used_during_commit()
-        if key >= USER_KEYSPACE_END:
+        if key >= USER_KEYSPACE_END and not self._access_system_keys:
             raise error.key_outside_legal_range()
 
     # -- commit / retry --------------------------------------------------------

@@ -192,6 +192,16 @@ class LogSystemClient:
                 last_err = e
         raise last_err if last_err is not None else error.connection_failed()
 
+    def send_kcv(self, version: Version) -> None:
+        """Advertise a known-committed version to every replica
+        (unreliable one-ways; the same payload pushes piggyback)."""
+        for rep in self.config.tlogs:
+            self.net.one_way(
+                self.src, self.config.ep(rep, "kcv"),
+                TLogKnownCommittedRequest(version=version),
+                TaskPriority.TLOG_COMMIT,
+            )
+
     def pop(self, tag: int, version: Version) -> None:
         for rep in self.config.tlogs:
             self.net.one_way(

@@ -30,7 +30,7 @@ fresh resolvers instead of silently missing lost conflict history.
 """
 from __future__ import annotations
 
-from dataclasses import replace
+from dataclasses import dataclass, replace
 from typing import Dict, List, Optional, Tuple
 
 from ..core import error
@@ -39,6 +39,7 @@ from ..ops.host_engine import KeyShardMap
 from ..sim.actors import all_of, any_of
 from ..sim.loop import TaskPriority, delay, spawn
 from ..sim.network import Endpoint
+from . import system_keys
 from .coordinated_state import CoordinatedState, DBCoreState, LogGenerationInfo
 from .log_system import LogSystemConfig, fetch_recovery_data, lock_generation
 from .master import GET_COMMIT_VERSION_TOKEN, Master, RECOVERY_VERSION_JUMP
@@ -56,11 +57,31 @@ from .worker import (
     INIT_STORAGE_TOKEN,
     INIT_TLOG_TOKEN,
     RETIRE_TOKEN,
+    RETIRE_STORAGE_TOKEN,
     RetireGenerationsRequest,
+    RetireStorageRequest,
     ServerDBInfo,
 )
 
 RECRUIT_TIMEOUT = 2.0
+MOVE_SHARD_TOKEN = "master.moveShard"
+
+
+def _teams_by_begin(storage_tags) -> "Dict[bytes, List[Tuple[int, str]]]":
+    out: Dict[bytes, List[Tuple[int, str]]] = {}
+    for tag, b, _e, addr in storage_tags:
+        out.setdefault(b, []).append((tag, addr))
+    return {b: sorted(t) for b, t in out.items()}
+
+
+@dataclass
+class MoveShardRequest:
+    """Management request: move the whole shard beginning at `begin` to a
+    team on `dest_workers` (one replica each). reference: MoveKeys
+    (MoveKeys.actor.cpp:821), driven here by a DD-lite under the master."""
+
+    begin: bytes
+    dest_workers: List[str]
 
 
 class MasterServer:
@@ -97,6 +118,107 @@ class MasterServer:
                 self.master.unregister()
             # Falling out ends the role; the worker unregisters our
             # wait-failure token and the CC recruits a successor.
+
+    async def _move_shard(self, req: MoveShardRequest, dd, dd_db, log_client,
+                          cstate, ratekeeper):
+        """MoveKeys v0 (MoveKeys.actor.cpp:821 reduced to whole shards):
+
+          1. commit keyServers(begin) = old team + new tags — proxies drain
+             this through the metadata stream and start double-tagging the
+             range, so the log buffers the destinations' history;
+          2. recruit destination replicas, which fetchKeys a snapshot at a
+             read version taken AFTER step 1 and then drain their tag;
+          3. commit keyServers(begin) = new team — reads/writes flip;
+          4. persist the new map in cstate (the recovery authority), then
+             retire the old replicas and their tags.
+        A crash before (4) recovers with the OLD map: the old team was
+        never retired, and dd_init prunes the orphaned destinations."""
+        tags = dd["storage_tags"]
+        team = sorted((t, a) for (t, b, _e, a) in tags if b == req.begin)
+        if not team:
+            raise error.client_invalid_operation(f"no shard begins at {req.begin!r}")
+        end = next(e for (_t, b, e, _a) in tags if b == req.begin)
+        dests = list(req.dest_workers)
+        if len(dests) != len(team) or len(set(dests)) != len(dests):
+            raise error.client_invalid_operation("need one distinct dest per replica")
+        busy_addrs = {a for (_t, _b, _e, a) in tags}
+        if any(d in busy_addrs for d in dests):
+            raise error.client_invalid_operation("dest already hosts storage")
+        next_tag = max(t for (t, _b, _e, _a) in tags) + 1
+        new_team = [(next_tag + i, d) for i, d in enumerate(dests)]
+        TraceEvent("MoveShardStart", id=self.salt).detail(
+            "Begin", req.begin).detail("NewTeam", str(new_team)).log()
+
+        # (1) old + new tags: destinations' history starts accumulating
+        async def ph1(tr):
+            tr.set_access_system_keys()
+            tr.set(system_keys.key_servers_key(req.begin),
+                   system_keys.encode_key_servers(team, tuple(t for t, _ in new_team)))
+        await dd_db.run(ph1)
+
+        try:
+            # (2) fetch version AFTER (1): the tag stream covers all newer
+            tr = dd_db.create_transaction()
+            v0 = await tr.get_read_version()
+            await all_of([
+                self.net.request(
+                    self.proc.address, Endpoint(d, INIT_STORAGE_TOKEN),
+                    InitializeStorageRequest(
+                        tag=nt, begin=req.begin, end=end,
+                        fetch_from=[a for _t, a in team], fetch_version=v0,
+                    ),
+                    TaskPriority.MOVE_KEYS, timeout=60.0,
+                )
+                for nt, d in new_team
+            ])
+
+            # (3) flip
+            async def ph2(tr):
+                tr.set_access_system_keys()
+                tr.set(system_keys.key_servers_key(req.begin),
+                       system_keys.encode_key_servers(new_team))
+            await dd_db.run(ph2)
+        except error.FDBError:
+            # Roll back (1): stop double-tagging, retire half-built
+            # destinations and their tags. If the rollback commit itself
+            # fails, the next epoch's dd_init reseeds keyServers from
+            # cstate and prunes the orphans — the backstop.
+            TraceEvent("MoveShardAbort", id=self.salt).detail("Begin", req.begin).log()
+
+            async def rollback(tr):
+                tr.set_access_system_keys()
+                tr.set(system_keys.key_servers_key(req.begin),
+                       system_keys.encode_key_servers(team))
+            await dd_db.run(rollback)
+            for nt, d in new_team:
+                self.net.one_way(self.proc.address, Endpoint(d, RETIRE_STORAGE_TOKEN),
+                                 RetireStorageRequest(tags=(nt,)),
+                                 TaskPriority.MOVE_KEYS)
+                log_client.pop(nt, -1)
+            raise
+
+        # (4) durable authority + cleanup
+        new_tags = sorted(
+            [(t, b, e, a) for (t, b, e, a) in tags if b != req.begin]
+            + [(nt, req.begin, end, d) for nt, d in new_team]
+        )
+        dd["cstate_val"] = replace(dd["cstate_val"], storage_tags=tuple(new_tags))
+        await cstate.set_exclusive(dd["cstate_val"])
+        dd["storage_tags"][:] = new_tags
+        ratekeeper.storage_tags = list(new_tags)
+        from .cluster_controller import CC_MASTER_RECOVERED_TOKEN
+
+        dd["info"] = replace(dd["info"], storage_tags=tuple(new_tags))
+        self.net.one_way(self.proc.address,
+                         Endpoint(self.cc_addr, CC_MASTER_RECOVERED_TOKEN),
+                         dd["info"], TaskPriority.CLUSTER_CONTROLLER)
+        for t, a in team:
+            self.net.one_way(self.proc.address, Endpoint(a, RETIRE_STORAGE_TOKEN),
+                             RetireStorageRequest(tags=(t,)),
+                             TaskPriority.MOVE_KEYS)
+            log_client.pop(t, -1)
+        TraceEvent("MoveShardDone", id=self.salt).detail("Begin", req.begin).log()
+        return {"begin": req.begin, "team": new_team}
 
     async def _recover_and_serve(self) -> None:
         cfg = self.cfg
@@ -173,15 +295,20 @@ class MasterServer:
             replication_factor=getattr(cfg, "log_replication_factor", 0),
         )
         # Seed each new replica with only the tags it will hold (per-tag
-        # subsets): the recovery copy routes exactly like future pushes.
+        # subsets), and only tags that still EXIST: a tag retired by a
+        # finished move — or minted by an unfinished one — must not ride
+        # the recovery copy into the new generation, where nothing would
+        # ever pop it (it would pin the disk-queue front forever).
+        live_tags = {t for (t, _b, _e, _a) in prev.storage_tags}
+        live_tags.add(system_keys.METADATA_TAG)
         await all_of([
             self._init_role(a, INIT_TLOG_TOKEN, InitializeTLogRequest(
                 gen_id=gen_id, start_version=recovery_version,
                 token_suffix=rep_suffix, replica_index=i,
                 preload={t: e for t, e in preload.items()
-                         if i in new_log.tag_subset(t)},
+                         if t in live_tags and i in new_log.tag_subset(t)},
                 preload_popped={t: v for t, v in preload_popped.items()
-                                if i in new_log.tag_subset(t)},
+                                if t in live_tags and i in new_log.tag_subset(t)},
             ))
             for i, (a, rep_suffix) in enumerate(tlog_reps)
         ])
@@ -306,11 +433,12 @@ class MasterServer:
 
         # -- WRITING_CSTATE: the durable hand-over ---------------------------
         self._state("writing_cstate")
-        await cstate.set_exclusive(DBCoreState(
+        cstate_val = DBCoreState(
             recovery_count=rc,
             generations=(LogGenerationInfo(config=new_log, end_version=None),),
             storage_tags=storage_tags,
-        ))
+        )
+        await cstate.set_exclusive(cstate_val)
 
         # -- FULLY_RECOVERED -------------------------------------------------
         info = ServerDBInfo(
@@ -332,6 +460,85 @@ class MasterServer:
                              TaskPriority.CLUSTER_CONTROLLER)
         self._state("fully_recovered", RecoveryCount=rc)
 
+        # -- DD-lite: the shard-movement coordinator -------------------------
+        # (DataDistribution reduced to explicit whole-shard MoveKeys; the
+        # authoritative map is cstate.storage_tags, mirrored into
+        # \xff/keyServers by real transactions at epoch start and on every
+        # move, so proxies and clients follow transactionally.)
+        from ..client.database import Database as ClientDatabase
+
+        from ..sim.loop import Promise as _Promise
+
+        dd = {
+            "storage_tags": list(storage_tags),
+            "cstate_val": cstate_val,
+            "busy": False,
+            "info": info,
+            "init_done": _Promise(),
+        }
+        dd_db = ClientDatabase(self.net, self.proc.address, list(proxy_addrs))
+        move_token = MOVE_SHARD_TOKEN + suffix
+
+        async def dd_init() -> None:
+            # Mirror the authoritative map into the system keyspace and
+            # prune orphaned destinations of a move the last epoch never
+            # finished (their tags are absent from cstate).
+            valid = tuple(t for (t, _b, _e, _a) in dd["storage_tags"])
+            for a in self.workers:
+                self.net.one_way(
+                    self.proc.address, Endpoint(a, RETIRE_STORAGE_TOKEN),
+                    RetireStorageRequest(tags=valid, prune=True),
+                    TaskPriority.MOVE_KEYS,
+                )
+
+            async def seed(tr):
+                tr.set_access_system_keys()
+                for begin, team in _teams_by_begin(dd["storage_tags"]).items():
+                    tr.set(system_keys.key_servers_key(begin),
+                           system_keys.encode_key_servers(team))
+            await dd_db.run(seed)
+            dd["init_done"].send(None)
+
+        async def dd_metadata_gc() -> None:
+            """Pop METADATA_TAG at the minimum drained version over every
+            proxy (the resolver's oldest-proxy-version GC): without this
+            the tag pins every tlog's disk-queue front forever."""
+            from .proxy import METADATA_VERSION_TOKEN
+
+            while True:
+                await delay(2.0, TaskPriority.MOVE_KEYS)
+                versions = []
+                ok = True
+                for a in proxy_addrs:
+                    try:
+                        versions.append(await self.net.request(
+                            self.proc.address, Endpoint(a, METADATA_VERSION_TOKEN),
+                            None, TaskPriority.MOVE_KEYS, timeout=1.0,
+                        ))
+                    except error.FDBError:
+                        ok = False
+                        break
+                if ok and versions:
+                    log_client.pop(system_keys.METADATA_TAG, min(versions))
+
+        async def move_shard(req: MoveShardRequest):
+            await dd["init_done"].future  # serialize vs the seed transaction
+            if dd["busy"]:
+                raise error.client_invalid_operation("a shard move is already running")
+            dd["busy"] = True
+            try:
+                return await self._move_shard(req, dd, dd_db, log_client, cstate,
+                                              ratekeeper)
+            finally:
+                dd["busy"] = False
+
+        self.proc.register(move_token, move_shard)
+        dd_task = spawn(dd_init(), TaskPriority.MOVE_KEYS, name=f"ddInit:{self.salt}")
+        self.proc.actors.add(dd_task)
+        dd_gc_task = spawn(dd_metadata_gc(), TaskPriority.MOVE_KEYS,
+                           name=f"ddMetaGC:{self.salt}")
+        self.proc.actors.add(dd_gc_task)
+
         # Serve until any recruited role host dies (process-level watch;
         # role death on a live worker only happens when a successor
         # generation replaces us, in which case we are dead already).
@@ -350,7 +557,10 @@ class MasterServer:
             for w in watchers:
                 w.cancel()
             rk_task.cancel()
+            dd_task.cancel()
+            dd_gc_task.cancel()
             self.proc.unregister(rate_token)
             self.proc.unregister(status_token)
+            self.proc.unregister(move_token)
         self.master.unregister()
         raise error.master_tlog_failed("a transaction-role host failed")

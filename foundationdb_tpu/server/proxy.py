@@ -44,6 +44,13 @@ from ..sim.actors import ActorCollection, NotifiedVersion, PromiseStream, all_of
 from ..sim.loop import Future, Promise, TaskPriority, delay, spawn
 from ..sim.network import Endpoint, SimProcess
 from .log_system import LogSystemClient, LogSystemConfig
+from .system_keys import (
+    KEY_SERVERS_PREFIX,
+    METADATA_TAG,
+    decode_key_servers,
+    is_system_key,
+    shard_begin_of,
+)
 from .messages import (
     CommitReply,
     CommitTransactionRequest,
@@ -61,17 +68,49 @@ COMMIT_TOKEN = "proxy.commit"
 LOCATIONS_TOKEN = "proxy.getKeyServerLocations"
 STATS_TOKEN = "proxy.stats"
 COMMITTED_VERSION_TOKEN = "proxy.committedVersion"
+METADATA_VERSION_TOKEN = "proxy.metadataVersion"
 
 #: batching intervals/caps come from the knob registry so BUGGIFY can
 #: randomize them per simulation (reference: START_TRANSACTION_BATCH_* /
 #: COMMIT_TRANSACTION_BATCH_* knobs, fdbserver/Knobs.cpp)
 MAX_COMMIT_BATCH = 512
+#: empty-batch tick when idle (reference: the commitBatcher's max interval)
+IDLE_COMMIT_INTERVAL = 0.5
 #: reply timeout on proxy->master/resolver/tlog requests: an alive-but-
 #: partitioned peer must fail the batch (commit_unknown_result + repair)
 #: rather than wedge the pipeline forever (round-2 review finding).
 SERVER_REQUEST_TIMEOUT = 5.0
 
 _TLOG_STOPPED = error.tlog_stopped("").code
+
+
+class RoutingState:
+    """Mutable shard routing: the seed teams from ProxyConfig plus every
+    applied `\\xff/keyServers/` mutation (ApplyMetadataMutation's effect on
+    the proxy's keyServers cache). Whole-shard granularity: a keyServers
+    key must name an existing shard begin."""
+
+    def __init__(self, shards: KeyShardMap, teams):
+        self.shards = shards
+        self.teams = [list(t) for t in teams]
+        self.extra_tags: List[tuple] = [() for _ in self.teams]
+
+    def write_tags(self, s: int) -> List[int]:
+        return [t for t, _a in self.teams[s]] + list(self.extra_tags[s])
+
+    def addrs(self, s: int) -> List[str]:
+        return [a for _t, a in self.teams[s]]
+
+    def apply_mutation(self, m: Mutation) -> None:
+        if m.type != MutationType.SET_VALUE or not m.param1.startswith(KEY_SERVERS_PREFIX):
+            return
+        begin = shard_begin_of(m.param1)
+        s = self.shards.shard_of_key(begin) if begin else 0
+        if self.shards.begins[s] != begin:
+            return  # not a shard boundary (v0: whole-shard moves only)
+        team, extra = decode_key_servers(m.param2)
+        self.teams[s] = list(team)
+        self.extra_tags[s] = tuple(extra)
 
 
 def teams_from_storage_tags(storage_tags):
@@ -131,6 +170,11 @@ class Proxy:
         #: bn -> (prev_version, version) for batches whose version is taken
         #: from the master but not yet durably chained (crash repair)
         self._batch_versions: Dict[int, Tuple[Version, Version]] = {}
+        #: bn -> tagged messages, stashed once a push is ATTEMPTED: a failed
+        #: push may have landed on some tlog replicas, so repair must re-push
+        #: the identical payload (replicas dedupe by version) — an empty
+        #: repair would leave the replicas of one version divergent
+        self._batch_messages: Dict[int, Dict[int, List[Mutation]]] = {}
         #: bn -> master request_num for batches whose GetCommitVersion request
         #: is in flight; a lost reply may still have advanced the master's
         #: chain, so repair must re-query by request_num (the master's
@@ -138,6 +182,11 @@ class Proxy:
         self._pending_master_req: Dict[int, int] = {}
         self._grv_waiters: List[Promise] = []
         self._grv_flush_active = False
+        #: dynamic shard routing (seed + applied keyServers metadata)
+        self.routing = RoutingState(cfg.storage_shards, cfg.storage_teams)
+        #: metadata stream drained through this version (system_keys.py)
+        self._metadata_version = start_version
+        self._last_batch_time = 0.0
         self._commit_queue: PromiseStream = PromiseStream()
         #: reference: ProxyStats (MasterProxyServer.actor.cpp:48-80)
         self.stats = CounterCollection("Proxy", proc.address)
@@ -155,7 +204,9 @@ class Proxy:
         proc.register(LOCATIONS_TOKEN, self.get_key_server_locations)
         proc.register(STATS_TOKEN, self._stats_req)
         proc.register(COMMITTED_VERSION_TOKEN, self._committed_version_req)
+        proc.register(METADATA_VERSION_TOKEN, self._metadata_version_req)
         self._spawn(self.commit_batcher(), TaskPriority.PROXY_COMMIT_BATCHER, "commitBatcher")
+        self._spawn(self.idle_committer(), TaskPriority.PROXY_COMMIT_BATCHER, "idleCommitter")
         self._spawn(self.stats.run_logger(), TaskPriority.PROXY_GRV_TIMER, "proxyStats")
         if cfg.master_wf_ep is not None:
             self._spawn(self._watch_master(), TaskPriority.FAILURE_MONITOR, "watchMaster")
@@ -219,7 +270,7 @@ class Proxy:
             return
         self._dead = True
         for tok in (GRV_TOKEN, COMMIT_TOKEN, LOCATIONS_TOKEN, STATS_TOKEN,
-                    COMMITTED_VERSION_TOKEN):
+                    COMMITTED_VERSION_TOKEN, METADATA_VERSION_TOKEN):
             self.proc.unregister(tok)
         self.actors.cancel_all()
 
@@ -228,6 +279,12 @@ class Proxy:
 
     async def _committed_version_req(self, _req) -> Version:
         return self.committed_version.get()
+
+    async def _metadata_version_req(self, _req) -> Version:
+        """How far this proxy has drained METADATA_TAG — the master's DD
+        pops the tag at the minimum over proxies (the reference resolver's
+        GC by oldest proxy version, Resolver.actor.cpp:198-224)."""
+        return self._metadata_version
 
     # -- GRV path ------------------------------------------------------------
     async def get_read_version(self, req: GetReadVersionRequest) -> GetReadVersionReply:
@@ -298,8 +355,8 @@ class Proxy:
     # -- locations -----------------------------------------------------------
     async def get_key_server_locations(self, req: GetKeyServerLocationsRequest) -> GetKeyServerLocationsReply:
         out: List[Tuple[KeyRange, List[str]]] = []
-        for s, cb, ce in self.cfg.storage_shards.shards_of_range(req.begin, req.end):
-            out.append((KeyRange(cb, ce), [a for _t, a in self.cfg.storage_teams[s]]))
+        for s, cb, ce in self.routing.shards.shards_of_range(req.begin, req.end):
+            out.append((KeyRange(cb, ce), self.routing.addrs(s)))
         return GetKeyServerLocationsReply(results=out)
 
     # -- commit path -----------------------------------------------------------
@@ -308,6 +365,26 @@ class Proxy:
         p = Promise()
         self._commit_queue.send((req.transaction, p))
         return await p.future
+
+    async def idle_committer(self) -> None:
+        """Commit an empty batch when idle (the reference's interval-driven
+        commitBatcher): keeps the version chain, the tlogs' KCV horizon and
+        — critically — every proxy's metadata drain advancing even with no
+        client traffic, so routing changes (MoveKeys) become visible
+        without waiting for the next client commit."""
+        from ..sim.loop import now
+
+        while not self._dead:
+            await delay(IDLE_COMMIT_INTERVAL, TaskPriority.PROXY_COMMIT_BATCHER)
+            if now() - self._last_batch_time < IDLE_COMMIT_INTERVAL:
+                continue
+            self._batch_num += 1
+            self._last_batch_time = now()
+            self._spawn(
+                self.commit_batch(self._batch_num, []),
+                TaskPriority.PROXY_COMMIT_DISPATCH,
+                f"idleBatch:{self._batch_num}",
+            )
 
     async def commit_batcher(self) -> None:
         """Dynamic-interval batcher (reference: batcher.actor.h via
@@ -329,6 +406,9 @@ class Proxy:
                 batch.append(pending.get())
                 pending = self._commit_queue.stream.pop()
             self._batch_num += 1
+            from ..sim.loop import now as _now
+
+            self._last_batch_time = _now()
             self._spawn(
                 self.commit_batch(self._batch_num, batch),
                 TaskPriority.PROXY_COMMIT_DISPATCH,
@@ -345,6 +425,7 @@ class Proxy:
             self.batch_resolving.advance(bn)
             self.batch_logging.advance(bn)
             versions = self._batch_versions.pop(bn, None)
+            attempted = self._batch_messages.pop(bn, None)
             pending_rn = self._pending_master_req.pop(bn, None)
             if e.code == _TLOG_STOPPED:
                 # Our generation has been locked by a successor: this proxy
@@ -359,8 +440,11 @@ class Proxy:
                 # Version v is in the master's chain but may never have
                 # reached the resolvers/tlog; plug the hole or every later
                 # batch waits on when_at_least(v) forever. Resolvers and the
-                # tlog dedupe versions, so repair is idempotent.
-                self._spawn(self._repair_chain(*versions), TaskPriority.PROXY_COMMIT, f"repair:{bn}")
+                # tlog dedupe versions, so repair is idempotent. A batch
+                # that already ATTEMPTED its push repairs with the original
+                # payload (see _batch_messages).
+                self._spawn(self._repair_chain(*versions, messages=attempted or {}),
+                            TaskPriority.PROXY_COMMIT, f"repair:{bn}")
             elif pending_rn is not None:
                 # The GetCommitVersion reply was lost (request_maybe_delivered)
                 # — the master may still have advanced its chain for us. Ask
@@ -375,6 +459,39 @@ class Proxy:
             for _, p in items:
                 if not p.is_set:
                     p.send_error(error.commit_unknown_result(e.name))
+
+    async def _drain_metadata(self, upto: Version) -> None:
+        """Apply every METADATA_TAG entry with version <= upto to the
+        routing state. The peek horizon is the log's known-committed
+        version; while it trails, re-advertise our own committed version to
+        the replicas (the KCV one-ways after an ack are unreliable, and the
+        next carrier would otherwise be the very push this drain gates)."""
+        attempts = 0
+        while self._metadata_version < upto and not self._dead:
+            try:
+                reply = await self.log.peek(
+                    METADATA_TAG, self._metadata_version + 1, timeout=1.0)
+            except error.FDBError as e:
+                attempts += 1
+                if attempts >= int(SERVER_REQUEST_TIMEOUT * 4):
+                    raise
+                self.log.send_kcv(self.committed_version.get())
+                await delay(0.25, TaskPriority.PROXY_COMMIT)
+                continue
+            for mv, muts in reply.messages:
+                if mv <= self._metadata_version or mv > upto:
+                    continue
+                for m in muts:
+                    self.routing.apply_mutation(m)
+            new_floor = min(reply.end_version, upto)
+            if new_floor <= self._metadata_version:
+                attempts += 1
+                if attempts >= int(SERVER_REQUEST_TIMEOUT * 4):
+                    raise error.timed_out("metadata drain stalled")
+                self.log.send_kcv(self.committed_version.get())
+                await delay(0.25, TaskPriority.PROXY_COMMIT)
+                continue
+            self._metadata_version = new_floor
 
     async def _repair_unknown_version(self, request_num: int) -> None:
         """Recover the version pair for a lost GetCommitVersion exchange and
@@ -400,10 +517,14 @@ class Proxy:
             return
         await self._repair_chain(vr.prev_version, vr.version)
 
-    async def _repair_chain(self, prev_v: Version, v: Version) -> None:
-        """Push an empty batch for (prev_v, v) until every chained consumer
-        has it; epoch-ending recovery supersedes it when this generation is
-        deposed (shutdown cancels the loop)."""
+    async def _repair_chain(self, prev_v: Version, v: Version,
+                            messages: Optional[Dict[int, List[Mutation]]] = None) -> None:
+        """Push a batch for (prev_v, v) until every chained consumer has it
+        — with the ORIGINAL payload when the failed batch had already
+        attempted its push (a partial push may have landed on some tlog
+        replicas; re-pushing identical bytes converges them, an empty push
+        would diverge them). Epoch-ending recovery supersedes it when this
+        generation is deposed (shutdown cancels the loop)."""
         while not self._dead:
             try:
                 for ep in self.cfg.resolver_eps:
@@ -417,7 +538,8 @@ class Proxy:
                         TaskPriority.PROXY_RESOLVER_REPLY,
                         timeout=SERVER_REQUEST_TIMEOUT,
                     )
-                await self.log.push(prev_v, v, {}, self.committed_version.get())
+                await self.log.push(prev_v, v, messages or {},
+                                    self.committed_version.get())
                 if v > self.committed_version.get():
                     self.committed_version.set(v)
                 return
@@ -507,6 +629,15 @@ class Proxy:
             else:
                 verdicts.append(min(int(replies[r].committed[i]) for r, i in placed))
 
+        # ---- Phase 3.5: drain the metadata stream to prev_v ----
+        # Routing below must reflect every keyServers change with version
+        # <= prev_v (commit versions form one global chain, so prev_v is
+        # exactly "everything before this batch"). The committing proxy of
+        # a metadata txn copies its system mutations into METADATA_TAG
+        # (phase 4 below), which this drain consumes — the txnState-tag /
+        # ApplyMetadataMutation circuit of the reference.
+        await self._drain_metadata(prev_v)
+
         # Assign committed mutations to storage tags, preserving batch order.
         # Versionstamped mutations become SET_VALUE here, stamped with
         # (commit version, index in batch) — the reference does this while
@@ -514,29 +645,41 @@ class Proxy:
         # doing it post-verdict is equivalent because only the mutation
         # payload changes, never the conflict ranges.
         messages: Dict[int, List[Mutation]] = {}
+        meta_muts: List[Mutation] = []
         for t, (txn, _) in enumerate(items):
             if verdicts[t] != int(TransactionCommitResult.COMMITTED):
                 continue
             for m in txn.mutations:
                 if m.type in VERSIONSTAMP_MUTATIONS:
                     m = transform_versionstamp_mutation(m, v, t)
+                if m.type != MutationType.CLEAR_RANGE and is_system_key(m.param1):
+                    meta_muts.append(m)
                 # Every team member's tag receives the mutation (the
                 # reference tags each mutation for all replicas of its
                 # shard, MasterProxyServer.actor.cpp:516-756).
                 if m.type == MutationType.CLEAR_RANGE:
-                    for s, cb, ce in cfg.storage_shards.shards_of_range(m.param1, m.param2):
+                    for s, cb, ce in self.routing.shards.shards_of_range(m.param1, m.param2):
                         clipped = Mutation(m.type, cb, ce)
-                        for tag, _addr in cfg.storage_teams[s]:
+                        for tag in self.routing.write_tags(s):
                             messages.setdefault(tag, []).append(clipped)
                 else:
-                    s = cfg.storage_shards.shard_of_key(m.param1)
-                    for tag, _addr in cfg.storage_teams[s]:
+                    s = self.routing.shards.shard_of_key(m.param1)
+                    for tag in self.routing.write_tags(s):
                         messages.setdefault(tag, []).append(m)
+        if meta_muts:
+            messages[METADATA_TAG] = meta_muts
 
         # ---- Phase 4: log, in version order (:805) ----
         await self.batch_logging.when_at_least(bn - 1)
+        self._batch_messages[bn] = messages
         await self.log.push(prev_v, v, messages, self.committed_version.get())
+        self._batch_messages.pop(bn, None)
         self.batch_logging.advance(bn)
+        # Apply our own committed metadata now (idempotent under the later
+        # drain): this proxy's location replies must reflect a move it
+        # itself just committed.
+        for m in meta_muts:
+            self.routing.apply_mutation(m)
 
         # ---- Phase 5: report (:824-860) ----
         self._batch_versions.pop(bn, None)

@@ -169,6 +169,9 @@ class StorageServer:
         self.durable_version: Version = start_version
         self.queue: Optional[DiskQueue] = DiskQueue(disk, f"storage-{tag}") if disk is not None else None
         self._disk = disk
+        self._update_task = None
+        self._tokens = [GET_VALUE_TOKEN, GET_KEY_VALUES_TOKEN, WATCH_VALUE_TOKEN,
+                        "storage.stats"]
         proc.register(GET_VALUE_TOKEN, self.get_value)
         proc.register(GET_KEY_VALUES_TOKEN, self.get_key_values)
         #: parked watches: key -> [(expected value, Promise)]
@@ -188,13 +191,68 @@ class StorageServer:
         proc.register("storage.stats", stats_req)
 
         proc.register(STORAGE_QUEUE_INFO_TOKEN, queue_info)
+        self._tokens.append(STORAGE_QUEUE_INFO_TOKEN)
         if not defer_update_loop:
             self.start_update_loop()
 
     def start_update_loop(self) -> None:
-        self.proc.actors.add(
-            spawn(self.update_loop(), TaskPriority.STORAGE, name=f"ss-update:{self.tag}")
-        )
+        self._update_task = spawn(self.update_loop(), TaskPriority.STORAGE,
+                                  name=f"ss-update:{self.tag}")
+        self.proc.actors.add(self._update_task)
+
+    def retire(self) -> None:
+        """This replica's shard moved away (MoveKeys finish): stop serving,
+        stop pulling the tag, drop the disk footprint."""
+        for tok in self._tokens:
+            self.proc.unregister(tok)
+        if self._update_task is not None:
+            self._update_task.cancel()
+        for parked in self._watches.values():
+            for _expected, p in parked:
+                if not p.is_set:
+                    p.send_error(error.watch_cancelled())
+        self._watches.clear()
+        if self._disk is not None:
+            for suffix in (".meta", ".snap", ".snap.tmp", ".dq", ".dq.tmp"):
+                self._disk.delete(self._meta_name() + suffix)
+
+    async def fetch_keys(self, addrs: List[str], version: Version) -> None:
+        """Populate this fresh replica with its shard's contents at
+        `version`, read from the serving team (fetchKeys,
+        storageserver.actor.cpp:1777). The AddingShard double buffer is the
+        log system itself here: this tag's mutations > `version` are
+        already accumulating at the tlogs and the update loop consumes them
+        once this snapshot is loaded."""
+        from ..core.types import key_after
+
+        items: List[Tuple[Key, Value]] = []
+        cb, ce = self.shard.begin, self.shard.end
+        while cb < ce:
+            reply = None
+            last: Optional[error.FDBError] = None
+            for i in range(len(addrs) * 3):
+                addr = addrs[i % len(addrs)]
+                try:
+                    reply = await self.net.request(
+                        self.proc.address,
+                        Endpoint(addr, GET_KEY_VALUES_TOKEN),
+                        GetKeyValuesRequest(begin=cb, end=ce, version=version,
+                                            limit=10_000),
+                        TaskPriority.FETCH_KEYS, timeout=5.0,
+                    )
+                    break
+                except error.FDBError as e:
+                    last = e
+                    await delay(0.2, TaskPriority.FETCH_KEYS)
+            if reply is None:
+                raise last if last is not None else error.connection_failed()
+            items.extend(reply.data)
+            if not reply.more or not reply.data:
+                break
+            cb = key_after(reply.data[-1][0])
+        self.store.load_snapshot(items, version)
+        self.version = NotifiedVersion(version)
+        self.durable_version = version
 
     # -- durability ----------------------------------------------------------
     def _meta_name(self) -> str:

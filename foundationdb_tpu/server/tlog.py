@@ -80,6 +80,9 @@ class TLog:
         self.tag_data: Dict[int, List[Tuple[Version, List[Mutation]]]] = dict(preload or {})
         self.popped: Dict[int, Version] = dict(preload_popped or {})
         self.tags_seen = set(self.tag_data) | set(self.popped)
+        #: tags whose shard moved away (pop version < 0): straggler pops and
+        #: repair re-pushes must not resurrect them, or the queue front pins
+        self._retired_tags: set = set()
         #: append-order (version, queue end offset) for front-advance math
         self._ver_offsets: List[Tuple[Version, int]] = []
         self._pops_since_persist = 0
@@ -166,6 +169,7 @@ class TLog:
                 "kcv": self.known_committed.get(),
                 "version": self.version.get(),
                 "tags_seen": set(self.tags_seen),
+                "retired": set(self._retired_tags),
             })
             tmp = disk.open(self._meta_name() + ".side.tmp")
             await tmp.truncate(0)
@@ -203,12 +207,15 @@ class TLog:
         )
         tlog.popped = dict(side.get("popped", {}))
         tlog.tags_seen = set(side.get("tags_seen", set())) | set(tlog.popped)
+        tlog._retired_tags = set(side.get("retired", set()))
         version = max(meta["start_version"], side.get("version", 0))
         for off, payload in entries:
             v, messages = wire.loads(payload)
             version = max(version, v)
             tlog._ver_offsets.append((v, off))
             for tag, muts in messages.items():
+                if tag in tlog._retired_tags:
+                    continue
                 tlog.tags_seen.add(tag)
                 if v > tlog.popped.get(tag, 0):
                     tlog.tag_data.setdefault(tag, []).append((v, muts))
@@ -264,6 +271,8 @@ class TLog:
             return self.version.get()
         self._inflight.add(req.version)
         for tag, muts in req.messages.items():
+            if tag in self._retired_tags:
+                continue  # late repair re-push of a moved-away shard's tag
             self.tags_seen.add(tag)
             self.tag_data.setdefault(tag, []).append((req.version, muts))
         if buggify.buggify():
@@ -337,6 +346,18 @@ class TLog:
         return TLogPeekReply(messages=msgs, end_version=horizon)
 
     async def pop(self, req: TLogPopRequest) -> None:
+        if req.version < 0:
+            # Tag retired (its shard moved away, MoveKeys finish): forget it
+            # entirely so the queue front no longer waits on it.
+            self._retired_tags.add(req.tag)
+            self.tag_data.pop(req.tag, None)
+            self.popped.pop(req.tag, None)
+            self.tags_seen.discard(req.tag)
+            await self._advance_queue_front()
+            await self._persist_side_state(force=True)
+            return
+        if req.tag in self._retired_tags:
+            return  # straggler pop from the retired replica's update loop
         prev = self.popped.get(req.tag, 0)
         if req.version <= prev:
             return
@@ -368,6 +389,7 @@ class TLog:
         out = {
             tag: [(v, m) for (v, m) in entries if v <= clip]
             for tag, entries in self.tag_data.items()
+            if tag not in self._retired_tags
         }
         return TLogRecoveryDataReply(
             tag_data={t: e for t, e in out.items() if e},

@@ -36,6 +36,7 @@ INIT_PROXY_TOKEN = "worker.initProxy"
 INIT_STORAGE_TOKEN = "worker.initStorage"
 INIT_MASTER_TOKEN = "worker.initMaster"
 RETIRE_TOKEN = "worker.retireGenerations"
+RETIRE_STORAGE_TOKEN = "worker.retireStorage"
 
 REGISTER_INTERVAL = 0.5
 
@@ -85,6 +86,20 @@ class InitializeStorageRequest:
     tag: int
     begin: bytes
     end: bytes
+    #: when set, the new replica first copies its shard from these
+    #: addresses at fetch_version (MoveKeys' fetchKeys destination)
+    fetch_from: Optional[List[str]] = None
+    fetch_version: int = 0
+
+
+@dataclass
+class RetireStorageRequest:
+    """Drop storage roles whose tag is in `tags` (MoveKeys finish), or —
+    with prune=True — any storage role whose tag is NOT in `tags` (the
+    master's post-recovery reconcile of orphaned move destinations)."""
+
+    tags: tuple
+    prune: bool = False
 
 
 @dataclass
@@ -126,6 +141,7 @@ class Worker:
         proc.register(INIT_STORAGE_TOKEN, self.init_storage)
         proc.register(INIT_MASTER_TOKEN, self.init_master)
         proc.register(RETIRE_TOKEN, self.retire_generations)
+        proc.register(RETIRE_STORAGE_TOKEN, self.retire_storage)
         proc.actors.add(spawn(
             monitor_leader(self.net, proc.address, self.coords, self.leader),
             TaskPriority.COORDINATION, name=f"monLeader:{proc.name}",
@@ -242,14 +258,34 @@ class Worker:
 
         key = ("storage", 0, req.tag, 0)
         if key not in self.roles:
+            fetch = req.fetch_from is not None
             ss = StorageServer(
                 self.proc, tag=req.tag, shard=KeyRange(req.begin, req.end),
                 log_view=self.log_view, net=self.net,
                 disk=self.sim.disk_for(self.proc.address),
+                defer_update_loop=fetch,
             )
-            await ss.persist_initial()
+            if fetch:
+                # MoveKeys destination: copy the shard BEFORE persisting the
+                # role (a crash mid-fetch leaves no half-alive replica), then
+                # let the update loop drain this tag's buffered mutations.
+                await ss.fetch_keys(req.fetch_from, req.fetch_version)
+                await ss.persist_initial()
+                await ss._write_snapshot()
+                ss.start_update_loop()
+            else:
+                await ss.persist_initial()
             self.roles[key] = ss
         return self.proc.address
+
+    async def retire_storage(self, req: RetireStorageRequest) -> None:
+        for key in list(self.roles):
+            kind, _z, tag, _i = key
+            if kind != "storage":
+                continue
+            drop = (tag not in req.tags) if req.prune else (tag in req.tags)
+            if drop:
+                self.roles.pop(key).retire()
 
     async def init_master(self, req: InitializeMasterRequest):
         from .masterserver import MasterServer

@@ -61,6 +61,7 @@ class TaskPriority(enum.IntEnum):
     DEFAULT_ON_MAIN_THREAD = 7500
     DEFAULT_ENDPOINT = 7000
     UNKNOWN_ENDPOINT = 6500
+    FETCH_KEYS = 3560
     MOVE_KEYS = 3550
     DATA_DISTRIBUTION_LAUNCH = 3530
     RATEKEEPER = 3510

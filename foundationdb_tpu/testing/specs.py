@@ -21,6 +21,7 @@ from .workloads import (
     IncrementWorkload,
     MachineAttritionWorkload,
     RandomCloggingWorkload,
+    RandomMoveKeysWorkload,
     RandomReadWriteWorkload,
     SelectorCorrectnessWorkload,
     VersionStampWorkload,
@@ -80,6 +81,21 @@ SPECS: Dict[str, Callable[[], Spec]] = {
         ],
         dynamic=DynamicClusterConfig(n_workers=8, n_tlogs=2, n_resolvers=2,
                                      n_storage=2, storage_replication=2),
+        client_count=2,
+        timeout=900.0,
+    ),
+    # shards move between teams while cycle churn runs (MoveKeys v0 through
+    # the \xff system keyspace); the cycle + replica checks prove no
+    # mutation is lost across either phase of a move
+    "MoveKeysCycle": lambda: Spec(
+        title="MoveKeysCycle",
+        workloads=[
+            (CycleWorkload, {"nodes": 8, "transactions": 12, "think_time": 1.5}),
+            (RandomMoveKeysWorkload, {"moves": 3, "interval": 4.0}),
+            (ConsistencyCheckWorkload, {}),
+        ],
+        dynamic=DynamicClusterConfig(n_workers=10, n_tlogs=2, n_resolvers=2,
+                                     n_storage=2),
         client_count=2,
         timeout=900.0,
     ),

@@ -682,3 +682,71 @@ class ConsistencyCheckWorkload(TestWorkload):
                     self.ctx.count("replica_mismatches")
                     return False
         return True
+
+
+class RandomMoveKeysWorkload(TestWorkload):
+    """Move random shards to random spare workers while other workloads
+    run (RandomMoveKeys.actor.cpp): the shard map is discovered through
+    the `\\xff/keyServers/` system keyspace, and every move must leave the
+    database consistent (the spec's other checkers + ConsistencyCheck
+    prove it)."""
+
+    name = "RandomMoveKeys"
+
+    async def start(self, db: Database) -> None:
+        from ..server import system_keys
+        from ..server.masterserver import MOVE_SHARD_TOKEN, MoveShardRequest
+        from ..sim.loop import TaskPriority
+        from ..sim.network import Endpoint
+
+        if self.ctx.client_id != 0:
+            return
+        cluster = self.ctx.cluster
+        sim = cluster.sim
+        rng = self.ctx.rng
+        moves = int(self.ctx.options.get("moves", 2))
+        interval = float(self.ctx.options.get("interval", 4.0))
+        await delay(float(self.ctx.options.get("delay_before", 3.0)))
+        for _ in range(moves):
+            await delay(interval * (0.5 + rng.random01()))
+            ep = None
+            for p in cluster.worker_procs:
+                for tok in p.handlers:
+                    if tok.startswith(MOVE_SHARD_TOKEN):
+                        ep = Endpoint(p.address, tok)
+            if ep is None:
+                continue
+            # shard map + team sizes from the system keyspace
+            async def read_meta(tr):
+                return await tr.get_range(system_keys.KEY_SERVERS_PREFIX,
+                                          system_keys.KEY_SERVERS_PREFIX + b"\xff")
+            try:
+                rows = await db.run(read_meta)
+            except error.FDBError:
+                continue
+            if not rows:
+                continue
+            key, value = rows[rng.random_int(0, len(rows))]
+            begin = system_keys.shard_begin_of(key)
+            team, _extra = system_keys.decode_key_servers(value)
+            storage_addrs = {
+                p.address for p in cluster.worker_procs
+                if any(t.startswith("storage.getValue") for t in p.handlers)
+            }
+            spare = [p.address for p in cluster.worker_procs
+                     if p.alive and p.address not in storage_addrs]
+            if len(spare) < len(team):
+                continue
+            dests = []
+            pool = list(spare)
+            for _i in range(len(team)):
+                dests.append(pool.pop(rng.random_int(0, len(pool))))
+            try:
+                await sim.net.request(
+                    db.client_addr, ep,
+                    MoveShardRequest(begin=begin, dest_workers=dests),
+                    TaskPriority.MOVE_KEYS, timeout=120.0,
+                )
+                self.ctx.count("moves")
+            except error.FDBError:
+                self.ctx.count("move_failures")
