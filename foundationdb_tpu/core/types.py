@@ -335,3 +335,11 @@ def apply_atomic_op(op: MutationType, existing: Optional[Value], param: Value) -
             return param
         return old if old < param else param
     raise ValueError(f"not an atomic op: {op}")
+
+
+# -- wire registration (core/wire.py named records for disk state) ----------
+from . import wire as _wire
+
+_wire.register_record(Mutation)
+_wire.register_record(KeyRange)
+_wire.register_enum(MutationType)

@@ -149,3 +149,9 @@ class CoordinatedState:
                 raise error.master_recovery_failed(
                     f"cstate write lost to generation {r.max_gen}"
                 )
+
+
+from ..core import wire as _wire
+
+_wire.register_record(LogGenerationInfo)
+_wire.register_record(DBCoreState)

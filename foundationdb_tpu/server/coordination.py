@@ -313,3 +313,6 @@ async def _first(a, b):
     from ..sim.actors import any_of
 
     await any_of([a, b])
+
+
+wire.register_record(Generation)

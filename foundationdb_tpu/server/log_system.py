@@ -302,3 +302,8 @@ async def fetch_recovery_data(
         for tag, by_ver in tag_data.items()
     }
     return merged, popped
+
+
+from ..core import wire as _wire
+
+_wire.register_record(LogSystemConfig)

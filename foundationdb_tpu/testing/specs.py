@@ -18,12 +18,14 @@ from .workloads import (
     ConflictRangeWorkload,
     ConsistencyCheckWorkload,
     CycleWorkload,
+    FuzzApiCorrectnessWorkload,
     IncrementWorkload,
     MachineAttritionWorkload,
     RandomCloggingWorkload,
     RandomMoveKeysWorkload,
     RandomReadWriteWorkload,
     SelectorCorrectnessWorkload,
+    SerializabilityWorkload,
     VersionStampWorkload,
     WatchesWorkload,
     WriteDuringReadWorkload,
@@ -201,6 +203,29 @@ SPECS: Dict[str, Callable[[], Spec]] = {
             n_resolvers=1, n_storage=4, engine_factory=_sharded_engine_factory
         ),
         client_count=6,
+    ),
+    # rare/FuzzApiCorrectness.txt: randomized op streams vs the model,
+    # with clogging so retry/unknown-result paths actually fire
+    "FuzzApiCorrectness": lambda: Spec(
+        title="FuzzApiCorrectness",
+        workloads=[
+            (FuzzApiCorrectnessWorkload, {"transactions": 18}),
+            (RandomCloggingWorkload, {"scale": 0.02}),
+            (ConsistencyCheckWorkload, {}),
+        ],
+        cluster=ClusterConfig(n_resolvers=2, n_storage=2, storage_replication=2),
+        client_count=3,
+    ),
+    # write-skew + balance invariants under contention: anomalies snapshot
+    # isolation allows and the resolver's read-conflict detection forbids
+    "Serializability": lambda: Spec(
+        title="Serializability",
+        workloads=[
+            (SerializabilityWorkload, {"rounds": 10}),
+            (RandomCloggingWorkload, {"scale": 0.02}),
+        ],
+        cluster=ClusterConfig(n_resolvers=2, n_storage=2),
+        client_count=4,
     ),
     "IncrementTest": lambda: Spec(
         title="IncrementTest",

@@ -97,6 +97,52 @@ class SpecResult:
     virtual_time: float
 
 
+async def quiet_database(cluster, timeout: float = 120.0) -> None:
+    """Wait until every reachable storage replica has caught up to a
+    post-workload read version (waitForQuietDatabase reduced to its
+    storage-lag core: no checker should race the mutation pipeline)."""
+    from ..core import error as _error
+    from ..server.ratekeeper import STORAGE_QUEUE_INFO_TOKEN
+    from ..sim.loop import TaskPriority, delay, now
+    from ..sim.network import Endpoint
+
+    sim = cluster.sim
+    db = cluster.new_client()
+    tr = db.create_transaction()
+    while True:
+        try:
+            rv = await tr.get_read_version()
+            break
+        except _error.FDBError as e:
+            await tr.on_error(e)
+            tr = db.create_transaction()
+
+    deadline = now() + timeout
+    while now() < deadline:
+        procs = [p for p in getattr(cluster, "worker_procs", [])
+                 if p.alive and STORAGE_QUEUE_INFO_TOKEN in p.handlers]
+        procs += [getattr(s, "proc") for s in getattr(cluster, "storages", [])
+                  if s.proc.alive and STORAGE_QUEUE_INFO_TOKEN in s.proc.handlers]
+        lagging = False
+        for p in procs:
+            try:
+                info = await sim.net.request(
+                    db.client_addr, Endpoint(p.address, STORAGE_QUEUE_INFO_TOKEN),
+                    None, TaskPriority.DEFAULT_ENDPOINT, timeout=2.0,
+                )
+            except _error.FDBError:
+                continue  # dead/unreachable replicas don't gate quiescence
+            if info.version < rv:
+                lagging = True
+                break
+        if not lagging:
+            return
+        await delay(0.5, TaskPriority.DEFAULT_ENDPOINT)
+    # Giving up silently would let checks race the mutation pipeline —
+    # the exact flakiness this phase exists to prevent. Fail loudly.
+    raise _error.timed_out("quiet_database: storage still lagging at deadline")
+
+
 def run_spec(spec: Spec, seed: int) -> SpecResult:
     """Deterministic: same spec+seed -> same result and metrics."""
     sim = Simulator(seed, randomize_knobs=spec.randomize_knobs)
@@ -129,7 +175,13 @@ def run_spec(spec: Spec, seed: int) -> SpecResult:
         await all_of(main_tasks)
         for t in injector_tasks:
             t.cancel()
-        # check
+        # quiesce, then check (waitForQuietDatabase, QuietDatabase.actor.cpp:304)
+        try:
+            await quiet_database(cluster)
+        except error.FDBError:
+            ok = False
+            metrics["quiesce_timeout"] = 1
+            return
         for w in instances:
             if w.ctx.client_id == 0:
                 if not await w.check(cluster.new_client()):

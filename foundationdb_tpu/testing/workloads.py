@@ -750,3 +750,252 @@ class RandomMoveKeysWorkload(TestWorkload):
                 self.ctx.count("moves")
             except error.FDBError:
                 self.ctx.count("move_failures")
+
+
+class FuzzApiCorrectnessWorkload(TestWorkload):
+    """Randomized multi-transaction op streams vs the in-memory model
+    (FuzzApiCorrectness.actor.cpp strategy). Each client owns a prefix.
+
+    Unknown-result settling: every transaction READS the marker key (a
+    conflict range) and then writes its own id to it. Two copies of the
+    same logical transaction therefore conflict, so on
+    commit_unknown_result the client can safely re-issue the SAME ops in
+    a fresh transaction and loop — whichever copy lands first aborts the
+    other, and the marker tells which id committed. No race window
+    remains between "read the marker" and "the in-flight copy lands"."""
+
+    name = "FuzzApiCorrectness"
+
+    @property
+    def _prefix(self) -> bytes:
+        return b"fuzz%d/" % self.ctx.client_id
+
+    def _k(self) -> bytes:
+        return self._prefix + b"%03d" % self.ctx.rng.random_int(0, 24)
+
+    async def start(self, db: Database) -> None:
+        from ..core.types import Mutation
+
+        rng = self.ctx.rng
+        model = MemoryKeyValueStore()
+        pre = self._prefix
+        marker = pre + b"!txn"
+        txns = int(self.ctx.options.get("transactions", 20))
+        for txn_id in range(1, txns + 1):
+            # Build this transaction's op list once; commits may re-issue it.
+            ops: List = []
+            for _ in range(rng.random_int(1, 8)):
+                op = rng.random_int(0, 6)
+                if op == 0:
+                    ops.append(("set", self._k(), b"v%d" % rng.random_int(0, 1000)))
+                elif op == 1:
+                    ops.append(("clear", self._k()))
+                elif op == 2:
+                    a, b = sorted([self._k(), self._k()])
+                    ops.append(("clear_range", a, b))
+                elif op == 3:
+                    ops.append(("get", self._k()))
+                elif op == 4:
+                    a, b = sorted([self._k(), self._k()])
+                    ops.append(("get_range", a, b))
+                else:
+                    ops.append(("atomic_add", self._k(),
+                                rng.random_int(1, 9).to_bytes(8, "little")))
+
+            async def attempt(check_ryw: bool):
+                """One execution of the op list; returns staged mutations."""
+                tr = db.create_transaction()
+                # conflict guard vs our twin — and if the twin already
+                # landed, do NOT apply a second copy on top of it
+                if await tr.get(marker) == b"%06d" % txn_id:
+                    return "already"
+                staged: List[Mutation] = []
+                view = MemoryKeyValueStore()  # model + staged, maintained
+                view._d = dict(model._d)
+                for op in ops:
+                    kind = op[0]
+                    n_before = len(tr.mutations)
+                    if kind == "set":
+                        tr.set(op[1], op[2])
+                    elif kind == "clear":
+                        tr.clear(op[1])
+                    elif kind == "clear_range":
+                        tr.clear_range(op[1], op[2])
+                    elif kind == "atomic_add":
+                        tr.atomic_op(op[1], op[2], MutationType.ADD_VALUE)
+                    elif kind == "get":
+                        got = await tr.get(op[1])
+                        if check_ryw:
+                            assert got == view.get(op[1]), (op, got)
+                    else:
+                        got = await tr.get_range(op[1], op[2])
+                        if check_ryw:
+                            assert got == view.get_range(op[1], op[2]), op
+                    for m in tr.mutations[n_before:]:
+                        staged.append(m)
+                        view.apply_mutation(m)
+                tr.set(marker, b"%06d" % txn_id)
+                staged.append(tr.mutations[-1])
+                await tr.commit()
+                return staged
+
+            committed_staged = None
+            check_ryw = True
+            while True:
+                try:
+                    committed_staged = await attempt(check_ryw)
+                    if committed_staged == "already":
+                        committed_staged = "landed"
+                    break
+                except error.FDBError as e:
+                    if e.is_maybe_committed():
+                        # Re-issue; the marker read makes twins conflict.
+                        # RYW asserts are skipped on replays: the first copy
+                        # may have landed, changing the base the model knows.
+                        check_ryw = False
+                        async def read_marker(tr2):
+                            return await tr2.get(marker)
+                        if await db.run(read_marker) == b"%06d" % txn_id:
+                            committed_staged = "landed"
+                            break
+                        continue
+                    if e.is_retryable():
+                        continue
+                    raise
+            if committed_staged == "landed":
+                # the in-flight copy won; rebuild its staged effects by
+                # replaying ops against the model (deterministic op list)
+                view = MemoryKeyValueStore()
+                view._d = dict(model._d)
+                for op in ops:
+                    if op[0] == "set":
+                        view.apply_mutation(Mutation(MutationType.SET_VALUE, op[1], op[2]))
+                    elif op[0] == "clear":
+                        from ..core.types import key_after
+                        view.apply_mutation(Mutation(MutationType.CLEAR_RANGE, op[1], key_after(op[1])))
+                    elif op[0] == "clear_range":
+                        if op[1] < op[2]:
+                            view.apply_mutation(Mutation(MutationType.CLEAR_RANGE, op[1], op[2]))
+                    elif op[0] == "atomic_add":
+                        view.apply_mutation(Mutation(MutationType.ADD_VALUE, op[1], op[2]))
+                view.set(marker, b"%06d" % txn_id)
+                model = view
+            else:
+                for m in committed_staged:
+                    model.apply_mutation(m)
+            self.ctx.count("fuzz_commits")
+        self.ctx.shared.setdefault("models", {})[self.ctx.client_id] = model
+
+    async def check(self, db: Database) -> bool:
+        for cid, model in self.ctx.shared.get("models", {}).items():
+            pre = b"fuzz%d/" % cid
+
+            async def read_all(tr):
+                return await tr.get_range(pre, pre + b"\xff")
+
+            got = await db.run(read_all)
+            if got != model.get_range(pre, pre + b"\xff"):
+                return False
+        return True
+
+
+class SerializabilityWorkload(TestWorkload):
+    """Write-skew + invariant checks that snapshot isolation would violate
+    but serializability forbids (Serializability.actor.cpp's intent,
+    reduced to two classic anomalies):
+
+      * on-call constraint: each txn reads BOTH duty keys and may resign
+        (zero its own) only if the other is still on duty — serializable
+        histories always leave >= 1 on duty;
+      * bank transfers: total balance is invariant under concurrent
+        read-check-move transactions."""
+
+    name = "Serializability"
+
+    #: keys deliberately spread across the keyspace so duty pairs and
+    #: transfers straddle resolver shards — a broken cross-resolver vote
+    #: combine is invisible to single-shard transactions
+    DUTY_A = b"\x10ser/dutyA"
+    DUTY_B = b"\xd0ser/dutyB"
+
+    @staticmethod
+    def bank_key(i: int, n: int) -> bytes:
+        return bytes([(256 * i) // n]) + b"ser/bank/%d" % i
+
+    async def start(self, db: Database) -> None:
+        rng = self.ctx.rng
+        me = self.ctx.client_id
+        n_banks = 4
+        if me == 0 and self.ctx.client_count > 0:
+            async def init(tr):
+                tr.set(self.DUTY_A, b"1")
+                tr.set(self.DUTY_B, b"1")
+                for i in range(n_banks):
+                    tr.set(self.bank_key(i, n_banks), b"100")
+            await db.run(init)
+            self.ctx.shared["initialized"] = True
+        while not self.ctx.shared.get("initialized"):
+            await delay(0.1)
+
+        rounds = int(self.ctx.options.get("rounds", 10))
+        for _ in range(rounds):
+            if rng.random01() < 0.5:
+                # write-skew attempt: resignations are PERMANENT — under
+                # serializability at most one duty key can ever reach 0
+                # (the second resigner must see the first's write), so the
+                # invariant is observable mid-run AND at check time; a
+                # snapshot-isolation-only resolver lets both clients
+                # resign concurrently
+                mine = self.DUTY_A if rng.random01() < 0.5 else self.DUTY_B
+                other = self.DUTY_B if mine == self.DUTY_A else self.DUTY_A
+
+                async def resign(tr):
+                    a = int(await tr.get(mine) or b"0")
+                    b = int(await tr.get(other) or b"0")
+                    if a + b >= 2:
+                        tr.set(mine, b"0")
+                        return True
+                    return False
+
+                if await db.run(resign):
+                    self.ctx.count("resignations")
+
+                async def observe(tr):
+                    return (int(await tr.get(self.DUTY_A) or b"0")
+                            + int(await tr.get(self.DUTY_B) or b"0"))
+
+                if await db.run(observe) < 1:
+                    self.ctx.shared["write_skew_observed"] = True
+            else:
+                i, j = rng.random_int(0, n_banks), rng.random_int(0, n_banks)
+                if i == j:
+                    continue
+                amt = rng.random_int(1, 40)
+                ki, kj = self.bank_key(i, n_banks), self.bank_key(j, n_banks)
+
+                async def transfer(tr):
+                    a = int(await tr.get(ki) or b"0")
+                    if a >= amt:
+                        b = int(await tr.get(kj) or b"0")
+                        tr.set(ki, str(a - amt).encode())
+                        tr.set(kj, str(b + amt).encode())
+
+                await db.run(transfer)
+                self.ctx.count("transfers")
+
+    async def check(self, db: Database) -> bool:
+        n_banks = 4
+
+        async def read(tr):
+            duty = [int(await tr.get(self.DUTY_A) or b"0"),
+                    int(await tr.get(self.DUTY_B) or b"0")]
+            total = 0
+            for i in range(n_banks):
+                total += int(await tr.get(self.bank_key(i, n_banks)) or b"0")
+            return duty, total
+
+        duty, total = await db.run(read)
+        # at least one on duty (no write skew, final AND mid-run) and
+        # balance conserved
+        return (sum(duty) >= 1 and total == 400
+                and not self.ctx.shared.get("write_skew_observed"))

@@ -1,0 +1,73 @@
+"""Fuzz/Serializability workloads must catch seeded resolver bugs.
+
+The VERDICT bar: 'each catching a seeded bug when you mutate the proxy
+verdict combine as a sanity test' — a checker that never fails is not a
+checker (reference: the correctness-run philosophy behind
+FuzzApiCorrectness.actor.cpp and Serializability.actor.cpp).
+"""
+import pytest
+
+from foundationdb_tpu.testing.specs import SPECS
+from foundationdb_tpu.testing.workload import run_spec
+
+
+def test_specs_green():
+    for name in ("FuzzApiCorrectness", "Serializability"):
+        for seed in (3, 4):
+            res = run_spec(SPECS[name](), seed)
+            assert res.ok, (name, seed, res.metrics)
+
+
+def test_serializability_catches_broken_verdict_combine(monkeypatch):
+    """Seed the bug: combine resolver votes with MAX instead of MIN (a
+    single dissenting resolver can no longer abort a transaction), which
+    silently turns off cross-shard conflict detection. The write-skew /
+    bank invariants must go red."""
+    from foundationdb_tpu.server import proxy as proxy_mod
+
+    orig = proxy_mod.Proxy._commit_batch_impl
+    src_min = min
+
+    async def broken(self, bn, items):
+        return await orig(self, bn, items)
+
+    # Patch by swapping min for max inside the vote-combine: simplest is to
+    # patch the TransactionCommitResult combine through a shim on builtins
+    # within the module — instead, monkeypatch the method to post-process
+    # verdicts cannot reach phase-3 internals, so patch the module-level
+    # `min` lookup the combine uses.
+    import builtins
+
+    failures = 0
+    for seed in (5, 6, 7, 8):
+        monkeypatch.setattr(proxy_mod, "min", max, raising=False)
+        try:
+            res = run_spec(SPECS["Serializability"](), seed)
+        finally:
+            monkeypatch.delattr(proxy_mod, "min", raising=False)
+        if not res.ok:
+            failures += 1
+    assert failures > 0, "broken verdict combine was never caught"
+
+
+def test_fuzz_catches_dropped_conflict_detection(monkeypatch):
+    """Seed the bug: resolvers report every transaction as COMMITTED.
+    Concurrent fuzz clients then trample the shared RYW assumptions and
+    committed-state models diverge."""
+    from foundationdb_tpu.core.types import TransactionCommitResult
+    from foundationdb_tpu.server import resolver as resolver_mod
+
+    orig_resolve = resolver_mod.Resolver.resolve_batch
+
+    async def lying(self, req):
+        reply = await orig_resolve(self, req)
+        reply.committed = [TransactionCommitResult.COMMITTED for _ in reply.committed]
+        return reply
+
+    monkeypatch.setattr(resolver_mod.Resolver, "resolve_batch", lying)
+    failures = 0
+    for seed in (5, 6, 7):
+        res = run_spec(SPECS["Serializability"](), seed)
+        if not res.ok:
+            failures += 1
+    assert failures > 0, "lying resolvers were never caught"
