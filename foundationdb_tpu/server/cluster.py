@@ -147,6 +147,10 @@ class DynamicClusterConfig:
     storage_replication: int = 1  # replicas per shard (team size)
     #: per-tag tlog replication factor; 0 = every replica holds every tag
     log_replication_factor: int = 0
+    #: resolutionBalancing trigger floor (rows per poll window) and poll
+    #: interval; tests lower them to provoke rebalances quickly
+    rebalance_min_rows: int = 200
+    rebalance_interval: float = 5.0
     engine_factory: Callable = OracleConflictEngine
 
 

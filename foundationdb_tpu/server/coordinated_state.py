@@ -52,6 +52,9 @@ class DBCoreState:
     recovery_count: int = 0
     generations: tuple = ()           # of LogGenerationInfo, oldest..newest
     storage_tags: tuple = ()          # of (tag, shard_begin, shard_end, address)
+    #: resolver key-shard split keys chosen by resolutionBalancing; empty =
+    #: uniform splits (masterserver.actor.cpp:919-977)
+    resolver_splits: tuple = ()
 
 
 class CoordinatedState:

@@ -354,6 +354,18 @@ class MasterServer:
         # the MVCC-window jump. Without it, a post-recovery cluster
         # deadlocks: reads need storage at the jumped GRV, storage advances
         # only on commits, and every client transaction starts with a read.
+        # Resolver key shards: the splits chosen by a previous epoch's
+        # resolutionBalancing, else uniform (rebalancing hands over by
+        # epoch bounce: fresh resolvers + the MVCC-window version jump
+        # make the empty conflict history safe).
+        splits = list(prev.resolver_splits)
+        if len(splits) == cfg.n_resolvers - 1 and splits == sorted(splits) and all(splits):
+            resolver_map = KeyShardMap(splits)
+            used_splits = tuple(splits)
+        else:
+            resolver_map = KeyShardMap.uniform(cfg.n_resolvers)
+            used_splits = ()
+
         recovery_txn_version = recovery_version + max(first_jump, 1)
         from .log_system import LogSystemClient
         from .messages import ResolveTransactionBatchRequest
@@ -416,7 +428,7 @@ class MasterServer:
             master_ep=Endpoint(self.proc.address, GET_COMMIT_VERSION_TOKEN + suffix),
             resolver_eps=[Endpoint(a, RESOLVE_TOKEN + f"{suffix}.{i}")
                           for i, a in enumerate(resolver_addrs)],
-            resolver_shards=KeyShardMap.uniform(cfg.n_resolvers),
+            resolver_shards=resolver_map,
             log_config=new_log,
             storage_teams=storage_teams,
             storage_shards=storage_shards,
@@ -437,6 +449,7 @@ class MasterServer:
             recovery_count=rc,
             generations=(LogGenerationInfo(config=new_log, end_version=None),),
             storage_tags=storage_tags,
+            resolver_splits=used_splits,  # balanced splits survive epochs
         )
         await cstate.set_exclusive(cstate_val)
 
@@ -539,6 +552,79 @@ class MasterServer:
                            name=f"ddMetaGC:{self.salt}")
         self.proc.actors.add(dd_gc_task)
 
+        # -- resolutionBalancing (masterserver.actor.cpp:919-977) -------------
+        # Poll resolver row counts; on sustained imbalance, persist new
+        # split keys (quantiles of the resolvers' key samples) in cstate
+        # and bounce the epoch: the successor recruits resolvers on the new
+        # splits, and the recovery version jump makes their empty conflict
+        # history safe. Handoff-by-bounce trades a recovery (~seconds) for
+        # the reference's in-epoch range transfer.
+        rebalance_p = _Promise()
+
+        async def resolution_balancing() -> None:
+            from .resolver import RESOLUTION_METRICS_TOKEN
+
+            interval = float(cfg.rebalance_interval)
+            min_rows = int(cfg.rebalance_min_rows)
+            ratio = 3.0
+            while True:
+                await delay(interval, TaskPriority.RESOLUTION_METRICS)
+                stats = []
+                try:
+                    for i, a in enumerate(resolver_addrs):
+                        stats.append(await self.net.request(
+                            self.proc.address,
+                            Endpoint(a, RESOLUTION_METRICS_TOKEN + f"{suffix}.{i}"),
+                            None, TaskPriority.RESOLUTION_METRICS, timeout=1.0,
+                        ))
+                except error.FDBError:
+                    continue
+                rows = [s["rows"] for s in stats]
+                if len(rows) < 2 or sum(rows) < min_rows:
+                    continue
+                if max(rows) <= ratio * (min(rows) + 10):
+                    continue
+                # new splits: quantiles of the union of key samples, each
+                # sample weighted by its resolver's observed rows
+                weighted: List[bytes] = []
+                for s in stats:
+                    sample = [k for k in s["sample"] if k]
+                    if not sample:
+                        continue
+                    w = max(1, s["rows"] // len(sample))
+                    for k in sample:
+                        weighted.extend([k] * min(w, 64))
+                if not weighted:
+                    continue
+                weighted.sort()
+                n = len(resolver_addrs)
+                new_splits = []
+                for i in range(1, n):
+                    new_splits.append(weighted[(len(weighted) * i) // n])
+                new_splits = sorted(set(new_splits))
+                if len(new_splits) != n - 1 or not all(new_splits):
+                    continue
+                if tuple(new_splits) == used_splits:
+                    # an unsplittable hot spot (e.g. one hot key): bouncing
+                    # onto identical splits would loop recoveries forever
+                    continue
+                splits = new_splits
+                TraceEvent("ResolutionBalancing", id=self.salt).detail(
+                    "Rows", str(rows)).detail("NewSplits", str(splits)).log()
+                dd["cstate_val"] = replace(dd["cstate_val"],
+                                           resolver_splits=tuple(splits))
+                try:
+                    await cstate.set_exclusive(dd["cstate_val"])
+                except error.FDBError:
+                    return  # a successor owns the cstate; we are done anyway
+                if not rebalance_p.is_set:
+                    rebalance_p.send(None)
+                return
+
+        balance_task = spawn(resolution_balancing(), TaskPriority.RESOLUTION_METRICS,
+                             name=f"resBalance:{self.salt}")
+        self.proc.actors.add(balance_task)
+
         # Serve until any recruited role host dies (process-level watch;
         # role death on a live worker only happens when a successor
         # generation replaces us, in which case we are dead already).
@@ -552,15 +638,20 @@ class MasterServer:
             for a in watch_addrs
         ]
         try:
-            await any_of(watchers)
+            which, _ = await any_of([rebalance_p.future] + watchers)
         finally:
             for w in watchers:
                 w.cancel()
             rk_task.cancel()
             dd_task.cancel()
             dd_gc_task.cancel()
+            balance_task.cancel()
             self.proc.unregister(rate_token)
             self.proc.unregister(status_token)
             self.proc.unregister(move_token)
         self.master.unregister()
+        if which == 0:
+            # Deliberate epoch bounce: the successor recruits resolvers on
+            # the rebalanced splits persisted above.
+            raise error.master_recovery_failed("resolution rebalance epoch bounce")
         raise error.master_tlog_failed("a transaction-role host failed")

@@ -22,6 +22,11 @@ from ..sim.network import SimProcess
 from .messages import ResolveTransactionBatchRequest, ResolveTransactionBatchReply
 
 RESOLVE_TOKEN = "resolver.resolve"
+RESOLUTION_METRICS_TOKEN = "resolver.metrics"
+
+#: reservoir size for the split-key sample (the analog of the resolver's
+#: iops TransientStorageMetricSample feeding ResolutionSplitRequest)
+KEY_SAMPLE_SIZE = 64
 
 
 class Resolver:
@@ -31,17 +36,52 @@ class Resolver:
         clear(version) — OracleConflictEngine, JaxConflictEngine or
         ShardedConflictEngine (ops/, parallel/). token_suffix scopes the
         endpoint to one recovery generation."""
+        from ..sim.loop import current_scheduler
+
         self.proc = proc
         self.engine = engine
         self.version = NotifiedVersion(start_version)
         self.token = RESOLVE_TOKEN + token_suffix
+        self.metrics_token = RESOLUTION_METRICS_TOKEN + token_suffix
         # replay window: version -> reply, for proxy retries after
         # request_maybe_delivered (reference keeps recentStateTransactions)
         self._recent: Dict[Version, ResolveTransactionBatchReply] = {}
+        #: conflict-range rows since the last metrics poll + a reservoir
+        #: sample of range-begin keys (reference: ResolutionMetricsRequest /
+        #: ResolutionSplitRequest, Resolver.actor.cpp:276-284)
+        self._rows_since_poll = 0
+        self._rows_total = 0
+        self._key_sample: list = []
+        self._sample_rng = current_scheduler().rng
         proc.register(self.token, self.resolve_batch)
+        proc.register(self.metrics_token, self.resolution_metrics)
 
     def unregister(self) -> None:
         self.proc.unregister(self.token)
+        self.proc.unregister(self.metrics_token)
+
+    def _sample_rows(self, transactions) -> None:
+        rng = self._sample_rng
+        for txn in transactions:
+            for rng_list in (txn.read_conflict_ranges, txn.write_conflict_ranges):
+                self._rows_since_poll += len(rng_list)
+                self._rows_total += len(rng_list)
+                for r in rng_list:
+                    # reservoir sampling keyed by the running row count
+                    if len(self._key_sample) < KEY_SAMPLE_SIZE:
+                        self._key_sample.append(r.begin)
+                    elif rng.random_int(0, self._rows_total) < KEY_SAMPLE_SIZE:
+                        self._key_sample[rng.random_int(0, KEY_SAMPLE_SIZE)] = r.begin
+
+    async def resolution_metrics(self, _req) -> dict:
+        out = {"rows": self._rows_since_poll, "sample": list(self._key_sample)}
+        # window-scoped: the split chooser must see the CURRENT key
+        # distribution, not a lifetime-weighted one (a long uniform phase
+        # would otherwise drown the hot range that triggered rebalancing)
+        self._rows_since_poll = 0
+        self._rows_total = 0
+        self._key_sample = []
+        return out
 
     async def resolve_batch(self, req: ResolveTransactionBatchRequest) -> ResolveTransactionBatchReply:
         """reference: resolveBatch, Resolver.actor.cpp:71-260."""
@@ -53,6 +93,7 @@ class Resolver:
             # A duplicate delivery resolved this version while we waited.
             return self._replay(req.version)
         new_oldest = max(0, req.version - MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
+        self._sample_rows(req.transactions)
         verdicts = self.engine.resolve(req.transactions, req.version, new_oldest)
         reply = ResolveTransactionBatchReply(committed=[int(v) for v in verdicts])
         self._recent[req.version] = reply
