@@ -1,0 +1,4 @@
+from .container import BlobContainer
+from .agent import BackupAgent
+
+__all__ = ["BlobContainer", "BackupAgent"]

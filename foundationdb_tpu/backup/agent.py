@@ -1,0 +1,247 @@
+"""Backup/restore agent v0 (reference: fdbclient/FileBackupAgent.actor.cpp
++ design/backup.md, reduced to its load-bearing shape):
+
+  * start_backup(): a transaction sets `\\xff/backup/active` = a fresh log
+    tag; from its commit version on, every proxy copies every committed
+    user mutation into that tag (the metadata-drain circuit guarantees the
+    hand-over version is exact). A log-mover actor peeks the tag, writes
+    `log/<version>` objects to the container and pops as it goes.
+  * snapshot(): TaskBucket tasks, one per key chunk, executed by N agent
+    workers — each reads its chunk at ONE shared read version and writes a
+    `range/<n>` object. Exactly-once chunk execution comes from the task
+    bucket's transactional claims.
+  * finish_backup(): picks the end version, waits for the log mover to
+    pass it, writes the manifest, clears the active flag and retires the
+    tag. Restorable = snapshot done AND logs cover (snapshot_version,
+    end_version].
+  * restore(): loads every range object (values at snapshot_version),
+    then replays log mutations with snapshot_version < v <= end_version
+    in version order — atomic ops replay as atomic ops, so the restored
+    state equals the source state at end_version exactly.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..bindings.fdb_api import Subspace
+from ..bindings.task_bucket import TaskBucket
+from ..core import error, wire
+from ..core.types import Mutation, MutationType, SINGLE_KEY_MUTATIONS
+from ..client.database import Database
+from ..server import system_keys
+from ..server.log_system import LogSystemClient
+from ..sim.actors import all_of
+from ..sim.loop import TaskPriority, delay, spawn
+from ..sim.network import Endpoint
+from . import container as blob
+
+USER_END = b"\xff"
+LOG_CHUNK_VERSIONS = 200_000
+
+
+class BackupAgent:
+    def __init__(self, sim, db: Database, container_addr: str):
+        self.sim = sim
+        self.db = db
+        self.container_addr = container_addr
+        self.tag: Optional[int] = None
+        self.start_version: Optional[int] = None
+        self.snapshot_version: Optional[int] = None
+        self.end_version: Optional[int] = None
+        self._log_floor: Optional[int] = None
+        self._mover = None
+
+    # -- container io --------------------------------------------------------
+    async def _put(self, name: str, data: bytes) -> None:
+        await self.db.net.request(
+            self.db.client_addr, Endpoint(self.container_addr, blob.PUT_TOKEN),
+            blob.BlobPut(name, data), TaskPriority.DEFAULT_ENDPOINT, timeout=5.0)
+
+    async def _get(self, name: str) -> Optional[bytes]:
+        return await self.db.net.request(
+            self.db.client_addr, Endpoint(self.container_addr, blob.GET_TOKEN),
+            blob.BlobGet(name), TaskPriority.DEFAULT_ENDPOINT, timeout=5.0)
+
+    async def _list(self, prefix: str) -> List[str]:
+        return await self.db.net.request(
+            self.db.client_addr, Endpoint(self.container_addr, blob.LIST_TOKEN),
+            blob.BlobList(prefix), TaskPriority.DEFAULT_ENDPOINT, timeout=5.0)
+
+    # -- log access ----------------------------------------------------------
+    async def _log_client(self) -> LogSystemClient:
+        """The current generation's log config, fetched like any client
+        learns the cluster: from the CC's ServerDBInfo."""
+        from ..server.cluster_controller import CC_OPEN_DATABASE_TOKEN, OpenDatabaseRequest
+        from ..server.leader_election import tally_leader_once
+
+        while True:
+            leader = await tally_leader_once(self.db.net, self.db.client_addr,
+                                             self.db.coordinator_addrs)
+            if leader is not None:
+                try:
+                    info = await self.db.net.request(
+                        self.db.client_addr,
+                        Endpoint(leader.address, CC_OPEN_DATABASE_TOKEN),
+                        OpenDatabaseRequest(), TaskPriority.DEFAULT_ENDPOINT,
+                        timeout=1.0)
+                except error.FDBError:
+                    info = None
+                if info is not None and info.log_config is not None:
+                    return LogSystemClient(self.db.net, self.db.client_addr,
+                                           info.log_config)
+            await delay(0.5)
+
+    # -- backup --------------------------------------------------------------
+    async def start_backup(self) -> None:
+        async def begin(tr):
+            tr.set_access_system_keys()
+            seq = int(await tr.get(system_keys.BACKUP_SEQ_KEY) or b"0")
+            tag = system_keys.FIRST_BACKUP_TAG - seq
+            tr.set(system_keys.BACKUP_SEQ_KEY, str(seq + 1).encode())
+            tr.set(system_keys.BACKUP_ACTIVE_KEY,
+                   system_keys.encode_backup_active(tag))
+            return tag
+
+        self.tag = await self.db.run(begin)
+        tr = self.db.create_transaction()
+        self.start_version = await tr.get_read_version()
+        self._log_floor = self.start_version
+        self._mover = spawn(self._log_mover(), TaskPriority.DEFAULT_ENDPOINT,
+                            name="backupLogMover")
+
+    async def _log_mover(self) -> None:
+        """Continuously drain the backup tag into log/<version> objects."""
+        floor = self._log_floor
+        while True:
+            client = await self._log_client()
+            try:
+                reply = await client.peek(self.tag, floor + 1, timeout=2.0)
+            except error.FDBError:
+                await delay(0.5)
+                continue
+            if reply.messages:
+                name = "log/%020d" % reply.messages[0][0]
+                await self._put(name, wire.dumps(list(reply.messages)))
+                client.pop(self.tag, reply.messages[-1][0])
+            if reply.end_version > floor:
+                floor = reply.end_version
+                self._log_floor = floor
+            else:
+                await delay(0.25)
+
+    async def snapshot(self, chunks: int = 8, workers: int = 3) -> None:
+        """Range snapshot at one read version via TaskBucket chunk tasks."""
+        bucket = TaskBucket(Subspace((b"backup-tasks",)), timeout_seconds=20.0)
+        tr = self.db.create_transaction()
+        vs = await tr.get_read_version()
+        self.snapshot_version = vs
+
+        bounds = [b""] + [bytes([(256 * i) // chunks]) for i in range(1, chunks)] + [USER_END]
+
+        async def add_tasks(tr2):
+            for i in range(chunks):
+                bucket.add(tr2, i, {b"begin": bounds[i], b"end": bounds[i + 1]})
+        await self.db.run(add_tasks)
+
+        async def worker(wid: int):
+            while True:
+                tr2 = self.db.create_transaction()
+                try:
+                    task = await bucket.get_one(tr2)
+                    if task is None:
+                        if await bucket.is_empty(tr2):
+                            return
+                        await delay(0.5)   # only claimed tasks remain
+                        continue
+                    await tr2.commit()
+                except error.FDBError as e:
+                    if e.is_retryable() or e.is_maybe_committed():
+                        continue
+                    raise
+                rows = await self._read_chunk(task.params[b"begin"],
+                                              task.params[b"end"], vs)
+                await self._put("range/%04d" % task.id, wire.dumps({
+                    "begin": task.params[b"begin"], "end": task.params[b"end"],
+                    "version": vs, "rows": rows,
+                }))
+
+                async def done(tr3):
+                    bucket.finish(tr3, task)
+                await self.db.run(done)
+
+        await all_of([
+            spawn(worker(w), TaskPriority.DEFAULT_ENDPOINT, name=f"backupSnap{w}")
+            for w in range(workers)
+        ])
+
+    async def _read_chunk(self, begin: bytes, end: bytes, version: int):
+        rows: List[Tuple[bytes, bytes]] = []
+        tr = self.db.create_transaction()
+        tr.read_version = version
+        at = begin
+        while at < end:
+            page = await tr.get_range(at, end, limit=1000, snapshot=True)
+            rows.extend(page)
+            if len(page) < 1000:
+                break
+            at = page[-1][0] + b"\x00"
+        return rows
+
+    async def finish_backup(self) -> None:
+        """Pick the end version, wait for log coverage, write the manifest,
+        stop the proxies' copying and retire the tag."""
+        tr = self.db.create_transaction()
+        self.end_version = await tr.get_read_version()
+        while self._log_floor < self.end_version:
+            await delay(0.25)
+
+        async def stop(tr2):
+            tr2.set_access_system_keys()
+            tr2.set(system_keys.BACKUP_ACTIVE_KEY, b"")
+        await self.db.run(stop)
+
+        await self._put("manifest", wire.dumps({
+            "snapshot_version": self.snapshot_version,
+            "end_version": self.end_version,
+            "start_version": self.start_version,
+        }))
+        self._mover.cancel()
+        client = await self._log_client()
+        client.pop(self.tag, -1)   # retire: nothing pins the queue front
+
+    # -- restore -------------------------------------------------------------
+    async def restore(self, dest: Database) -> int:
+        """Restore the backup into `dest` (an empty keyspace). Returns the
+        restored end version."""
+        manifest = wire.loads(await self._get("manifest"))
+        vs, vend = manifest["snapshot_version"], manifest["end_version"]
+
+        for name in await self._list("range/"):
+            chunk = wire.loads(await self._get(name))
+            rows = chunk["rows"]
+            for i in range(0, len(rows), 200):
+                batch = rows[i:i + 200]
+
+                async def put_batch(tr):
+                    for k, v in batch:
+                        tr.set(k, v)
+                await dest.run(put_batch)
+
+        for name in await self._list("log/"):
+            entries = wire.loads(await self._get(name))
+            for v, muts in entries:
+                if v <= vs or v > vend:
+                    continue
+                for i in range(0, len(muts), 200):
+                    batch = muts[i:i + 200]
+
+                    async def apply_batch(tr):
+                        for m in batch:
+                            if m.type == MutationType.SET_VALUE:
+                                tr.set(m.param1, m.param2)
+                            elif m.type == MutationType.CLEAR_RANGE:
+                                tr.clear_range(m.param1, m.param2)
+                            elif m.type in SINGLE_KEY_MUTATIONS:
+                                tr.atomic_op(m.param1, m.param2, m.type)
+                    await dest.run(apply_batch)
+        return vend

@@ -41,6 +41,9 @@ WORKER_STALE_SECONDS = 2.0
 class WorkerRegisterRequest:
     addr: str
     known_info_version: int = -1
+    #: role kinds this worker currently hosts (for the status document's
+    #: machine layer; reference: worker details in Status.actor.cpp)
+    roles: tuple = ()
 
 
 @dataclass
@@ -55,6 +58,10 @@ class ClusterController:
         self.worker = worker
         self.net = worker.net
         self.proc = worker.proc
+        #: addr -> role kinds last reported in registration
+        self.worker_roles = {}
+        #: (recovery_count, sim time) for every master hand-over seen
+        self.recovery_history = []
         self.coords = worker.coords
         self.cluster_cfg = worker.cluster_cfg
         self.workers: Dict[str, float] = {}            # addr -> last_seen
@@ -86,6 +93,7 @@ class ClusterController:
     # -- worker registry ------------------------------------------------------
     async def register_worker(self, req: WorkerRegisterRequest) -> Optional[ServerDBInfo]:
         self.workers[req.addr] = now()
+        self.worker_roles[req.addr] = tuple(req.roles)
         if req.known_info_version < self.db_info.info_version:
             return self.db_info
         return None
@@ -119,12 +127,17 @@ class ClusterController:
                 "log_generation": (str(info.log_config.gen_id)
                                    if info.log_config is not None else None),
                 "workers": {
-                    addr: {"seconds_since_heartbeat": round(t - seen, 3)}
+                    addr: {
+                        "seconds_since_heartbeat": round(t - seen, 3),
+                        "roles": sorted(self.worker_roles.get(addr, ())),
+                    }
                     for addr, seen in sorted(self.workers.items())
                 },
+                "recovery_history": list(self.recovery_history),
             },
             "qos": {},
             "storage": [],
+            "data": {"shards": []},
         }
         if info.master_status_ep is not None:
             try:
@@ -151,6 +164,8 @@ class ClusterController:
                 )
             except error.FDBError:
                 pass
+        committed = doc["cluster"].get("version")
+        shards = {}
         for tag, b, e, addr in info.storage_tags:
             entry = {"tag": tag, "address": addr,
                      "shard_begin": b.hex(), "shard_end": e.hex()}
@@ -161,6 +176,8 @@ class ClusterController:
                 )
                 entry["version"] = qi.version
                 entry["durable_version"] = qi.durable_version
+                if committed is not None:
+                    entry["lag_versions"] = max(0, committed - qi.durable_version)
                 entry["counters"] = await self.net.request(
                     self.proc.address, Endpoint(addr, "storage.stats"), None,
                     TaskPriority.CLUSTER_CONTROLLER, timeout=1.0,
@@ -168,6 +185,16 @@ class ClusterController:
             except error.FDBError:
                 entry["unreachable"] = True
             doc["storage"].append(entry)
+            shards.setdefault((b, e), []).append(entry)
+        doc["data"]["shards"] = [
+            {
+                "begin": b.hex(), "end": e.hex(),
+                "replicas": [x["address"] for x in team],
+                "replication": len(team),
+                "healthy": all(not x.get("unreachable") for x in team),
+            }
+            for (b, e), team in sorted(shards.items())
+        ]
         return doc
 
     # -- database watch -------------------------------------------------------
@@ -179,6 +206,8 @@ class ClusterController:
             return
         info.info_version = self.db_info.info_version + 1
         self.db_info = info
+        self.recovery_history.append((info.recovery_count, round(now(), 3)))
+        del self.recovery_history[:-20]
         TraceEvent("MasterRecoveredToCC").detail("RecoveryCount", info.recovery_count).log()
 
     async def cluster_watch_database(self) -> None:
@@ -216,6 +245,7 @@ class ClusterController:
                 )
             except error.FDBError:
                 self.workers.pop(target, None)
+                self.worker_roles.pop(target, None)
                 await delay(0.5, TaskPriority.CLUSTER_CONTROLLER)
                 continue
             TraceEvent("CCRecruitedMaster").detail("Worker", target).detail("Salt", salt).log()

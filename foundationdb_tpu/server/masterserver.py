@@ -300,15 +300,21 @@ class MasterServer:
         # the recovery copy into the new generation, where nothing would
         # ever pop it (it would pin the disk-queue front forever).
         live_tags = {t for (t, _b, _e, _a) in prev.storage_tags}
-        live_tags.add(system_keys.METADATA_TAG)
+
+        def keep_tag(t: int) -> bool:
+            # negative tags (metadata stream, live backup logs) always ride
+            # the recovery copy; positive tags only while a storage server
+            # still owns them
+            return t < 0 or t in live_tags
+
         await all_of([
             self._init_role(a, INIT_TLOG_TOKEN, InitializeTLogRequest(
                 gen_id=gen_id, start_version=recovery_version,
                 token_suffix=rep_suffix, replica_index=i,
                 preload={t: e for t, e in preload.items()
-                         if t in live_tags and i in new_log.tag_subset(t)},
+                         if keep_tag(t) and i in new_log.tag_subset(t)},
                 preload_popped={t: v for t, v in preload_popped.items()
-                                if t in live_tags and i in new_log.tag_subset(t)},
+                                if keep_tag(t) and i in new_log.tag_subset(t)},
             ))
             for i, (a, rep_suffix) in enumerate(tlog_reps)
         ])
@@ -509,6 +515,13 @@ class MasterServer:
                 for begin, team in _teams_by_begin(dd["storage_tags"]).items():
                     tr.set(system_keys.key_servers_key(begin),
                            system_keys.encode_key_servers(team))
+                # a backup that straddled the recovery: re-advertise its
+                # flag so this generation's proxies resume copying into the
+                # backup tag (commits between recovery and this rewrite are
+                # a known v0 gap; agents should restart on generation turn)
+                active = await tr.get(system_keys.BACKUP_ACTIVE_KEY)
+                if active:
+                    tr.set(system_keys.BACKUP_ACTIVE_KEY, active)
             await dd_db.run(seed)
             dd["init_done"].send(None)
 

@@ -45,8 +45,10 @@ from ..sim.loop import Future, Promise, TaskPriority, delay, spawn
 from ..sim.network import Endpoint, SimProcess
 from .log_system import LogSystemClient, LogSystemConfig
 from .system_keys import (
+    BACKUP_ACTIVE_KEY,
     KEY_SERVERS_PREFIX,
     METADATA_TAG,
+    decode_backup_active,
     decode_key_servers,
     is_system_key,
     shard_begin_of,
@@ -94,6 +96,8 @@ class RoutingState:
         self.shards = shards
         self.teams = [list(t) for t in teams]
         self.extra_tags: List[tuple] = [() for _ in self.teams]
+        #: live backup's log tag (None = no backup running)
+        self.backup_tag: Optional[int] = None
 
     def write_tags(self, s: int) -> List[int]:
         return [t for t, _a in self.teams[s]] + list(self.extra_tags[s])
@@ -102,7 +106,12 @@ class RoutingState:
         return [a for _t, a in self.teams[s]]
 
     def apply_mutation(self, m: Mutation) -> None:
-        if m.type != MutationType.SET_VALUE or not m.param1.startswith(KEY_SERVERS_PREFIX):
+        if m.type != MutationType.SET_VALUE:
+            return
+        if m.param1 == BACKUP_ACTIVE_KEY:
+            self.backup_tag = decode_backup_active(m.param2)
+            return
+        if not m.param1.startswith(KEY_SERVERS_PREFIX):
             return
         begin = shard_begin_of(m.param1)
         s = self.shards.shard_of_key(begin) if begin else 0
@@ -646,6 +655,7 @@ class Proxy:
         # payload changes, never the conflict ranges.
         messages: Dict[int, List[Mutation]] = {}
         meta_muts: List[Mutation] = []
+        backup_muts: List[Mutation] = []
         for t, (txn, _) in enumerate(items):
             if verdicts[t] != int(TransactionCommitResult.COMMITTED):
                 continue
@@ -654,6 +664,11 @@ class Proxy:
                     m = transform_versionstamp_mutation(m, v, t)
                 if m.type != MutationType.CLEAR_RANGE and is_system_key(m.param1):
                     meta_muts.append(m)
+                elif self.routing.backup_tag is not None:
+                    # live backup: copy every committed USER mutation into
+                    # the backup's log tag (the reference's backup ranges
+                    # via ApplyMetadataMutation)
+                    backup_muts.append(m)
                 # Every team member's tag receives the mutation (the
                 # reference tags each mutation for all replicas of its
                 # shard, MasterProxyServer.actor.cpp:516-756).
@@ -668,6 +683,8 @@ class Proxy:
                         messages.setdefault(tag, []).append(m)
         if meta_muts:
             messages[METADATA_TAG] = meta_muts
+        if backup_muts and self.routing.backup_tag is not None:
+            messages[self.routing.backup_tag] = backup_muts
 
         # ---- Phase 4: log, in version order (:805) ----
         await self.batch_logging.when_at_least(bn - 1)

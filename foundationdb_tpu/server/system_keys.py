@@ -22,10 +22,27 @@ from ..core.types import Key
 
 SYSTEM_PREFIX = b"\xff"
 KEY_SERVERS_PREFIX = b"\xff/keyServers/"
+#: holds wire({"tag": <backup tag>}) while a backup is running; proxies
+#: copy every committed user mutation into that log tag (the reference's
+#: backup mutation ranges through ApplyMetadataMutation)
+BACKUP_ACTIVE_KEY = b"\xff/backup/active"
+BACKUP_SEQ_KEY = b"\xff/backup/seq"
 
 #: the log-system tag carrying committed system-key mutations to every
 #: proxy (the reference's txsTag, TagPartitionedLogSystem.actor.cpp)
 METADATA_TAG = -1
+#: backup tags count downward from here, one per backup generation
+FIRST_BACKUP_TAG = -2
+
+
+def encode_backup_active(tag: int) -> bytes:
+    return wire.dumps({"tag": tag})
+
+
+def decode_backup_active(value: bytes) -> Optional[int]:
+    if not value:
+        return None
+    return wire.loads(value).get("tag")
 
 
 def is_system_key(key: Key) -> bool:

@@ -201,7 +201,8 @@ class Worker:
                     self.proc.address,
                     Endpoint(leader.address, CC_REGISTER_TOKEN),
                     WorkerRegisterRequest(addr=self.proc.address,
-                                          known_info_version=known_version),
+                                          known_info_version=known_version,
+                                          roles=tuple(sorted({k[0] for k in self.roles}))),
                     TaskPriority.CLUSTER_CONTROLLER,
                     timeout=2.0,
                 )

@@ -15,6 +15,7 @@ from ..server.cluster import ClusterConfig, DynamicClusterConfig
 from .workload import Spec
 from .workloads import (
     AtomicOpsWorkload,
+    BackupCorrectnessWorkload,
     ConflictRangeWorkload,
     ConsistencyCheckWorkload,
     CycleWorkload,
@@ -203,6 +204,19 @@ SPECS: Dict[str, Callable[[], Spec]] = {
             n_resolvers=1, n_storage=4, engine_factory=_sharded_engine_factory
         ),
         client_count=6,
+    ),
+    # fast/BackupCorrectness.txt: a live backup straddles cycle churn and
+    # restores bit-identically into a second cluster
+    "BackupCorrectness": lambda: Spec(
+        title="BackupCorrectness",
+        workloads=[
+            (CycleWorkload, {"nodes": 8, "transactions": 12, "think_time": 0.3}),
+            (BackupCorrectnessWorkload, {"chunks": 4}),
+        ],
+        dynamic=DynamicClusterConfig(n_workers=6, n_tlogs=2, n_resolvers=2,
+                                     n_storage=2),
+        client_count=2,
+        timeout=900.0,
     ),
     # rare/FuzzApiCorrectness.txt: randomized op streams vs the model,
     # with clogging so retry/unknown-result paths actually fire

@@ -999,3 +999,50 @@ class SerializabilityWorkload(TestWorkload):
         # balance conserved
         return (sum(duty) >= 1 and total == 400
                 and not self.ctx.shared.get("write_skew_observed"))
+
+
+class BackupCorrectnessWorkload(TestWorkload):
+    """Back up under live load, restore into a second cluster in the same
+    simulation, and require the restored keyspace to equal the source at
+    the backup's end version (BackupCorrectness.actor.cpp)."""
+
+    name = "BackupCorrectness"
+
+    async def start(self, db: Database) -> None:
+        from ..backup import BackupAgent, BlobContainer
+
+        if self.ctx.client_id != 0:
+            return
+        sim = self.ctx.cluster.sim
+        container = BlobContainer(sim.new_process("wl-blobstore"))
+        agent = BackupAgent(sim, db, container.proc.address)
+        await delay(float(self.ctx.options.get("delay_before", 1.0)))
+        await agent.start_backup()
+        await agent.snapshot(chunks=int(self.ctx.options.get("chunks", 4)),
+                             workers=2)
+        await delay(float(self.ctx.options.get("tail_seconds", 1.0)))
+        await agent.finish_backup()
+        self.ctx.shared["agent"] = agent
+        self.ctx.count("backups")
+
+    async def check(self, db: Database) -> bool:
+        from ..server.cluster import DynamicCluster, DynamicClusterConfig
+
+        agent = self.ctx.shared.get("agent")
+        if agent is None:
+            return False
+        sim = self.ctx.cluster.sim
+        dst = DynamicCluster(sim, DynamicClusterConfig(
+            n_workers=5, n_tlogs=2, n_resolvers=1, n_storage=2))
+        db2 = dst.new_client()
+        await agent.restore(db2)
+
+        tr = db.create_transaction()
+        tr.read_version = agent.end_version
+        src_rows = await tr.get_range(b"", b"\xff", limit=100_000, snapshot=True)
+        tr2 = db2.create_transaction()
+        rows2 = await tr2.get_range(b"", b"\xff", limit=100_000, snapshot=True)
+        if rows2 != src_rows:
+            self.ctx.count("restore_mismatch")
+            return False
+        return True

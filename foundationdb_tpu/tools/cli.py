@@ -27,6 +27,8 @@ commands:
   clear KEY            clear a key
   clearrange BEGIN END clear a key range
   getrange BEGIN END [LIMIT]   read a range
+  shards               shard map + replica teams (from \\xff/keyServers)
+  move BEGIN WORKER [WORKER...]  move the shard at BEGIN to new workers
   help                 this text
   exit                 quit
 Keys/values are text; prefix with 0x for hex bytes."""
@@ -87,6 +89,14 @@ class Cli:
         for s in doc.get("storage", []):
             state = "unreachable" if s.get("unreachable") else f"v={s.get('durable_version')}"
             self._print(f"  storage tag {s['tag']}      - {s['address']} ({state})")
+        for sh in doc.get("data", {}).get("shards", []):
+            health = "healthy" if sh.get("healthy") else "DEGRADED"
+            self._print(f"  shard [{sh['begin'] or chr(39)*2} ...)     - "
+                        f"x{sh['replication']} {health}")
+        hist = c.get("recovery_history", [])
+        if hist:
+            self._print(f"  recoveries         - {len(hist)} "
+                        f"(latest generation {hist[-1][0]})")
         self._print(f"  workers            - {len(c.get('workers', {}))}")
 
     def do_get(self, args: List[str]) -> None:
@@ -136,6 +146,51 @@ class Cli:
             self._print(f"  {_fmt(k)} -> {_fmt(v)}")
         self._print(f"{len(rows)} row(s)")
 
+    def do_shards(self, args: List[str]) -> None:
+        from ..server import system_keys
+
+        async def go(tr):
+            return await tr.get_range(system_keys.KEY_SERVERS_PREFIX,
+                                      system_keys.KEY_SERVERS_PREFIX + b"\xff")
+
+        rows = self._drive(self.db.run(go))
+        if not rows:
+            self._print("no shard metadata (cluster still seeding?)")
+            return
+        for k, v in rows:
+            begin = system_keys.shard_begin_of(k)
+            team, extra = system_keys.decode_key_servers(v)
+            label = _fmt(begin) if begin else "''"
+            dests = ", ".join(f"tag {t} @ {a}" for t, a in team)
+            moving = f"  (moving: +tags {list(extra)})" if extra else ""
+            self._print(f"  [{label} ...) -> {dests}{moving}")
+
+    def do_move(self, args: List[str]) -> None:
+        from ..server.masterserver import MOVE_SHARD_TOKEN, MoveShardRequest
+        from ..sim.loop import TaskPriority
+        from ..sim.network import Endpoint
+
+        begin, dests = _arg_bytes(args[0]) if args[0] != "''" else b"", args[1:]
+        ep = None
+        for p in self.cluster.worker_procs:
+            for tok in p.handlers:
+                if tok.startswith(MOVE_SHARD_TOKEN):
+                    ep = Endpoint(p.address, tok)
+        if ep is None:
+            self._print("no master reachable")
+            return
+
+        async def go():
+            return await self.sim.net.request(
+                self.db.client_addr, ep,
+                MoveShardRequest(begin=begin, dest_workers=list(dests)),
+                TaskPriority.MOVE_KEYS, timeout=120.0,
+            )
+
+        reply = self._drive(go(), timeout=240.0)
+        self._print(f"moved shard at {_fmt(begin) if begin else chr(39)*2}: "
+                    f"new team {reply['team']}")
+
     # -- loop -----------------------------------------------------------------
     def run_command(self, line: str) -> bool:
         """Returns False on exit. Errors print, never crash the shell."""
@@ -158,7 +213,7 @@ class Cli:
             return True
         try:
             fn(args)
-        except (ValueError, TypeError):
+        except (ValueError, TypeError, IndexError):
             self._print(f"usage error (try help)")
         except error.FDBError as e:
             self._print(f"error: {e}")

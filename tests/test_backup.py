@@ -1,0 +1,130 @@
+"""Backup v0: range snapshot + mutation log into a blob container, restore.
+
+reference: fdbclient/FileBackupAgent.actor.cpp + design/backup.md. The
+bar (round-2 VERDICT #9): a backup taken UNDER LOAD restores to a state
+that passes the source's own consistency checks.
+"""
+import pytest
+
+from foundationdb_tpu.backup import BackupAgent, BlobContainer
+from foundationdb_tpu.core import error
+from foundationdb_tpu.core.types import MutationType
+from foundationdb_tpu.server.cluster import DynamicClusterConfig, DynamicCluster
+from foundationdb_tpu.sim.loop import delay
+from foundationdb_tpu.sim.simulator import Simulator
+
+USER_END = b"\xff"
+
+
+def build_two_clusters(seed):
+    """Source + destination clusters inside ONE simulation, sharing a blob
+    container — the reference's cluster-to-cluster restore topology."""
+    sim = Simulator(seed)
+    src = DynamicCluster(sim, DynamicClusterConfig(
+        n_workers=6, n_tlogs=2, n_resolvers=2, n_storage=2))
+    dst = DynamicCluster(sim, DynamicClusterConfig(
+        n_workers=6, n_tlogs=2, n_resolvers=2, n_storage=2))
+    container = BlobContainer(sim.new_process("blobstore"))
+    return sim, src, dst, container
+
+
+def test_backup_restore_under_load():
+    sim, src, dst, container = build_two_clusters(seed=131)
+    db = src.new_client()
+    db2 = dst.new_client()
+
+    async def scenario():
+        # pre-backup data (must come from the snapshot)
+        async def seed(tr):
+            for i in range(40):
+                tr.set(b"pre/%03d" % i, b"v%d" % i)
+            tr.atomic_op(b"ctr", (5).to_bytes(8, "little"), MutationType.ADD_VALUE)
+        await db.run(seed)
+
+        agent = BackupAgent(sim, db, container.proc.address)
+        await agent.start_backup()
+
+        # concurrent load while the snapshot runs (must come from the log)
+        async def load():
+            for i in range(60):
+                async def body(tr):
+                    tr.set(b"live/%03d" % (i % 25), b"w%d" % i)
+                    if i % 7 == 0:
+                        tr.clear_range(b"pre/%03d" % (i % 10),
+                                       b"pre/%03d\x00" % (i % 10))
+                    if i % 5 == 0:
+                        tr.atomic_op(b"ctr", (3).to_bytes(8, "little"),
+                                     MutationType.ADD_VALUE)
+                await db.run(body)
+                if i % 10 == 9:
+                    await delay(0.2)
+            return True
+
+        load_task = sim.sched.spawn(load(), name="load")
+        await agent.snapshot(chunks=6, workers=3)
+        assert await load_task
+        await agent.finish_backup()
+
+        # post-backup writes must NOT appear in the restore
+        async def post(tr):
+            tr.set(b"after/end", b"not-in-backup")
+        await db.run(post)
+
+        vend = await agent.restore(db2)
+        assert vend == agent.end_version
+
+        # source state AT end_version vs restored state: compare via a
+        # source read at end_version (the MVCC window still covers it)
+        async def read_all(d, version=None):
+            tr = d.create_transaction()
+            if version is not None:
+                tr.read_version = version
+            return await tr.get_range(b"", USER_END, limit=100_000, snapshot=True)
+
+        src_rows = await read_all(db, agent.end_version)
+        dst_rows = await read_all(db2)
+        assert dst_rows == src_rows, (len(dst_rows), len(src_rows))
+        # sanity on content classes: snapshot data, log data, atomic totals
+        d = dict(dst_rows)
+        assert d.get(b"live/000") is not None
+        assert b"after/end" not in d
+        return True
+
+    assert sim.run_until(sim.sched.spawn(scenario(), name="s"), until=600.0)
+
+
+def test_backup_tag_is_retired_after_finish():
+    """After finish_backup, no tlog retains or accepts the backup tag's
+    data (the disk-queue front must not pin)."""
+    sim, src, dst, container = build_two_clusters(seed=137)
+    db = src.new_client()
+
+    async def scenario():
+        agent = BackupAgent(sim, db, container.proc.address)
+
+        async def w(tr):
+            for i in range(10):
+                tr.set(b"k%02d" % i, b"v")
+        await db.run(w)
+        await agent.start_backup()
+        async def w2(tr):
+            tr.set(b"k00", b"v2")
+        await db.run(w2)
+        await agent.snapshot(chunks=2, workers=1)
+        await agent.finish_backup()
+        await delay(3.0)
+        tag = agent.tag
+        for p in src.worker_procs:
+            for key, role in getattr(p, "handlers", {}).items():
+                pass
+        # inspect tlog roles via worker disk-independent handle: check no
+        # tag data remains by peeking (must yield nothing / retired)
+        from foundationdb_tpu.backup.agent import BackupAgent as _BA
+        client = await agent._log_client()
+        try:
+            reply = await client.peek(tag, 1, timeout=1.0)
+            return len(reply.messages) == 0
+        except error.FDBError:
+            return True   # peek refused: equally fine, nothing served
+
+    assert sim.run_until(sim.sched.spawn(scenario(), name="s"), until=300.0)

@@ -38,6 +38,45 @@ def test_status_document_fields():
     for s in doc["storage"]:
         assert s.get("durable_version", 0) > 0 or s.get("unreachable")
     assert len(doc["cluster"]["workers"]) == 5
+    # machine layer: every worker reports its hosted role kinds
+    all_roles = set()
+    for w in doc["cluster"]["workers"].values():
+        all_roles.update(w["roles"])
+    assert {"tlog", "storage", "proxy", "resolver"} <= all_roles
+    # recovery history + data layer
+    assert doc["cluster"]["recovery_history"]
+    assert doc["cluster"]["recovery_history"][-1][0] == doc["cluster"]["generation"]
+    assert len(doc["data"]["shards"]) == 2
+    for sh in doc["data"]["shards"]:
+        assert sh["healthy"] and sh["replication"] == 1
+    for s in doc["storage"]:
+        assert "lag_versions" in s or s.get("unreachable")
+
+
+def test_cli_shards_and_move():
+    c = build_dynamic_cluster(
+        seed=171, cfg=DynamicClusterConfig(n_workers=8))
+    out = io.StringIO()
+    cli = Cli(c, out=out)
+    c.sim.run(until=8.0)  # boot + keyServers seeding
+    cli.run_command("set mk mv")
+    cli.run_command("shards")
+    text = out.getvalue()
+    assert "tag 0 @" in text and "tag 1 @" in text
+
+    # move the first shard to a spare worker through the CLI
+    storage_addrs = {
+        p.address for p in c.worker_procs
+        if any(t.startswith("storage.getValue") for t in p.handlers)
+    }
+    spare = next(p.address for p in c.worker_procs
+                 if p.alive and p.address not in storage_addrs)
+    out.truncate(0)
+    cli.run_command(f"move '' {spare}")
+    assert "new team" in out.getvalue()
+    out.truncate(0)
+    cli.run_command("get mk")
+    assert "'mv'" in out.getvalue()
 
 
 def test_status_reflects_recovery_after_kill():
