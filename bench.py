@@ -179,6 +179,7 @@ def main():
     host_pack_ms = host_packing_ms_per_batch()
     parity_ok = parity_measurement_set()
     e2e = CFG.max_txns / ((device_ms_per_batch + host_pack_ms) / 1e3)
+    native_cpu = native_baseline_txns_per_sec()
 
     print(json.dumps({
         "metric": "resolved_txns_per_sec_per_chip",
@@ -191,8 +192,35 @@ def main():
         "parity_configs_ok": parity_ok,
         "p99_link_ms": round(p99_ms, 3),
         "batch_txns": CFG.max_txns,
+        "native_cpu_txns_per_sec": native_cpu,
+        "vs_native_cpu": round(txns_per_sec / native_cpu, 2) if native_cpu else None,
         "device": str(dev),
     }))
+
+
+def native_baseline_txns_per_sec():
+    """The C++ resolver on one CPU core, same transaction shape (the
+    `-r skiplisttest` baseline the kernel is judged against). Wire blocks
+    are pre-encoded outside the timed loop — the comparison is engine vs
+    engine, with host packing charged separately on both sides."""
+    try:
+        from foundationdb_tpu.tools.skiplist_bench import make_batches
+        from foundationdb_tpu.ops.native_engine import NativeConflictEngine
+
+        eng = NativeConflictEngine()
+    except Exception:
+        return None
+    batches = make_batches(40, 1000, POOL, 7)
+    encoded = [
+        ([t.conflict_wire_block() for t in txns],
+         [t.read_snapshot for t in txns], now, oldest)
+        for txns, now, oldest in batches
+    ]
+    eng.resolve_wire(*encoded[0])
+    t0 = time.perf_counter()
+    for blocks, snaps, now, oldest in encoded[1:]:
+        eng.resolve_wire(blocks, snaps, now, oldest)
+    return round((len(encoded) - 1) * 1000 / (time.perf_counter() - t0))
 
 
 def host_packing_ms_per_batch() -> float:

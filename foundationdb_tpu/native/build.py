@@ -35,6 +35,61 @@ def _build() -> bool:
     return False
 
 
+_CPP_SRC = os.path.join(_DIR, "conflict_engine.cpp")
+_CPP_SO = os.path.join(_DIR, "_conflict_engine.so")
+_cpp_lib: Optional[ctypes.CDLL] = None
+_cpp_tried = False
+
+
+def _build_cpp() -> bool:
+    for cxx in ("g++", "c++", "clang++"):
+        try:
+            r = subprocess.run(
+                [cxx, "-O2", "-std=c++17", "-fPIC", "-shared",
+                 "-o", _CPP_SO, _CPP_SRC],
+                capture_output=True, timeout=120,
+            )
+            if r.returncode == 0:
+                return True
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+    return False
+
+
+def load_conflict_engine() -> Optional[ctypes.CDLL]:
+    """The native C++ ConflictSet engine; None if no C++ toolchain."""
+    global _cpp_lib, _cpp_tried
+    if _cpp_lib is not None or _cpp_tried:
+        return _cpp_lib
+    _cpp_tried = True
+    try:
+        if (not os.path.exists(_CPP_SO)
+                or os.path.getmtime(_CPP_SO) < os.path.getmtime(_CPP_SRC)):
+            if not _build_cpp():
+                return None
+        lib = ctypes.CDLL(_CPP_SO)
+        i64 = ctypes.c_int64
+        i64p = ctypes.POINTER(i64)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.cse_new.restype = ctypes.c_void_p
+        lib.cse_new.argtypes = [i64]
+        lib.cse_free.restype = None
+        lib.cse_free.argtypes = [ctypes.c_void_p]
+        lib.cse_clear.restype = None
+        lib.cse_clear.argtypes = [ctypes.c_void_p, i64]
+        lib.cse_boundary_count.restype = i64
+        lib.cse_boundary_count.argtypes = [ctypes.c_void_p]
+        lib.cse_resolve.restype = ctypes.c_int
+        lib.cse_resolve.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, i64p, ctypes.c_int,
+            i64p, i64, i64, u8p,
+        ]
+        _cpp_lib = lib
+    except OSError:
+        _cpp_lib = None
+    return _cpp_lib
+
+
 def load_fastpack() -> Optional[ctypes.CDLL]:
     """The fastpack library, building it on first use; None if unavailable."""
     global _lib, _tried

@@ -1,0 +1,80 @@
+"""The `-r skiplisttest` analog (SkipList.cpp:1412-1502): drive the CPU
+resolver engines with the reference self-benchmark's shape — randomized
+batches over a hot key pool — and print transactions/sec.
+
+    python -m foundationdb_tpu.tools.skiplist_bench [--engine native|oracle]
+        [--batches N] [--txns N]
+
+The native C++ engine is the framework's CPU baseline; the TPU kernel's
+bench.py number is judged against the same transaction shape.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import time
+
+from ..core.types import CommitTransaction, KeyRange
+
+
+def make_batches(n_batches: int, txns_per_batch: int, pool: int, seed: int):
+    rng = random.Random(seed)
+    keys = [b"sl/%08d" % i for i in range(pool)]
+    batches = []
+    version = 1000
+    for _ in range(n_batches):
+        version += txns_per_batch
+        txns = []
+        for _t in range(txns_per_batch):
+            tr = CommitTransaction(read_snapshot=version - rng.randrange(1, 2000))
+            for _ in range(2):
+                k = keys[rng.randrange(pool)]
+                tr.read_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            for _ in range(2):
+                k = keys[rng.randrange(pool)]
+                tr.write_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            txns.append(tr)
+        batches.append((txns, version, max(0, version - 5_000_000)))
+    return batches
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--engine", choices=("native", "oracle"), default="native")
+    ap.add_argument("--batches", type=int, default=200)
+    ap.add_argument("--txns", type=int, default=1000)
+    ap.add_argument("--pool", type=int, default=8192)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args(argv)
+
+    if args.engine == "native":
+        from ..ops.native_engine import NativeConflictEngine
+
+        eng = NativeConflictEngine()
+    else:
+        from ..ops.oracle import OracleConflictEngine
+
+        eng = OracleConflictEngine()
+
+    batches = make_batches(args.batches, args.txns, args.pool, args.seed)
+    # warm (build/load, allocator)
+    eng.resolve(*batches[0])
+    t0 = time.perf_counter()
+    committed = 0
+    for txns, now, oldest in batches[1:]:
+        for s in eng.resolve(txns, now, oldest):
+            committed += int(s) == 2
+    dt = time.perf_counter() - t0
+    n = (len(batches) - 1) * args.txns
+    print(json.dumps({
+        "engine": eng.name,
+        "txns_per_sec": round(n / dt),
+        "batches_per_sec": round((len(batches) - 1) / dt, 1),
+        "committed_fraction": round(committed / n, 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

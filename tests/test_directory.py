@@ -1,0 +1,151 @@
+"""Directory layer: hierarchical namespaces over allocated prefixes.
+
+reference: bindings/python/fdb/directory_impl.py (DirectoryLayer +
+HighContentionAllocator); the bindingtester's directory ops are the
+behavioral spec.
+"""
+import pytest
+
+from foundationdb_tpu.bindings.directory import DirectoryError, DirectoryLayer
+from foundationdb_tpu.server.cluster import ClusterConfig, build_cluster
+
+
+def drive(sim, coro, until=120.0):
+    return sim.run_until(sim.sched.spawn(coro, name="dir"), until=until)
+
+
+def test_directory_lifecycle():
+    c = build_cluster(seed=51, cfg=ClusterConfig(n_storage=2))
+    sim, db = c.sim, c.new_client()
+    dl = DirectoryLayer()
+
+    async def scenario():
+        async def create(tr):
+            users = await dl.create_or_open(tr, ("app", "users"))
+            events = await dl.create_or_open(tr, ("app", "events"), layer=b"log")
+            tr.set(users.pack((42,)), b"alice")
+            tr.set(events.pack((1,)), b"login")
+            return users.raw_prefix, events.raw_prefix
+        up, ep = await db.run(create)
+        assert up != ep and not up.startswith(ep) and not ep.startswith(up)
+
+        async def reopen(tr):
+            users = await dl.open(tr, ("app", "users"))
+            assert users.raw_prefix == up
+            assert await tr.get(users.pack((42,))) == b"alice"
+            # layer tag is enforced
+            try:
+                await dl.open(tr, ("app", "events"), layer=b"queue")
+                return "no-error"
+            except DirectoryError:
+                pass
+            ev = await dl.open(tr, ("app", "events"), layer=b"log")
+            assert ev.raw_prefix == ep
+            return sorted(await dl.list(tr, ("app",)))
+        assert await db.run(reopen) == ["events", "users"]
+
+        # create without open fails on existing; open fails on missing
+        async def guards(tr):
+            try:
+                await dl.create(tr, ("app", "users"))
+                return "created-twice"
+            except DirectoryError:
+                pass
+            try:
+                await dl.open(tr, ("nope",))
+                return "opened-missing"
+            except DirectoryError:
+                return "ok"
+        assert await db.run(guards) == "ok"
+        return True
+
+    assert drive(sim, scenario())
+
+
+def test_directory_move_keeps_data():
+    c = build_cluster(seed=53, cfg=ClusterConfig(n_storage=2))
+    sim, db = c.sim, c.new_client()
+    dl = DirectoryLayer()
+
+    async def scenario():
+        async def setup(tr):
+            d = await dl.create_or_open(tr, ("a", "b"))
+            tr.set(d.pack(("k",)), b"v")
+            return d.raw_prefix
+        prefix = await db.run(setup)
+
+        async def mv(tr):
+            moved = await dl.move(tr, ("a", "b"), ("c",))
+            return moved.raw_prefix
+        assert await db.run(mv) == prefix  # data never moves
+
+        async def check(tr):
+            d = await dl.open(tr, ("c",))
+            assert await tr.get(d.pack(("k",))) == b"v"
+            assert not await dl.exists(tr, ("a", "b"))
+            try:
+                await dl.move(tr, ("c",), ("c", "inside"))
+                return "moved-into-self"
+            except DirectoryError:
+                return "ok"
+        return await db.run(check)
+
+    assert drive(sim, scenario()) == "ok"
+
+
+def test_directory_remove_subtree():
+    c = build_cluster(seed=57, cfg=ClusterConfig(n_storage=2))
+    sim, db = c.sim, c.new_client()
+    dl = DirectoryLayer()
+
+    async def scenario():
+        async def setup(tr):
+            d1 = await dl.create_or_open(tr, ("root", "x"))
+            d2 = await dl.create_or_open(tr, ("root", "x", "y"))
+            tr.set(d1.pack((1,)), b"one")
+            tr.set(d2.pack((2,)), b"two")
+            return d1.raw_prefix, d2.raw_prefix
+        p1, p2 = await db.run(setup)
+
+        async def rm(tr):
+            return await dl.remove(tr, ("root", "x"))
+        assert await db.run(rm) is True
+
+        async def check(tr):
+            assert not await dl.exists(tr, ("root", "x"))
+            assert not await dl.exists(tr, ("root", "x", "y"))
+            # contents gone
+            assert await tr.get_range(p1, p1 + b"\xff") == []
+            assert await tr.get_range(p2, p2 + b"\xff") == []
+            assert await dl.remove(tr, ("root", "x")) is False
+            return True
+        return await db.run(check)
+
+    assert drive(sim, scenario())
+
+
+def test_allocator_uniqueness_under_contention():
+    """Concurrent clients allocating directories never collide (the HCA's
+    claim conflict) and no prefix is a prefix of another."""
+    c = build_cluster(seed=59, cfg=ClusterConfig(n_resolvers=2, n_storage=2))
+    sim = c.sim
+    dl = DirectoryLayer()
+    prefixes = []
+
+    async def client(cid):
+        db = c.new_client()
+        for i in range(6):
+            async def mk(tr):
+                d = await dl.create_or_open(tr, ("c%d" % cid, "d%d" % i))
+                return d.raw_prefix
+            prefixes.append(await db.run(mk))
+        return True
+
+    tasks = [sim.sched.spawn(client(i), name=f"alloc{i}") for i in range(4)]
+    from foundationdb_tpu.sim.actors import all_of
+    assert sim.run_until(all_of(tasks), until=300.0)
+    # 4 clients x 6 dirs + 4 parents... all distinct and prefix-free
+    assert len(set(prefixes)) == len(prefixes)
+    ps = sorted(prefixes)
+    for a, b in zip(ps, ps[1:]):
+        assert not b.startswith(a), (a, b)
