@@ -97,3 +97,4 @@ conflict_capacity_exceeded = _define(
 )
 key_too_large = _define(2102, "key_too_large", "Key exceeds the engine's exact-compare width")
 end_of_stream = _define(1, "end_of_stream", "End of stream")
+internal_error = _define(4100, "internal_error", "An internal error occurred")

@@ -1,0 +1,3 @@
+from .transport import RealNetwork, RealProcess
+
+__all__ = ["RealNetwork", "RealProcess"]

@@ -1,0 +1,85 @@
+"""A standalone KV node over the real transport — the two-OS-process
+demo: run one of these per terminal, point a client at it over TCP.
+
+    python -m foundationdb_tpu.real.demo_server --port 4500
+
+Serves the storage-interface message types (GetValueRequest /
+GetKeyValuesRequest) plus set/clear one-ways, all serialized with the
+versioned flat wire format over token-addressed frames.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import bisect
+from typing import Dict, List
+
+from ..server.messages import (
+    GetKeyValuesReply,
+    GetKeyValuesRequest,
+    GetValueReply,
+    GetValueRequest,
+)
+from .transport import RealProcess
+
+SET_TOKEN = "demo.set"
+GET_TOKEN = "demo.get"
+RANGE_TOKEN = "demo.getRange"
+PING_TOKEN = "demo.ping"
+
+
+class DemoKV:
+    def __init__(self, proc: RealProcess):
+        self.proc = proc
+        self._d: Dict[bytes, bytes] = {}
+        proc.register(GET_TOKEN, self.get)
+        proc.register(RANGE_TOKEN, self.get_range)
+        proc.register(SET_TOKEN, self.set)
+        proc.register(PING_TOKEN, self.ping)
+
+    async def ping(self, body):
+        return body
+
+    async def set(self, body) -> bool:
+        k, v = body
+        if v is None:
+            self._d.pop(k, None)
+        else:
+            self._d[k] = v
+        return True
+
+    async def get(self, req: GetValueRequest) -> GetValueReply:
+        return GetValueReply(value=self._d.get(req.key))
+
+    async def get_range(self, req: GetKeyValuesRequest) -> GetKeyValuesReply:
+        keys = sorted(self._d)
+        lo = bisect.bisect_left(keys, req.begin)
+        hi = bisect.bisect_left(keys, req.end)
+        rows: List = [(k, self._d[k]) for k in keys[lo:hi]]
+        more = len(rows) > req.limit
+        return GetKeyValuesReply(data=rows[: req.limit], more=more)
+
+
+async def serve(host: str, port: int) -> None:
+    proc = RealProcess(host, port)
+    DemoKV(proc)
+    await proc.start()
+    print(f"listening on {proc.address}", flush=True)
+    while True:
+        await asyncio.sleep(3600)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args(argv)
+    try:
+        asyncio.run(serve(args.host, args.port))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,258 @@
+"""Real transport: token-addressed RPC over TCP (asyncio).
+
+The FlowTransport analog (fdbrpc/FlowTransport.actor.cpp) for clusters of
+actual OS processes: the same Endpoint/request/one_way surface the sim
+network exposes, so code written against that seam can run over real
+sockets. Frames are length-prefixed and carry the repo's versioned flat
+wire format (core/wire.py) — the on-disk encoding and the on-wire
+encoding are the same bytes, like flow/serialize.h serving both.
+
+    frame := [u32 len][wire payload]
+    payload := {"kind": "req"|"reply"|"err"|"oneway",
+                "id": int, "token": str, "body": any}
+
+Every dataclass in server/messages.py is wire-registered at import, so
+role interfaces serialize without pickle. Connections are per-peer,
+created on demand, reconnected on failure; replies match requests by id.
+A request to an address with no listener (or a handler raising) surfaces
+as the same FDBError codes the sim transport uses, keeping failure
+handling uniform across both worlds.
+"""
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import struct
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ..core import error, wire
+from ..sim.network import Endpoint
+
+
+def _register_messages() -> None:
+    from ..server import messages as msgs
+    from ..core import types as t
+
+    for mod in (msgs, t):
+        for name in dir(mod):
+            obj = getattr(mod, name)
+            if dataclasses.is_dataclass(obj) and isinstance(obj, type):
+                if obj not in wire._RECORD_NAMES:
+                    wire.register_record(obj)
+
+
+_register_messages()
+
+_LEN = struct.Struct("<I")
+MAX_FRAME = 64 << 20
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Any:
+    hdr = await reader.readexactly(4)
+    (n,) = _LEN.unpack(hdr)
+    if n > MAX_FRAME:
+        raise error.connection_failed("oversized frame")
+    return wire.loads(await reader.readexactly(n))
+
+
+def _write_frame(writer: asyncio.StreamWriter, payload: Any) -> None:
+    raw = wire.dumps(payload)
+    writer.write(_LEN.pack(len(raw)) + raw)
+
+
+class _Peer:
+    """One outgoing connection + its in-flight request table."""
+
+    def __init__(self, addr: str):
+        self.addr = addr
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self.pending: Dict[int, asyncio.Future] = {}
+        self.lock = asyncio.Lock()
+        self._pump: Optional[asyncio.Task] = None
+
+    async def connect(self) -> None:
+        host, port = self.addr.rsplit(":", 1)
+        self.reader, self.writer = await asyncio.open_connection(host, int(port))
+        self._pump = asyncio.create_task(self._pump_replies())
+
+    async def _pump_replies(self) -> None:
+        try:
+            while True:
+                msg = await _read_frame(self.reader)
+                fut = self.pending.pop(msg.get("id"), None)
+                if fut is None or fut.done():
+                    continue
+                if msg["kind"] == "err":
+                    code, name = msg["body"]
+                    fut.set_exception(error.FDBError(code, name))
+                else:
+                    fut.set_result(msg["body"])
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # ANY pump death (decode error, oversized frame, socket loss)
+            # must fail the in-flight table and drop the connection, or the
+            # peer wedges: requests keep writing to a socket nobody reads
+            self._fail_all()
+
+    def _fail_all(self) -> None:
+        """Tear down the connection: fail waiters, close the socket, stop
+        the pump (unless we ARE the pump, which is exiting anyway)."""
+        for fut in self.pending.values():
+            if not fut.done():
+                fut.set_exception(error.connection_failed("peer connection lost"))
+        self.pending.clear()
+        if self.writer is not None:
+            self.writer.close()
+        self.reader = self.writer = None
+        pump = self._pump
+        if pump is not None and pump is not asyncio.current_task():
+            pump.cancel()
+        self._pump = None
+
+    def close(self) -> None:
+        self._fail_all()
+
+
+class RealProcess:
+    """The listener half: a handler registry bound to a TCP port
+    (workerServer's mailbox). `address` is "host:port"."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self.handlers: Dict[str, Callable] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: set = set()
+        #: strong refs — the loop keeps only weak ones, and a collected
+        #: handler task means a silently dropped reply
+        self._tasks: set = set()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def register(self, token: str, handler: Callable) -> None:
+        self.handlers[token] = handler
+
+    def unregister(self, token: str) -> None:
+        self.handlers.pop(token, None)
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._serve, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # drop live connections too: wait_closed() blocks on them
+            for w in list(self._conns):
+                w.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        self._conns.add(writer)
+        try:
+            while True:
+                msg = await _read_frame(reader)
+                if msg["kind"] == "oneway":
+                    handler = self.handlers.get(msg["token"])
+                    if handler is not None:
+                        self._track(asyncio.create_task(
+                            self._run_oneway(handler, msg["body"])))
+                    continue
+                self._track(asyncio.create_task(self._answer(writer, msg)))
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            self._conns.discard(writer)
+            writer.close()
+
+    def _track(self, task: asyncio.Task) -> None:
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def _run_oneway(self, handler, body) -> None:
+        try:
+            await handler(body)
+        except Exception:
+            pass
+
+    async def _answer(self, writer: asyncio.StreamWriter, msg) -> None:
+        handler = self.handlers.get(msg["token"])
+        try:
+            if handler is None:
+                raise error.FDBError(error.request_maybe_delivered("").code,
+                                     "request_maybe_delivered")
+            body = await handler(msg["body"])
+            reply = {"kind": "reply", "id": msg["id"], "body": body}
+        except error.FDBError as e:
+            reply = {"kind": "err", "id": msg["id"], "body": (e.code, e.name)}
+        except Exception:
+            reply = {"kind": "err", "id": msg["id"],
+                     "body": (error.internal_error("").code, "internal_error")}
+        try:
+            _write_frame(writer, reply)
+            await writer.drain()
+        except (ConnectionError, OSError):
+            pass
+
+
+class RealNetwork:
+    """The sender half: the sim network's request/one_way surface over
+    real sockets. One instance per OS process; peers cached per address."""
+
+    def __init__(self):
+        self._peers: Dict[str, _Peer] = {}
+        self._next_id = 0
+
+    async def _peer(self, addr: str) -> _Peer:
+        p = self._peers.get(addr)
+        if p is None:
+            p = self._peers[addr] = _Peer(addr)
+        async with p.lock:
+            if p.writer is None:
+                try:
+                    await p.connect()
+                except (ConnectionError, OSError) as e:
+                    raise error.connection_failed(str(e))
+        return p
+
+    async def request(self, src: str, ep: Endpoint, payload: Any,
+                      priority: int = 0, timeout: float = 5.0) -> Any:
+        p = await self._peer(ep.address)
+        self._next_id += 1
+        rid = self._next_id
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        p.pending[rid] = fut
+        try:
+            _write_frame(p.writer, {"kind": "req", "id": rid,
+                                    "token": ep.token, "body": payload})
+            await p.writer.drain()
+        except (ConnectionError, OSError) as e:
+            p.pending.pop(rid, None)
+            p._fail_all()
+            raise error.connection_failed(str(e))
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            p.pending.pop(rid, None)
+            raise error.request_maybe_delivered("request timed out")
+
+    async def one_way(self, src: str, ep: Endpoint, payload: Any,
+                      priority: int = 0) -> None:
+        try:
+            p = await self._peer(ep.address)
+            _write_frame(p.writer, {"kind": "oneway", "id": 0,
+                                    "token": ep.token, "body": payload})
+            await p.writer.drain()
+        except (error.FDBError, ConnectionError, OSError):
+            pass   # unreliable by contract
+
+    def close(self) -> None:
+        for p in self._peers.values():
+            p.close()
+        self._peers.clear()

@@ -16,17 +16,21 @@ from .workload import Spec
 from .workloads import (
     AtomicOpsWorkload,
     BackupCorrectnessWorkload,
+    BulkLoadWorkload,
     ConflictRangeWorkload,
     ConsistencyCheckWorkload,
     CycleWorkload,
     FuzzApiCorrectnessWorkload,
     IncrementWorkload,
+    InventoryWorkload,
     MachineAttritionWorkload,
+    QueuePushWorkload,
     RandomCloggingWorkload,
     RandomMoveKeysWorkload,
     RandomReadWriteWorkload,
     SelectorCorrectnessWorkload,
     SerializabilityWorkload,
+    ThroughputWorkload,
     VersionStampWorkload,
     WatchesWorkload,
     WriteDuringReadWorkload,
@@ -240,6 +244,29 @@ SPECS: Dict[str, Callable[[], Spec]] = {
         ],
         cluster=ClusterConfig(n_resolvers=2, n_storage=2),
         client_count=4,
+    ),
+    # Inventory + QueuePush + clogging: conditional RMWs and contended
+    # versionstamped appends under transport loss
+    "InventoryQueue": lambda: Spec(
+        title="InventoryQueue",
+        workloads=[
+            (InventoryWorkload, {"ops": 12}),
+            (QueuePushWorkload, {"pushes": 10}),
+            (RandomCloggingWorkload, {"scale": 0.02}),
+            (ConsistencyCheckWorkload, {}),
+        ],
+        cluster=ClusterConfig(n_resolvers=2, n_storage=2, storage_replication=2),
+        client_count=3,
+    ),
+    # sustained sequential loading + a timed 90/10 measurement pass
+    "BulkLoadThroughput": lambda: Spec(
+        title="BulkLoadThroughput",
+        workloads=[
+            (BulkLoadWorkload, {"batches": 5, "batch_size": 40}),
+            (ThroughputWorkload, {"seconds": 4.0}),
+        ],
+        cluster=ClusterConfig(n_resolvers=2, n_storage=4),
+        client_count=3,
     ),
     "IncrementTest": lambda: Spec(
         title="IncrementTest",

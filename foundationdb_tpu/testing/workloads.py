@@ -1046,3 +1046,167 @@ class BackupCorrectnessWorkload(TestWorkload):
             self.ctx.count("restore_mismatch")
             return False
         return True
+
+
+class InventoryWorkload(TestWorkload):
+    """Conditional read-modify-writes over per-item stock counters
+    (Inventory.actor.cpp): restock or (only if in stock) take one item.
+    The final physical stock must equal restocks minus takes — lost
+    updates or phantom takes break the equation."""
+
+    name = "Inventory"
+
+    async def start(self, db: Database) -> None:
+        rng = self.ctx.rng
+        items = int(self.ctx.options.get("items", 6))
+        ops = int(self.ctx.options.get("ops", 15))
+        me = self.ctx.client_id
+        for op_i in range(ops):
+            item = b"inv/%02d" % rng.random_int(0, items)
+            want_take = rng.random01() < 0.45
+            # per-op marker: a maybe-committed retry must neither re-apply
+            # the RMW nor double-count (the marker read is also the
+            # conflict guard that serializes the retry against its twin)
+            marker = b"inv!/%02d/%04d" % (me, op_i)
+
+            async def body(tr):
+                prev = await tr.get(marker)
+                if prev is not None:
+                    return prev.decode()   # the earlier attempt landed
+                stock = int(await tr.get(item) or b"0")
+                if want_take and stock > 0:
+                    tr.set(item, str(stock - 1).encode())
+                    action = "take"
+                else:
+                    tr.set(item, str(stock + 1).encode())
+                    action = "restock"
+                tr.set(marker, action.encode())
+                return action
+
+            what = await db.run(body)
+            self.ctx.count("takes" if what == "take" else "restocks")
+
+    async def check(self, db: Database) -> bool:
+        async def read_all(tr):
+            return await tr.get_range(b"inv/", b"inv0")
+
+        rows = await db.run(read_all)
+        stock = sum(int(v) for _, v in rows)
+        if any(int(v) < 0 for _, v in rows):
+            return False
+        return stock == (self.ctx.shared.get("restocks", 0)
+                         - self.ctx.shared.get("takes", 0))
+
+
+class BulkLoadWorkload(TestWorkload):
+    """Sequential batch loading (BulkLoad.actor.cpp): each client commits
+    `batches` transactions of `batch_size` contiguous rows; every row must
+    land exactly once, and the sustained load rate is reported."""
+
+    name = "BulkLoad"
+
+    async def start(self, db: Database) -> None:
+        from ..sim.loop import now
+
+        me = self.ctx.client_id
+        batches = int(self.ctx.options.get("batches", 6))
+        size = int(self.ctx.options.get("batch_size", 40))
+        t0 = now()
+        for b in range(batches):
+            async def body(tr):
+                for i in range(size):
+                    tr.set(b"bulk/%02d/%04d" % (me, b * size + i), b"x" * 16)
+            await db.run(body)
+            self.ctx.count("rows_loaded", size)
+        dt = max(now() - t0, 1e-9)
+        # count() sums across clients (rates add: total cluster rate)
+        self.ctx.count("bulk_rows_per_sec", round(batches * size / dt, 1))
+
+    async def check(self, db: Database) -> bool:
+        me = self.ctx.client_id
+        batches = int(self.ctx.options.get("batches", 6))
+        size = int(self.ctx.options.get("batch_size", 40))
+
+        async def count(tr):
+            return len(await tr.get_range(b"bulk/%02d/" % me, b"bulk/%02d0" % me,
+                                          limit=100_000))
+
+        return await db.run(count) == batches * size
+
+
+class QueuePushWorkload(TestWorkload):
+    """Contended queue appends via versionstamped keys
+    (QueuePush.actor.cpp): pushes never conflict, land in commit order,
+    and the queue length equals the number of committed pushes."""
+
+    name = "QueuePush"
+
+    async def start(self, db: Database) -> None:
+        import struct
+
+        pushes = int(self.ctx.options.get("pushes", 12))
+        me = self.ctx.client_id
+        for i in range(pushes):
+            tr = db.create_transaction()
+            raw_key = b"queue/" + b"\x00" * 10 + struct.pack("<i", len(b"queue/"))
+            tr.atomic_op(raw_key, b"%02d:%04d" % (me, i),
+                         MutationType.SET_VERSIONSTAMPED_KEY)
+            try:
+                await tr.commit()
+                self.ctx.count("pushes")
+            except error.FDBError as e:
+                if not e.is_retryable() and not e.is_maybe_committed():
+                    raise
+                if e.is_maybe_committed():
+                    self.ctx.count("maybe_pushes")
+
+    async def check(self, db: Database) -> bool:
+        async def read_all(tr):
+            return await tr.get_range(b"queue/", b"queue0", limit=100_000)
+
+        rows = await db.run(read_all)
+        keys = [k for k, _ in rows]
+        if keys != sorted(keys):
+            return False
+        certain = self.ctx.shared.get("pushes", 0)
+        maybe = self.ctx.shared.get("maybe_pushes", 0)
+        if not (certain <= len(rows) <= certain + maybe):
+            return False
+        # commit order: each client's sequence numbers must be increasing
+        # along the versionstamped key order (QueuePush.actor.cpp's check)
+        last_seq: Dict[bytes, int] = {}
+        for _k, v in rows:
+            client, seq = v.split(b":")
+            if client in last_seq and int(seq) <= last_seq[client]:
+                return False
+            last_seq[client] = int(seq)
+        return True
+
+
+class ThroughputWorkload(TestWorkload):
+    """The timed 90/10 measurement loop (Throughput.actor.cpp): runs for
+    a fixed virtual duration and reports transactions/sec as a metric the
+    spec harness records."""
+
+    name = "Throughput"
+
+    async def start(self, db: Database) -> None:
+        from ..sim.loop import now
+
+        rng = self.ctx.rng
+        seconds = float(self.ctx.options.get("seconds", 5.0))
+        keys = int(self.ctx.options.get("keys", 128))
+        t0 = now()
+        done = 0
+        while now() - t0 < seconds:
+            async def body(tr):
+                for _ in range(9):
+                    await tr.get(b"tp/%04d" % rng.random_int(0, keys))
+                tr.set(b"tp/%04d" % rng.random_int(0, keys), b"v")
+            try:
+                await db.run(body)
+                done += 1
+            except error.FDBError:
+                pass
+        self.ctx.count("throughput_txns", done)
+        self.ctx.count("txns_per_sec", round(done / (now() - t0), 1))
