@@ -7,17 +7,18 @@
     hand-over version is exact). A log-mover actor peeks the tag, writes
     `log/<version>` objects to the container and pops as it goes.
   * snapshot(): TaskBucket tasks, one per key chunk, executed by N agent
-    workers — each reads its chunk at ONE shared read version and writes a
-    `range/<n>` object. Exactly-once chunk execution comes from the task
-    bucket's transactional claims.
+    workers — each chunk reads at its own fresh version and writes a
+    `range/<n>` object carrying it (the reference's versioned range
+    files). Exactly-once chunk execution comes from the task bucket's
+    transactional claims.
   * finish_backup(): picks the end version, waits for the log mover to
     pass it, writes the manifest, clears the active flag and retires the
-    tag. Restorable = snapshot done AND logs cover (snapshot_version,
-    end_version].
-  * restore(): loads every range object (values at snapshot_version),
-    then replays log mutations with snapshot_version < v <= end_version
-    in version order — atomic ops replay as atomic ops, so the restored
-    state equals the source state at end_version exactly.
+    tag. Restorable = snapshot done AND logs cover every chunk version
+    through end_version (tagging started before any chunk read).
+  * restore(): loads every range object, then replays log mutations in
+    version order clipped per range to versions AFTER that range's chunk
+    version — atomic ops replay as atomic ops exactly once, so the
+    restored state equals the source state at end_version exactly.
 """
 from __future__ import annotations
 
@@ -130,18 +131,24 @@ class BackupAgent:
                 await delay(0.25)
 
     async def snapshot(self, chunks: int = 8, workers: int = 3) -> None:
-        """Range snapshot at one read version via TaskBucket chunk tasks."""
+        """Range snapshot via TaskBucket chunk tasks. Each chunk reads at
+        its OWN fresh version (the reference's range files each carry a
+        version, design/backup.md): a chunk needs only its own reads to
+        fit the MVCC window, however slow task claiming is. restore()
+        replays log mutations per range from that range's chunk version,
+        which keeps atomic ops exactly-once."""
         bucket = TaskBucket(Subspace((b"backup-tasks",)), timeout_seconds=20.0)
-        tr = self.db.create_transaction()
-        vs = await tr.get_read_version()
-        self.snapshot_version = vs
-
         bounds = [b""] + [bytes([(256 * i) // chunks]) for i in range(1, chunks)] + [USER_END]
 
         async def add_tasks(tr2):
+            lo, hi = bucket.avail.range()
+            tr2.clear_range(lo, hi)
+            lo, hi = bucket.timeouts.range()
+            tr2.clear_range(lo, hi)
             for i in range(chunks):
                 bucket.add(tr2, i, {b"begin": bounds[i], b"end": bounds[i + 1]})
         await self.db.run(add_tasks)
+        versions: List[int] = []
 
         async def worker(wid: int):
             while True:
@@ -151,19 +158,35 @@ class BackupAgent:
                     if task is None:
                         if await bucket.is_empty(tr2):
                             return
-                        await delay(0.5)   # only claimed tasks remain
+                        # only claimed tasks remain; resurface expired
+                        # claims (a maybe-committed claim whose worker
+                        # moved on would otherwise strand the task and
+                        # busy-wait every worker here forever)
+                        await bucket.check_timeouts(tr2)
+                        await tr2.commit()
+                        await delay(0.5)
                         continue
                     await tr2.commit()
                 except error.FDBError as e:
                     if e.is_retryable() or e.is_maybe_committed():
                         continue
                     raise
-                rows = await self._read_chunk(task.params[b"begin"],
-                                              task.params[b"end"], vs)
+                while True:
+                    vtr = self.db.create_transaction()
+                    vc = await vtr.get_read_version()
+                    try:
+                        rows = await self._read_chunk(task.params[b"begin"],
+                                                      task.params[b"end"], vc)
+                        break
+                    except error.FDBError as e:
+                        if e.code != error.transaction_too_old("").code:
+                            raise
+                        # chunk outlived the window: fresh version, re-read
                 await self._put("range/%04d" % task.id, wire.dumps({
                     "begin": task.params[b"begin"], "end": task.params[b"end"],
-                    "version": vs, "rows": rows,
+                    "version": vc, "rows": rows,
                 }))
+                versions.append(vc)
 
                 async def done(tr3):
                     bucket.finish(tr3, task)
@@ -173,6 +196,7 @@ class BackupAgent:
             spawn(worker(w), TaskPriority.DEFAULT_ENDPOINT, name=f"backupSnap{w}")
             for w in range(workers)
         ])
+        self.snapshot_version = min(versions) if versions else self.start_version
 
     async def _read_chunk(self, begin: bytes, end: bytes, version: int):
         rows: List[Tuple[bytes, bytes]] = []
@@ -212,12 +236,17 @@ class BackupAgent:
     # -- restore -------------------------------------------------------------
     async def restore(self, dest: Database) -> int:
         """Restore the backup into `dest` (an empty keyspace). Returns the
-        restored end version."""
+        restored end version. Log mutations replay per range from that
+        range's chunk version — a mutation already reflected in a chunk's
+        snapshot (v <= chunk version) is never applied twice, which is
+        what keeps atomic ops exact."""
         manifest = wire.loads(await self._get("manifest"))
-        vs, vend = manifest["snapshot_version"], manifest["end_version"]
+        vend = manifest["end_version"]
 
+        ranges: List[Tuple[bytes, bytes, int]] = []
         for name in await self._list("range/"):
             chunk = wire.loads(await self._get(name))
+            ranges.append((chunk["begin"], chunk["end"], chunk["version"]))
             rows = chunk["rows"]
             for i in range(0, len(rows), 200):
                 batch = rows[i:i + 200]
@@ -226,14 +255,31 @@ class BackupAgent:
                     for k, v in batch:
                         tr.set(k, v)
                 await dest.run(put_batch)
+        ranges.sort()
+
+        def clip(m: Mutation) -> List[Tuple[int, Mutation]]:
+            """(chunk_version, clipped mutation) parts of m per range."""
+            out = []
+            if m.type == MutationType.CLEAR_RANGE:
+                for b, e, vc in ranges:
+                    cb, ce = max(m.param1, b), min(m.param2, e)
+                    if cb < ce:
+                        out.append((vc, Mutation(m.type, cb, ce)))
+            else:
+                for b, e, vc in ranges:
+                    if b <= m.param1 < e:
+                        out.append((vc, m))
+                        break
+            return out
 
         for name in await self._list("log/"):
             entries = wire.loads(await self._get(name))
             for v, muts in entries:
-                if v <= vs or v > vend:
+                if v > vend:
                     continue
-                for i in range(0, len(muts), 200):
-                    batch = muts[i:i + 200]
+                todo = [cm for m in muts for (vc, cm) in clip(m) if v > vc]
+                for i in range(0, len(todo), 200):
+                    batch = todo[i:i + 200]
 
                     async def apply_batch(tr):
                         for m in batch:

@@ -194,6 +194,10 @@ class Database:
         self._locations = []
 
     async def get_locations(self, begin: Key, end: Key) -> List[Tuple[KeyRange, List[str]]]:
+        from ..core import buggify
+
+        if buggify.buggify():
+            self.invalidate_cache()   # spontaneous cache loss (sim only)
         covered = self._cached_locations(begin, end)
         if covered is not None:
             return covered

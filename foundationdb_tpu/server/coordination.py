@@ -23,7 +23,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..core import error, wire
+from ..core import buggify, error, wire
 from ..sim.loop import Promise, TaskPriority, delay, now, spawn
 from ..sim.network import Endpoint, SimProcess
 
@@ -250,6 +250,9 @@ class CoordinationServer:
         previously synced promises)."""
         if self.disk is None:
             return
+        if buggify.buggify():
+            # stretch the window between answering and persisting races
+            await delay(0.05, TaskPriority.COORDINATION)
         async with self._persist_mutex:
             payload = wire.dumps({
                 k: (r.read_gen, r.write_gen, r.value) for k, r in self.regs.items()

@@ -36,7 +36,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
-from ..core import error
+from ..core import buggify, error
 from ..core.types import Mutation, Version
 from ..sim.actors import all_of
 from ..sim.loop import Future, TaskPriority
@@ -165,12 +165,15 @@ class LogSystemClient:
         ])
         # Every replica is durable at `version`: advance the peek horizon.
         # Unreliable one-ways — the next push carries the same KCV anyway.
-        for rep in self.config.tlogs:
-            self.net.one_way(
-                self.src, self.config.ep(rep, "kcv"),
-                TLogKnownCommittedRequest(version=version),
-                TaskPriority.TLOG_COMMIT,
-            )
+        # BUGGIFY: drop them entirely; peeks must survive on the belt
+        # (drain re-advertising / subsequent pushes).
+        if not buggify.buggify():
+            for rep in self.config.tlogs:
+                self.net.one_way(
+                    self.src, self.config.ep(rep, "kcv"),
+                    TLogKnownCommittedRequest(version=version),
+                    TaskPriority.TLOG_COMMIT,
+                )
         return version
 
     async def peek(self, tag: int, begin_version: Version, timeout: float = 5.0) -> TLogPeekReply:

@@ -33,7 +33,7 @@ from __future__ import annotations
 from dataclasses import dataclass, replace
 from typing import Dict, List, Optional, Tuple
 
-from ..core import error
+from ..core import buggify, error
 from ..core.trace import TraceEvent
 from ..ops.host_engine import KeyShardMap
 from ..sim.actors import all_of, any_of
@@ -257,6 +257,10 @@ class MasterServer:
         else:
             recovery_version = 1
             first_jump = 0
+        if buggify.buggify():
+            # stretch LOCKING->RECRUITING: competing masters and worker
+            # failures race the recruitment window harder
+            await delay(0.5, TaskPriority.CLUSTER_CONTROLLER)
         self._state("recruiting", RecoveryVersion=recovery_version)
 
         # -- RECRUITING ------------------------------------------------------
@@ -450,6 +454,10 @@ class MasterServer:
         ])
 
         # -- WRITING_CSTATE: the durable hand-over ---------------------------
+        if buggify.buggify():
+            # a slow hand-over widens the window where the old generation
+            # is locked but the new one is not yet authoritative
+            await delay(0.5, TaskPriority.CLUSTER_CONTROLLER)
         self._state("writing_cstate")
         cstate_val = DBCoreState(
             recovery_count=rc,

@@ -477,6 +477,9 @@ class Proxy:
         next carrier would otherwise be the very push this drain gates)."""
         attempts = 0
         while self._metadata_version < upto and not self._dead:
+            if buggify.buggify():
+                # stall the drain: later batches pile up behind phase 3.5
+                await delay(0.05, TaskPriority.PROXY_COMMIT)
             try:
                 reply = await self.log.peek(
                     METADATA_TAG, self._metadata_version + 1, timeout=1.0)

@@ -230,6 +230,9 @@ class StorageServer:
         while cb < ce:
             reply = None
             last: Optional[error.FDBError] = None
+            if buggify.buggify():
+                # fetchKeys pauses mid-copy: the tag stream must buffer
+                await delay(0.25, TaskPriority.FETCH_KEYS)
             for i in range(len(addrs) * 3):
                 addr = addrs[i % len(addrs)]
                 try:

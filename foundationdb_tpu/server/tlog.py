@@ -340,6 +340,8 @@ class TLog:
         the known-committed horizon so nothing recovery could discard is
         ever served (blocks until the horizon passes begin_version)."""
         await self.known_committed.when_at_least(req.begin_version)
+        if buggify.buggify():
+            await delay(0.05, TaskPriority.TLOG_PEEK)  # slow peek service
         data = self.tag_data.get(req.tag, [])
         horizon = min(self.version.get(), self.known_committed.get())
         msgs = [(v, m) for (v, m) in data if req.begin_version <= v <= horizon]
