@@ -55,6 +55,9 @@ class DBCoreState:
     #: resolver key-shard split keys chosen by resolutionBalancing; empty =
     #: uniform splits (masterserver.actor.cpp:919-977)
     resolver_splits: tuple = ()
+    #: worker addresses excluded from hosting storage (ManagementAPI's
+    #: \xff/conf/excluded analog — persisted so recoveries keep them)
+    excluded: tuple = ()
 
 
 class CoordinatedState:

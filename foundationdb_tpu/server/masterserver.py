@@ -84,6 +84,19 @@ class MoveShardRequest:
     dest_workers: List[str]
 
 
+EXCLUDE_TOKEN = "master.exclude"
+
+
+@dataclass
+class ExcludeServersRequest:
+    """ManagementAPI excludeServers (ManagementAPI.actor.cpp): drain every
+    shard replica off `addresses` by moving affected shards to spare
+    workers; with exclude=False, re-admit the addresses as move targets."""
+
+    addresses: List[str]
+    exclude: bool = True
+
+
 class MasterServer:
     def __init__(self, worker, req):
         self.worker = worker
@@ -144,6 +157,8 @@ class MasterServer:
         busy_addrs = {a for (_t, _b, _e, a) in tags}
         if any(d in busy_addrs for d in dests):
             raise error.client_invalid_operation("dest already hosts storage")
+        if any(d in dd["excluded"] for d in dests):
+            raise error.client_invalid_operation("dest is excluded")
         next_tag = max(t for (t, _b, _e, _a) in tags) + 1
         new_team = [(next_tag + i, d) for i, d in enumerate(dests)]
         TraceEvent("MoveShardStart", id=self.salt).detail(
@@ -464,6 +479,7 @@ class MasterServer:
             generations=(LogGenerationInfo(config=new_log, end_version=None),),
             storage_tags=storage_tags,
             resolver_splits=used_splits,  # balanced splits survive epochs
+            excluded=prev.excluded,       # exclusions survive epochs too
         )
         await cstate.set_exclusive(cstate_val)
 
@@ -566,7 +582,60 @@ class MasterServer:
             finally:
                 dd["busy"] = False
 
+        dd["excluded"] = set(cstate_val.excluded)
+        exclude_token = EXCLUDE_TOKEN + suffix
+
+        async def persist_excluded():
+            dd["cstate_val"] = replace(dd["cstate_val"],
+                                       excluded=tuple(sorted(dd["excluded"])))
+            await cstate.set_exclusive(dd["cstate_val"])
+
+        async def exclude_servers(req: ExcludeServersRequest):
+            """Drain shards off the excluded addresses, one move at a time
+            (ManagementAPI excludeServers + DD's trackExcludedServers)."""
+            await dd["init_done"].future
+            if not req.exclude:
+                dd["excluded"] -= set(req.addresses)
+                await persist_excluded()
+                return {"excluded": sorted(dd["excluded"])}
+            dd["excluded"] |= set(req.addresses)
+            await persist_excluded()
+            moved = []
+            while True:
+                tags = dd["storage_tags"]
+                victim = next(
+                    ((t, b, e, a) for (t, b, e, a) in tags
+                     if a in dd["excluded"]), None)
+                if victim is None:
+                    break
+                _t, begin, _e, _a = victim
+                team = sorted((t, a) for (t, b2, _e2, a) in tags if b2 == begin)
+                hosts = {a for (_t2, _b2, _e2, a) in tags}
+                spares = sorted(
+                    w for w in self.workers
+                    if not self.net.monitor.is_failed(w)
+                    and w not in hosts and w not in dd["excluded"]
+                )
+                if len(spares) < len(team):
+                    raise error.recruitment_failed(
+                        "not enough non-excluded spare workers to drain onto")
+                # v0 moves are whole-team: when any member is excluded the
+                # whole team relocates onto spares
+                dests = spares[:len(team)]
+                if dd["busy"]:
+                    raise error.client_invalid_operation("a shard move is already running")
+                dd["busy"] = True
+                try:
+                    await self._move_shard(
+                        MoveShardRequest(begin=begin, dest_workers=dests),
+                        dd, dd_db, log_client, cstate, ratekeeper)
+                finally:
+                    dd["busy"] = False
+                moved.append(begin)
+            return {"excluded": sorted(dd["excluded"]), "moved": moved}
+
         self.proc.register(move_token, move_shard)
+        self.proc.register(exclude_token, exclude_servers)
         dd_task = spawn(dd_init(), TaskPriority.MOVE_KEYS, name=f"ddInit:{self.salt}")
         self.proc.actors.add(dd_task)
         dd_gc_task = spawn(dd_metadata_gc(), TaskPriority.MOVE_KEYS,
@@ -670,6 +739,7 @@ class MasterServer:
             self.proc.unregister(rate_token)
             self.proc.unregister(status_token)
             self.proc.unregister(move_token)
+            self.proc.unregister(exclude_token)
         self.master.unregister()
         if which == 0:
             # Deliberate epoch bounce: the successor recruits resolvers on

@@ -29,6 +29,8 @@ commands:
   getrange BEGIN END [LIMIT]   read a range
   shards               shard map + replica teams (from \\xff/keyServers)
   move BEGIN WORKER [WORKER...]  move the shard at BEGIN to new workers
+  exclude ADDR [ADDR...]         drain all shard replicas off workers
+  include ADDR [ADDR...]         re-admit excluded workers
   help                 this text
   exit                 quit
 Keys/values are text; prefix with 0x for hex bytes."""
@@ -165,17 +167,21 @@ class Cli:
             moving = f"  (moving: +tags {list(extra)})" if extra else ""
             self._print(f"  [{label} ...) -> {dests}{moving}")
 
+    def _find_master_ep(self, token_prefix: str):
+        from ..sim.network import Endpoint
+
+        for p in self.cluster.worker_procs:
+            for tok in p.handlers:
+                if tok.startswith(token_prefix):
+                    return Endpoint(p.address, tok)
+        return None
+
     def do_move(self, args: List[str]) -> None:
         from ..server.masterserver import MOVE_SHARD_TOKEN, MoveShardRequest
         from ..sim.loop import TaskPriority
-        from ..sim.network import Endpoint
 
         begin, dests = _arg_bytes(args[0]) if args[0] != "''" else b"", args[1:]
-        ep = None
-        for p in self.cluster.worker_procs:
-            for tok in p.handlers:
-                if tok.startswith(MOVE_SHARD_TOKEN):
-                    ep = Endpoint(p.address, tok)
+        ep = self._find_master_ep(MOVE_SHARD_TOKEN)
         if ep is None:
             self._print("no master reachable")
             return
@@ -190,6 +196,35 @@ class Cli:
         reply = self._drive(go(), timeout=240.0)
         self._print(f"moved shard at {_fmt(begin) if begin else chr(39)*2}: "
                     f"new team {reply['team']}")
+
+    def _exclude_cmd(self, addrs: List[str], exclude: bool) -> None:
+        from ..server.masterserver import EXCLUDE_TOKEN, ExcludeServersRequest
+        from ..sim.loop import TaskPriority
+
+        if not addrs:
+            raise ValueError("need at least one address")
+        ep = self._find_master_ep(EXCLUDE_TOKEN)
+        if ep is None:
+            self._print("no master reachable")
+            return
+
+        async def go():
+            return await self.sim.net.request(
+                self.db.client_addr, ep,
+                ExcludeServersRequest(addresses=list(addrs), exclude=exclude),
+                TaskPriority.MOVE_KEYS, timeout=240.0,
+            )
+
+        reply = self._drive(go(), timeout=480.0)
+        verb = "excluded" if exclude else "included"
+        self._print(f"{verb}: now excluding {reply['excluded'] or 'nothing'}"
+                    + (f"; moved shards {reply['moved']}" if reply.get("moved") else ""))
+
+    def do_exclude(self, args: List[str]) -> None:
+        self._exclude_cmd(args, exclude=True)
+
+    def do_include(self, args: List[str]) -> None:
+        self._exclude_cmd(args, exclude=False)
 
     # -- loop -----------------------------------------------------------------
     def run_command(self, line: str) -> bool:

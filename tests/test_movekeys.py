@@ -186,3 +186,56 @@ def test_move_rejects_bad_requests():
     got = sim.run_until(sim.sched.spawn(scenario(), name="s"), until=240.0)
     assert got.get("bad_begin") == "client_invalid_operation"
     assert got.get("busy_dest") == "client_invalid_operation"
+
+
+def test_exclude_drains_worker():
+    """ManagementAPI exclude: every shard replica leaves the excluded
+    worker, data stays exact, and include re-admits it."""
+    from foundationdb_tpu.server.masterserver import EXCLUDE_TOKEN, ExcludeServersRequest
+
+    c = boot(seed=79, n_workers=10)
+    sim = c.sim
+    db = c.new_client()
+
+    async def scenario():
+        async def w(tr):
+            for i in range(20):
+                tr.set(b"x%03d" % i, b"v%d" % i)
+        await db.run(w)
+
+        ep = None
+        for _ in range(100):
+            for p in c.worker_procs:
+                for tok in p.handlers:
+                    if tok.startswith(EXCLUDE_TOKEN):
+                        ep = Endpoint(p.address, tok)
+            if ep is not None:
+                break
+            await delay(0.5)
+        assert ep is not None
+        victim = sorted(_storage_addrs(c))[0]
+        reply = await sim.net.request(
+            db.client_addr, ep,
+            ExcludeServersRequest(addresses=[victim]),
+            TaskPriority.MOVE_KEYS, timeout=240.0,
+        )
+        assert victim in reply["excluded"] and reply["moved"]
+
+        async def r(tr):
+            return [await tr.get(b"x%03d" % i) for i in range(20)]
+        got = await db.run(r)
+        assert got == [b"v%d" % i for i in range(20)]
+
+        # the victim hosts no storage anymore
+        await delay(2.0)
+        assert victim not in _storage_addrs(c)
+
+        reply2 = await sim.net.request(
+            db.client_addr, ep,
+            ExcludeServersRequest(addresses=[victim], exclude=False),
+            TaskPriority.MOVE_KEYS, timeout=60.0,
+        )
+        assert victim not in reply2["excluded"]
+        return True
+
+    assert sim.run_until(sim.sched.spawn(scenario(), name="s"), until=900.0)
