@@ -237,23 +237,70 @@ class Database:
     # -- replica load balancing ---------------------------------------------
     async def storage_request(self, addrs: List[str], token: str, req,
                               priority: int = TaskPriority.DEFAULT_ENDPOINT,
-                              timeout: float = 0.0):
-        """loadBalance (fdbrpc/LoadBalance.actor.h:158) reduced to
-        rotate-and-failover: reads spread across a shard's replica team and
-        fail over to the next member on transport loss. Reads are
-        idempotent, so a maybe-delivered first attempt is safely reissued.
-        Non-transport errors (wrong_shard, future_version, ...) surface
-        immediately — they come from a live replica and would repeat."""
+                              timeout: float = 0.0, hedge: bool = True):
+        """loadBalance (fdbrpc/LoadBalance.actor.h:158): reads spread
+        across a shard's replica team, fail over on transport loss, and
+        HEDGE — when the preferred replica is slow (read_hedge_delay), a
+        second request races it on the next replica and the first answer
+        wins (the reference's second-request machinery, :413). Reads are
+        idempotent, so duplicates are safe. Non-transport errors
+        (wrong_shard, future_version, ...) surface immediately — they come
+        from a live replica and would repeat."""
         self._lb_counter += 1
         start = self._lb_counter % len(addrs)
+        to = timeout or REQUEST_TIMEOUT
+
+        def send(i: int):
+            return self.net.request(
+                self.client_addr,
+                Endpoint(addrs[(start + i) % len(addrs)], token), req,
+                priority, timeout=to,
+            )
+
+        if hedge and len(addrs) > 1:
+            from ..sim.actors import any_of
+
+            first = send(0)
+            which, _ = await any_of(
+                [_swallow(first), delay(CLIENT_KNOBS.read_hedge_delay, priority)]
+            )
+            if which == 0 and not first.is_error:
+                return first.get()
+            if which == 0:
+                # fast failure: fall through to plain failover on the rest
+                try:
+                    first.get()
+                except error.FDBError as e:
+                    if e.code not in (_MAYBE_DELIVERED, _CONNECTION_FAILED):
+                        raise
+                start += 1
+            else:
+                # slow replica: race a hedge on the next one
+                second = send(1)
+                got = await any_of([_swallow(first), _swallow(second)])
+                winner = (first, second)[got[0]]
+                other = (second, first)[got[0]]
+                if not winner.is_error:
+                    return winner.get()
+                try:
+                    winner.get()
+                except error.FDBError as e:
+                    if e.code not in (_MAYBE_DELIVERED, _CONNECTION_FAILED):
+                        raise
+                await _swallow(other)
+                if not other.is_error:
+                    return other.get()
+                try:
+                    other.get()
+                except error.FDBError as e:
+                    if e.code not in (_MAYBE_DELIVERED, _CONNECTION_FAILED):
+                        raise
+                start += 2
+
         last: Optional[error.FDBError] = None
         for i in range(len(addrs)):
-            addr = addrs[(start + i) % len(addrs)]
             try:
-                return await self.net.request(
-                    self.client_addr, Endpoint(addr, token), req,
-                    priority, timeout=timeout or REQUEST_TIMEOUT,
-                )
+                return await send(i)
             except error.FDBError as e:
                 if e.code in (_MAYBE_DELIVERED, _CONNECTION_FAILED):
                     last = e

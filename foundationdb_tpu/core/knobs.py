@@ -93,6 +93,12 @@ def _make_client_knobs() -> Knobs:
     k.init("backoff_growth_rate", 2.0)
     k.init("grv_batch_size_max", 1024)
     k.init("location_cache_size", 100_000)
+    #: hedged reads (LoadBalance.actor.h second requests): after this long
+    #: with no reply, race a second replica
+    k.init("read_hedge_delay", 0.05, lambda r: 0.005 + r.random01() * 0.1)
+    #: sampled transactions carry a debug id traced through the commit
+    #: pipeline (g_traceBatch probes); 0 disables
+    k.init("commit_sample_rate", 0.01, lambda r: r.random01() * 0.5)
     return k
 
 
