@@ -258,11 +258,11 @@ class Database:
             )
 
         if hedge and len(addrs) > 1:
-            from ..sim.actors import any_of
+            from ..sim.actors import any_of, ready_or_error
 
             first = send(0)
             which, _ = await any_of(
-                [_swallow(first), delay(CLIENT_KNOBS.read_hedge_delay, priority)]
+                [ready_or_error(first), delay(CLIENT_KNOBS.read_hedge_delay, priority)]
             )
             if which == 0 and not first.is_error:
                 return first.get()
@@ -277,7 +277,7 @@ class Database:
             else:
                 # slow replica: race a hedge on the next one
                 second = send(1)
-                got = await any_of([_swallow(first), _swallow(second)])
+                got = await any_of([ready_or_error(first), ready_or_error(second)])
                 winner = (first, second)[got[0]]
                 other = (second, first)[got[0]]
                 if not winner.is_error:
@@ -287,7 +287,7 @@ class Database:
                 except error.FDBError as e:
                     if e.code not in (_MAYBE_DELIVERED, _CONNECTION_FAILED):
                         raise
-                await _swallow(other)
+                await ready_or_error(other)
                 if not other.is_error:
                     return other.get()
                 try:
