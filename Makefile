@@ -1,0 +1,9 @@
+# Gate before every commit/snapshot: the deterministic-sim methodology is
+# the product — a red suite must never ship (round-3 lesson).
+check:
+	python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+.PHONY: check bench

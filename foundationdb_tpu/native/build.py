@@ -118,7 +118,20 @@ def load_fastpack() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p, ctypes.c_int64,
             u32p, i32p, u32p, i32p, i64p,
         ]
+        lib.conflict_counts_sharded.restype = ctypes.c_int
+        lib.conflict_counts_sharded.argtypes = [
+            ctypes.c_char_p, i64p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_char_p, i64p, ctypes.c_int64, i32p, i32p,
+        ]
+        lib.build_point_rows_sharded.restype = None
+        lib.build_point_rows_sharded.argtypes = [
+            ctypes.c_char_p, i64p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_char_p, i64p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64,
+            u32p, i32p, u32p, i32p, i64p,
+        ]
         _lib = lib
-    except OSError:
+    except (OSError, AttributeError):
         _lib = None
     return _lib

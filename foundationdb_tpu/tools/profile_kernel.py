@@ -1,0 +1,487 @@
+"""Per-phase device timing for the conflict kernel at the bench shape.
+
+The tunneled dev chip has ~100ms dispatch RTT, so each candidate piece is
+timed as a lax.scan of STEPS iterations inside ONE compiled program; the
+per-iteration figure amortizes the link away. Each body folds a checksum of
+its outputs into the carry so XLA cannot DCE or hoist the work; the batch
+index varies per iteration so nothing is loop-invariant.
+
+Usage: python -m foundationdb_tpu.tools.profile_kernel [variant ...]
+Variants: full phases12 sort fixpoint apply binsearch
+"""
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops import conflict_kernel as ck
+
+CFG = ck.KernelConfig(
+    key_words=4, capacity=24576,
+    max_point_reads=8192, max_point_writes=8192,
+    max_reads=256, max_writes=256, max_txns=4096,
+)
+READS_PER_TXN = 2
+WRITES_PER_TXN = 2
+POOL = 8192
+NB = 8
+STEPS = 256
+VPB = CFG.max_txns
+GC_LAG = 4
+
+
+def synth(rng):
+    K = CFG.lanes
+    Rp, Wp, T = CFG.rp, CFG.wp, CFG.max_txns
+    Rr, Wr = CFG.max_reads, CFG.max_writes
+    pool = np.zeros((POOL, K), np.uint32)
+    pool[:, :4] = rng.integers(0, 2**32, size=(POOL, 4), dtype=np.uint32)
+    pool[:, K - 1] = 16
+    pool = pool[np.lexsort([pool[:, c] for c in range(K - 1, -1, -1)])]
+    batches = []
+    for _ in range(NB):
+        r_idx = rng.integers(0, POOL, size=Rp)
+        w_idx = rng.integers(0, POOL, size=Wp)
+        batches.append({
+            "rpb": pool[r_idx].copy(),
+            "rp_txn": np.repeat(np.arange(T, dtype=np.int32), READS_PER_TXN),
+            "rp_valid": np.ones((Rp,), bool),
+            "rb": np.zeros((Rr, K), np.uint32),
+            "re": np.zeros((Rr, K), np.uint32),
+            "r_snap": np.zeros((Rr,), np.int32),
+            "r_txn": np.zeros((Rr,), np.int32),
+            "r_valid": np.zeros((Rr,), bool),
+            "wpb": pool[w_idx].copy(),
+            "wp_txn": np.repeat(np.arange(T, dtype=np.int32), WRITES_PER_TXN),
+            "wp_valid": np.ones((Wp,), bool),
+            "wb": np.zeros((Wr, K), np.uint32),
+            "we": np.zeros((Wr, K), np.uint32),
+            "w_txn": np.zeros((Wr,), np.int32),
+            "w_valid": np.zeros((Wr,), bool),
+            "t_ok": np.ones((T,), bool),
+            "t_too_old": np.zeros((T,), bool),
+        })
+    return jax.device_put(jax.tree.map(lambda *xs: np.stack(xs), *batches))
+
+
+def versioned(batch, now):
+    snap = jnp.maximum(now - VPB // 2, 0)
+    gc = jnp.maximum(now - GC_LAG * VPB, 0)
+    return dict(
+        batch,
+        rp_snap=jnp.full((CFG.rp,), snap, jnp.int32),
+        now=jnp.asarray(now, jnp.int32),
+        gc=jnp.asarray(gc, jnp.int32),
+    )
+
+
+def steady_state(batches):
+    """Run enough full steps that the table reaches steady occupancy."""
+    state = jax.device_put(ck.initial_state(CFG))
+
+    def body(carry, i):
+        st, now = carry
+        b = jax.tree.map(lambda x: x[i % NB], batches)
+        st, out = ck.resolve_step(CFG, st, versioned(b, now))
+        gc_applied = jnp.maximum(now - GC_LAG * VPB, 0)
+        return (st, now + VPB - gc_applied), out["n"]
+
+    (state, now), ns = jax.jit(
+        lambda st, now: lax.scan(body, (st, now), jnp.arange(64))
+    )(state, jnp.int32(1))
+    jax.block_until_ready(state)
+    return state, now, int(np.asarray(ns)[-1])
+
+
+def _sync(*trees):
+    """block_until_ready returns early on the tunneled dev-chip platform;
+    a host transfer of the smallest leaf is the reliable barrier (same
+    trick bench.py uses)."""
+    leaves = [l for t in trees for l in jax.tree.leaves(t)]
+    smallest = min(leaves, key=lambda l: getattr(l, "size", 1 << 60))
+    _ = np.asarray(smallest)
+
+
+def timed_scan(name, body, carry0, donate=False):
+    run = jax.jit(
+        lambda c: lax.scan(body, c, jnp.arange(STEPS)),
+        donate_argnums=(0,) if donate else (),
+    )
+    c, ys = run(carry0)          # compile + warm
+    _sync(c, ys)
+    if donate:
+        carry0 = c
+    t0 = time.perf_counter()
+    c, ys = run(carry0)
+    _sync(c, ys)
+    dt = time.perf_counter() - t0
+    print(f"{name:10s} {dt / STEPS * 1e3:8.3f} ms/iter", flush=True)
+    return dt / STEPS * 1e3
+
+
+def main(variants):
+    rng = np.random.default_rng(2026)
+    batches = synth(rng)
+    state, now0, n_steady = steady_state(batches)
+    print(f"steady-state boundary rows: {n_steady} / {CFG.capacity}")
+
+    def get_batch(i, now):
+        return versioned(jax.tree.map(lambda x: x[i % NB], batches), now)
+
+    if "full" in variants:
+        def body(carry, i):
+            st, now = carry
+            st, out = ck.resolve_step(CFG, st, get_batch(i, now))
+            gc_applied = jnp.maximum(now - GC_LAG * VPB, 0)
+            return (st, now + VPB - gc_applied), out["n"]
+        timed_scan("full", body, (jax.tree.map(jnp.copy, state), jnp.copy(now0)), donate=True)
+
+    if "phases12" in variants:
+        def body(carry, i):
+            acc, now = carry
+            b = get_batch(i, now)
+            hist, edges, wpos = ck.local_phases(CFG, state, b)
+            committed = ck.commit_fixpoint(CFG, b["t_ok"], hist, edges, b)
+            return (acc + jnp.sum(committed.astype(jnp.int32))
+                    + jnp.sum(hist) + wpos["lo_b"][0], now + 7), None
+        timed_scan("phases12", body, (jnp.int32(0), now0))
+
+    if "phases1only" in variants:
+        def body(carry, i):
+            acc, now = carry
+            b = get_batch(i, now)
+            hist, edges, wpos = ck.local_phases(CFG, state, b)
+            return (acc + jnp.sum(hist) + jnp.sum(edges["gid_rp"])
+                    + wpos["lo_b"][0], now + 7), None
+        timed_scan("phases1only", body, (jnp.int32(0), now0))
+
+    if "sort" in variants:
+        H, K = CFG.capacity, CFG.lanes
+        hkeys, n = state["hkeys"], state["n"]
+
+        def body(carry, i):
+            acc, now = carry
+            b = get_batch(i, now)
+            groups = (
+                (b["rpb"], 3, b["rp_valid"]),
+                (b["rb"], 3, b["r_valid"]),
+                (b["re"], 0, b["r_valid"]),
+                (ck._bump(b["rb"]), 0, b["r_valid"]),
+                (b["wpb"], 4, b["wp_valid"]),
+                (b["wb"], 2, b["w_valid"]),
+                (b["we"], 1, b["w_valid"]),
+            )
+            bkeys = jnp.concatenate([g[0] for g in groups], axis=0)
+            B = bkeys.shape[0]
+            bcode = jnp.concatenate(
+                [jnp.full((g[0].shape[0],), g[1], jnp.uint32) for g in groups])
+            bvalid = jnp.concatenate([g[2] for g in groups])
+            N = H + B
+            idx_bits = max(1, (N - 1).bit_length())
+            keys_all = jnp.concatenate([hkeys, bkeys], axis=0)
+            code_all = jnp.concatenate([jnp.full((H,), 5, jnp.uint32), bcode])
+            valid_all = jnp.concatenate([jnp.arange(H) < n, bvalid])
+            keys_eff = jnp.where(valid_all[:, None], keys_all, jnp.uint32(0xFFFFFFFF))
+            idx = jnp.arange(N, dtype=jnp.uint32)
+            codeidx = (jnp.where(valid_all, code_all, jnp.uint32(7)) << idx_bits) | idx
+            ops = tuple(keys_eff[:, c] for c in range(K)) + (codeidx,)
+            s = lax.sort(ops, num_keys=K + 1)
+            return (acc + s[K][0] + s[0][-1], now + 7), None
+        timed_scan("sort", body, (jnp.uint32(0), now0))
+
+    if "fixpoint" in variants:
+        b0 = get_batch(0, now0)
+        hist, edges, wpos = jax.jit(
+            lambda b: ck.local_phases(CFG, state, b))(b0)
+        jax.block_until_ready(edges)
+
+        def body(carry, i):
+            acc, now = carry
+            b = get_batch(i, now)
+            committed = ck.commit_fixpoint(CFG, b["t_ok"], hist, edges, b)
+            return (acc + jnp.sum(committed.astype(jnp.int32)), now + 7), None
+        timed_scan("fixpoint", body, (jnp.int32(0), now0))
+
+    if "apply" in variants:
+        b0 = get_batch(0, now0)
+        hist, edges, wpos = jax.jit(
+            lambda b: ck.local_phases(CFG, state, b))(b0)
+        committed0 = jax.jit(
+            lambda b: ck.commit_fixpoint(CFG, b["t_ok"], hist, edges, b))(b0)
+        jax.block_until_ready((wpos, committed0))
+
+        def body(carry, i):
+            st, now = carry
+            b = get_batch(i, now)
+            st2, _ = ck.apply_writes_and_gc(CFG, st, b, committed0, wpos)
+            return (st2, now + 7), None
+        timed_scan("apply", body, (jax.tree.map(jnp.copy, state), jnp.copy(now0)), donate=True)
+
+    if "binsearch" in variants:
+        # Alternative to the fused sort: vectorized binary search of all
+        # batch endpoint queries into the (already sorted) table.
+        H, K = CFG.capacity, CFG.lanes
+        hkeys, n = state["hkeys"], state["n"]
+        LEV = CFG.levels
+
+        def lower_bound(q):  # q: [Q, K] -> [Q]
+            Q = q.shape[0]
+            lo = jnp.zeros((Q,), jnp.int32)
+            size = jnp.int32(H)
+
+            def it(carry, _):
+                lo, size = carry
+                half = size // 2
+                mid = lo + half
+                row = hkeys[jnp.minimum(mid, H - 1)]
+                lt = (mid < n) & ck._key_less(row, q)
+                return (jnp.where(lt, mid + 1, lo), size - half), None
+
+            (lo, _), _ = lax.scan(it, (lo, size), None, length=LEV)
+            return lo
+
+        def body(carry, i):
+            acc, now = carry
+            b = get_batch(i, now)
+            q = jnp.concatenate(
+                [b["rpb"], b["rb"], b["re"], ck._bump(b["rb"]),
+                 b["wpb"], b["wb"], b["we"]], axis=0)
+            lb = lower_bound(q)
+            return (acc + jnp.sum(lb), now + 7), None
+        timed_scan("binsearch", body, (jnp.int32(0), now0))
+
+    if "sortbatch" in variants:
+        # Sort ONLY the point rows (for gid grouping) — the small-sort half
+        # of a search+small-sort redesign.
+        K = CFG.lanes
+
+        def body(carry, i):
+            acc, now = carry
+            b = get_batch(i, now)
+            bkeys = jnp.concatenate([b["rpb"], b["wpb"]], axis=0)
+            B = bkeys.shape[0]
+            idx_bits = max(1, (B - 1).bit_length())
+            valid = jnp.concatenate([b["rp_valid"], b["wp_valid"]])
+            keys_eff = jnp.where(valid[:, None], bkeys, jnp.uint32(0xFFFFFFFF))
+            idx = jnp.arange(B, dtype=jnp.uint32)
+            code = jnp.where(
+                jnp.arange(B) < CFG.rp, jnp.uint32(0), jnp.uint32(1))
+            codeidx = (code << idx_bits) | idx
+            ops = tuple(keys_eff[:, c] for c in range(K)) + (codeidx,)
+            s = lax.sort(ops, num_keys=K + 1)
+            return (acc + s[K][0] + s[0][-1], now + 7), None
+        timed_scan("sortbatch", body, (jnp.uint32(0), now0))
+
+
+def main2(variants):
+    """Second-stage variants: fixpoint iteration counts + sub-sharded step."""
+    rng = np.random.default_rng(2026)
+    batches = synth(rng)
+    state, now0, n_steady = steady_state(batches)
+
+    def get_batch(i, now):
+        return versioned(jax.tree.map(lambda x: x[i % NB], batches), now)
+
+    if "fixiters" in variants:
+        # How many while_loop iterations does the earlier-in-batch fixpoint
+        # take at the bench shape? (Per-iter cost is mostly launch overhead
+        # of many small fused ops, so iters ~ proportional cost.)
+        def counted_fixpoint(t_ok, hist, edges, b):
+            base_commit = t_ok & ~(hist > 0)
+
+            def blocked_of(c):
+                return ck._blocked_txns(CFG, edges, b, c) > 0
+
+            def cond(carry):
+                c, prev, it = carry
+                return jnp.any(c != prev) & (it < CFG.max_txns)
+
+            def body(carry):
+                c, _, it = carry
+                return base_commit & ~blocked_of(c), c, it + 1
+
+            c0 = base_commit
+            c1 = base_commit & ~blocked_of(c0)
+            committed, _, iters = lax.while_loop(cond, body, (c1, c0, jnp.int32(0)))
+            return committed, iters
+
+        def body(carry, i):
+            acc, now = carry
+            b = get_batch(i, now)
+            hist, edges, wpos = ck.local_phases(CFG, state, b)
+            committed, iters = counted_fixpoint(b["t_ok"], hist, edges, b)
+            return (acc + jnp.sum(committed.astype(jnp.int32)), now + 7), iters
+
+        run = jax.jit(lambda c: lax.scan(body, c, jnp.arange(32)))
+        c, iters = run((jnp.int32(0), now0))
+        iters = np.asarray(iters)
+        print(f"fixpoint iterations: mean={iters.mean():.1f} max={iters.max()}"
+              f" min={iters.min()}", flush=True)
+
+    if "stacked8" in variants:
+        # Sub-sharded device rate at the bench shape: 8 pro-rata tables on
+        # one chip, balanced synthetic routing (keys drawn as permutations
+        # so each shard gets exactly Rp/8 rows).
+        S = 8
+        cfg8 = ck.KernelConfig(
+            key_words=4, capacity=4096,
+            max_point_reads=CFG.rp // S, max_point_writes=CFG.wp // S,
+            max_reads=32, max_writes=32, max_txns=CFG.max_txns,
+        )
+        K = cfg8.lanes
+        T = cfg8.max_txns
+        Rp8, Wp8 = cfg8.rp, cfg8.wp
+        pool = np.zeros((POOL, K), np.uint32)
+        pool[:, :4] = rng.integers(0, 2**32, size=(POOL, 4), dtype=np.uint32)
+        pool[:, K - 1] = 16
+        pool = pool[np.lexsort([pool[:, c] for c in range(K - 1, -1, -1)])]
+        per_shard_pool = POOL // S
+
+        def synth_stacked():
+            outs = []
+            for _ in range(NB):
+                shards = []
+                r_perm = rng.permutation(POOL)
+                w_perm = rng.permutation(POOL)
+                r_txn_of = np.repeat(np.arange(T, dtype=np.int32), READS_PER_TXN)
+                w_txn_of = np.repeat(np.arange(T, dtype=np.int32), WRITES_PER_TXN)
+                for s in range(S):
+                    rmask = (r_perm // per_shard_pool) == s
+                    wmask = (w_perm // per_shard_pool) == s
+                    rk = pool[r_perm[rmask]]
+                    wk = pool[w_perm[wmask]]
+                    rt = r_txn_of[rmask]
+                    wt = w_txn_of[wmask]
+                    assert rk.shape[0] == Rp8 and wk.shape[0] == Wp8
+                    shards.append({
+                        "rpb": rk, "rp_txn": rt,
+                        "rp_valid": np.ones((Rp8,), bool),
+                        "rb": np.zeros((cfg8.max_reads, K), np.uint32),
+                        "re": np.zeros((cfg8.max_reads, K), np.uint32),
+                        "r_snap": np.zeros((cfg8.max_reads,), np.int32),
+                        "r_txn": np.zeros((cfg8.max_reads,), np.int32),
+                        "r_valid": np.zeros((cfg8.max_reads,), bool),
+                        "wpb": wk, "wp_txn": wt,
+                        "wp_valid": np.ones((Wp8,), bool),
+                        "wb": np.zeros((cfg8.max_writes, K), np.uint32),
+                        "we": np.zeros((cfg8.max_writes, K), np.uint32),
+                        "w_txn": np.zeros((cfg8.max_writes,), np.int32),
+                        "w_valid": np.zeros((cfg8.max_writes,), bool),
+                        "t_ok": np.ones((T,), bool),
+                        "t_too_old": np.zeros((T,), bool),
+                    })
+                outs.append(jax.tree.map(lambda *xs: np.stack(xs), *shards))
+            return jax.device_put(jax.tree.map(lambda *xs: np.stack(xs), *outs))
+
+        stacked = synth_stacked()
+
+        def versioned8(b, now):
+            snap = jnp.maximum(now - VPB // 2, 0)
+            gc = jnp.maximum(now - GC_LAG * VPB, 0)
+            return dict(
+                b,
+                rp_snap=jnp.full((S, Rp8), snap, jnp.int32),
+                now=jnp.broadcast_to(jnp.asarray(now, jnp.int32), (S,)),
+                gc=jnp.broadcast_to(gc.astype(jnp.int32), (S,)),
+            )
+
+        st8 = jax.tree.map(
+            lambda *xs: jnp.stack(xs),
+            *[ck.initial_state(cfg8) for _ in range(S)])
+
+        def body(carry, i):
+            st, now = carry
+            b = versioned8(jax.tree.map(lambda x: x[i % NB], stacked), now)
+            st, out = ck.resolve_step_stacked(cfg8, st, b)
+            gc_applied = jnp.maximum(now - GC_LAG * VPB, 0)
+            return (st, now + VPB - gc_applied), (out["n"], out["overflow"])
+
+        ms = timed_scan("stacked8", body, (st8, jnp.int32(1)), donate=True)
+        print(f"stacked8 txns/s: {CFG.max_txns / ms * 1e3:,.0f}", flush=True)
+
+
+def main3(variants):
+    """Candidate bench shapes: GC cadence + batch width sweeps."""
+    for name, T, gc_every in (
+        ("gc4_T4096", 4096, 4),
+        ("gc4_T8192", 8192, 4),
+        ("gc1_T8192", 8192, 1),
+    ):
+        if name not in variants:
+            continue
+        cfg = ck.KernelConfig(
+            key_words=4, capacity=24576,
+            max_point_reads=2 * T, max_point_writes=2 * T,
+            max_reads=256, max_writes=256, max_txns=T,
+        )
+        rng = np.random.default_rng(2026)
+        K = cfg.lanes
+        pool = np.zeros((POOL, K), np.uint32)
+        pool[:, :4] = rng.integers(0, 2**32, size=(POOL, 4), dtype=np.uint32)
+        pool[:, K - 1] = 16
+        pool = pool[np.lexsort([pool[:, c] for c in range(K - 1, -1, -1)])]
+        batches = []
+        for _ in range(NB):
+            r_idx = rng.integers(0, POOL, size=cfg.rp)
+            w_idx = rng.integers(0, POOL, size=cfg.wp)
+            batches.append({
+                "rpb": pool[r_idx].copy(),
+                "rp_txn": np.repeat(np.arange(T, dtype=np.int32), READS_PER_TXN),
+                "rp_valid": np.ones((cfg.rp,), bool),
+                "rb": np.zeros((cfg.max_reads, K), np.uint32),
+                "re": np.zeros((cfg.max_reads, K), np.uint32),
+                "r_snap": np.zeros((cfg.max_reads,), np.int32),
+                "r_txn": np.zeros((cfg.max_reads,), np.int32),
+                "r_valid": np.zeros((cfg.max_reads,), bool),
+                "wpb": pool[w_idx].copy(),
+                "wp_txn": np.repeat(np.arange(T, dtype=np.int32), WRITES_PER_TXN),
+                "wp_valid": np.ones((cfg.wp,), bool),
+                "wb": np.zeros((cfg.max_writes, K), np.uint32),
+                "we": np.zeros((cfg.max_writes, K), np.uint32),
+                "w_txn": np.zeros((cfg.max_writes,), np.int32),
+                "w_valid": np.zeros((cfg.max_writes,), bool),
+                "t_ok": np.ones((T,), bool),
+                "t_too_old": np.zeros((T,), bool),
+            })
+        stacked = jax.device_put(jax.tree.map(lambda *xs: np.stack(xs), *batches))
+        vpb = T
+
+        def body(carry, i):
+            st, now = carry
+            b = jax.tree.map(lambda x: x[i % NB], stacked)
+            do = (i % gc_every) == 0
+            gcv = jnp.where(do, jnp.maximum(now - GC_LAG * vpb, 0), 0)
+            b = dict(
+                b,
+                rp_snap=jnp.full((cfg.rp,), jnp.maximum(now - vpb // 2, 0), jnp.int32),
+                now=now.astype(jnp.int32),
+                gc=gcv.astype(jnp.int32),
+            )
+            st, out = ck.resolve_step(cfg, st, b)
+            return (st, now + vpb - gcv), (out["n"], out["overflow"])
+
+        state = jax.device_put(ck.initial_state(cfg))
+        (state, now), ns = jax.jit(
+            lambda st, nw: lax.scan(body, (st, nw), jnp.arange(64))
+        )(state, jnp.int32(1))
+        _ = np.asarray(ns[0])
+        assert not np.any(np.asarray(ns[1])), "overflow during warm"
+        ms = timed_scan(name, body, (state, now), donate=True)
+        print(f"{name} txns/s: {T / ms * 1e3:,.0f}", flush=True)
+
+
+if __name__ == "__main__":
+    args = sys.argv[1:] or [
+        "full", "phases12", "phases1only", "sort", "fixpoint", "apply",
+        "binsearch", "sortbatch",
+    ]
+    if any(v.startswith(("gc4_", "gc1_")) for v in args):
+        main3(args)
+    elif any(v in ("fixiters", "stacked8") for v in args):
+        main2(args)
+    else:
+        main(args)

@@ -1,0 +1,114 @@
+"""Sharded-resolver throughput on the virtual CPU mesh (scaling-shape proxy).
+
+Multi-chip hardware is not available in this environment, so the 8-shard
+scaling story is measured the same way it is tested: S key-range shards over
+S virtual CPU devices (xla_force_host_platform_device_count), end-to-end
+through the columnar native router (wire blocks -> per-shard C routing ->
+fused shard_map step with ICI-psum fixpoint). The comparison S=8 vs S=1 on
+identical hardware isolates the sharding overhead: routing pass, smaller
+per-shard tables, psum rounds. bench.py runs this module as a subprocess
+with the CPU platform forced and folds the JSON into its output line.
+
+Reference analog: the 8-shard SimulatedCluster config of BASELINE.json and
+the proxy's per-resolver request splitting (MasterProxyServer.actor.cpp:
+263-316).
+"""
+import json
+import os
+import sys
+import time
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.expanduser("~"), ".cache", "fdb_tpu_jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    import numpy as np
+
+    from foundationdb_tpu.core.types import CommitTransaction, KeyRange
+    from foundationdb_tpu.ops.conflict_kernel import KernelConfig
+    from foundationdb_tpu.ops.host_engine import JaxConflictEngine
+    from foundationdb_tpu.parallel.sharding import KeyShardMap, ShardedConflictEngine
+
+    T = 1024
+    # Per-shard capacities scale with 1/S (+2x headroom for skew): a shard
+    # owns 1/S of the keyspace, so its boundary table and row caps are
+    # pro-rata — that is what makes sharding a throughput win rather than
+    # S copies of the full-size program (the reference's resolvers likewise
+    # each hold only their key range's state).
+    CFG = KernelConfig(
+        key_words=4, capacity=8192,
+        max_point_reads=2048, max_point_writes=2048,
+        max_reads=8, max_writes=8, max_txns=T,
+    )
+    CFG8 = KernelConfig(
+        key_words=4, capacity=2048,
+        max_point_reads=512, max_point_writes=512,
+        max_reads=8, max_writes=8, max_txns=T,
+    )
+    POOL = 4096
+    BATCHES = 8
+    REPS = 3
+
+    rng = np.random.default_rng(7)
+
+    def synth_batches():
+        out = []
+        for _ in range(BATCHES):
+            txns = []
+            for _ in range(T):
+                t = CommitTransaction()
+                for _ in range(2):
+                    k = b"%06d" % rng.integers(0, POOL)
+                    t.read_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+                for _ in range(2):
+                    k = b"%06d" % rng.integers(0, POOL)
+                    t.write_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+                txns.append(t)
+            out.append(txns)
+        return out
+
+    streams = synth_batches()
+    # Key pool is b"000000".."004095": uniform splits on the generated key
+    # space so all 8 shards carry load.
+    splits = [b"%06d" % ((POOL * i) // 8) for i in range(1, 8)]
+
+    def run(engine):
+        now = 1000
+        # warm: compile + table fill
+        for txns in streams:
+            engine.resolve(txns, now, max(0, now - 40_000))
+            now += T
+        t0 = time.perf_counter()
+        total = 0
+        for _ in range(REPS):
+            for txns in streams:
+                engine.resolve(txns, now, max(0, now - 40_000))
+                now += T
+                total += len(txns)
+        return total / (time.perf_counter() - t0)
+
+    res = {}
+    for name, mk in (
+        ("s1", lambda: JaxConflictEngine(CFG)),
+        ("s8", lambda: ShardedConflictEngine(
+            CFG8, KeyShardMap(splits),
+            jax.make_mesh((8,), ("shard",), devices=jax.devices()[:8]))),
+    ):
+        for t in streams:
+            for tr in t:
+                tr.read_snapshot = 990  # reset snapshots under fresh engine
+        res[name] = round(run(mk()), 1)
+    res["speedup"] = round(res["s8"] / res["s1"], 3)
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
