@@ -68,9 +68,11 @@ def _make_server_knobs() -> Knobs:
     k.init("grv_batch_interval", 0.0005, lambda r: r.random01() * 0.005)
     # Ratekeeper (reference: fdbserver/Knobs.cpp ratekeeper section)
     k.init("ratekeeper_update_interval", 0.25)
-    k.init("target_storage_queue_bytes", 250 << 20)
-    k.init("spring_storage_queue_bytes", 50 << 20)
+    k.init("target_storage_queue_bytes", 4 << 20)
+    k.init("spring_storage_queue_bytes", 2 << 20)
     k.init("target_tlog_queue_bytes", 1 << 30)
+    # TLog spill (reference: updatePersistentData, TLogServer.actor.cpp:539)
+    k.init("tlog_spill_bytes", 2 << 20, lambda r: r.random_int(2_000, 200_000))
     k.init("max_transactions_per_second", 1e7)
     # Storage
     k.init("storage_durability_lag_versions", 2_000_000)

@@ -176,8 +176,11 @@ class ClusterController:
                 )
                 entry["version"] = qi.version
                 entry["durable_version"] = qi.durable_version
+                entry["queue_bytes"] = getattr(qi, "queue_bytes", 0)
                 if committed is not None:
-                    entry["lag_versions"] = max(0, committed - qi.durable_version)
+                    # fetch lag, not durability lag: the durable version
+                    # trails by design (storage_durability_lag_versions)
+                    entry["lag_versions"] = max(0, committed - qi.version)
                 entry["counters"] = await self.net.request(
                     self.proc.address, Endpoint(addr, "storage.stats"), None,
                     TaskPriority.CLUSTER_CONTROLLER, timeout=1.0,

@@ -1,0 +1,420 @@
+"""Durable ordered key-value engine: log-structured merge over the sim disk.
+
+The "ssd-class" IKeyValueStore the round-3 verdict called for (the role of
+the reference's patched-sqlite btree engine, fdbserver/KeyValueStoreSQLite
+.actor.cpp + IKeyValueStore.h:30-99) — own design: an LSM tree rather than
+a B-tree, because the sim disk's fault model (torn un-synced writes,
+AsyncFileNonDurable semantics) rewards append-only structures with
+checksummed framing, and the write path of a storage server is
+sequential-batch shaped anyway.
+
+Structure on disk (all under a name prefix):
+  <name>.dq            WAL via DiskQueue (checksummed frames, torn-tail
+                       recovery, alternating pop headers)
+  <name>-<seq>.sst     immutable sorted runs: 4KB-target blocks of wire-
+                       encoded entries, a block index, range tombstones,
+                       and a checksummed footer; always fully synced
+                       BEFORE the manifest references them
+  <name>.manifest      wire dict {runs: [...], seq}: written to a temp
+                       file, synced, renamed (atomic install)
+
+Write path: set/clear buffer into the memtable; commit() appends one WAL
+frame and fsyncs — that is the durability point. When the memtable exceeds
+flush_bytes, commit() also flushes it to a new run and truncates the WAL.
+When runs pile past max_runs, a full merge compacts them to one (newest
+precedence, tombstones dropped).
+
+Read path: memtable -> runs newest-to-oldest, block reads on demand through
+the per-run index with a small LRU block cache — the dataset does NOT live
+in process memory; RAM holds the memtable, indexes, and the cache only.
+
+Mutation precedence inside the memtable is tracked with sequence numbers;
+a flush materializes point entries post-tombstone, so within a run a point
+entry always wins and the run's range tombstones mask only older levels.
+"""
+from __future__ import annotations
+
+import struct
+import zlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from ..core import wire
+from ..sim.disk import SimDisk
+from .disk_queue import DiskQueue
+
+Key = bytes
+Value = bytes
+
+_FOOT = struct.Struct("<II")      # footer length, crc32
+
+
+class _Run:
+    """One immutable sorted run: lazy block reads through the index."""
+
+    def __init__(self, disk: SimDisk, name: str, index, tombs, cache, cache_cap):
+        self.disk = disk
+        self.name = name
+        #: [(first_key, offset, length)] per block, ascending
+        self.index = index
+        #: [(begin, end)] range tombstones masking OLDER levels
+        self.tombs = tombs
+        self._cache = cache
+        self._cache_cap = cache_cap
+
+    @classmethod
+    async def open(cls, disk: SimDisk, name: str, cache, cache_cap) -> "_Run":
+        f = disk.open(name, create=False)
+        size = f.size()
+        raw = await f.read(size - _FOOT.size, _FOOT.size)
+        flen, crc = _FOOT.unpack(raw)
+        foot = await f.read(size - _FOOT.size - flen, flen)
+        if zlib.crc32(foot) != crc:
+            raise IOError(f"corrupt run footer: {name}")
+        meta = wire.loads(foot)
+        return cls(disk, name, meta["index"], meta["tombs"], cache, cache_cap)
+
+    def covers_tomb(self, key: Key) -> bool:
+        return any(b <= key < e for b, e in self.tombs)
+
+    def _block_of(self, key: Key) -> int:
+        """Index of the last block whose first_key <= key (-1: before all)."""
+        lo, hi = -1, len(self.index) - 1
+        while lo < hi:
+            mid = (lo + hi + 1) // 2
+            if self.index[mid][0] <= key:
+                lo = mid
+            else:
+                hi = mid - 1
+        return lo
+
+    async def _block(self, i: int) -> List[Tuple[Key, Optional[Value]]]:
+        ck = (self.name, i)
+        hit = self._cache.get(ck)
+        if hit is not None:
+            self._cache.move_to_end(ck)
+            return hit
+        _, off, length = self.index[i]
+        f = self.disk.open(self.name, create=False)
+        raw = await f.read(off, length)
+        entries = wire.loads(raw)
+        self._cache[ck] = entries
+        while len(self._cache) > self._cache_cap:
+            self._cache.popitem(last=False)
+        return entries
+
+    async def get(self, key: Key) -> Tuple[bool, Optional[Value]]:
+        """(found, value|None-tombstone). found=False: key absent from this
+        run's points (range tombstones are the caller's concern)."""
+        i = self._block_of(key)
+        if i < 0:
+            return False, None
+        entries = await self._block(i)
+        lo, hi = 0, len(entries)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if entries[mid][0] < key:
+                lo = mid + 1
+            else:
+                hi = mid
+        if lo < len(entries) and entries[lo][0] == key:
+            return True, entries[lo][1]
+        return False, None
+
+    async def iter_from(self, key: Key, reverse: bool = False):
+        """Async generator of (k, v|None) from `key` (inclusive forward,
+        <= key backward for reverse)."""
+        nb = len(self.index)
+        if not reverse:
+            i = max(self._block_of(key), 0)
+            while i < nb:
+                for k, v in await self._block(i):
+                    if k >= key:
+                        yield k, v
+                i += 1
+        else:
+            i = self._block_of(key)
+            if i < 0:
+                return
+            while i >= 0:
+                for k, v in reversed(await self._block(i)):
+                    if k <= key:
+                        yield k, v
+                i -= 1
+
+
+class SSTableStore:
+    FLUSH_BYTES = 1 << 16
+    MAX_RUNS = 6
+    BLOCK_BYTES = 1 << 12
+    CACHE_BLOCKS = 64
+
+    def __init__(self, disk: SimDisk, name: str):
+        self.disk = disk
+        self.name = name
+        self.wal = DiskQueue(disk, name)
+        #: key -> (seq, value|None); range tombstones [(seq, begin, end)]
+        self._mem: Dict[Key, Tuple[int, Optional[Value]]] = {}
+        self._mem_tombs: List[Tuple[int, Key, Key]] = []
+        self._mem_bytes = 0
+        self._seq = 0
+        self._run_seq = 0
+        self._runs: List[_Run] = []          # newest first
+        self._pending: List[Tuple] = []      # ops since last commit
+        self._cache: OrderedDict = OrderedDict()
+
+    # -- lifecycle -----------------------------------------------------------
+    @classmethod
+    async def open(cls, disk: SimDisk, name: str) -> "SSTableStore":
+        st = cls(disk, name)
+        man = f"{name}.manifest"
+        run_names: List[str] = []
+        if disk.exists(man):
+            f = disk.open(man)
+            try:
+                meta = wire.loads(await f.read(0, f.size()))
+                run_names = meta["runs"]
+                st._run_seq = meta["seq"]
+            except Exception:
+                run_names = []      # torn manifest: fresh store (pre-install)
+        for rn in run_names:
+            st._runs.append(await _Run.open(disk, rn, st._cache, cls.CACHE_BLOCKS))
+        # Orphaned runs (crash between run sync and manifest install): GC.
+        keep = set(run_names)
+        for fname in disk.list(f"{name}-"):
+            if fname.endswith(".sst") and fname not in keep:
+                disk.delete(fname)
+        for _, payload in await st.wal.recover():
+            try:
+                ops = wire.loads(payload)
+            except Exception:
+                break
+            st._apply_ops(ops)
+        st._pending = []
+        return st
+
+    def _apply_ops(self, ops) -> None:
+        for op in ops:
+            if op[0] == 0:
+                self._mem_set(op[1], op[2])
+            else:
+                self._mem_clear(op[1], op[2])
+
+    # -- write path ----------------------------------------------------------
+    def _mem_set(self, key: Key, value: Optional[Value]) -> None:
+        self._seq += 1
+        self._mem[key] = (self._seq, value)
+        self._mem_bytes += len(key) + (len(value) if value else 0) + 16
+
+    def _mem_clear(self, begin: Key, end: Key) -> None:
+        self._seq += 1
+        self._mem_tombs.append((self._seq, begin, end))
+        for k in [k for k in self._mem if begin <= k < end]:
+            self._mem[k] = (self._seq, None)
+        self._mem_bytes += len(begin) + len(end) + 16
+
+    def set(self, key: Key, value: Value) -> None:
+        self._pending.append((0, key, value))
+        self._mem_set(key, value)
+
+    def clear_range(self, begin: Key, end: Key) -> None:
+        self._pending.append((1, begin, end))
+        self._mem_clear(begin, end)
+
+    async def commit(self) -> None:
+        """Durability point: WAL frame + fsync; flush/compact as needed
+        (IKeyValueStore::commit)."""
+        if self._pending:
+            await self.wal.push(wire.dumps(self._pending))
+            self._pending = []
+        await self.wal.commit()
+        if self._mem_bytes >= self.FLUSH_BYTES:
+            await self._flush()
+            if len(self._runs) > self.MAX_RUNS:
+                await self._compact()
+
+    async def _write_run(self, entries, tombs) -> str:
+        """entries: sorted [(k, v|None)]; returns the installed file name."""
+        self._run_seq += 1
+        rn = f"{self.name}-{self._run_seq}.sst"
+        f = self.disk.open(rn)
+        await f.truncate(0)
+        index = []
+        off = 0
+        i = 0
+        while i < len(entries):
+            blk = []
+            bbytes = 0
+            j = i
+            while j < len(entries) and (bbytes < self.BLOCK_BYTES or j == i):
+                blk.append(entries[j])
+                bbytes += len(entries[j][0]) + len(entries[j][1] or b"") + 8
+                j += 1
+            raw = wire.dumps(blk)
+            await f.write(off, raw)
+            index.append((entries[i][0], off, len(raw)))
+            off += len(raw)
+            i = j
+        foot = wire.dumps({"index": index, "tombs": tombs, "n": len(entries)})
+        await f.write(off, foot + _FOOT.pack(len(foot), zlib.crc32(foot)))
+        await f.sync()
+        return rn
+
+    async def _install_manifest(self, run_names: List[str]) -> None:
+        tmp = f"{self.name}.manifest.tmp"
+        f = self.disk.open(tmp)
+        await f.truncate(0)
+        await f.write(0, wire.dumps({"runs": run_names, "seq": self._run_seq}))
+        await f.sync()
+        self.disk.rename(tmp, f"{self.name}.manifest")
+
+    async def _flush(self) -> None:
+        if not self._mem and not self._mem_tombs:
+            return
+        entries = sorted((k, v) for k, (_s, v) in self._mem.items())
+        tombs = [(b, e) for _s, b, e in self._mem_tombs]
+        rn = await self._write_run(entries, tombs)
+        run = await _Run.open(self.disk, rn, self._cache, self.CACHE_BLOCKS)
+        self._runs.insert(0, run)
+        await self._install_manifest([r.name for r in self._runs])
+        self._mem.clear()
+        self._mem_tombs.clear()
+        self._mem_bytes = 0
+        # WAL content is fully covered by the installed run.
+        await self.wal.pop_to(self.wal.end_offset)
+
+    async def _compact(self) -> None:
+        """Full merge: newest precedence; tombstones drop out entirely."""
+        merged: Dict[Key, Optional[Value]] = {}
+        for level, run in enumerate(self._runs):
+            async for k, v in run.iter_from(b""):
+                if k in merged:
+                    continue
+                if any(self._runs[up].covers_tomb(k) for up in range(level)):
+                    continue
+                merged[k] = v
+        entries = sorted((k, v) for k, v in merged.items() if v is not None)
+        old = [r.name for r in self._runs]
+        rn = await self._write_run(entries, [])
+        run = await _Run.open(self.disk, rn, self._cache, self.CACHE_BLOCKS)
+        self._runs = [run]
+        await self._install_manifest([rn])
+        for name in old:
+            self.disk.delete(name)
+            for ck in [c for c in self._cache if c[0] == name]:
+                del self._cache[ck]
+
+    # -- read path -----------------------------------------------------------
+    def _mem_lookup(self, key: Key) -> Tuple[bool, Optional[Value]]:
+        e = self._mem.get(key)
+        tomb_seq = max((s for s, b, x in self._mem_tombs if b <= key < x),
+                       default=-1)
+        if e is not None and e[0] > tomb_seq:
+            return True, e[1]
+        if tomb_seq >= 0:
+            return True, None
+        return False, None
+
+    async def get(self, key: Key) -> Optional[Value]:
+        found, v = self._mem_lookup(key)
+        if found:
+            return v
+        for run in self._runs:
+            found, v = await run.get(key)
+            if found:
+                return v
+            if run.covers_tomb(key):
+                return None
+        return None
+
+    def _masked(self, key: Key, level: int) -> bool:
+        """Masked by a range tombstone strictly newer than `level`
+        (level -1 = memtable; runs are levels 0..)."""
+        if level >= 0:
+            if any(b <= key < e for _s, b, e in self._mem_tombs):
+                # memtable point entries override tombs via seq; for runs the
+                # memtable tomb always wins (it is newer than every run)
+                return True
+        for up in range(max(level, 0)):
+            if self._runs[up].covers_tomb(key):
+                return True
+        return False
+
+    async def get_range(self, begin: Key, end: Key, limit: int,
+                        reverse: bool = False) -> Tuple[List[Tuple[Key, Value]], bool]:
+        """Merged live entries in [begin, end); (items, more)."""
+        out: List[Tuple[Key, Value]] = []
+        # Per-level cursors: (next entry, level, iterator). Memtable is
+        # level -1 (highest precedence).
+        mem_keys = sorted(k for k in self._mem if begin <= k < end)
+        if reverse:
+            mem_keys.reverse()
+
+        async def mem_iter():
+            for k in mem_keys:
+                yield k, self._mem[k][1]
+
+        iters = [(-1, mem_iter())]
+        for lvl, run in enumerate(self._runs):
+            if reverse:
+                it = run.iter_from(end, reverse=True)
+            else:
+                it = run.iter_from(begin)
+            iters.append((lvl, it))
+
+        heads: List[Optional[Tuple[Key, Optional[Value]]]] = []
+        live: List = []
+        for lvl, it in iters:
+            try:
+                nxt = await anext(it)
+                if reverse and lvl >= 0 and nxt[0] >= end:
+                    while nxt[0] >= end:
+                        nxt = await anext(it)
+            except StopAsyncIteration:
+                nxt = None
+            heads.append(nxt)
+            live.append(it)
+
+        def better(a: Key, b: Key) -> bool:
+            return a > b if reverse else a < b
+
+        while len(out) < limit:
+            # pick frontier key across levels
+            pick: Optional[Key] = None
+            for h in heads:
+                if h is not None and (not reverse and h[0] >= end):
+                    continue
+                if h is not None and (pick is None or better(h[0], pick)):
+                    pick = h[0]
+            if pick is None or (not reverse and pick >= end) or (reverse and pick < begin):
+                return out, False
+            # resolve precedence: lowest level index with this key wins
+            val: Optional[Value] = None
+            taken_level = None
+            for idx, h in enumerate(heads):
+                if h is not None and h[0] == pick:
+                    if taken_level is None:
+                        taken_level = idx - 1   # level: -1 memtable
+                        val = h[1]
+                    try:
+                        heads[idx] = await anext(live[idx])
+                    except StopAsyncIteration:
+                        heads[idx] = None
+            if taken_level is not None and taken_level >= 0 and self._masked(pick, taken_level):
+                val = None
+            elif taken_level == -1:
+                # memtable entry: seq already resolved vs mem tombs
+                found, val = self._mem_lookup(pick)
+            if val is not None and (begin <= pick < end):
+                out.append((pick, val))
+        return out, True
+
+    # -- maintenance ---------------------------------------------------------
+    def destroy(self) -> None:
+        """Delete every on-disk artifact (IKeyValueStore::dispose)."""
+        for rn in [r.name for r in self._runs]:
+            self.disk.delete(rn)
+        self.disk.delete(f"{self.name}.manifest")
+        self.disk.delete(f"{self.name}.manifest.tmp")
+        self.disk.delete(f"{self.name}.dq")
+        self.disk.delete(f"{self.name}.dq.tmp")

@@ -705,6 +705,12 @@ class Proxy:
         self._batch_versions.pop(bn, None)
         if v > self.committed_version.get():
             self.committed_version.set(v)
+            # Advertise the new KCV to the replicas now (unreliable one-way;
+            # the drain's retry path re-sends on loss). Without this the
+            # next batch's metadata drain waits on its OWN push for the
+            # horizon — storage pops used to paper over it by carrying
+            # fresh durable versions, which the durable tier no longer does.
+            self.log.send_kcv(v)
         for t, (_, p) in enumerate(items):
             verdict = verdicts[t]
             if verdict == int(TransactionCommitResult.COMMITTED):

@@ -39,6 +39,10 @@ class StorageQueueInfo:
     tag: int
     version: int
     durable_version: int
+    #: overlay bytes not yet in the durable engine (the reference's
+    #: storage-queue-bytes signal; durable VERSION lag is by design
+    #: ~storage_durability_lag_versions and is NOT a throttling signal)
+    queue_bytes: int = 0
 
 
 @dataclass
@@ -79,22 +83,37 @@ class Ratekeeper:
             self.tps_limit = self._update_rate(infos)
 
     def _update_rate(self, infos: List[StorageQueueInfo]) -> float:
-        """The core of updateRate: worst storage lag -> TPS limit, linear
-        between the target and max lag (the reference's smoother + spring
-        reduced to its proportional core)."""
+        """The core of updateRate: the worst storage FETCH lag (committed -
+        applied version: how far the update loop trails the tlogs) and the
+        worst un-durable queue depth each map to a TPS limit; the minimum
+        wins. Durable-version lag is NOT a signal — the durability cycle
+        trails by storage_durability_lag_versions on purpose (the MVCC
+        window lives above the engine), exactly like the reference's
+        updateStorage (updateRate:251-430 throttles on queue bytes and
+        version lag, not on durability's designed offset)."""
         max_tps = float(SERVER_KNOBS.max_transactions_per_second)
         if not infos:
             return max_tps
         committed = self.committed_version_fn()
-        self.worst_lag = max(max(0, committed - i.durable_version) for i in infos)
-        if self.worst_lag <= TARGET_STORAGE_LAG_VERSIONS:
-            return max_tps
+        self.worst_lag = max(max(0, committed - i.version) for i in infos)
+        tps_lag = max_tps
         if self.worst_lag >= MAX_STORAGE_LAG_VERSIONS:
-            return 1.0   # never fully zero: progress lets the lag drain
-        frac = (MAX_STORAGE_LAG_VERSIONS - self.worst_lag) / (
-            MAX_STORAGE_LAG_VERSIONS - TARGET_STORAGE_LAG_VERSIONS
-        )
-        return max(1.0, max_tps * frac)
+            tps_lag = 1.0   # never fully zero: progress lets the lag drain
+        elif self.worst_lag > TARGET_STORAGE_LAG_VERSIONS:
+            frac = (MAX_STORAGE_LAG_VERSIONS - self.worst_lag) / (
+                MAX_STORAGE_LAG_VERSIONS - TARGET_STORAGE_LAG_VERSIONS
+            )
+            tps_lag = max(1.0, max_tps * frac)
+        worst_bytes = max(i.queue_bytes for i in infos)
+        target_b = SERVER_KNOBS.target_storage_queue_bytes
+        spring_b = SERVER_KNOBS.spring_storage_queue_bytes
+        tps_bytes = max_tps
+        if worst_bytes >= target_b:
+            tps_bytes = 1.0
+        elif worst_bytes > target_b - spring_b:
+            frac = (target_b - worst_bytes) / spring_b
+            tps_bytes = max(1.0, max_tps * frac)
+        return min(tps_lag, tps_bytes)
 
     async def get_rate_info(self, req: GetRateInfoRequest) -> GetRateInfoReply:
         return GetRateInfoReply(tps_limit=self.tps_limit)

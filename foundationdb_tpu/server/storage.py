@@ -1,11 +1,23 @@
-"""Storage server: MVCC reads over a versioned in-memory store.
+"""Storage server: MVCC window over a durable ordered engine.
 
-Round-1 scope of fdbserver/storageserver.actor.cpp: a per-key version-chain
-store standing in for VersionedMap (fdbclient/VersionedMap.h) over a durable
-engine; an update loop pulling the server's tag from the tlog (update:2340),
-applying mutations (incl. atomic ops, Atomic.h) in version order; reads wait
-for the requested version (waitForVersion:644), answer from the MVCC window,
-and reject out-of-window versions with transaction_too_old / future_version.
+Re-design of fdbserver/storageserver.actor.cpp with the reference's actual
+memory/durability split (round-4: the RAM-resident round-3 design is gone):
+
+  * a per-key version-chain overlay (VersionedMap's role) holds ONLY the
+    mutations in (durable_version, latest] — the MVCC read window;
+  * a durable LSM engine (kvstore.SSTableStore, the KeyValueStoreSQLite
+    role) holds the full dataset at exactly durable_version;
+  * the update loop pulls the tag (update:2340) and applies to the overlay;
+    a durability cycle (updateStorage:2585) writes resolved mutations up to
+    latest - storage_durability_lag_versions into the engine, commits,
+    advances oldest_version to the new durable_version, drops the covered
+    overlay entries, and pops the tlog (tLogPop:898) — so reads at any
+    version in [durable, latest] merge engine state with the overlay
+    (readRange:936), RAM holds only the window, and crash recovery replays
+    only the tag tail above durable, never the whole history.
+
+Reads wait for the requested version (waitForVersion:644) and reject
+out-of-window versions with transaction_too_old / future_version.
 """
 from __future__ import annotations
 
@@ -59,6 +71,11 @@ class VersionedStore:
     def __init__(self) -> None:
         self._keys: List[Key] = []
         self._chains: Dict[Key, List[Tuple[Version, Optional[Value]]]] = {}
+        #: version-stamped range tombstones [(version, begin, end)]: as an
+        #: OVERLAY over a durable engine, a clear must mask engine keys the
+        #: overlay has no chain for (chains alone were only correct when
+        #: they held the whole dataset)
+        self._tombs: List[Tuple[Version, Key, Key]] = []
         self.oldest_version: Version = 0
 
     def _chain(self, key: Key) -> List[Tuple[Version, Optional[Value]]]:
@@ -87,6 +104,7 @@ class VersionedStore:
             c = self._chains[k]
             if c and c[-1][1] is not None:
                 c.append((version, None))
+        self._tombs.append((version, begin, end))
 
     def range_at(
         self, begin: Key, end: Key, version: Version, limit: int, reverse: bool = False
@@ -117,12 +135,15 @@ class VersionedStore:
     def load_snapshot(self, items: List[Tuple[Key, Value]], version: Version) -> None:
         self._keys = sorted(k for k, _ in items)
         self._chains = {k: [(version, v)] for k, v in items}
+        self._tombs = []
         self.oldest_version = version
 
     def forget_before(self, version: Version) -> None:
         """Drop history below `version`, keeping each chain's latest entry at
-        or below it (the storage analog of removeBefore)."""
+        or below it (the storage analog of removeBefore) — the memory-mode
+        rule, where chains ARE the dataset."""
         self.oldest_version = max(self.oldest_version, version)
+        self._tombs = [t for t in self._tombs if t[0] > version]
         dead: List[Key] = []
         for k, c in self._chains.items():
             i = bisect.bisect_right(c, version, key=lambda e: e[0]) - 1
@@ -135,10 +156,64 @@ class VersionedStore:
             i = bisect.bisect_left(self._keys, k)
             del self._keys[i]
 
+    def entry_at(self, key: Key, version: Version) -> Optional[Tuple[Version, Optional[Value]]]:
+        """Latest overlay fact about `key` at or below `version` — a chain
+        entry or a range tombstone, whichever is newer (chains win ties:
+        within one version, mutations applied later appended later). None
+        means the overlay has nothing to say and the engine answers."""
+        ce = None
+        c = self._chains.get(key)
+        if c:
+            i = bisect.bisect_right(c, version, key=lambda e: e[0]) - 1
+            if i >= 0:
+                ce = c[i]
+        if not self._tombs:     # common case: clear-free window
+            return ce
+        tv = -1
+        for v, b, e in self._tombs:
+            if v <= version and b <= key < e and v > tv:
+                tv = v
+        if ce is not None and (tv < 0 or ce[0] >= tv):
+            return ce
+        if tv >= 0:
+            return (tv, None)
+        return ce
+
+    def drop_through(self, version: Version) -> None:
+        """Durable-mode trim: entries <= `version` are now in the engine, so
+        they leave the overlay ENTIRELY (no anchors — the engine at
+        durable_version is the base the overlay patches)."""
+        self.oldest_version = max(self.oldest_version, version)
+        self._tombs = [t for t in self._tombs if t[0] > version]
+        dead: List[Key] = []
+        for k, c in self._chains.items():
+            i = bisect.bisect_right(c, version, key=lambda e: e[0])
+            if i > 0:
+                del c[:i]
+            if not c:
+                dead.append(k)
+        for k in dead:
+            del self._chains[k]
+            i = bisect.bisect_left(self._keys, k)
+            del self._keys[i]
+
+    def overlay_keys(self, begin: Key, end: Key) -> List[Key]:
+        lo = bisect.bisect_left(self._keys, begin)
+        hi = bisect.bisect_left(self._keys, end)
+        return self._keys[lo:hi]
+
+
+#: the engine's private keyspace: strictly above every servable shard end
+#: (cluster shards end at b"\xff\xff\xff"), so range reads never see it —
+#: the analog of the reference's persistent-format keys in its own KVS
+STORAGE_PRIVATE_PREFIX = b"\xff\xff\xff\xff/"
+DURABLE_VERSION_KEY = STORAGE_PRIVATE_PREFIX + b"durableVersion"
+
 
 class StorageServer:
-    #: rewrite the snapshot when the WAL exceeds this
-    SNAPSHOT_BYTES = 1 << 18
+    #: durability cycle fires when the overlay backlog exceeds this
+    #: (memory pressure overrides the version-lag cadence)
+    PENDING_BYTES = 1 << 20
 
     def __init__(
         self,
@@ -149,6 +224,7 @@ class StorageServer:
         net,
         start_version: Version = 0,
         disk=None,
+        kvs=None,
         defer_update_loop: bool = False,
     ):
         """`log_view` is an AsyncVar[LogSystemConfig | None]: the current
@@ -165,9 +241,18 @@ class StorageServer:
         #: reference: StorageServer::Counters (storageserver.actor.cpp)
         self.stats = CounterCollection("Storage", f"tag{tag}")
         self.version = NotifiedVersion(start_version)
-        #: durable (synced) version: the tlog may only be popped to here
+        #: durable (engine-committed) version: the tlog may only be popped
+        #: to here, and oldest_version tracks it in durable mode
         self.durable_version: Version = start_version
-        self.queue: Optional[DiskQueue] = DiskQueue(disk, f"storage-{tag}") if disk is not None else None
+        #: durable engine (kvstore.SSTableStore) or None = memory mode
+        self.kvs = kvs
+        #: resolved ops per version awaiting the durability cycle:
+        #: [(version, [(0,k,v)|(1,b,e)], bytes)]
+        self._pending: List[Tuple[Version, list, int]] = []
+        self._pending_bytes = 0
+        #: a durability cycle is mid-flight toward this version: reads below
+        #: it must not consult the half-mutated engine (see _read_floor)
+        self._durabilizing_to: Version = 0
         self._disk = disk
         self._update_task = None
         self._tokens = [GET_VALUE_TOKEN, GET_KEY_VALUES_TOKEN, WATCH_VALUE_TOKEN,
@@ -183,6 +268,7 @@ class StorageServer:
             return StorageQueueInfo(
                 tag=self.tag, version=self.version.get(),
                 durable_version=self.durable_version,
+                queue_bytes=self._pending_bytes,
             )
 
         async def stats_req(_req):
@@ -212,6 +298,8 @@ class StorageServer:
                 if not p.is_set:
                     p.send_error(error.watch_cancelled())
         self._watches.clear()
+        if self.kvs is not None:
+            self.kvs.destroy()
         if self._disk is not None:
             for suffix in (".meta", ".snap", ".snap.tmp", ".dq", ".dq.tmp"):
                 self._disk.delete(self._meta_name() + suffix)
@@ -222,9 +310,12 @@ class StorageServer:
         storageserver.actor.cpp:1777). The AddingShard double buffer is the
         log system itself here: this tag's mutations > `version` are
         already accumulating at the tlogs and the update loop consumes them
-        once this snapshot is loaded."""
+        once this snapshot is loaded. In durable mode the copy streams into
+        the engine (a retried half-fetch starts from a cleared shard)."""
         from ..core.types import key_after
 
+        if self.kvs is not None:
+            self.kvs.clear_range(self.shard.begin, self.shard.end)
         items: List[Tuple[Key, Value]] = []
         cb, ce = self.shard.begin, self.shard.end
         while cb < ce:
@@ -249,17 +340,41 @@ class StorageServer:
                     await delay(0.2, TaskPriority.FETCH_KEYS)
             if reply is None:
                 raise last if last is not None else error.connection_failed()
-            items.extend(reply.data)
+            if self.kvs is not None:
+                for k, v in reply.data:
+                    self.kvs.set(k, v)
+                await self.kvs.commit()
+            else:
+                items.extend(reply.data)
             if not reply.more or not reply.data:
                 break
             cb = key_after(reply.data[-1][0])
-        self.store.load_snapshot(items, version)
+        if self.kvs is not None:
+            self.kvs.set(DURABLE_VERSION_KEY, wire.dumps(version))
+            await self.kvs.commit()
+            self.store = VersionedStore()
+            self.store.oldest_version = version
+        else:
+            self.store.load_snapshot(items, version)
         self.version = NotifiedVersion(version)
         self.durable_version = version
 
     # -- durability ----------------------------------------------------------
     def _meta_name(self) -> str:
         return f"storage-{self.tag}"
+
+    @classmethod
+    async def create(cls, proc: SimProcess, tag: int, shard: KeyRange,
+                     log_view: AsyncVar, net, disk,
+                     start_version: Version = 0,
+                     defer_update_loop: bool = False) -> "StorageServer":
+        """Fresh durable-mode server: open (or re-open) the engine."""
+        from .kvstore import SSTableStore
+
+        kvs = await SSTableStore.open(disk, f"storage-{tag}")
+        return cls(proc, tag=tag, shard=shard, log_view=log_view, net=net,
+                   start_version=start_version, disk=disk, kvs=kvs,
+                   defer_update_loop=defer_update_loop)
 
     async def persist_initial(self) -> None:
         if self._disk is None:
@@ -269,55 +384,65 @@ class StorageServer:
             "tag": self.tag, "begin": self.shard.begin, "end": self.shard.end,
         }))
         await meta.sync()
+        if self.kvs is not None and await self.kvs.get(DURABLE_VERSION_KEY) is None:
+            self.kvs.set(DURABLE_VERSION_KEY, wire.dumps(self.durable_version))
+            await self.kvs.commit()
 
-    async def _write_snapshot(self) -> None:
-        """Flatten at the durable version into a fresh file + rename, then
-        drop the covered WAL prefix (KeyValueStoreMemory's snapshot cycle)."""
-        items = self.store.snapshot_items(self.durable_version)
-        payload = wire.dumps({"version": self.durable_version, "items": items})
-        tmp = self._disk.open(self._meta_name() + ".snap.tmp")
-        await tmp.truncate(0)
-        await tmp.write(0, payload)
-        await tmp.sync()
-        self._disk.rename(self._meta_name() + ".snap.tmp", self._meta_name() + ".snap")
-        await self.queue.pop_to(self.queue.end_offset)
+    async def _make_durable(self, target: Version) -> None:
+        """updateStorage:2585: push resolved ops <= target into the engine,
+        commit (the durability point), advance the MVCC floor, trim the
+        overlay, and let the caller pop the tlog."""
+        i = 0
+        new_durable = self.durable_version
+        for v, _ops, _nb in self._pending:
+            if v > target:
+                break
+            new_durable = v
+            i += 1
+        if i == 0:
+            return
+        # Raise the read floor BEFORE touching the engine: the memtable
+        # makes each set visible immediately, so a concurrent read below
+        # new_durable falling through to the engine could otherwise observe
+        # a higher version's write. Reads past the gate re-check the floor
+        # after their engine await (get_value/get_key_values).
+        self._durabilizing_to = max(self._durabilizing_to, new_durable)
+        self.store.oldest_version = max(self.store.oldest_version, new_durable)
+        for v, ops, nbytes in self._pending[:i]:
+            for op in ops:
+                if op[0] == 0:
+                    self.kvs.set(op[1], op[2])
+                else:
+                    self.kvs.clear_range(op[1], op[2])
+            self._pending_bytes -= nbytes
+        del self._pending[:i]
+        self.kvs.set(DURABLE_VERSION_KEY, wire.dumps(new_durable))
+        await self.kvs.commit()
+        self.durable_version = new_durable
+        self.store.drop_through(new_durable)
 
     @classmethod
     async def restore(cls, proc: SimProcess, disk, meta_name: str,
                       log_view: AsyncVar, net) -> Optional["StorageServer"]:
+        """Reboot recovery: the engine IS the state at durable_version; the
+        update loop replays only the tag tail above it from the tlogs —
+        restart cost is the durability lag, never the dataset size."""
         meta_file = disk.open(meta_name)
         raw = await meta_file.read(0, meta_file.size())
         try:
             meta = wire.loads(raw)
         except Exception:
             return None
-        snap_version, items = 0, []
-        if disk.exists(f"storage-{meta['tag']}.snap"):
-            f = disk.open(f"storage-{meta['tag']}.snap")
-            raw = await f.read(0, f.size())
-            try:
-                snap = wire.loads(raw)
-                snap_version, items = snap["version"], snap["items"]
-            except Exception:
-                pass  # torn snapshot: the WAL replays everything
-        # The update loop must not run while the WAL/snapshot rebuild the
-        # store, or freshly-peeked mutations interleave with the replay
-        # (round-2 review): defer it until the state is consistent.
+        from .kvstore import SSTableStore
+
+        kvs = await SSTableStore.open(disk, f"storage-{meta['tag']}")
+        raw = await kvs.get(DURABLE_VERSION_KEY)
+        durable = wire.loads(raw) if raw is not None else 0
         ss = cls(proc, tag=meta["tag"], shard=KeyRange(meta["begin"], meta["end"]),
-                 log_view=log_view, net=net, start_version=0, disk=disk,
-                 defer_update_loop=True)
-        ss.store.load_snapshot(items, snap_version)
-        version = snap_version
-        for _, payload in await ss.queue.recover():
-            v, muts = wire.loads(payload)
-            if v <= version:
-                continue
-            for m in muts:
-                ss._apply(m, v)
-            version = v
-        ss.version = NotifiedVersion(version)
-        ss.durable_version = version
-        ss.start_update_loop()
+                 log_view=log_view, net=net, start_version=durable, disk=disk,
+                 kvs=kvs)
+        ss.durable_version = durable
+        ss.store.oldest_version = durable
         return ss
 
     # -- write path ----------------------------------------------------------
@@ -339,30 +464,47 @@ class StorageServer:
         else:
             del self._watches[key]
 
-    def _apply(self, m: Mutation, version: Version) -> None:
+    async def _existing_value(self, key: Key, version: Version) -> Optional[Value]:
+        """Current value for an atomic-op read-modify-write: overlay entry
+        if one covers `version`, else the durable engine (doEagerReads'
+        read-before-apply, storageserver.actor.cpp:1370)."""
+        e = self.store.entry_at(key, version)
+        if e is not None:
+            return e[1]
+        if self.kvs is not None:
+            return await self.kvs.get(key)
+        return None
+
+    async def _apply(self, m: Mutation, version: Version) -> Optional[tuple]:
+        """Apply one mutation to the overlay; returns the RESOLVED op for
+        the durability cycle ((0, k, v) set / (1, b, e) clear) — atomic ops
+        are materialized here, so the engine only ever stores values."""
         if m.type == MutationType.SET_VALUE:
             self.store.set(m.param1, m.param2, version)
             self._fire_watches(m.param1, m.param2)
+            return (0, m.param1, m.param2)
         elif m.type == MutationType.CLEAR_RANGE:
             self.store.clear_range(m.param1, m.param2, version)
             for k in [k for k in self._watches if m.param1 <= k < m.param2]:
                 self._fire_watches(k, None)
+            return (1, m.param1, m.param2)
         elif m.type in STORAGE_ATOMIC_MUTATIONS:
-            existing = self.store.value_at(m.param1, version)
+            existing = await self._existing_value(m.param1, version)
             new = apply_atomic_op(m.type, existing, m.param2)
             self.store.set(m.param1, new, version)
             self._fire_watches(m.param1, new)
+            return (0, m.param1, new)
         else:
             # Versionstamped mutations must have been rewritten to SET_VALUE
             # by the proxy (transform_versionstamp_mutation) before logging.
             raise error.client_invalid_operation(f"unsupported mutation {m.type}")
 
     async def update_loop(self) -> None:
-        """Pull this server's tag from the tlog forever (update:2340 +
-        updateStorage:2585 merged: in-memory apply == durable here). Peeks
-        are idempotent, so transport loss (tlog death, partition, timeout)
-        just retries; a blocked peek is re-armed every few virtual seconds so
-        a partitioned-then-healed link recovers."""
+        """Pull this server's tag from the tlog forever (update:2340), then
+        run the durability cycle (updateStorage:2585). Peeks are idempotent,
+        so transport loss (tlog death, partition, timeout) just retries; a
+        blocked peek is re-armed every few virtual seconds so a
+        partitioned-then-healed link recovers."""
         while True:
             cfg = self.log_view.get()
             if cfg is None:
@@ -376,39 +518,49 @@ class StorageServer:
                 # view and retry (peeks are idempotent).
                 await delay(0.5, TaskPriority.TLOG_PEEK)
                 continue
-            applied_any = False
             for v, muts in reply.messages:
                 if v <= self.version.get():
                     continue
-                for m in muts:
-                    self._apply(m, v)
+                if self.kvs is None:
+                    for m in muts:
+                        await self._apply(m, v)
+                else:
+                    ops = []
+                    nbytes = 0
+                    for m in muts:
+                        op = await self._apply(m, v)
+                        ops.append(op)
+                        nbytes += len(op[1]) + len(op[2] or b"") + 24
+                    self._pending.append((v, ops, nbytes))
+                    self._pending_bytes += nbytes
                 self.stats.add("mutations", len(muts))
-                if self.queue is not None:
-                    await self.queue.push(wire.dumps((v, muts)))
-                applied_any = True
             if reply.end_version > self.version.get():
                 self.version.set(reply.end_version)
-                window = self.version.get() - MAX_WRITE_TRANSACTION_LIFE_VERSIONS
-                if window > 0:
-                    self.store.forget_before(window)
-                if self.queue is None:
+                if self.kvs is None:
+                    window = self.version.get() - MAX_WRITE_TRANSACTION_LIFE_VERSIONS
+                    if window > 0:
+                        self.store.forget_before(window)
                     self.durable_version = self.version.get()
                     client.pop(self.tag, self.durable_version)
-                elif applied_any or self.version.get() - self.durable_version > 0:
-                    # Make the applied window durable before popping the
-                    # tlog (updateStorage:2585 -> tLogPop:898 ordering: the
-                    # tlog must retain anything we could lose in a crash).
-                    await self.queue.commit()
-                    self.durable_version = self.version.get()
+                else:
+                    from ..core.knobs import SERVER_KNOBS
+
+                    lag = SERVER_KNOBS.storage_durability_lag_versions
+                    if buggify.buggify():
+                        lag = 100  # an eager flusher stresses the floor
+                    target = self.version.get() - lag
+                    limit = 1024 if buggify.buggify() else self.PENDING_BYTES
+                    if self._pending_bytes > limit:
+                        # memory pressure: drain everything applied so far
+                        target = self.version.get()
+                    if self._pending and target >= self._pending[0][0]:
+                        await self._make_durable(target)
                     client.pop(self.tag, self.durable_version)
-                    snap_limit = 1024 if buggify.buggify() else self.SNAPSHOT_BYTES
-                    if self.queue.end_offset - self.queue._begin > snap_limit:
-                        await self._write_snapshot()
 
     # -- read path -----------------------------------------------------------
     async def _wait_for_version(self, version: Version) -> None:
         """reference: waitForVersion, storageserver.actor.cpp:644."""
-        if version < self.store.oldest_version:
+        if version < self._read_floor():
             raise error.transaction_too_old()
         if version > self.version.get() + MAX_READ_AHEAD_VERSIONS:
             raise error.future_version()
@@ -418,12 +570,96 @@ class StorageServer:
         if begin < self.shard.begin or end > self.shard.end:
             raise error.wrong_shard_server()
 
+    async def _value_at(self, key: Key, version: Version) -> Optional[Value]:
+        """Overlay entry at `version` wins; otherwise the durable engine
+        (the getValueQ read merge, storageserver.actor.cpp:697)."""
+        e = self.store.entry_at(key, version)
+        if e is not None:
+            return e[1]
+        if self.kvs is not None:
+            return await self.kvs.get(key)
+        return None
+
+    async def _range_at(
+        self, begin: Key, end: Key, version: Version, limit: int, reverse: bool
+    ) -> Tuple[List[Tuple[Key, Value]], bool]:
+        """Range read merging the durable engine with the overlay
+        (readRange:936: disk + VersionedMap). Overlay entries at or below
+        `version` override engine values (None = cleared); overlay keys
+        whose chains start after `version` defer to the engine."""
+        if self.kvs is None:
+            return self.store.range_at(begin, end, version, limit, reverse)
+        from ..core.types import key_after
+
+        okeys = self.store.overlay_keys(begin, end)
+        if reverse:
+            okeys = list(reversed(okeys))
+        oi = 0
+        out: List[Tuple[Key, Value]] = []
+        cb, ce = begin, end
+        exhausted = False
+        while len(out) < limit and not exhausted:
+            page, more = await self.kvs.get_range(cb, ce, max(limit - len(out), 16),
+                                                  reverse=reverse)
+            if not more:
+                exhausted = True
+            elif page:
+                if reverse:
+                    ce = page[-1][0]
+                else:
+                    cb = key_after(page[-1][0])
+            for k, v in page:
+                # overlay keys strictly before k (in scan order) are
+                # overlay-only: emit their value if live at `version`
+                while oi < len(okeys) and (
+                    (okeys[oi] < k) if not reverse else (okeys[oi] > k)
+                ):
+                    e = self.store.entry_at(okeys[oi], version)
+                    if e is not None and e[1] is not None:
+                        out.append((okeys[oi], e[1]))
+                        if len(out) >= limit:
+                            break
+                    oi += 1
+                if len(out) >= limit:
+                    break
+                if oi < len(okeys) and okeys[oi] == k:
+                    oi += 1
+                # the overlay (chain entry OR range tombstone <= version)
+                # overrides the engine value; otherwise the engine answers
+                e = self.store.entry_at(k, version)
+                if e is not None:
+                    if e[1] is not None:
+                        out.append((k, e[1]))
+                else:
+                    out.append((k, v))
+                if len(out) >= limit:
+                    break
+            if len(out) >= limit:
+                return out, True
+        # trailing overlay-only keys past the engine's last page
+        while oi < len(okeys) and len(out) < limit:
+            e = self.store.entry_at(okeys[oi], version)
+            if e is not None and e[1] is not None:
+                out.append((okeys[oi], e[1]))
+            oi += 1
+        return out, oi < len(okeys)
+
+    def _read_floor(self) -> Version:
+        """Oldest readable version: the MVCC floor plus any durability
+        cycle currently mutating the engine. Reads that awaited across a
+        cycle must re-check (and retry via transaction_too_old) rather than
+        return values a higher version wrote."""
+        return max(self.store.oldest_version, self._durabilizing_to)
+
     async def get_value(self, req: GetValueRequest) -> GetValueReply:
         if not self.shard.contains(req.key):
             raise error.wrong_shard_server()
         await self._wait_for_version(req.version)
         self.stats.add("get_value")
-        return GetValueReply(value=self.store.value_at(req.key, req.version))
+        value = await self._value_at(req.key, req.version)
+        if req.version < self._read_floor():
+            raise error.transaction_too_old()
+        return GetValueReply(value=value)
 
     async def watch_value(self, req) -> Optional[Value]:
         """Park until key's value differs from req.value; returns the new
@@ -435,7 +671,7 @@ class StorageServer:
         if not self.shard.contains(req.key):
             raise error.wrong_shard_server()
         await self._wait_for_version(req.version)
-        current = self.store.value_at(req.key, self.version.get())
+        current = await self._value_at(req.key, self.version.get())
         if current != req.value:
             return current
         p = Promise()
@@ -468,6 +704,9 @@ class StorageServer:
         self._check_shard(req.begin, req.end)
         await self._wait_for_version(req.version)
         self.stats.add("get_range")
-        data, more = self.store.range_at(req.begin, req.end, req.version, req.limit, req.reverse)
+        data, more = await self._range_at(req.begin, req.end, req.version,
+                                          req.limit, req.reverse)
+        if req.version < self._read_floor():
+            raise error.transaction_too_old()
         self.stats.add("rows_read", len(data))
         return GetKeyValuesReply(data=data, more=more)

@@ -48,6 +48,12 @@ RECOVERY_DATA_TOKEN = "tlog.recoveryData"
 FSYNC_SECONDS = 0.0005
 
 
+def _spill_key(tag: int, version: Version) -> bytes:
+    """Order-preserving (tag, version) key for the spill store. Tags can be
+    negative (METADATA_TAG, backup tags), so bias into unsigned space."""
+    return (tag + 2**63).to_bytes(8, "big") + version.to_bytes(8, "big")
+
+
 class TLog:
     def __init__(
         self,
@@ -85,7 +91,17 @@ class TLog:
         self._retired_tags: set = set()
         #: append-order (version, queue end offset) for front-advance math
         self._ver_offsets: List[Tuple[Version, int]] = []
+        #: spill tier (updatePersistentData, TLogServer.actor.cpp:539):
+        #: versions <= spilled_version live in a durable KVS, not in
+        #: tag_data / the DiskQueue — memory and queue length stay bounded
+        #: by the spill threshold however far a slow storage lags
+        self.spilled_version: Version = 0
+        self._spill_store = None     # lazily-opened SSTableStore
+        self._mem_bytes = 0
+        self._bytes_by_version: List[Tuple[Version, int]] = []
         self._pops_since_persist = 0
+        self._spilling = False
+        self._deleted = False    # retired + files dropped; stop persisting
         self._side_mutex = AsyncMutex()   # serializes side-state persists
         self._inflight: set = set()  # versions appended but not yet durable
         self.tokens = {
@@ -113,11 +129,14 @@ class TLog:
 
     def delete_files(self) -> None:
         """Drop this retired generation's disk footprint."""
+        self._deleted = True
         if self.queue is None:
             return
         disk = self.queue.disk
         for suffix in (".meta", ".side", ".side.tmp", ".dq", ".dq.tmp"):
             disk.delete(self._store_name + suffix)
+        for name in disk.list(self._store_name + "-spill"):
+            disk.delete(name)
 
     async def persist_initial(self, token_suffix: str) -> None:
         """Write role metadata + the recovery-copy preload durably, so the
@@ -163,6 +182,8 @@ class TLog:
         # cycles on the shared tmp file). Snapshot taken inside the lock so
         # an older state can never land after a newer one.
         async with self._side_mutex:
+            if self._deleted:
+                return   # retired mid-persist: nothing to protect anymore
             disk = self.queue.disk
             payload = wire.dumps({
                 "popped": dict(self.popped),
@@ -170,11 +191,16 @@ class TLog:
                 "version": self.version.get(),
                 "tags_seen": set(self.tags_seen),
                 "retired": set(self._retired_tags),
+                "spilled": self.spilled_version,
             })
+            if self._spill_store is not None:
+                await self._spill_store.commit()   # pending pop clears
             tmp = disk.open(self._meta_name() + ".side.tmp")
             await tmp.truncate(0)
             await tmp.write(0, payload)
             await tmp.sync()
+            if self._deleted or not disk.exists(self._meta_name() + ".side.tmp"):
+                return   # retired between sync and rename (delete_files ran)
             disk.rename(self._meta_name() + ".side.tmp", self._meta_name() + ".side")
 
     @classmethod
@@ -208,17 +234,26 @@ class TLog:
         tlog.popped = dict(side.get("popped", {}))
         tlog.tags_seen = set(side.get("tags_seen", set())) | set(tlog.popped)
         tlog._retired_tags = set(side.get("retired", set()))
+        tlog.spilled_version = side.get("spilled", 0)
+        if (disk.exists(base + "-spill.manifest") or disk.exists(base + "-spill.dq")):
+            from .kvstore import SSTableStore
+
+            tlog._spill_store = await SSTableStore.open(disk, base + "-spill")
         version = max(meta["start_version"], side.get("version", 0))
         for off, payload in entries:
             v, messages = wire.loads(payload)
             version = max(version, v)
             tlog._ver_offsets.append((v, off))
+            if v <= tlog.spilled_version:
+                continue   # already served by the spill store
             for tag, muts in messages.items():
                 if tag in tlog._retired_tags:
                     continue
                 tlog.tags_seen.add(tag)
                 if v > tlog.popped.get(tag, 0):
                     tlog.tag_data.setdefault(tag, []).append((v, muts))
+                    tlog._bytes_by_version.append((v, len(payload)))
+                    tlog._mem_bytes += len(payload)
         tlog.version = NotifiedVersion(version)
         # Restored data is durable here but the KCV horizon must be
         # re-learned; the stored floor keeps already-served data servable.
@@ -226,6 +261,89 @@ class TLog:
             max(side.get("kcv", 0), meta["start_version"])
         )
         return tlog
+
+    # -- spill tier (updatePersistentData, TLogServer.actor.cpp:539) ---------
+    async def _maybe_spill(self) -> None:
+        """Move the oldest un-popped versions into the durable spill store
+        when the in-memory index outgrows the knob: memory and DiskQueue
+        length stay bounded no matter how far a slow storage server lags,
+        the reference's btree-spill property."""
+        from ..core.knobs import SERVER_KNOBS
+
+        if self.queue is None or self._spilling or self.stopped or self._deleted:
+            return
+        limit = SERVER_KNOBS.tlog_spill_bytes
+        if buggify.buggify():
+            limit = 512   # spill eagerly: exercises the tier under load
+        if self._mem_bytes <= limit:
+            return
+        self._spilling = True
+        try:
+            # Spill the oldest versions until memory halves.
+            acc = 0
+            target = 0
+            for v, nb in self._bytes_by_version:
+                if self._mem_bytes - acc <= limit // 2:
+                    break
+                acc += nb
+                target = v
+            if target <= self.spilled_version:
+                return
+            if self._spill_store is None:
+                from .kvstore import SSTableStore
+
+                self._spill_store = await SSTableStore.open(
+                    self.queue.disk, self._store_name + "-spill")
+            st = self._spill_store
+            for tag, entries in self.tag_data.items():
+                for v, muts in entries:
+                    if v <= target:
+                        st.set(_spill_key(tag, v), wire.dumps(muts))
+            await st.commit()
+            self.spilled_version = max(self.spilled_version, target)
+            for tag in list(self.tag_data):
+                kept = [(v, m) for (v, m) in self.tag_data[tag] if v > target]
+                if kept:
+                    self.tag_data[tag] = kept
+                else:
+                    del self.tag_data[tag]
+            keep = []
+            freed = 0
+            for v, nb in self._bytes_by_version:
+                if v <= target:
+                    freed += nb
+                else:
+                    keep.append((v, nb))
+            self._bytes_by_version = keep
+            self._mem_bytes -= freed
+            # Watermark (incl. spilled_version) BEFORE truncating the queue:
+            # the spill store + side state now carry these versions. A crash
+            # between store-commit and side-persist double-stores rows —
+            # harmless (idempotent keys); restore dedupes via the watermark.
+            await self._persist_side_state(force=True)
+            tgt_off = None
+            keep_off = []
+            for v, off in self._ver_offsets:
+                if v <= target:
+                    tgt_off = off
+                else:
+                    keep_off.append((v, off))
+            if tgt_off is not None:
+                self._ver_offsets = keep_off
+                await self.queue.pop_to(tgt_off)
+        finally:
+            self._spilling = False
+
+    async def _spilled_messages(self, tag: int, begin: Version, end: Version):
+        """Spill-store rows for `tag` in [begin, end], ascending, plus a
+        truncation flag (the caller must clip end_version when truncated)."""
+        if self._spill_store is None or begin > self.spilled_version:
+            return [], False
+        lo = _spill_key(tag, begin)
+        hi = _spill_key(tag, min(end, self.spilled_version) + 1)
+        items, more = await self._spill_store.get_range(lo, hi, 5_000)
+        out = [(int.from_bytes(k[8:], "big"), wire.loads(v)) for k, v in items]
+        return out, more
 
     async def _advance_queue_front(self) -> None:
         """Discard queue entries whose every tag has popped past them
@@ -279,8 +397,11 @@ class TLog:
             # Slow disk: stretches the fsync window other failures race with.
             await delay(0.02, TaskPriority.TLOG_COMMIT)
         if self.queue is not None:
-            off = await self.queue.push(wire.dumps((req.version, req.messages)))
+            payload = wire.dumps((req.version, req.messages))
+            off = await self.queue.push(payload)
             self._ver_offsets.append((req.version, off))
+            self._bytes_by_version.append((req.version, len(payload)))
+            self._mem_bytes += len(payload)
             await self.queue.commit()
         else:
             await delay(FSYNC_SECONDS, TaskPriority.TLOG_COMMIT)
@@ -292,8 +413,21 @@ class TLog:
             # already treats it as maybe-committed.
             raise error.tlog_stopped("locked during fsync")
         self.version.set(req.version)
+        # Only the PUSHER's known-committed may raise the KCV. prev_version
+        # is NOT safe here with multiple proxies: another proxy's partial
+        # push (died before full quorum) can be a later pusher's
+        # prev_version, and serving it would diverge from what epoch-end
+        # recovery keeps. Fresh KCVs arrive via the proxies' phase-5
+        # send_kcv one-ways, which fire only after a push's full quorum ack.
         if req.known_committed > self.known_committed.get():
             self.known_committed.set(min(req.known_committed, self.version.get()))
+        from ..core.knobs import SERVER_KNOBS
+        if (self.queue is not None and not self._spilling
+                and self._mem_bytes > SERVER_KNOBS.tlog_spill_bytes):
+            from ..sim.loop import spawn
+            task = spawn(self._maybe_spill(), TaskPriority.TLOG_COMMIT,
+                         name=f"tlog-spill:{self._store_name}")
+            self.proc.actors.add(task)
         return req.version
 
     async def _wait_version_or_stop(self, version: Version) -> None:
@@ -344,7 +478,14 @@ class TLog:
             await delay(0.05, TaskPriority.TLOG_PEEK)  # slow peek service
         data = self.tag_data.get(req.tag, [])
         horizon = min(self.version.get(), self.known_committed.get())
-        msgs = [(v, m) for (v, m) in data if req.begin_version <= v <= horizon]
+        begin = max(req.begin_version, self.popped.get(req.tag, 0) + 1)
+        spilled, truncated = await self._spilled_messages(req.tag, begin, horizon)
+        if truncated and spilled:
+            # partial spill read: serve what we have and clip the horizon so
+            # the peeker resumes exactly after the last served version
+            horizon = spilled[-1][0]
+        msgs = spilled + [(v, m) for (v, m) in data
+                          if begin <= v <= horizon and v > self.spilled_version]
         return TLogPeekReply(messages=msgs, end_version=horizon)
 
     async def pop(self, req: TLogPopRequest) -> None:
@@ -368,6 +509,12 @@ class TLog:
         data = self.tag_data.get(req.tag)
         if data:
             self.tag_data[req.tag] = [(v, m) for (v, m) in data if v > req.version]
+        if self._spill_store is not None:
+            # lazily durable (uncommitted clears are memtable-visible; a
+            # crash only re-serves acknowledged rows)
+            self._spill_store.clear_range(
+                _spill_key(req.tag, 0),
+                _spill_key(req.tag, min(req.version, self.spilled_version) + 1))
         await self._advance_queue_front()
         await self._persist_side_state()
 
@@ -386,14 +533,25 @@ class TLog:
     async def recovery_data(self, req: TLogRecoveryDataRequest) -> TLogRecoveryDataReply:
         """All un-popped data up to the recovery version, for seeding the
         next generation (the copy replaces the reference's old-generation
-        peek cursors; bounded by the 5s un-popped window)."""
+        peek cursors) — INCLUDING the spilled tier, which holds the oldest
+        part of the un-popped window (the reference's recovery peeks read
+        through the persistent store the same way)."""
         clip = req.end_version
-        out = {
-            tag: [(v, m) for (v, m) in entries if v <= clip]
-            for tag, entries in self.tag_data.items()
-            if tag not in self._retired_tags
-        }
+        out: Dict[int, list] = {}
+        for tag in self.tags_seen:
+            if tag in self._retired_tags:
+                continue
+            begin = self.popped.get(tag, 0) + 1
+            spilled, truncated = await self._spilled_messages(tag, begin, clip)
+            while truncated:
+                more, truncated = await self._spilled_messages(
+                    tag, spilled[-1][0] + 1, clip)
+                spilled.extend(more)
+            mem = [(v, m) for (v, m) in self.tag_data.get(tag, [])
+                   if v <= clip and v > self.spilled_version]
+            if spilled or mem:
+                out[tag] = spilled + mem
         return TLogRecoveryDataReply(
-            tag_data={t: e for t, e in out.items() if e},
+            tag_data=out,
             popped=dict(self.popped),
         )

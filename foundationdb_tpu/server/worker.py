@@ -260,7 +260,7 @@ class Worker:
         key = ("storage", 0, req.tag, 0)
         if key not in self.roles:
             fetch = req.fetch_from is not None
-            ss = StorageServer(
+            ss = await StorageServer.create(
                 self.proc, tag=req.tag, shard=KeyRange(req.begin, req.end),
                 log_view=self.log_view, net=self.net,
                 disk=self.sim.disk_for(self.proc.address),
@@ -272,7 +272,6 @@ class Worker:
                 # let the update loop drain this tag's buffered mutations.
                 await ss.fetch_keys(req.fetch_from, req.fetch_version)
                 await ss.persist_initial()
-                await ss._write_snapshot()
                 ss.start_update_loop()
             else:
                 await ss.persist_initial()

@@ -107,6 +107,17 @@ class Simulator:
 
             self.sched.at(self.sched.time + reboot_delay, do_boot, TaskPriority.DEFAULT_DELAY)
 
+    def revive_process(self, proc: SimProcess) -> None:
+        """Boot a process previously killed with KILL_INSTANTLY (targeted
+        down-then-up scenarios; the reference's workloads drive the same
+        through reboot requests after a delay)."""
+        if proc.alive:
+            return
+        proc.alive = True
+        proc.reboots += 1
+        self.net.monitor.set_status(proc.address, False)
+        self.boot(proc)
+
     def kill_machine(self, machine_id: str, kill_type: KillType = KillType.KILL_INSTANTLY) -> None:
         for proc in self.machines.get(machine_id, []):
             self.kill_process(proc, kill_type)

@@ -1,0 +1,109 @@
+"""The durable storage tier (VERDICT r3 item 4).
+
+The round-3 design held the dataset in RAM (snapshot + full-WAL replay);
+now the LSM engine (kvstore.SSTableStore) holds the dataset at
+durable_version, the overlay holds only the MVCC window, and recovery
+replays only the tag tail. These tests drive the Done criterion: write more
+data than the overlay window is allowed to hold (memory pressure forces
+engine flushes), crash every process with torn un-synced writes, and
+recover — intact, and WITHOUT replaying the whole history.
+Reference: storageserver.actor.cpp updateStorage:2585 + update:2340,
+KeyValueStoreSQLite.actor.cpp (engine role), tLogPop:898 ordering.
+"""
+import pytest
+
+from foundationdb_tpu.server.cluster import (
+    DynamicClusterConfig,
+    build_dynamic_cluster,
+)
+from foundationdb_tpu.server.storage import StorageServer
+from foundationdb_tpu.sim.simulator import KillType
+
+
+def drive(sim, coro, until=240.0):
+    return sim.run_until(sim.sched.spawn(coro), until=until)
+
+
+def live_storage_servers(cluster):
+    out = []
+    for p in cluster.worker_procs:
+        h = p.handlers.get("storage.getValue")
+        if h is not None:
+            out.append(h.__self__)
+    return out
+
+
+ROWS = 150
+VAL = b"v" * 120
+
+
+def fill(db):
+    async def go():
+        for base in range(0, ROWS, 10):
+            async def w(tr):
+                for i in range(base, min(base + 10, ROWS)):
+                    tr.set(b"big/%04d" % i, VAL + b"%04d" % i)
+            await db.run(w)
+        return True
+    return go()
+
+
+def read_all(db):
+    async def go():
+        out = []
+        async def r(tr):
+            out.clear()
+            out.extend(await tr.get_range(b"big/", b"big/\xff"))
+        await db.run(r)
+        return out
+    return go()
+
+
+def test_engine_absorbs_dataset_under_memory_pressure(monkeypatch):
+    """With a tiny pending budget, the durability cycle must push data into
+    the engine: durable_version advances and the overlay stays small."""
+    monkeypatch.setattr(StorageServer, "PENDING_BYTES", 2048)
+    c = build_dynamic_cluster(seed=71, cfg=DynamicClusterConfig())
+    sim = c.sim
+    db = c.new_client()
+    assert drive(sim, fill(db))
+    sim.run(until=sim.sched.time + 3.0)
+    sses = live_storage_servers(c)
+    assert sses, "no live storage servers"
+    flushed = [ss for ss in sses if ss.kvs is not None and ss.durable_version > 0]
+    assert flushed, "no storage server ever flushed to the durable engine"
+    for ss in flushed:
+        # the overlay holds only the un-durable window, not the dataset
+        assert len(ss.store._keys) < ROWS, (
+            f"overlay still holds {len(ss.store._keys)} keys")
+
+
+def test_crash_all_recovers_from_engine_without_full_replay(monkeypatch):
+    monkeypatch.setattr(StorageServer, "PENDING_BYTES", 2048)
+    c = build_dynamic_cluster(seed=72, cfg=DynamicClusterConfig())
+    sim = c.sim
+    db = c.new_client()
+    assert drive(sim, fill(db))
+    sim.run(until=sim.sched.time + 3.0)
+    pre = live_storage_servers(c)
+    pre_mutations = sum(ss.stats.as_dict().get("mutations", 0) for ss in pre)
+    pre_durable = {ss.tag: ss.durable_version for ss in pre if ss.durable_version > 0}
+    assert pre_durable, "nothing was durable before the crash"
+
+    for p in c.coord_procs + c.worker_procs:
+        sim.kill_process(p, KillType.REBOOT)
+
+    got = drive(sim, read_all(db), until=sim.sched.time + 300.0)
+    want = [(b"big/%04d" % i, VAL + b"%04d" % i) for i in range(ROWS)]
+    assert got == want
+
+    post = live_storage_servers(c)
+    restored = [ss for ss in post if ss.tag in pre_durable]
+    assert restored
+    for ss in restored:
+        # recovery replayed only the tag tail above durable — a re-applied
+        # history would show mutation counts near the pre-crash total
+        replayed = ss.stats.as_dict().get("mutations", 0)
+        assert replayed < max(pre_mutations // 2, 1), (
+            f"tag {ss.tag} replayed {replayed} mutations "
+            f"(pre-crash total across servers: {pre_mutations})")

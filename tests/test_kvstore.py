@@ -1,0 +1,161 @@
+"""SSTableStore: the durable LSM key-value engine (VERDICT r3 item 4).
+
+Covers the IKeyValueStore contract the storage tier now stands on: batch
+commits are the durability point, flush/compaction keep the dataset on
+disk (not in the memtable), reopen recovers exactly the committed state,
+and crashes that tear un-synced writes lose only un-acked batches.
+Reference roles: KeyValueStoreSQLite.actor.cpp (durable engine),
+DiskQueue.actor.cpp (WAL), IKeyValueStore.h:30-99 (contract).
+"""
+import random
+
+import pytest
+
+from foundationdb_tpu.server.kvstore import SSTableStore
+from foundationdb_tpu.sim.simulator import Simulator
+
+
+def drive(sim, coro, until=300.0):
+    return sim.run_until(sim.sched.spawn(coro), until=until)
+
+
+def model_apply(model, ops):
+    for op in ops:
+        if op[0] == 0:
+            model[op[1]] = op[2]
+        else:
+            for k in [k for k in model if op[1] <= k < op[2]]:
+                del model[k]
+
+
+def test_basic_set_get_clear_reopen():
+    sim = Simulator(seed=3)
+    disk = sim.disk_for("kv")
+
+    async def work():
+        st = await SSTableStore.open(disk, "db")
+        st.set(b"a", b"1")
+        st.set(b"b", b"2")
+        st.set(b"c", b"3")
+        await st.commit()
+        assert await st.get(b"b") == b"2"
+        st.clear_range(b"b", b"c")
+        await st.commit()
+        assert await st.get(b"b") is None
+        assert await st.get(b"c") == b"3"
+        items, more = await st.get_range(b"", b"\xff", 10)
+        assert items == [(b"a", b"1"), (b"c", b"3")] and not more
+        # reopen: WAL replay restores the same state
+        st2 = await SSTableStore.open(disk, "db")
+        assert await st2.get(b"a") == b"1"
+        assert await st2.get(b"b") is None
+        items2, _ = await st2.get_range(b"", b"\xff", 10)
+        assert items2 == items
+        return True
+
+    assert drive(sim, work())
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_randomized_vs_model_with_flushes(seed):
+    """Enough volume to force flushes + compactions; every read window is
+    checked against a dict model, including reverse ranges."""
+    sim = Simulator(seed=seed)
+    disk = sim.disk_for("kv")
+    rng = random.Random(seed)
+
+    async def work():
+        st = await SSTableStore.open(disk, "db")
+        st.FLUSH_BYTES = 4096
+        st.MAX_RUNS = 3
+        model = {}
+        for batch in range(60):
+            ops = []
+            for _ in range(rng.randrange(1, 20)):
+                if rng.random() < 0.8:
+                    k = b"k%04d" % rng.randrange(500)
+                    v = (b"v%06d" % rng.randrange(10**6)) * rng.randrange(1, 4)
+                    ops.append((0, k, v))
+                else:
+                    a, b = sorted(
+                        [b"k%04d" % rng.randrange(500), b"k%04d" % rng.randrange(500)])
+                    ops.append((1, a, b + b"\x00"))
+            for op in ops:
+                if op[0] == 0:
+                    st.set(op[1], op[2])
+                else:
+                    st.clear_range(op[1], op[2])
+            model_apply(model, ops)
+            await st.commit()
+            if batch % 7 == 0:
+                a, b = sorted(
+                    [b"k%04d" % rng.randrange(500), b"k%04d" % rng.randrange(500)])
+                b = b + b"\xff"
+                want = sorted((k, v) for k, v in model.items() if a <= k < b)
+                got, _ = await st.get_range(a, b, 10_000)
+                assert got == want, (batch, a, b)
+                got_r, _ = await st.get_range(a, b, 10_000, reverse=True)
+                assert got_r == list(reversed(want)), (batch, "reverse")
+                for _ in range(5):
+                    k = b"k%04d" % rng.randrange(500)
+                    assert await st.get(k) == model.get(k), (batch, k)
+        # limit + more pagination
+        want = sorted(model.items())
+        page, more = await st.get_range(b"", b"\xff", 7)
+        assert page == want[:7]
+        assert more == (len(want) > 7)
+        # reopen equivalence after all that compaction
+        st2 = await SSTableStore.open(disk, "db")
+        got, _ = await st2.get_range(b"", b"\xff", 100_000)
+        assert got == want
+        return True
+
+    assert drive(sim, work(), until=3000.0)
+
+
+@pytest.mark.parametrize("seed", list(range(20, 30)))
+def test_crash_loses_only_unacked_batches(seed):
+    """Kill the process with torn un-synced writes at a random moment:
+    reopen must serve exactly some prefix of committed batches — never a
+    corrupt state, never a lost ACKED batch."""
+    sim = Simulator(seed=seed)
+    disk = sim.disk_for("kv")
+    rng = random.Random(seed)
+    committed_states = []
+
+    async def work():
+        st = await SSTableStore.open(disk, "db")
+        st.FLUSH_BYTES = 2048
+        st.MAX_RUNS = 3
+        model = {}
+        for batch in range(rng.randrange(5, 25)):
+            ops = []
+            for _ in range(rng.randrange(1, 10)):
+                if rng.random() < 0.85:
+                    ops.append((0, b"k%03d" % rng.randrange(80),
+                                b"v%05d.%03d" % (rng.randrange(10**5), batch)))
+                else:
+                    a, b = sorted([b"k%03d" % rng.randrange(80),
+                                   b"k%03d" % rng.randrange(80)])
+                    ops.append((1, a, b + b"\x00"))
+            for op in ops:
+                if op[0] == 0:
+                    st.set(op[1], op[2])
+                else:
+                    st.clear_range(op[1], op[2])
+            model_apply(model, ops)
+            await st.commit()          # ACK boundary
+            committed_states.append(sorted(model.items()))
+        return True
+
+    assert drive(sim, work(), until=3000.0)
+    disk.crash(sim.sched.rng)          # tear whatever was un-synced
+
+    async def readback():
+        st = await SSTableStore.open(disk, "db")
+        got, _ = await st.get_range(b"", b"\xff", 100_000)
+        return got
+
+    got = drive(sim, readback(), until=3000.0)
+    # every batch was ACKed (commit returned), so the final state must match
+    assert got == committed_states[-1]

@@ -19,25 +19,41 @@ from foundationdb_tpu.server.ratekeeper import (
 
 
 def test_update_rate_mapping():
+    """Signals: FETCH lag (committed - applied version) and un-durable
+    queue bytes. Durability-version lag is by design (the engine trails by
+    storage_durability_lag_versions) and must NOT throttle."""
     rk = Ratekeeper(None, "x", [], lambda: 10_000_000)
     max_tps = float(SERVER_KNOBS.max_transactions_per_second)
     # no info -> unthrottled
     assert rk._update_rate([]) == max_tps
-    # below target lag -> unthrottled
-    infos = [StorageQueueInfo(0, 10_000_000, 10_000_000 - TARGET_STORAGE_LAG_VERSIONS // 2)]
+    # below target fetch lag -> unthrottled
+    infos = [StorageQueueInfo(0, 10_000_000 - TARGET_STORAGE_LAG_VERSIONS // 2, 0)]
     assert rk._update_rate(infos) == max_tps
-    # mid lag -> proportional
+    # a large DURABILITY lag alone must not throttle
+    infos = [StorageQueueInfo(0, 10_000_000, 10_000_000 - 2 * MAX_STORAGE_LAG_VERSIONS)]
+    assert rk._update_rate(infos) == max_tps
+    # mid fetch lag -> proportional
     mid = (TARGET_STORAGE_LAG_VERSIONS + MAX_STORAGE_LAG_VERSIONS) // 2
-    infos = [StorageQueueInfo(0, 10_000_000, 10_000_000 - mid)]
+    infos = [StorageQueueInfo(0, 10_000_000 - mid, 0)]
     got = rk._update_rate(infos)
     assert 0.3 * max_tps < got < 0.7 * max_tps
-    # beyond max lag -> crawl, never zero
-    infos = [StorageQueueInfo(0, 10_000_000, 10_000_000 - 2 * MAX_STORAGE_LAG_VERSIONS)]
+    # beyond max fetch lag -> crawl, never zero
+    infos = [StorageQueueInfo(0, 10_000_000 - 2 * MAX_STORAGE_LAG_VERSIONS, 0)]
     assert rk._update_rate(infos) == 1.0
+    # queue bytes past the target -> crawl; mid-spring -> proportional
+    infos = [StorageQueueInfo(0, 10_000_000, 10_000_000,
+                              queue_bytes=SERVER_KNOBS.target_storage_queue_bytes)]
+    assert rk._update_rate(infos) == 1.0
+    infos = [StorageQueueInfo(
+        0, 10_000_000, 10_000_000,
+        queue_bytes=SERVER_KNOBS.target_storage_queue_bytes
+        - SERVER_KNOBS.spring_storage_queue_bytes // 2)]
+    got = rk._update_rate(infos)
+    assert 0.3 * max_tps < got < 0.7 * max_tps
     # the WORST storage wins
     infos = [
         StorageQueueInfo(0, 10_000_000, 10_000_000),
-        StorageQueueInfo(1, 10_000_000, 10_000_000 - 2 * MAX_STORAGE_LAG_VERSIONS),
+        StorageQueueInfo(1, 10_000_000 - 2 * MAX_STORAGE_LAG_VERSIONS, 0),
     ]
     assert rk._update_rate(infos) == 1.0
 
