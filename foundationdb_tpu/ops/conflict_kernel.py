@@ -58,6 +58,11 @@ class KernelConfig:
     max_txns: int = 1 << 12     # T: transactions per device batch
     max_point_reads: int = -1   # Rp: POINT read rows (-1: same as max_reads)
     max_point_writes: int = -1  # Wp: POINT write rows (-1: same as max_writes)
+    #: commit-fixpoint engine: "xla" (while_loop of small kernels; the only
+    #: option for the mesh engine, whose psum is its collective round),
+    #: "pallas" (one fused TPU kernel, fixpoint_pallas.py), or
+    #: "pallas_interpret" (the same kernel on the interpreter, for CPU CI)
+    fixpoint: str = "xla"
 
     @property
     def lanes(self) -> int:     # K: words per packed key incl. length
@@ -676,7 +681,7 @@ def fix_step(cfg: KernelConfig, t_ok: jnp.ndarray, hist_hits: jnp.ndarray,
              edges: Dict[str, jnp.ndarray], batch: Dict[str, jnp.ndarray]) -> jnp.ndarray:
     """Re-run the earlier-in-batch-wins fixpoint with an updated t_ok mask
     (host-tier aborts folded in); cheap relative to detect_step."""
-    return commit_fixpoint(cfg, t_ok, hist_hits, edges, batch)
+    return _fixpoint(cfg, t_ok, hist_hits, edges, batch)
 
 
 def apply_step(cfg: KernelConfig, state: Dict[str, jnp.ndarray],
@@ -696,11 +701,31 @@ def status_of(t_too_old: jnp.ndarray, committed: jnp.ndarray) -> jnp.ndarray:
     )
 
 
+def _fixpoint(cfg: KernelConfig, t_ok, hist_hits, edges, batch) -> jnp.ndarray:
+    """Dispatch to the configured single-shard fixpoint engine. An explicit
+    'pallas' request on an unsupported shape raises rather than silently
+    measuring the XLA path under the wrong label."""
+    if cfg.fixpoint in ("pallas", "pallas_interpret"):
+        from . import fixpoint_pallas as fp
+
+        if not fp.supported(cfg):
+            raise ValueError(
+                f"fixpoint='{cfg.fixpoint}' requested but the config is not "
+                f"kernel-supported (need max_txns%32==0 and the gid/txn "
+                f"encoding to fit int32); use fixpoint='xla'")
+        return fp.commit_fixpoint_pallas(
+            cfg, t_ok, hist_hits, edges, batch,
+            interpret=(cfg.fixpoint == "pallas_interpret"))
+    if cfg.fixpoint != "xla":
+        raise ValueError(f"unknown fixpoint engine {cfg.fixpoint!r}")
+    return commit_fixpoint(cfg, t_ok, hist_hits, edges, batch)
+
+
 def resolve_step(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[str, jnp.ndarray]) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
     """One single-shard resolver batch: (state, batch) -> (state', outputs).
     Pure; jit me. See local_phases for the batch layout."""
     hist_hits, edges, wpos = local_phases(cfg, state, batch)
-    committed = commit_fixpoint(cfg, batch["t_ok"], hist_hits, edges, batch)
+    committed = _fixpoint(cfg, batch["t_ok"], hist_hits, edges, batch)
     new_state, overflow = apply_writes_and_gc(cfg, state, batch, committed, wpos)
     out = {
         "status": status_of(batch["t_too_old"], committed),

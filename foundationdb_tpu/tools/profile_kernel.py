@@ -405,11 +405,14 @@ def main2(variants):
 
 
 def main3(variants):
-    """Candidate bench shapes: GC cadence + batch width sweeps."""
-    for name, T, gc_every in (
-        ("gc4_T4096", 4096, 4),
-        ("gc4_T8192", 8192, 4),
-        ("gc1_T8192", 8192, 1),
+    """Candidate bench shapes: GC cadence + batch width + fixpoint sweeps."""
+    for name, T, gc_every, fixp in (
+        ("gc4_T4096", 4096, 4, "xla"),
+        ("gc4_T8192", 8192, 4, "xla"),
+        ("gc1_T8192", 8192, 1, "xla"),
+        ("pallas_T4096", 4096, 4, "pallas"),
+        ("pallas_gc1_T4096", 4096, 1, "pallas"),
+        ("pallas_T8192", 8192, 4, "pallas"),
     ):
         if name not in variants:
             continue
@@ -417,6 +420,7 @@ def main3(variants):
             key_words=4, capacity=24576,
             max_point_reads=2 * T, max_point_writes=2 * T,
             max_reads=256, max_writes=256, max_txns=T,
+            fixpoint=fixp,
         )
         rng = np.random.default_rng(2026)
         K = cfg.lanes
@@ -479,7 +483,7 @@ if __name__ == "__main__":
         "full", "phases12", "phases1only", "sort", "fixpoint", "apply",
         "binsearch", "sortbatch",
     ]
-    if any(v.startswith(("gc4_", "gc1_")) for v in args):
+    if any(v.startswith(("gc4_", "gc1_", "pallas_")) for v in args):
         main3(args)
     elif any(v in ("fixiters", "stacked8") for v in args):
         main2(args)

@@ -1,0 +1,111 @@
+"""Pallas fused-fixpoint kernel vs the XLA while_loop fixpoint.
+
+The kernel (ops/fixpoint_pallas.py) must produce bit-identical committed
+sets: same monotone function, same iteration start, integer-only ops. CI
+runs it on the Pallas interpreter (CPU); the bench's parity gate covers
+the compiled TPU path.
+"""
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from foundationdb_tpu.core.types import CommitTransaction, KeyRange
+from foundationdb_tpu.ops import conflict_kernel as ck
+from foundationdb_tpu.ops import fixpoint_pallas as fp
+from foundationdb_tpu.ops.conflict_kernel import KernelConfig, build_batch_arrays
+from foundationdb_tpu.ops.host_engine import JaxConflictEngine
+from foundationdb_tpu.ops.oracle import OracleConflictEngine
+
+CFG = KernelConfig(key_words=2, capacity=512, max_txns=32,
+                   max_point_reads=128, max_point_writes=128,
+                   max_reads=32, max_writes=32)
+
+
+def synth_batch(rng, cfg, now_rel):
+    T = cfg.max_txns
+    ntx = rng.randrange(2, T + 1)
+    rp_keys, rp_snap, rp_txn = [], [], []
+    r_b, r_e, r_s, r_t = [], [], [], []
+    wp_keys, wp_txn = [], []
+    w_b, w_e, w_t = [], [], []
+    for t in range(ntx):
+        for _ in range(rng.randrange(0, 4)):
+            k = b"%02d" % rng.randrange(24)
+            rp_keys.append(k); rp_snap.append(rng.randrange(0, 50)); rp_txn.append(t)
+        if rng.random() < 0.4:
+            a, b = sorted([b"%02d" % rng.randrange(24), b"%02d" % rng.randrange(24)])
+            r_b.append(a); r_e.append(b + b"\x00")
+            r_s.append(rng.randrange(0, 50)); r_t.append(t)
+        for _ in range(rng.randrange(0, 3)):
+            k = b"%02d" % rng.randrange(24)
+            wp_keys.append(k); wp_txn.append(t)
+        if rng.random() < 0.3:
+            a, b = sorted([b"%02d" % rng.randrange(24), b"%02d" % rng.randrange(24)])
+            w_b.append(a); w_e.append(b + b"\x00"); w_t.append(t)
+    t_ok = np.zeros((T,), bool)
+    t_ok[:ntx] = True
+    for t in rng.sample(range(ntx), k=min(3, ntx)):
+        if rng.random() < 0.3:
+            t_ok[t] = False
+    t_old = np.zeros((T,), bool)
+    batch = build_batch_arrays(cfg, rp_keys, rp_snap, rp_txn, r_b, r_e, r_s, r_t,
+                               wp_keys, wp_txn, w_b, w_e, w_t, t_ok, t_old,
+                               now_rel=now_rel, gc_rel=0)
+    return {k: jnp.asarray(v) for k, v in batch.items()}, t_ok
+
+
+def test_kernel_matches_xla_fixpoint():
+    assert fp.supported(CFG)
+    rng = random.Random(3)
+    state = ck.initial_state(CFG)
+    for trial in range(20):
+        batch, t_ok = synth_batch(rng, CFG, 100 + trial)
+        hist, edges, wpos = jax.jit(
+            lambda s, b: ck.local_phases(CFG, s, b))(state, batch)
+        want = jax.jit(
+            lambda tok, h, e, b: ck.commit_fixpoint(CFG, tok, h, e, b)
+        )(jnp.asarray(t_ok), hist, edges, batch)
+        got = fp.commit_fixpoint_pallas(
+            CFG, jnp.asarray(t_ok), hist, edges, batch, interpret=True)
+        assert np.array_equal(np.asarray(got), np.asarray(want)), trial
+        state, _ = jax.jit(lambda s, b: ck.resolve_step(CFG, s, b))(state, batch)
+
+
+def test_engine_with_pallas_fixpoint_matches_oracle():
+    """Whole-engine path under fixpoint='pallas_interpret' (incl. the
+    long-key split-step fix_step) vs the reference-exact oracle."""
+    cfg = KernelConfig(key_words=2, capacity=512, max_txns=32,
+                       max_point_reads=128, max_point_writes=128,
+                       max_reads=32, max_writes=32,
+                       fixpoint="pallas_interpret")
+    eng = JaxConflictEngine(cfg)
+    ora = OracleConflictEngine()
+    rng = random.Random(9)
+    now, oldest = 10, 0
+    for b in range(20):
+        now += rng.randrange(1, 30)
+        if rng.random() < 0.3:
+            oldest = max(oldest, now - rng.randrange(20, 100))
+        txns = []
+        for _ in range(rng.randrange(1, 10)):
+            t = CommitTransaction(read_snapshot=max(0, now - rng.randrange(1, 40)))
+            for _ in range(rng.randrange(0, 3)):
+                k = b"%02d" % rng.randrange(32)
+                t.read_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            if rng.random() < 0.4:
+                a, bk = sorted([b"%02d" % rng.randrange(32), b"%02d" % rng.randrange(32)])
+                t.read_conflict_ranges.append(KeyRange(a, bk + b"\x00"))
+            for _ in range(rng.randrange(0, 3)):
+                k = b"%02d" % rng.randrange(32)
+                t.write_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            if rng.random() < 0.25:
+                a, bk = sorted([b"%02d" % rng.randrange(32), b"%02d" % rng.randrange(32)])
+                t.write_conflict_ranges.append(KeyRange(a, bk + b"\x00"))
+            txns.append(t)
+        got = eng.resolve(txns, now, oldest)
+        want = ora.resolve(txns, now, oldest)
+        assert got == want, (b, got, want)
