@@ -77,6 +77,13 @@ def _make_server_knobs() -> Knobs:
     # Storage
     k.init("storage_durability_lag_versions", 2_000_000)
     k.init("desired_total_bytes", 150_000)
+    #: byte-sample granularity (reference: BYTE_SAMPLING_FACTOR — keys are
+    #: sampled with probability size/factor and carry weight `factor`)
+    k.init("dd_byte_sample_factor", 200)
+    # DataDistribution (reference: DataDistributionTracker split/merge)
+    k.init("dd_tracker_interval", 2.0)
+    k.init("dd_shard_split_bytes", 100_000, lambda r: r.random_int(4_000, 50_000))
+    k.init("dd_shard_merge_bytes", 2_000)
     # Failure detection (reference: CC failureDetectionServer)
     k.init("failure_detection_delay", 1.0, lambda r: 0.2 + r.random01() * 2)
     k.init("heartbeat_interval", 0.25)

@@ -44,6 +44,9 @@ class WorkerRegisterRequest:
     #: role kinds this worker currently hosts (for the status document's
     #: machine layer; reference: worker details in Status.actor.cpp)
     roles: tuple = ()
+    #: (machine_id, dc_id) — the sim's LocalityData (fdbrpc/Locality.h),
+    #: feeding the master's replication policy
+    locality: tuple = ("", "")
 
 
 @dataclass
@@ -60,6 +63,7 @@ class ClusterController:
         self.proc = worker.proc
         #: addr -> role kinds last reported in registration
         self.worker_roles = {}
+        self.worker_locality = {}
         #: (recovery_count, sim time) for every master hand-over seen
         self.recovery_history = []
         self.coords = worker.coords
@@ -94,6 +98,7 @@ class ClusterController:
     async def register_worker(self, req: WorkerRegisterRequest) -> Optional[ServerDBInfo]:
         self.workers[req.addr] = now()
         self.worker_roles[req.addr] = tuple(req.roles)
+        self.worker_locality[req.addr] = tuple(req.locality)
         if req.known_info_version < self.db_info.info_version:
             return self.db_info
         return None
@@ -205,7 +210,8 @@ class ClusterController:
         """The master finished its recovery transaction + cstate write. A
         delayed report from an older, deposed generation must not overwrite
         a newer one (one-ways can reorder under clogging)."""
-        if info.recovery_count <= self.db_info.recovery_count:
+        cur = (self.db_info.recovery_count, self.db_info.dd_version)
+        if (info.recovery_count, getattr(info, "dd_version", 0)) <= cur:
             return
         info.info_version = self.db_info.info_version + 1
         self.db_info = info
@@ -242,6 +248,7 @@ class ClusterController:
                         salt=salt,
                         cc_addr=self.proc.address,
                         cluster_cfg=self.cluster_cfg,
+                        worker_localities=dict(self.worker_locality),
                     ),
                     TaskPriority.CLUSTER_CONTROLLER,
                     timeout=2.0,

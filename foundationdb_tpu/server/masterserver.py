@@ -107,6 +107,8 @@ class MasterServer:
         self.salt = req.salt
         self.cc_addr = req.cc_addr
         self.cfg = req.cluster_cfg
+        #: addr -> (machine_id, dc_id) for policy-driven team placement
+        self.localities = dict(getattr(req, "worker_localities", None) or {})
         self.master: Optional[Master] = None
 
     def _state(self, s: str, **details) -> None:
@@ -213,20 +215,11 @@ class MasterServer:
             raise
 
         # (4) durable authority + cleanup
-        new_tags = sorted(
+        new_tags = (
             [(t, b, e, a) for (t, b, e, a) in tags if b != req.begin]
             + [(nt, req.begin, end, d) for nt, d in new_team]
         )
-        dd["cstate_val"] = replace(dd["cstate_val"], storage_tags=tuple(new_tags))
-        await cstate.set_exclusive(dd["cstate_val"])
-        dd["storage_tags"][:] = new_tags
-        ratekeeper.storage_tags = list(new_tags)
-        from .cluster_controller import CC_MASTER_RECOVERED_TOKEN
-
-        dd["info"] = replace(dd["info"], storage_tags=tuple(new_tags))
-        self.net.one_way(self.proc.address,
-                         Endpoint(self.cc_addr, CC_MASTER_RECOVERED_TOKEN),
-                         dd["info"], TaskPriority.CLUSTER_CONTROLLER)
+        await self._publish_tags(dd, cstate, ratekeeper, new_tags)
         for t, a in team:
             self.net.one_way(self.proc.address, Endpoint(a, RETIRE_STORAGE_TOKEN),
                              RetireStorageRequest(tags=(t,)),
@@ -234,6 +227,162 @@ class MasterServer:
             log_client.pop(t, -1)
         TraceEvent("MoveShardDone", id=self.salt).detail("Begin", req.begin).log()
         return {"begin": req.begin, "team": new_team}
+
+    async def _publish_tags(self, dd, cstate, ratekeeper, new_tags) -> None:
+        """Persist a storage-map change in cstate (the recovery authority)
+        and fan the new map out to ratekeeper + the CC status document."""
+        from dataclasses import replace
+        from .cluster_controller import CC_MASTER_RECOVERED_TOKEN
+
+        new_tags = sorted(new_tags)
+        dd["cstate_val"] = replace(dd["cstate_val"], storage_tags=tuple(new_tags))
+        await cstate.set_exclusive(dd["cstate_val"])
+        dd["storage_tags"][:] = new_tags
+        ratekeeper.storage_tags = list(new_tags)
+        dd["info"] = replace(dd["info"], storage_tags=tuple(new_tags),
+                             dd_version=dd["info"].dd_version + 1)
+        self.net.one_way(self.proc.address,
+                         Endpoint(self.cc_addr, CC_MASTER_RECOVERED_TOKEN),
+                         dd["info"], TaskPriority.CLUSTER_CONTROLLER)
+
+    async def _split_shard(self, begin, split_key, dests, dd, dd_db,
+                           log_client, cstate, ratekeeper):
+        """DD shard split (DataDistributionTracker's shardSplitter +
+        MoveKeys combined): the team keeps [begin, split_key); a fresh team
+        is recruited for [split_key, end) — double-tagged, fetched at a
+        post-split read version, flipped, then the old replicas SHRINK."""
+        from .storage import SHRINK_SHARD_TOKEN, ShrinkShardRequest
+
+        tags = dd["storage_tags"]
+        team = sorted((t, a) for (t, b, _e, a) in tags if b == begin)
+        if not team:
+            raise error.client_invalid_operation(f"no shard begins at {begin!r}")
+        end = next(e for (_t, b, e, _a) in tags if b == begin)
+        if not (begin < split_key < end):
+            raise error.client_invalid_operation("split key outside shard")
+        next_tag = max(t for (t, _b, _e, _a) in tags) + 1
+        new_team = [(next_tag + i, d) for i, d in enumerate(dests)]
+        TraceEvent("ShardSplitStart", id=self.salt).detail(
+            "Begin", begin).detail("SplitKey", split_key).log()
+
+        async def ph1(tr):
+            tr.set_access_system_keys()
+            tr.set(system_keys.key_servers_key(split_key),
+                   system_keys.encode_key_servers(
+                       team, tuple(t for t, _ in new_team)))
+        await dd_db.run(ph1)
+        try:
+            tr = dd_db.create_transaction()
+            v0 = await tr.get_read_version()
+            await all_of([
+                self.net.request(
+                    self.proc.address, Endpoint(d, INIT_STORAGE_TOKEN),
+                    InitializeStorageRequest(
+                        tag=nt, begin=split_key, end=end,
+                        fetch_from=[a for _t, a in team], fetch_version=v0,
+                    ),
+                    TaskPriority.MOVE_KEYS, timeout=60.0,
+                )
+                for nt, d in new_team
+            ])
+
+            async def ph2(tr):
+                tr.set_access_system_keys()
+                tr.set(system_keys.key_servers_key(split_key),
+                       system_keys.encode_key_servers(new_team))
+            await dd_db.run(ph2)
+        except error.FDBError:
+            TraceEvent("ShardSplitAbort", id=self.salt).detail("Begin", begin).log()
+
+            async def rollback(tr):
+                tr.set_access_system_keys()
+                tr.set(system_keys.key_servers_key(split_key),
+                       system_keys.encode_key_servers([]))   # drop boundary
+            await dd_db.run(rollback)
+            for nt, d in new_team:
+                self.net.one_way(self.proc.address, Endpoint(d, RETIRE_STORAGE_TOKEN),
+                                 RetireStorageRequest(tags=(nt,)),
+                                 TaskPriority.MOVE_KEYS)
+                log_client.pop(nt, -1)
+            raise
+
+        # durable authority BEFORE shrinking: a crash after this point
+        # recovers with the split map and both teams intact
+        new_tags = (
+            [(t, b, split_key if b == begin else e, a)
+             for (t, b, e, a) in tags]
+            + [(nt, split_key, end, d) for nt, d in new_team]
+        )
+        await self._publish_tags(dd, cstate, ratekeeper, new_tags)
+        await all_of([
+            self.net.request(
+                self.proc.address, Endpoint(a, SHRINK_SHARD_TOKEN),
+                ShrinkShardRequest(tag=t, new_end=split_key),
+                TaskPriority.MOVE_KEYS, timeout=10.0,
+            )
+            for t, a in team
+        ])
+        TraceEvent("ShardSplitDone", id=self.salt).detail(
+            "Begin", begin).detail("SplitKey", split_key).log()
+        return {"begin": begin, "split_key": split_key, "new_team": new_team}
+
+    async def _merge_shards(self, begin1, begin2, dd, dd_db, log_client,
+                            cstate, ratekeeper):
+        """DD shard merge (shardMerger): the lower team absorbs the upper
+        range — double-tag the upper shard with the lower team's tags,
+        EXTEND the lower replicas (fetch at a post-tag version), remove the
+        boundary, retire the upper team."""
+        from .storage import EXTEND_SHARD_TOKEN, ExtendShardRequest
+
+        tags = dd["storage_tags"]
+        team1 = sorted((t, a) for (t, b, _e, a) in tags if b == begin1)
+        team2 = sorted((t, a) for (t, b, _e, a) in tags if b == begin2)
+        if not team1 or not team2:
+            raise error.client_invalid_operation("merge shards not found")
+        end1 = next(e for (_t, b, e, _a) in tags if b == begin1)
+        end2 = next(e for (_t, b, e, _a) in tags if b == begin2)
+        if end1 != begin2:
+            raise error.client_invalid_operation("shards not adjacent")
+        TraceEvent("ShardMergeStart", id=self.salt).detail(
+            "Begin", begin1).detail("Upper", begin2).log()
+
+        async def ph1(tr):
+            tr.set_access_system_keys()
+            tr.set(system_keys.key_servers_key(begin2),
+                   system_keys.encode_key_servers(
+                       team2, tuple(t for t, _ in team1)))
+        await dd_db.run(ph1)
+        tr = dd_db.create_transaction()
+        v0 = await tr.get_read_version()
+        await all_of([
+            self.net.request(
+                self.proc.address, Endpoint(a, EXTEND_SHARD_TOKEN),
+                ExtendShardRequest(tag=t, new_end=end2,
+                                   fetch_from=[a2 for _t2, a2 in team2],
+                                   fetch_version=v0),
+                TaskPriority.MOVE_KEYS, timeout=60.0,
+            )
+            for t, a in team1
+        ])
+
+        async def ph2(tr):
+            tr.set_access_system_keys()
+            tr.set(system_keys.key_servers_key(begin2),
+                   system_keys.encode_key_servers([]))   # remove boundary
+        await dd_db.run(ph2)
+
+        new_tags = (
+            [(t, b, end2 if b == begin1 else e, a)
+             for (t, b, e, a) in tags if b != begin2]
+        )
+        await self._publish_tags(dd, cstate, ratekeeper, new_tags)
+        for t, a in team2:
+            self.net.one_way(self.proc.address, Endpoint(a, RETIRE_STORAGE_TOKEN),
+                             RetireStorageRequest(tags=(t,)),
+                             TaskPriority.MOVE_KEYS)
+            log_client.pop(t, -1)
+        TraceEvent("ShardMergeDone", id=self.salt).detail("Begin", begin1).log()
+        return {"begin": begin1, "end": end2}
 
     async def _recover_and_serve(self) -> None:
         cfg = self.cfg
@@ -582,6 +731,106 @@ class MasterServer:
             finally:
                 dd["busy"] = False
 
+        def pick_spares(n: int):
+            """Policy-selected destination workers: alive, not hosting
+            storage, not excluded, spread across machines
+            (DDTeamCollection's team builder behind PolicyAcross)."""
+            from .replication_policy import PolicyAcross
+
+            hosts = {a for (_t, _b, _e, a) in dd["storage_tags"]}
+            cands = sorted(
+                w for w in self.workers
+                if not self.net.monitor.is_failed(w)
+                and w not in hosts and w not in dd["excluded"]
+            )
+            return PolicyAcross(n, "machine_id").select(cands, self.localities)
+
+        async def dd_tracker() -> None:
+            """Shard size tracking + split/merge decisions, the
+            DataDistributionTracker loop: poll each team's byte sample,
+            split the largest over-threshold shard at its sample median
+            onto a policy-picked fresh team, merge adjacent dwarf shards.
+            One relocation at a time (the move queue's parallelism limit;
+            DataDistributionQueue.actor.cpp)."""
+            from ..core.knobs import SERVER_KNOBS
+            from .storage import STORAGE_METRICS_TOKEN
+
+            await dd["init_done"].future
+            while True:
+                await delay(SERVER_KNOBS.dd_tracker_interval, TaskPriority.MOVE_KEYS)
+                if dd["busy"]:
+                    continue
+                tags = list(dd["storage_tags"])
+                teams = _teams_by_begin(tags)
+                ranges = sorted({(b, e) for (_t, b, e, _a) in tags})
+                metrics = {}
+                ok = True
+                for b, _e in ranges:
+                    _t0, a0 = teams[b][0]
+                    try:
+                        metrics[b] = await self.net.request(
+                            self.proc.address, Endpoint(a0, STORAGE_METRICS_TOKEN),
+                            None, TaskPriority.MOVE_KEYS, timeout=1.0,
+                        )
+                    except error.FDBError:
+                        ok = False
+                        break
+                if not ok:
+                    continue
+                split_bytes = SERVER_KNOBS.dd_shard_split_bytes
+                did = False
+                for b, e in sorted(ranges, key=lambda r: -metrics[r[0]]["bytes"]):
+                    m = metrics[b]
+                    k = m.get("split_key")
+                    if m["bytes"] <= split_bytes or not k or not (b < k < e):
+                        continue
+                    dests = pick_spares(len(teams[b]))
+                    if not dests:
+                        TraceEvent("ShardSplitNoSpares", id=self.salt).detail(
+                            "Begin", b).log()
+                        break
+                    if dd["busy"]:
+                        break
+                    dd["busy"] = True
+                    try:
+                        await self._split_shard(b, k, dests, dd, dd_db,
+                                                log_client, cstate, ratekeeper)
+                    except error.FDBError as exc:
+                        TraceEvent("ShardSplitFailed", id=self.salt).detail(
+                            "Reason", exc.name).log()
+                    finally:
+                        dd["busy"] = False
+                    did = True
+                    break
+                if did:
+                    continue
+                merge_bytes = SERVER_KNOBS.dd_shard_merge_bytes
+                if len(ranges) <= self.cfg.n_storage:
+                    # merge only what splitting created: the seeded shard
+                    # count is the configured floor (an empty cluster would
+                    # otherwise collapse to one shard at boot)
+                    continue
+                for (b1, e1), (b2, _e2) in zip(ranges, ranges[1:]):
+                    if e1 != b2:
+                        continue
+                    if (metrics[b1]["bytes"] < merge_bytes
+                            and metrics[b2]["bytes"] < merge_bytes
+                            and metrics[b1]["bytes"] + metrics[b2]["bytes"]
+                            < split_bytes // 4):
+                        if dd["busy"]:
+                            break
+                        dd["busy"] = True
+                        try:
+                            await self._merge_shards(b1, b2, dd, dd_db,
+                                                     log_client, cstate,
+                                                     ratekeeper)
+                        except error.FDBError as exc:
+                            TraceEvent("ShardMergeFailed", id=self.salt).detail(
+                                "Reason", exc.name).log()
+                        finally:
+                            dd["busy"] = False
+                        break
+
         dd["excluded"] = set(cstate_val.excluded)
         exclude_token = EXCLUDE_TOKEN + suffix
 
@@ -610,18 +859,12 @@ class MasterServer:
                     break
                 _t, begin, _e, _a = victim
                 team = sorted((t, a) for (t, b2, _e2, a) in tags if b2 == begin)
-                hosts = {a for (_t2, _b2, _e2, a) in tags}
-                spares = sorted(
-                    w for w in self.workers
-                    if not self.net.monitor.is_failed(w)
-                    and w not in hosts and w not in dd["excluded"]
-                )
-                if len(spares) < len(team):
+                # whole-team drain onto policy-picked spares (spread across
+                # machines; trackExcludedServers + team builder)
+                dests = pick_spares(len(team))
+                if not dests:
                     raise error.recruitment_failed(
                         "not enough non-excluded spare workers to drain onto")
-                # v0 moves are whole-team: when any member is excluded the
-                # whole team relocates onto spares
-                dests = spares[:len(team)]
                 if dd["busy"]:
                     raise error.client_invalid_operation("a shard move is already running")
                 dd["busy"] = True
@@ -641,6 +884,9 @@ class MasterServer:
         dd_gc_task = spawn(dd_metadata_gc(), TaskPriority.MOVE_KEYS,
                            name=f"ddMetaGC:{self.salt}")
         self.proc.actors.add(dd_gc_task)
+        dd_tracker_task = spawn(dd_tracker(), TaskPriority.MOVE_KEYS,
+                                name=f"ddTracker:{self.salt}")
+        self.proc.actors.add(dd_tracker_task)
 
         # -- resolutionBalancing (masterserver.actor.cpp:919-977) -------------
         # Poll resolver row counts; on sustained imbalance, persist new
@@ -735,6 +981,7 @@ class MasterServer:
             rk_task.cancel()
             dd_task.cancel()
             dd_gc_task.cancel()
+            dd_tracker_task.cancel()
             balance_task.cancel()
             self.proc.unregister(rate_token)
             self.proc.unregister(status_token)

@@ -93,7 +93,8 @@ class RoutingState:
     key must name an existing shard begin."""
 
     def __init__(self, shards: KeyShardMap, teams):
-        self.shards = shards
+        # private copy: splits/merges mutate the boundary list in place
+        self.shards = KeyShardMap(list(shards.begins[1:]))
         self.teams = [list(t) for t in teams]
         self.extra_tags: List[tuple] = [() for _ in self.teams]
         #: live backup's log tag (None = no backup running)
@@ -115,11 +116,28 @@ class RoutingState:
             return
         begin = shard_begin_of(m.param1)
         s = self.shards.shard_of_key(begin) if begin else 0
-        if self.shards.begins[s] != begin:
-            return  # not a shard boundary (v0: whole-shard moves only)
         team, extra = decode_key_servers(m.param2)
-        self.teams[s] = list(team)
-        self.extra_tags[s] = tuple(extra)
+        if self.shards.begins[s] == begin:
+            if not team:
+                # boundary removal (DD merge): [begin, next) joins the
+                # PREDECESSOR shard, whose team already absorbed the data
+                if s > 0:
+                    del self.shards.begins[s]
+                    self.shards.n_shards -= 1
+                    del self.teams[s]
+                    del self.extra_tags[s]
+                return
+            self.teams[s] = list(team)
+            self.extra_tags[s] = tuple(extra)
+            return
+        if not team:
+            return
+        # new boundary inside shard s (DD split): [begin, old_next) gets the
+        # value's team; the lower part keeps shard s's current team
+        self.shards.begins.insert(s + 1, begin)
+        self.shards.n_shards += 1
+        self.teams.insert(s + 1, list(team))
+        self.extra_tags.insert(s + 1, tuple(extra))
 
 
 def teams_from_storage_tags(storage_tags):

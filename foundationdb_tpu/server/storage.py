@@ -49,9 +49,31 @@ from .messages import (
     GetValueRequest,
 )
 
+from dataclasses import dataclass
+
+
+@dataclass
+class ShrinkShardRequest:
+    """Give up [new_end, shard.end) after a split moved it away."""
+    tag: int
+    new_end: Key
+
+
+@dataclass
+class ExtendShardRequest:
+    """Absorb [shard.end, new_end) from the retiring upper team (merge)."""
+    tag: int
+    new_end: Key
+    fetch_from: List[str]
+    fetch_version: Version
+
+
 GET_VALUE_TOKEN = "storage.getValue"
 GET_KEY_VALUES_TOKEN = "storage.getKeyValues"
 WATCH_VALUE_TOKEN = "storage.watchValue"
+STORAGE_METRICS_TOKEN = "storage.metrics"
+SHRINK_SHARD_TOKEN = "storage.shrinkShard"
+EXTEND_SHARD_TOKEN = "storage.extendShard"
 
 #: how far ahead of the storage version a read may wait before future_version
 #: (reference: storageserver waitForVersion MVCC window)
@@ -197,6 +219,16 @@ class VersionedStore:
             i = bisect.bisect_left(self._keys, k)
             del self._keys[i]
 
+    def drop_through_range(self, begin: Key, end: Key) -> None:
+        """Forget every chain in [begin, end) — the range left this shard
+        (split shrink); out-of-shard tombs are harmless and expire with
+        the window."""
+        lo = bisect.bisect_left(self._keys, begin)
+        hi = bisect.bisect_left(self._keys, end)
+        for k in self._keys[lo:hi]:
+            del self._chains[k]
+        del self._keys[lo:hi]
+
     def overlay_keys(self, begin: Key, end: Key) -> List[Key]:
         lo = bisect.bisect_left(self._keys, begin)
         hi = bisect.bisect_left(self._keys, end)
@@ -208,6 +240,7 @@ class VersionedStore:
 #: the analog of the reference's persistent-format keys in its own KVS
 STORAGE_PRIVATE_PREFIX = b"\xff\xff\xff\xff/"
 DURABLE_VERSION_KEY = STORAGE_PRIVATE_PREFIX + b"durableVersion"
+READ_FLOOR_KEY = STORAGE_PRIVATE_PREFIX + b"readFloor"
 
 
 class StorageServer:
@@ -253,6 +286,12 @@ class StorageServer:
         #: a durability cycle is mid-flight toward this version: reads below
         #: it must not consult the half-mutated engine (see _read_floor)
         self._durabilizing_to: Version = 0
+        #: byte sample (storageserver.actor.cpp:2776 byteSampleApplySet):
+        #: each written key is sampled with probability size/FACTOR and
+        #: carries weight FACTOR — total bytes and split points come from
+        #: the sample, never from scanning the dataset
+        self.byte_sample: Dict[Key, int] = {}
+        self.sampled_bytes: int = 0
         self._disk = disk
         self._update_task = None
         self._tokens = [GET_VALUE_TOKEN, GET_KEY_VALUES_TOKEN, WATCH_VALUE_TOKEN,
@@ -275,6 +314,11 @@ class StorageServer:
             return self.stats.as_dict()
 
         proc.register("storage.stats", stats_req)
+        proc.register(STORAGE_METRICS_TOKEN, self.storage_metrics)
+        proc.register(SHRINK_SHARD_TOKEN, self.shrink_shard)
+        proc.register(EXTEND_SHARD_TOKEN, self.extend_shard)
+        self._tokens += [STORAGE_METRICS_TOKEN, SHRINK_SHARD_TOKEN,
+                         EXTEND_SHARD_TOKEN]
 
         proc.register(STORAGE_QUEUE_INFO_TOKEN, queue_info)
         self._tokens.append(STORAGE_QUEUE_INFO_TOKEN)
@@ -304,20 +348,17 @@ class StorageServer:
             for suffix in (".meta", ".snap", ".snap.tmp", ".dq", ".dq.tmp"):
                 self._disk.delete(self._meta_name() + suffix)
 
-    async def fetch_keys(self, addrs: List[str], version: Version) -> None:
-        """Populate this fresh replica with its shard's contents at
-        `version`, read from the serving team (fetchKeys,
-        storageserver.actor.cpp:1777). The AddingShard double buffer is the
-        log system itself here: this tag's mutations > `version` are
-        already accumulating at the tlogs and the update loop consumes them
-        once this snapshot is loaded. In durable mode the copy streams into
-        the engine (a retried half-fetch starts from a cleared shard)."""
+    async def _fetch_range(self, addrs: List[str], begin: Key, end: Key,
+                           version: Version,
+                           items: Optional[List[Tuple[Key, Value]]] = None) -> None:
+        """Paged copy of [begin, end) at `version` from a serving team into
+        the engine (durable mode; committed per page) or `items` (memory
+        mode), with replica rotation + retries and BUGGIFY mid-copy pauses.
+        Shared by fetchKeys and the merge path's extend (one fetch loop, one
+        set of semantics)."""
         from ..core.types import key_after
 
-        if self.kvs is not None:
-            self.kvs.clear_range(self.shard.begin, self.shard.end)
-        items: List[Tuple[Key, Value]] = []
-        cb, ce = self.shard.begin, self.shard.end
+        cb, ce = begin, end
         while cb < ce:
             reply = None
             last: Optional[error.FDBError] = None
@@ -340,15 +381,31 @@ class StorageServer:
                     await delay(0.2, TaskPriority.FETCH_KEYS)
             if reply is None:
                 raise last if last is not None else error.connection_failed()
-            if self.kvs is not None:
-                for k, v in reply.data:
+            for k, v in reply.data:
+                if self.kvs is not None:
                     self.kvs.set(k, v)
+                else:
+                    items.append((k, v))
+                self._sample_set(k, v)
+            if self.kvs is not None:
                 await self.kvs.commit()
-            else:
-                items.extend(reply.data)
             if not reply.more or not reply.data:
                 break
             cb = key_after(reply.data[-1][0])
+
+    async def fetch_keys(self, addrs: List[str], version: Version) -> None:
+        """Populate this fresh replica with its shard's contents at
+        `version`, read from the serving team (fetchKeys,
+        storageserver.actor.cpp:1777). The AddingShard double buffer is the
+        log system itself here: this tag's mutations > `version` are
+        already accumulating at the tlogs and the update loop consumes them
+        once this snapshot is loaded. In durable mode the copy streams into
+        the engine (a retried half-fetch starts from a cleared shard)."""
+        if self.kvs is not None:
+            self.kvs.clear_range(self.shard.begin, self.shard.end)
+        items: List[Tuple[Key, Value]] = []
+        await self._fetch_range(addrs, self.shard.begin, self.shard.end,
+                                version, items)
         if self.kvs is not None:
             self.kvs.set(DURABLE_VERSION_KEY, wire.dumps(version))
             await self.kvs.commit()
@@ -388,6 +445,29 @@ class StorageServer:
             self.kvs.set(DURABLE_VERSION_KEY, wire.dumps(self.durable_version))
             await self.kvs.commit()
 
+    def _purge_pending_outside(self) -> None:
+        """Clip every pending durability op to the current shard bounds."""
+        b, e = self.shard.begin, self.shard.end
+        new_pending = []
+        self._pending_bytes = 0
+        for v, ops, _nb in self._pending:
+            kept = []
+            nbytes = 0
+            for op in ops:
+                if op[0] == 0:
+                    if op[2] is None or not (b <= op[1] < e):
+                        continue
+                    kept.append(op)
+                else:
+                    cb, ce = max(op[1], b), min(op[2], e)
+                    if cb >= ce:
+                        continue
+                    kept.append((1, cb, ce))
+                nbytes += len(kept[-1][1]) + len(kept[-1][2] or b"") + 24
+            new_pending.append((v, kept, nbytes))
+            self._pending_bytes += nbytes
+        self._pending = new_pending
+
     async def _make_durable(self, target: Version) -> None:
         """updateStorage:2585: push resolved ops <= target into the engine,
         commit (the durability point), advance the MVCC floor, trim the
@@ -411,7 +491,8 @@ class StorageServer:
         for v, ops, nbytes in self._pending[:i]:
             for op in ops:
                 if op[0] == 0:
-                    self.kvs.set(op[1], op[2])
+                    if op[2] is not None:
+                        self.kvs.set(op[1], op[2])
                 else:
                     self.kvs.clear_range(op[1], op[2])
             self._pending_bytes -= nbytes
@@ -443,6 +524,9 @@ class StorageServer:
                  kvs=kvs)
         ss.durable_version = durable
         ss.store.oldest_version = durable
+        floor = await kvs.get(READ_FLOOR_KEY)
+        if floor is not None:
+            ss._durabilizing_to = max(ss._durabilizing_to, wire.loads(floor))
         return ss
 
     # -- write path ----------------------------------------------------------
@@ -464,6 +548,138 @@ class StorageServer:
         else:
             del self._watches[key]
 
+    # -- byte sample + DD metrics -------------------------------------------
+    def _sample_set(self, key: Key, value: Optional[Value]) -> None:
+        from ..core.knobs import SERVER_KNOBS
+        from ..sim.loop import current_scheduler
+
+        old = self.byte_sample.pop(key, 0)
+        self.sampled_bytes -= old
+        if value is None:
+            return
+        size = len(key) + len(value)
+        factor = max(1, SERVER_KNOBS.dd_byte_sample_factor)
+        # deterministic per seed: the sim RNG drives sampling
+        if size >= factor or current_scheduler().rng.random01() < size / factor:
+            w = max(size, factor)
+            self.byte_sample[key] = w
+            self.sampled_bytes += w
+
+    def _sample_clear(self, begin: Key, end: Key) -> None:
+        for k in [k for k in self.byte_sample if begin <= k < end]:
+            self.sampled_bytes -= self.byte_sample.pop(k)
+
+    async def storage_metrics(self, _req) -> dict:
+        """Per-shard size estimate + a median split point from the byte
+        sample (the DD tracker's WaitMetrics/SplitMetrics, reduced to
+        polling; reference: StorageMetrics.actor.h)."""
+        split = None
+        if self.byte_sample:
+            keys = sorted(self.byte_sample)
+            total = sum(self.byte_sample[k] for k in keys)
+            acc = 0
+            for k in keys:
+                acc += self.byte_sample[k]
+                if acc * 2 >= total:
+                    # a split at the very first key would produce an empty
+                    # lower half; shard begin is excluded
+                    split = k if k > self.shard.begin else None
+                    break
+        return {
+            "tag": self.tag,
+            "begin": self.shard.begin,
+            "end": self.shard.end,
+            "bytes": self.sampled_bytes,
+            "mutations": self.stats.as_dict().get("mutations", 0),
+            "split_key": split,
+        }
+
+    # -- shard reshaping (DD split/merge) ------------------------------------
+    async def shrink_shard(self, req) -> None:
+        """Give up [new_end, end): the upper half moved to a new team
+        (split). Data beyond the new bound is dropped everywhere."""
+        old_end = self.shard.end
+        new_end = req.new_end
+        if not (self.shard.begin < new_end <= old_end):
+            raise error.client_invalid_operation("shrink bound outside shard")
+        self.shard = KeyRange(self.shard.begin, new_end)
+        self._sample_clear(new_end, old_end)
+        # overlay + engine drop the range; straggler tag mutations for it
+        # are discarded by the _apply bounds guard from now on. Ops already
+        # APPLIED but not yet durable must drop too — otherwise a later
+        # durability cycle resurrects the range in the engine, where a
+        # subsequent merge-extend would expose it (pre-shrink values that
+        # never saw the clears clipped away by the bounds guard).
+        self._purge_pending_outside()
+        self.store.clear_range(new_end, old_end, self.version.get())
+        self.store.drop_through_range(new_end, old_end)
+        if self.kvs is not None:
+            self.kvs.clear_range(new_end, old_end)
+            await self.kvs.commit()
+        if self._disk is not None:
+            meta = self._disk.open(self._meta_name() + ".meta")
+            await meta.write(0, wire.dumps({
+                "tag": self.tag, "begin": self.shard.begin,
+                "end": self.shard.end,
+            }))
+            await meta.sync()
+
+    async def extend_shard(self, req) -> None:
+        """Absorb [end, new_end) from `fetch_from` at `fetch_version` (the
+        merge path: this team's tags were added to the upper shard first,
+        so newer mutations are already flowing into the update loop)."""
+        from ..core.types import key_after
+
+        old_end = self.shard.end
+        if not (old_end <= req.new_end):
+            raise error.client_invalid_operation("extend bound inside shard")
+        cb, ce = old_end, req.new_end
+        while cb < ce:
+            reply = None
+            last: Optional[error.FDBError] = None
+            for i in range(len(req.fetch_from) * 3):
+                addr = req.fetch_from[i % len(req.fetch_from)]
+                try:
+                    reply = await self.net.request(
+                        self.proc.address,
+                        Endpoint(addr, GET_KEY_VALUES_TOKEN),
+                        GetKeyValuesRequest(begin=cb, end=ce,
+                                            version=req.fetch_version,
+                                            limit=10_000),
+                        TaskPriority.FETCH_KEYS, timeout=5.0,
+                    )
+                    break
+                except error.FDBError as e:
+                    last = e
+                    await delay(0.2, TaskPriority.FETCH_KEYS)
+            if reply is None:
+                raise last if last is not None else error.connection_failed()
+            for k, v in reply.data:
+                if self.kvs is not None:
+                    self.kvs.set(k, v)
+                else:
+                    self.store.set(k, v, req.fetch_version)
+                self._sample_set(k, v)
+            if self.kvs is not None:
+                await self.kvs.commit()
+            if not reply.more or not reply.data:
+                break
+            cb = key_after(reply.data[-1][0])
+        self.shard = KeyRange(self.shard.begin, req.new_end)
+        # The fetched rows reflect fetch_version; reads below it in the new
+        # range would see the future. Raise the floor (persisted so a
+        # restart keeps the gate) — retries get fresher read versions.
+        self._durabilizing_to = max(self._durabilizing_to, req.fetch_version)
+        if self.kvs is not None:
+            self.kvs.set(READ_FLOOR_KEY, wire.dumps(self._durabilizing_to))
+            meta = self._disk.open(self._meta_name() + ".meta")
+            await meta.write(0, wire.dumps({
+                "tag": self.tag, "begin": self.shard.begin,
+                "end": self.shard.end,
+            }))
+            await meta.sync()
+            await self.kvs.commit()
+
     async def _existing_value(self, key: Key, version: Version) -> Optional[Value]:
         """Current value for an atomic-op read-modify-write: overlay entry
         if one covers `version`, else the durable engine (doEagerReads'
@@ -480,18 +696,29 @@ class StorageServer:
         the durability cycle ((0, k, v) set / (1, b, e) clear) — atomic ops
         are materialized here, so the engine only ever stores values."""
         if m.type == MutationType.SET_VALUE:
+            if not self.shard.contains(m.param1):
+                return (0, b"", None)    # straggler for a shrunk-away range
             self.store.set(m.param1, m.param2, version)
+            self._sample_set(m.param1, m.param2)
             self._fire_watches(m.param1, m.param2)
             return (0, m.param1, m.param2)
         elif m.type == MutationType.CLEAR_RANGE:
-            self.store.clear_range(m.param1, m.param2, version)
-            for k in [k for k in self._watches if m.param1 <= k < m.param2]:
+            b = max(m.param1, self.shard.begin)
+            e = min(m.param2, self.shard.end)
+            if b >= e:
+                return (0, b"", None)
+            self.store.clear_range(b, e, version)
+            self._sample_clear(b, e)
+            for k in [k for k in self._watches if b <= k < e]:
                 self._fire_watches(k, None)
-            return (1, m.param1, m.param2)
+            return (1, b, e)
         elif m.type in STORAGE_ATOMIC_MUTATIONS:
+            if not self.shard.contains(m.param1):
+                return (0, b"", None)
             existing = await self._existing_value(m.param1, version)
             new = apply_atomic_op(m.type, existing, m.param2)
             self.store.set(m.param1, new, version)
+            self._sample_set(m.param1, new)
             self._fire_watches(m.param1, new)
             return (0, m.param1, new)
         else:

@@ -48,6 +48,9 @@ class ServerDBInfo:
 
     info_version: int = 0
     recovery_count: int = 0
+    #: orders in-generation map updates (DD moves/splits/merges) from the
+    #: same master; one-ways can reorder under clogging
+    dd_version: int = 0
     recovery_state: str = "unconfigured"
     master_addr: Optional[str] = None
     proxy_addrs: tuple = ()
@@ -109,6 +112,8 @@ class InitializeMasterRequest:
     salt: int
     cc_addr: str
     cluster_cfg: Any                      # DynamicClusterConfig
+    #: addr -> (machine_id, dc_id) from worker registrations
+    worker_localities: Any = None
 
 
 @dataclass
@@ -202,7 +207,9 @@ class Worker:
                     Endpoint(leader.address, CC_REGISTER_TOKEN),
                     WorkerRegisterRequest(addr=self.proc.address,
                                           known_info_version=known_version,
-                                          roles=tuple(sorted({k[0] for k in self.roles}))),
+                                          roles=tuple(sorted({k[0] for k in self.roles})),
+                                          locality=(self.proc.machine_id,
+                                                    self.proc.dc_id)),
                     TaskPriority.CLUSTER_CONTROLLER,
                     timeout=2.0,
                 )
