@@ -40,6 +40,7 @@ from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 from ..core import wire
+from ..sim.actors import AsyncMutex
 from ..sim.disk import SimDisk
 from .disk_queue import DiskQueue
 
@@ -47,6 +48,21 @@ Key = bytes
 Value = bytes
 
 _FOOT = struct.Struct("<II")      # footer length, crc32
+
+
+def _lookup(mem: Dict[Key, Tuple[int, Optional[Value]]],
+            tombs: List[Tuple[int, Key, Key]],
+            key: Key) -> Tuple[bool, Optional[Value]]:
+    """Memtable precedence rule, shared by point gets (live state) and
+    range reads (their snapshot): a point entry wins iff its seq is newer
+    than every covering range tombstone."""
+    e = mem.get(key)
+    tomb_seq = max((s for s, b, x in tombs if b <= key < x), default=-1)
+    if e is not None and e[0] > tomb_seq:
+        return True, e[1]
+    if tomb_seq >= 0:
+        return True, None
+    return False, None
 
 
 class _Run:
@@ -162,6 +178,17 @@ class SSTableStore:
         self._runs: List[_Run] = []          # newest first
         self._pending: List[Tuple] = []      # ops since last commit
         self._cache: OrderedDict = OrderedDict()
+        #: readers mid-await: compaction must not delete run files under
+        #: them (epoch-style reclamation — files die when the last reader
+        #: that could still hold their _Run finishes)
+        self._active_reads = 0
+        self._defer_delete: List[str] = []
+        #: serializes commit(): two concurrent committers (storage
+        #: durability cycle vs extend_shard page commits, tlog spill vs
+        #: pop clears) would otherwise interleave at the WAL-push await —
+        #: one clearing _pending ops the other never logged — or race a
+        #: _flush into the middle of a _compact's run-list rebuild
+        self._commit_mutex = AsyncMutex()
 
     # -- lifecycle -----------------------------------------------------------
     @classmethod
@@ -223,15 +250,18 @@ class SSTableStore:
 
     async def commit(self) -> None:
         """Durability point: WAL frame + fsync; flush/compact as needed
-        (IKeyValueStore::commit)."""
-        if self._pending:
-            await self.wal.push(wire.dumps(self._pending))
-            self._pending = []
-        await self.wal.commit()
-        if self._mem_bytes >= self.FLUSH_BYTES:
-            await self._flush()
-            if len(self._runs) > self.MAX_RUNS:
-                await self._compact()
+        (IKeyValueStore::commit). Serialized: ops staged after this
+        committer's WAL snapshot ride the NEXT commit (and its fsync ack),
+        never a half-logged state."""
+        async with self._commit_mutex:
+            if self._pending:
+                ops, self._pending = self._pending, []
+                await self.wal.push(wire.dumps(ops))
+            await self.wal.commit()
+            if self._mem_bytes >= self.FLUSH_BYTES:
+                await self._flush()
+                if len(self._runs) > self.MAX_RUNS:
+                    await self._compact()
 
     async def _write_run(self, entries, tombs) -> str:
         """entries: sorted [(k, v|None)]; returns the installed file name."""
@@ -300,120 +330,147 @@ class SSTableStore:
         self._runs = [run]
         await self._install_manifest([rn])
         for name in old:
-            self.disk.delete(name)
             for ck in [c for c in self._cache if c[0] == name]:
                 del self._cache[ck]
+        self._reclaim(old)
+
+    def _reclaim(self, names: List[str]) -> None:
+        """Delete run files now, or park them until in-flight reads drain
+        (a reader's _Run would otherwise hit file_not_found mid-block)."""
+        if self._active_reads > 0:
+            self._defer_delete.extend(names)
+        else:
+            for name in names:
+                self.disk.delete(name)
+
+    def _read_done(self) -> None:
+        self._active_reads -= 1
+        if self._active_reads == 0 and self._defer_delete:
+            names, self._defer_delete = self._defer_delete, []
+            for name in names:
+                self.disk.delete(name)
 
     # -- read path -----------------------------------------------------------
     def _mem_lookup(self, key: Key) -> Tuple[bool, Optional[Value]]:
-        e = self._mem.get(key)
-        tomb_seq = max((s for s, b, x in self._mem_tombs if b <= key < x),
-                       default=-1)
-        if e is not None and e[0] > tomb_seq:
-            return True, e[1]
-        if tomb_seq >= 0:
-            return True, None
-        return False, None
+        return _lookup(self._mem, self._mem_tombs, key)
 
     async def get(self, key: Key) -> Optional[Value]:
         found, v = self._mem_lookup(key)
         if found:
             return v
-        for run in self._runs:
-            found, v = await run.get(key)
-            if found:
-                return v
-            if run.covers_tomb(key):
-                return None
-        return None
-
-    def _masked(self, key: Key, level: int) -> bool:
-        """Masked by a range tombstone strictly newer than `level`
-        (level -1 = memtable; runs are levels 0..)."""
-        if level >= 0:
-            if any(b <= key < e for _s, b, e in self._mem_tombs):
-                # memtable point entries override tombs via seq; for runs the
-                # memtable tomb always wins (it is newer than every run)
-                return True
-        for up in range(max(level, 0)):
-            if self._runs[up].covers_tomb(key):
-                return True
-        return False
+        runs = list(self._runs)     # snapshot: a flush/compact mid-read
+        self._active_reads += 1     # must not shift or delete our levels
+        try:
+            for run in runs:
+                found, v = await run.get(key)
+                if found:
+                    return v
+                if run.covers_tomb(key):
+                    return None
+            return None
+        finally:
+            self._read_done()
 
     async def get_range(self, begin: Key, end: Key, limit: int,
                         reverse: bool = False) -> Tuple[List[Tuple[Key, Value]], bool]:
-        """Merged live entries in [begin, end); (items, more)."""
+        """Merged live entries in [begin, end); (items, more). The memtable
+        and run list are SNAPSHOTTED up front: a commit/flush/compact
+        interleaving with this read's block awaits must not clear _mem
+        under the lazy cursor or renumber the precedence levels."""
         out: List[Tuple[Key, Value]] = []
         # Per-level cursors: (next entry, level, iterator). Memtable is
         # level -1 (highest precedence).
-        mem_keys = sorted(k for k in self._mem if begin <= k < end)
+        mem_snap = {k: e for k, e in self._mem.items() if begin <= k < end}
+        mem_tombs = list(self._mem_tombs)
+        runs = list(self._runs)
+        mem_keys = sorted(mem_snap)
         if reverse:
             mem_keys.reverse()
 
         async def mem_iter():
             for k in mem_keys:
-                yield k, self._mem[k][1]
+                yield k, mem_snap[k][1]
+
+        def mem_lookup(key: Key) -> Tuple[bool, Optional[Value]]:
+            return _lookup(mem_snap, mem_tombs, key)
+
+        def masked(key: Key, level: int) -> bool:
+            # masked by a range tombstone strictly newer than `level`
+            # (level -1 = memtable; runs are levels 0..). Memtable point
+            # entries override mem tombs via seq; for runs the memtable
+            # tomb always wins (it is newer than every run).
+            if level >= 0 and any(b <= key < e for _s, b, e in mem_tombs):
+                return True
+            for up in range(max(level, 0)):
+                if runs[up].covers_tomb(key):
+                    return True
+            return False
 
         iters = [(-1, mem_iter())]
-        for lvl, run in enumerate(self._runs):
+        for lvl, run in enumerate(runs):
             if reverse:
                 it = run.iter_from(end, reverse=True)
             else:
                 it = run.iter_from(begin)
             iters.append((lvl, it))
 
-        heads: List[Optional[Tuple[Key, Optional[Value]]]] = []
-        live: List = []
-        for lvl, it in iters:
-            try:
-                nxt = await anext(it)
-                if reverse and lvl >= 0 and nxt[0] >= end:
-                    while nxt[0] >= end:
-                        nxt = await anext(it)
-            except StopAsyncIteration:
-                nxt = None
-            heads.append(nxt)
-            live.append(it)
+        self._active_reads += 1
+        try:
+            heads: List[Optional[Tuple[Key, Optional[Value]]]] = []
+            live: List = []
+            for lvl, it in iters:
+                try:
+                    nxt = await anext(it)
+                    if reverse and lvl >= 0 and nxt[0] >= end:
+                        while nxt[0] >= end:
+                            nxt = await anext(it)
+                except StopAsyncIteration:
+                    nxt = None
+                heads.append(nxt)
+                live.append(it)
 
-        def better(a: Key, b: Key) -> bool:
-            return a > b if reverse else a < b
+            def better(a: Key, b: Key) -> bool:
+                return a > b if reverse else a < b
 
-        while len(out) < limit:
-            # pick frontier key across levels
-            pick: Optional[Key] = None
-            for h in heads:
-                if h is not None and (not reverse and h[0] >= end):
-                    continue
-                if h is not None and (pick is None or better(h[0], pick)):
-                    pick = h[0]
-            if pick is None or (not reverse and pick >= end) or (reverse and pick < begin):
-                return out, False
-            # resolve precedence: lowest level index with this key wins
-            val: Optional[Value] = None
-            taken_level = None
-            for idx, h in enumerate(heads):
-                if h is not None and h[0] == pick:
-                    if taken_level is None:
-                        taken_level = idx - 1   # level: -1 memtable
-                        val = h[1]
-                    try:
-                        heads[idx] = await anext(live[idx])
-                    except StopAsyncIteration:
-                        heads[idx] = None
-            if taken_level is not None and taken_level >= 0 and self._masked(pick, taken_level):
-                val = None
-            elif taken_level == -1:
-                # memtable entry: seq already resolved vs mem tombs
-                found, val = self._mem_lookup(pick)
-            if val is not None and (begin <= pick < end):
-                out.append((pick, val))
-        return out, True
+            while len(out) < limit:
+                # pick frontier key across levels
+                pick: Optional[Key] = None
+                for h in heads:
+                    if h is not None and (not reverse and h[0] >= end):
+                        continue
+                    if h is not None and (pick is None or better(h[0], pick)):
+                        pick = h[0]
+                if pick is None or (not reverse and pick >= end) or (reverse and pick < begin):
+                    return out, False
+                # resolve precedence: lowest level index with this key wins
+                val: Optional[Value] = None
+                taken_level = None
+                for idx, h in enumerate(heads):
+                    if h is not None and h[0] == pick:
+                        if taken_level is None:
+                            taken_level = idx - 1   # level: -1 memtable
+                            val = h[1]
+                        try:
+                            heads[idx] = await anext(live[idx])
+                        except StopAsyncIteration:
+                            heads[idx] = None
+                if taken_level is not None and taken_level >= 0 and masked(pick, taken_level):
+                    val = None
+                elif taken_level == -1:
+                    # memtable entry: seq already resolved vs mem tombs
+                    found, val = mem_lookup(pick)
+                if val is not None and (begin <= pick < end):
+                    out.append((pick, val))
+            return out, True
+        finally:
+            self._read_done()
 
     # -- maintenance ---------------------------------------------------------
     def destroy(self) -> None:
         """Delete every on-disk artifact (IKeyValueStore::dispose)."""
-        for rn in [r.name for r in self._runs]:
+        for rn in [r.name for r in self._runs] + self._defer_delete:
             self.disk.delete(rn)
+        self._defer_delete = []
         self.disk.delete(f"{self.name}.manifest")
         self.disk.delete(f"{self.name}.manifest.tmp")
         self.disk.delete(f"{self.name}.dq")

@@ -37,7 +37,7 @@ from ..core.types import (
     Version,
     apply_atomic_op,
 )
-from ..sim.actors import AsyncVar, NotifiedVersion
+from ..sim.actors import AsyncMutex, AsyncVar, NotifiedVersion
 from ..sim.loop import TaskPriority, delay, spawn
 from ..sim.network import Endpoint, SimProcess
 from .disk_queue import DiskQueue
@@ -286,6 +286,15 @@ class StorageServer:
         #: a durability cycle is mid-flight toward this version: reads below
         #: it must not consult the half-mutated engine (see _read_floor)
         self._durabilizing_to: Version = 0
+        #: serializes _make_durable: the update loop's durability cycle and
+        #: extend_shard's replay flush both scan/trim _pending across
+        #: engine-commit awaits
+        self._durable_mutex = AsyncMutex()
+        #: an extend_shard fetch is in flight for (begin, end, buffer):
+        #: tag mutations for the incoming range are buffered here instead
+        #: of being dropped by the shard-bounds guard (AddingShard's double
+        #: buffer, storageserver.actor.cpp:77)
+        self._adding: Optional[Tuple[Key, Key, list]] = None
         #: byte sample (storageserver.actor.cpp:2776 byteSampleApplySet):
         #: each written key is sampled with probability size/FACTOR and
         #: carries weight FACTOR — total bytes and split points come from
@@ -472,12 +481,21 @@ class StorageServer:
         """updateStorage:2585: push resolved ops <= target into the engine,
         commit (the durability point), advance the MVCC floor, trim the
         overlay, and let the caller pop the tlog."""
+        async with self._durable_mutex:
+            await self._make_durable_locked(target)
+
+    async def _make_durable_locked(self, target: Version) -> None:
         i = 0
         new_durable = self.durable_version
         for v, _ops, _nb in self._pending:
             if v > target:
                 break
-            new_durable = v
+            # max, not assignment: extend_shard's buffered replay may have
+            # queued versions BELOW the current durable floor (durability
+            # advanced during its fetch) — writing them is correct (their
+            # keys are in the just-absorbed range, untouched above), but
+            # the floor itself must never regress
+            new_durable = max(new_durable, v)
             i += 1
         if i == 0:
             return
@@ -626,58 +644,88 @@ class StorageServer:
 
     async def extend_shard(self, req) -> None:
         """Absorb [end, new_end) from `fetch_from` at `fetch_version` (the
-        merge path: this team's tags were added to the upper shard first,
-        so newer mutations are already flowing into the update loop)."""
-        from ..core.types import key_after
-
+        merge path). AddingShard semantics (storageserver.actor.cpp:77):
+        this team's tags were added to the upper shard before the fetch, so
+        mutations for the incoming range arrive DURING the paged fetch —
+        they are buffered (the shard-bounds guard would otherwise drop them
+        and the version watermark would advance past them forever) and
+        replayed in version order on top of the fetched base, and only then
+        does the range join the shard."""
         old_end = self.shard.end
         if not (old_end <= req.new_end):
             raise error.client_invalid_operation("extend bound inside shard")
-        cb, ce = old_end, req.new_end
-        while cb < ce:
-            reply = None
-            last: Optional[error.FDBError] = None
-            for i in range(len(req.fetch_from) * 3):
-                addr = req.fetch_from[i % len(req.fetch_from)]
-                try:
-                    reply = await self.net.request(
-                        self.proc.address,
-                        Endpoint(addr, GET_KEY_VALUES_TOKEN),
-                        GetKeyValuesRequest(begin=cb, end=ce,
-                                            version=req.fetch_version,
-                                            limit=10_000),
-                        TaskPriority.FETCH_KEYS, timeout=5.0,
-                    )
-                    break
-                except error.FDBError as e:
-                    last = e
-                    await delay(0.2, TaskPriority.FETCH_KEYS)
-            if reply is None:
-                raise last if last is not None else error.connection_failed()
-            for k, v in reply.data:
-                if self.kvs is not None:
-                    self.kvs.set(k, v)
-                else:
-                    self.store.set(k, v, req.fetch_version)
-                self._sample_set(k, v)
+        if self._adding is not None:
+            raise error.client_invalid_operation("extend already in flight")
+        buf: list = []
+        self._adding = (old_end, req.new_end, buf)
+        try:
             if self.kvs is not None:
-                await self.kvs.commit()
-            if not reply.more or not reply.data:
-                break
-            cb = key_after(reply.data[-1][0])
+                # a retried half-fetch must not leave stale rows from the
+                # aborted attempt under the fresh snapshot
+                self.kvs.clear_range(old_end, req.new_end)
+            items: List[Tuple[Key, Value]] = []
+            await self._fetch_range(req.fetch_from, old_end, req.new_end,
+                                    req.fetch_version, items)
+        except BaseException:
+            self._adding = None   # master retries; a re-fetch starts clean
+            raise
+        if self.kvs is None:
+            # fetched base BEFORE the buffered replay: chains stay monotone
+            for k, v in items:
+                self.store.set(k, v, req.fetch_version)
+        # Replay buffered mutations above the snapshot version. The buffer
+        # may still grow during an atomic op's engine read; the index loop
+        # drains the tail too, and _adding stays active throughout so the
+        # update loop keeps routing new-range mutations here (an older
+        # buffered write can never land after a newer live one).
+        per_version: Dict[Version, list] = {}
+        i = 0
+        while i < len(buf):
+            v, m = buf[i]
+            i += 1
+            if v <= req.fetch_version:
+                continue   # already contained in the fetched snapshot
+            op = await self._apply(m, v, unbounded=True)
+            if self.kvs is not None:
+                per_version.setdefault(v, []).append(op)
+        self._adding = None
         self.shard = KeyRange(self.shard.begin, req.new_end)
+        # Replayed ops enter the durability pipeline at their versions
+        # (merge-sorted into _pending; a same-version entry may already
+        # exist from the commit's in-shard portion — the ranges are
+        # disjoint, so appending preserves apply semantics).
+        for v in sorted(per_version):
+            ops = per_version[v]
+            nbytes = sum(len(op[1]) + len(op[2] or b"") + 24 for op in ops)
+            j = bisect.bisect_left(self._pending, v, key=lambda e: e[0])
+            if j < len(self._pending) and self._pending[j][0] == v:
+                ev, eops, enb = self._pending[j]
+                self._pending[j] = (ev, eops + ops, enb + nbytes)
+            else:
+                self._pending.insert(j, (v, ops, nbytes))
+            self._pending_bytes += nbytes
+        if self.kvs is not None and per_version:
+            # The replayed versions may already be POPPED from the tlog
+            # (in-shard durability advanced during the fetch and popped
+            # past them); until they hit the engine they exist only in
+            # this process's RAM. Force them durable BEFORE acking the
+            # extend — a crash after the ack must not lose them, and the
+            # master retires the donor team on our ack.
+            await self._make_durable(max(per_version))
         # The fetched rows reflect fetch_version; reads below it in the new
         # range would see the future. Raise the floor (persisted so a
         # restart keeps the gate) — retries get fresher read versions.
         self._durabilizing_to = max(self._durabilizing_to, req.fetch_version)
         if self.kvs is not None:
             self.kvs.set(READ_FLOOR_KEY, wire.dumps(self._durabilizing_to))
+        if self._disk is not None:
             meta = self._disk.open(self._meta_name() + ".meta")
             await meta.write(0, wire.dumps({
                 "tag": self.tag, "begin": self.shard.begin,
                 "end": self.shard.end,
             }))
             await meta.sync()
+        if self.kvs is not None:
             await self.kvs.commit()
 
     async def _existing_value(self, key: Key, version: Version) -> Optional[Value]:
@@ -691,20 +739,38 @@ class StorageServer:
             return await self.kvs.get(key)
         return None
 
-    async def _apply(self, m: Mutation, version: Version) -> Optional[tuple]:
+    async def _apply(self, m: Mutation, version: Version,
+                     unbounded: bool = False) -> Optional[tuple]:
         """Apply one mutation to the overlay; returns the RESOLVED op for
         the durability cycle ((0, k, v) set / (1, b, e) clear) — atomic ops
-        are materialized here, so the engine only ever stores values."""
+        are materialized here, so the engine only ever stores values.
+        `unbounded` (extend_shard's buffered replay) skips the shard-bounds
+        guard: the mutation's range joins the shard only when the replay
+        finishes, but its keys are already clipped to the incoming range."""
+        if not unbounded and self._adding is not None:
+            ab, ae, buf = self._adding
+            if m.type == MutationType.CLEAR_RANGE:
+                cb, ce = max(m.param1, ab), min(m.param2, ae)
+                if cb < ce:
+                    buf.append((version, Mutation(
+                        type=MutationType.CLEAR_RANGE, param1=cb, param2=ce)))
+                # fall through: the in-shard portion still applies below
+            elif ab <= m.param1 < ae:
+                buf.append((version, m))
+                return (0, b"", None)
         if m.type == MutationType.SET_VALUE:
-            if not self.shard.contains(m.param1):
+            if not unbounded and not self.shard.contains(m.param1):
                 return (0, b"", None)    # straggler for a shrunk-away range
             self.store.set(m.param1, m.param2, version)
             self._sample_set(m.param1, m.param2)
             self._fire_watches(m.param1, m.param2)
             return (0, m.param1, m.param2)
         elif m.type == MutationType.CLEAR_RANGE:
-            b = max(m.param1, self.shard.begin)
-            e = min(m.param2, self.shard.end)
+            if unbounded:
+                b, e = m.param1, m.param2
+            else:
+                b = max(m.param1, self.shard.begin)
+                e = min(m.param2, self.shard.end)
             if b >= e:
                 return (0, b"", None)
             self.store.clear_range(b, e, version)
@@ -713,7 +779,7 @@ class StorageServer:
                 self._fire_watches(k, None)
             return (1, b, e)
         elif m.type in STORAGE_ATOMIC_MUTATIONS:
-            if not self.shard.contains(m.param1):
+            if not unbounded and not self.shard.contains(m.param1):
                 return (0, b"", None)
             existing = await self._existing_value(m.param1, version)
             new = apply_atomic_op(m.type, existing, m.param2)

@@ -246,14 +246,20 @@ class TLog:
             tlog._ver_offsets.append((v, off))
             if v <= tlog.spilled_version:
                 continue   # already served by the spill store
+            kept = False
             for tag, muts in messages.items():
                 if tag in tlog._retired_tags:
                     continue
                 tlog.tags_seen.add(tag)
                 if v > tlog.popped.get(tag, 0):
                     tlog.tag_data.setdefault(tag, []).append((v, muts))
-                    tlog._bytes_by_version.append((v, len(payload)))
-                    tlog._mem_bytes += len(payload)
+                    kept = True
+            if kept:
+                # one entry per VERSION, matching the commit path (a
+                # per-tag count would overstate memory by the tag
+                # multiplicity and trip the spill threshold early)
+                tlog._bytes_by_version.append((v, len(payload)))
+                tlog._mem_bytes += len(payload)
         tlog.version = NotifiedVersion(version)
         # Restored data is durable here but the KCV horizon must be
         # re-learned; the stored floor keeps already-served data servable.
