@@ -26,7 +26,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..bindings.fdb_api import Subspace
 from ..bindings.task_bucket import TaskBucket
-from ..core import error, wire
+from ..core import buggify, error, wire
 from ..core.types import Mutation, MutationType, SINGLE_KEY_MUTATIONS
 from ..client.database import Database
 from ..server import system_keys
@@ -121,8 +121,16 @@ class BackupAgent:
                 await delay(0.5)
                 continue
             if reply.messages:
+                if buggify.buggify():
+                    # mover stall mid-drain: the backup tag backs up at the
+                    # tlogs (spill pressure) and restorability lags
+                    await delay(1.0)
                 name = "log/%020d" % reply.messages[0][0]
                 await self._put(name, wire.dumps(list(reply.messages)))
+                if buggify.buggify():
+                    # crash-shaped duplicate: object written but pop lost —
+                    # the next peek re-serves; restore must dedupe by version
+                    continue
                 client.pop(self.tag, reply.messages[-1][0])
             if reply.end_version > floor:
                 floor = reply.end_version
@@ -172,6 +180,10 @@ class BackupAgent:
                         continue
                     raise
                 while True:
+                    if buggify.buggify():
+                        # slow chunk worker: its claim may expire and another
+                        # worker re-executes — exactly-once must still hold
+                        await delay(1.0)
                     vtr = self.db.create_transaction()
                     vc = await vtr.get_read_version()
                     try:

@@ -246,8 +246,15 @@ class Database:
         idempotent, so duplicates are safe. Non-transport errors
         (wrong_shard, future_version, ...) surface immediately — they come
         from a live replica and would repeat."""
+        from ..core import buggify
+
         self._lb_counter += 1
         start = self._lb_counter % len(addrs)
+        if buggify.buggify():
+            # sticky replica preference: all reads pile onto one replica,
+            # exercising hedging and server-side shedding instead of the
+            # rotation hiding them
+            start = 0
         to = timeout or REQUEST_TIMEOUT
 
         def send(i: int):
@@ -708,8 +715,15 @@ class Transaction:
             # already forgotten; re-resolve the proxy list so the retry
             # reaches the live generation.
             self.db.note_proxy_failure()
+        from ..core import buggify
+
         rng = current_scheduler().rng
-        await delay(self._backoff * rng.random01())
+        backoff = self._backoff
+        if buggify.buggify():
+            # impatient client: minimal backoff floods the retry path and
+            # stresses idempotent-commit / replay-window handling
+            backoff = 0.001
+        await delay(backoff * rng.random01())
         self._backoff = min(self._backoff * CLIENT_KNOBS.backoff_growth_rate,
                             CLIENT_KNOBS.max_backoff)
         self.reset()

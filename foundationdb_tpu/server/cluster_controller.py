@@ -96,9 +96,15 @@ class ClusterController:
 
     # -- worker registry ------------------------------------------------------
     async def register_worker(self, req: WorkerRegisterRequest) -> Optional[ServerDBInfo]:
+        from ..core import buggify
+
         self.workers[req.addr] = now()
         self.worker_roles[req.addr] = tuple(req.roles)
         self.worker_locality[req.addr] = tuple(req.locality)
+        if buggify.buggify():
+            # drop the broadcast piggyback once: the worker stays a beat
+            # stale and must pick the view up on its next heartbeat
+            return None
         if req.known_info_version < self.db_info.info_version:
             return self.db_info
         return None
@@ -235,6 +241,12 @@ class ClusterController:
             # (the reference's fitness preference, reduced to its core).
             others = [a for a in candidates if a != self.proc.address]
             target = (others or candidates)[0]
+            from ..core import buggify
+
+            if buggify.buggify() and len(others) > 1:
+                # adversarial placement: recruit the master on a different
+                # worker than the deterministic preference would pick
+                target = others[-1]
             salt = self.worker.sim.sched.rng.random_unique_id()
             from .worker import INIT_MASTER_TOKEN
 

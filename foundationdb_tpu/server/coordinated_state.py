@@ -12,7 +12,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field, replace
 from typing import Any, List, Optional
 
-from ..core import error
+from ..core import buggify, error
 from ..sim.actors import all_of
 from ..sim.loop import Future, TaskPriority
 from ..sim.network import Endpoint
@@ -71,12 +71,26 @@ class CoordinatedState:
     """
 
     def __init__(self, net, src_addr: str, coordinator_addrs: List[str], salt: int):
+        from ..sim.actors import AsyncMutex
+
         self.net = net
         self.src = src_addr
         self.coords = list(coordinator_addrs)
         self.salt = salt
         self._max_seen = ZERO_GEN
         self._read_gen: Optional[Generation] = None
+        #: generation the NEXT set_exclusive writes at. Starts at the read
+        #: generation (the exclusivity check needs write1 == read gen);
+        #: subsequent writes run a fresh read-verify-write cycle so they
+        #: are ordered after our earlier writes AND any interleaved writer
+        #: is detected — sequential writes from one handle MUST be ordered,
+        #: or a network-delayed earlier write applying late on one
+        #: coordinator silently reinstates a stale value at an equal
+        #: generation and a later quorum read can return it (found by
+        #: BUGGIFY reordering; the register max() can't break same-gen ties)
+        self._write_gen: Optional[Generation] = None
+        self._last_written: Optional[DBCoreState] = None
+        self._write_mutex = AsyncMutex()
 
     @property
     def _majority(self) -> int:
@@ -85,6 +99,11 @@ class CoordinatedState:
     async def _broadcast(self, token: str, req_for) -> List[Any]:
         """Send to every coordinator; return the successful majority of
         replies (error if a majority is unreachable)."""
+        if buggify.buggify():
+            # skewed quorum broadcast: a straggling master's ops interleave
+            # with a competitor's — the generation math must stay exclusive
+            from ..sim.loop import delay
+            await delay(0.1, TaskPriority.COORDINATION)
         futures = [
             self.net.request(
                 self.src, Endpoint(addr, token), req_for(addr),
@@ -138,23 +157,45 @@ class CoordinatedState:
             if stale:
                 continue
             self._read_gen = gen
+            self._write_gen = gen
             return value
 
     async def set_exclusive(self, state: DBCoreState) -> None:
-        """Write `state` at this handle's read generation; any interleaved
-        reader/writer with a higher generation wins and we die
-        (coordinated_state_conflict semantics via master_recovery_failed)."""
-        assert self._read_gen is not None, "read() before set_exclusive()"
-        gen = self._read_gen
-        replies = await self._broadcast(
-            GENERATION_WRITE_TOKEN,
-            lambda _: GenerationWriteRequest(CSTATE_KEY, gen, state),
-        )
-        for r in replies:
-            if not r.ok:
-                raise error.master_recovery_failed(
-                    f"cstate write lost to generation {r.max_gen}"
-                )
+        """Write `state`; any interleaved reader/writer with a higher
+        generation wins and we die (coordinated_state_conflict semantics
+        via master_recovery_failed).
+
+        The first write uses the read generation exactly (the register's
+        `gen >= read_gen` check is the exclusivity gate). Every LATER write
+        runs a fresh read-verify-write cycle (the reference's
+        ReusableCoordinatedState shape): the fresh read yields a strictly
+        higher generation — ordering this write after our own earlier ones
+        even when a delayed duplicate frame lands late on one register —
+        and verifies the value is still our last write, so an interleaved
+        writer is detected rather than silently overwritten (a bare
+        txn+1 bump would pass the register check on a salt tie and let two
+        masters both believe they hold exclusivity)."""
+        async with self._write_mutex:
+            assert self._write_gen is not None, "read() before set_exclusive()"
+            if self._last_written is not None:
+                cur = await self.read()
+                if cur != self._last_written:
+                    raise error.master_recovery_failed(
+                        "cstate changed under this master between writes"
+                    )
+            gen = self._write_gen
+            replies = await self._broadcast(
+                GENERATION_WRITE_TOKEN,
+                lambda _: GenerationWriteRequest(CSTATE_KEY, gen, state),
+            )
+            for r in replies:
+                if not r.ok:
+                    raise error.master_recovery_failed(
+                        f"cstate write lost to generation {r.max_gen}"
+                    )
+            if gen > self._max_seen:
+                self._max_seen = gen
+            self._last_written = state
 
 
 from ..core import wire as _wire

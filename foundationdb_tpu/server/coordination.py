@@ -273,6 +273,10 @@ class CoordinationServer:
         return reply
 
     async def _gen_write(self, req: GenerationWriteRequest) -> GenerationWriteReply:
+        if buggify.buggify():
+            # reorder writes against competing masters' broadcasts: the
+            # exclusive-write generation check must still pick one winner
+            await delay(0.05, TaskPriority.COORDINATION)
         reply = self._reg(req.key).write(req.gen, req.value)
         if reply.ok:
             await self._persist_regs()
@@ -307,7 +311,12 @@ class CoordinationServer:
     async def _sweeper(self) -> None:
         """Expire silent leaders even with no request traffic."""
         while True:
-            await delay(LEADER_TIMEOUT / 2, TaskPriority.COORDINATION)
+            tick = LEADER_TIMEOUT / 2
+            if buggify.buggify():
+                # eager sweeper: leases expire at the earliest legal moment,
+                # so heartbeat renewal races the sweep
+                tick = LEADER_TIMEOUT / 16
+            await delay(tick, TaskPriority.COORDINATION)
             self.leader.refresh(now())
 
 

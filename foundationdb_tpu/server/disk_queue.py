@@ -24,6 +24,7 @@ import struct
 import zlib
 from typing import List, Tuple
 
+from ..core import buggify
 from ..sim.actors import AsyncMutex
 from ..sim.disk import SimDisk, SimFile
 
@@ -120,12 +121,24 @@ class DiskQueue:
         pop_to once consumed downstream). Durable only after commit()."""
         async with self._mutex:
             frame = _FRAME.pack(len(payload), _frame_crc(self._end, payload)) + payload
-            await self.data.write(HEADER_SIZE + (self._end - self._base), frame)
+            off = HEADER_SIZE + (self._end - self._base)
+            if buggify.buggify():
+                # write split across two page-cache entries: a crash can
+                # tear between them — recovery's frame crc must catch it
+                mid = len(frame) // 2
+                await self.data.write(off, frame[:mid])
+                await self.data.write(off + mid, frame[mid:])
+            else:
+                await self.data.write(off, frame)
             self._end += len(frame)
             return self._end
 
     async def commit(self) -> None:
         """fsync the appended frames (the ack boundary)."""
+        if buggify.buggify():
+            # slow fsync: stretches the pre-ack window other failures race
+            from ..sim.loop import TaskPriority, delay
+            await delay(0.02, TaskPriority.DEFAULT_DELAY)
         async with self._mutex:
             await self.data.sync()
 
@@ -136,7 +149,8 @@ class DiskQueue:
         async with self._mutex:
             self._begin = min(max(logical_offset, self._begin), self._end)
             await self._write_header()
-            if (self._begin - self._base) > (1 << 16) and \
+            compact_at = (1 << 10) if buggify.buggify() else (1 << 16)
+            if (self._begin - self._base) > compact_at and \
                     (self._begin - self._base) * 2 > (self._end - self._base):
                 await self._compact()
 

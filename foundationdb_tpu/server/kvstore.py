@@ -39,7 +39,7 @@ import zlib
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
-from ..core import wire
+from ..core import buggify, wire
 from ..sim.actors import AsyncMutex
 from ..sim.disk import SimDisk
 from .disk_queue import DiskQueue
@@ -256,11 +256,18 @@ class SSTableStore:
         async with self._commit_mutex:
             if self._pending:
                 ops, self._pending = self._pending, []
+                if buggify.buggify():
+                    # slow WAL append: widens the un-fsynced window a crash
+                    # tears through
+                    from ..sim.loop import TaskPriority, delay
+                    await delay(0.01, TaskPriority.DEFAULT_DELAY)
                 await self.wal.push(wire.dumps(ops))
             await self.wal.commit()
-            if self._mem_bytes >= self.FLUSH_BYTES:
+            flush_at = 256 if buggify.buggify() else self.FLUSH_BYTES
+            if self._mem_bytes >= flush_at:
                 await self._flush()
-                if len(self._runs) > self.MAX_RUNS:
+                max_runs = 1 if buggify.buggify() else self.MAX_RUNS
+                if len(self._runs) > max_runs:
                     await self._compact()
 
     async def _write_run(self, entries, tombs) -> str:
@@ -310,6 +317,11 @@ class SSTableStore:
         self._mem.clear()
         self._mem_tombs.clear()
         self._mem_bytes = 0
+        if buggify.buggify():
+            # crash window: run installed in the manifest but the WAL not
+            # yet truncated — recovery must tolerate re-applying covered ops
+            from ..sim.loop import TaskPriority, delay
+            await delay(0.02, TaskPriority.DEFAULT_DELAY)
         # WAL content is fully covered by the installed run.
         await self.wal.pop_to(self.wal.end_offset)
 
@@ -328,6 +340,11 @@ class SSTableStore:
         rn = await self._write_run(entries, [])
         run = await _Run.open(self.disk, rn, self._cache, self.CACHE_BLOCKS)
         self._runs = [run]
+        if buggify.buggify():
+            # crash window: merged run durable but manifest not installed —
+            # reopen must GC the orphan and serve the OLD manifest's runs
+            from ..sim.loop import TaskPriority, delay
+            await delay(0.02, TaskPriority.DEFAULT_DELAY)
         await self._install_manifest([rn])
         for name in old:
             for ck in [c for c in self._cache if c[0] == name]:

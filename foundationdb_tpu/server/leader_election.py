@@ -11,7 +11,7 @@ from __future__ import annotations
 
 from typing import List, Optional
 
-from ..core import error
+from ..core import buggify, error
 from ..sim.actors import AsyncVar, all_of, any_of
 from ..sim.loop import Future, TaskPriority, delay, spawn
 from ..sim.network import Endpoint
@@ -46,6 +46,10 @@ async def try_become_leader(
     async def poll(i: int, addr: str) -> None:
         prev_id: Optional[int] = None
         while True:
+            if buggify.buggify():
+                # laggard candidate: this coordinator sees the candidacy
+                # late — elections must survive stragglers and re-votes
+                await delay(CANDIDACY_TTL, TaskPriority.COORDINATION)
             try:
                 nominee = await net.request(
                     src_addr,
@@ -106,7 +110,12 @@ async def hold_leadership(
                 pass
         if acks < _majority(len(coordinator_addrs)):
             return
-        await delay(HEARTBEAT_INTERVAL, TaskPriority.COORDINATION)
+        interval = HEARTBEAT_INTERVAL
+        if buggify.buggify():
+            # near-miss heartbeat cadence: the lease renews just before
+            # expiry, so coordinator-side TTL math gets exercised at the edge
+            interval = LEADER_TIMEOUT * 0.9
+        await delay(interval, TaskPriority.COORDINATION)
 
 
 async def _settle(f: Future):

@@ -183,8 +183,13 @@ class LogSystemClient:
         (LogSystemPeekCursor: best server first, then the others)."""
         subset = self.config.tag_subset(tag)
         last_err: Optional[error.FDBError] = None
+        start = tag
+        if buggify.buggify():
+            # randomize the preferred replica: the failover order and the
+            # "any member can serve" property get exercised without a death
+            start = tag + 1
         for attempt in range(len(subset)):
-            idx = subset[(tag + attempt) % len(subset)]
+            idx = subset[(start + attempt) % len(subset)]
             try:
                 return await self.net.request(
                     self.src, self.config.ep(self.config.tlogs[idx], "peek"),
@@ -222,6 +227,12 @@ async def lock_generation(
     the locked set is smaller than the tag-coverage quorum (retry later —
     some tag's un-popped window would be unrecoverable until a subset
     member comes back)."""
+    if buggify.buggify():
+        # stalled epoch end: in-flight pushes race the lock fan-out, so
+        # some replicas take the commit and some reject it (the
+        # maybe-committed window recovery's min(end) math must cover)
+        from ..sim.loop import delay
+        await delay(0.1, TaskPriority.TLOG_COMMIT)
     futures = [
         (rep, net.request(
             src_addr, config.ep(rep, "lock"), TLogLockRequest(),

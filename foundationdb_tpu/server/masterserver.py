@@ -394,6 +394,10 @@ class MasterServer:
         prev = prev or DBCoreState()
         rc = prev.recovery_count + 1
         self._state("locking_cstate", RecoveryCount=rc)
+        if buggify.buggify():
+            # gap between reading and locking the cstate: a competing
+            # master can slip its own lock in — ours must then lose cleanly
+            await delay(0.3, TaskPriority.CLUSTER_CONTROLLER)
         await cstate.set_exclusive(replace(prev, recovery_count=rc))
 
         # -- LOCKING_TLOGS: end the previous epoch ---------------------------
@@ -757,7 +761,12 @@ class MasterServer:
 
             await dd["init_done"].future
             while True:
-                await delay(SERVER_KNOBS.dd_tracker_interval, TaskPriority.MOVE_KEYS)
+                interval = SERVER_KNOBS.dd_tracker_interval
+                if buggify.buggify():
+                    # frantic tracker: split/merge decisions race fresh
+                    # moves and each other's metadata transactions
+                    interval = interval / 8
+                await delay(interval, TaskPriority.MOVE_KEYS)
                 if dd["busy"]:
                     continue
                 tags = list(dd["storage_tags"])

@@ -324,6 +324,10 @@ class Proxy:
             self._grv_flush_active = True
             self._spawn(self._grv_flush(), TaskPriority.PROXY_GRV_TIMER, "grvBatch")
         version = await p.future
+        if buggify.buggify():
+            # reply delivery lag: the client's GRV is extra stale by the
+            # time it reads — MVCC windows and too-old paths get exercised
+            await delay(0.05, TaskPriority.PROXY_GRV_TIMER)
         self.stats.add("txn_start_out")
         return GetReadVersionReply(version=max(version, self.committed_version.get()))
 
@@ -402,7 +406,12 @@ class Proxy:
         from ..sim.loop import now
 
         while not self._dead:
-            await delay(IDLE_COMMIT_INTERVAL, TaskPriority.PROXY_COMMIT_BATCHER)
+            interval = IDLE_COMMIT_INTERVAL
+            if buggify.buggify():
+                # hyperactive idle committer: floods the version chain with
+                # empty batches (tiny version deltas, KCV churn)
+                interval = IDLE_COMMIT_INTERVAL / 10
+            await delay(interval, TaskPriority.PROXY_COMMIT_BATCHER)
             if now() - self._last_batch_time < IDLE_COMMIT_INTERVAL:
                 continue
             self._batch_num += 1

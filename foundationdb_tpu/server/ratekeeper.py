@@ -67,9 +67,16 @@ class Ratekeeper:
         self.worst_lag: int = 0
 
     async def run(self) -> None:
+        from ..core import buggify
+
         interval = SERVER_KNOBS.ratekeeper_update_interval
         while True:
-            await delay(interval, TaskPriority.RATEKEEPER)
+            tick = interval
+            if buggify.buggify():
+                # stale ratekeeper: proxies run on an old budget while the
+                # cluster state moves — metering must degrade gracefully
+                tick = interval * 10
+            await delay(tick, TaskPriority.RATEKEEPER)
             infos: List[StorageQueueInfo] = []
             for tag, _b, _e, addr in self.storage_tags:
                 try:
@@ -116,4 +123,11 @@ class Ratekeeper:
         return min(tps_lag, tps_bytes)
 
     async def get_rate_info(self, req: GetRateInfoRequest) -> GetRateInfoReply:
-        return GetRateInfoReply(tps_limit=self.tps_limit)
+        from ..core import buggify
+
+        limit = self.tps_limit
+        if buggify.buggify():
+            # brief artificial squeeze: the GRV back-pressure path (queued
+            # starts, latency instead of errors) runs even on idle clusters
+            limit = max(1.0, limit / 100)
+        return GetRateInfoReply(tps_limit=limit)

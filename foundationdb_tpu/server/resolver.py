@@ -15,7 +15,7 @@ from __future__ import annotations
 
 from typing import Dict
 
-from ..core import error
+from ..core import buggify, error
 from ..core.types import MAX_WRITE_TRANSACTION_LIFE_VERSIONS, Version
 from ..sim.actors import NotifiedVersion
 from ..sim.network import SimProcess
@@ -92,7 +92,19 @@ class Resolver:
         if req.version <= self.version.get():
             # A duplicate delivery resolved this version while we waited.
             return self._replay(req.version)
-        new_oldest = max(0, req.version - MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
+        if buggify.buggify():
+            # slow resolve: batches queue up behind the version chain, so
+            # proxies see deep pipelining + retry races
+            from ..sim.loop import TaskPriority, delay
+            await delay(0.05, TaskPriority.PROXY_COMMIT)
+            if req.version <= self.version.get():
+                return self._replay(req.version)
+        window = MAX_WRITE_TRANSACTION_LIFE_VERSIONS
+        if buggify.buggify():
+            # tight replay/conflict window: drives the too-old and
+            # replay-window-GC'd paths that normally need huge lag
+            window = window // 100
+        new_oldest = max(0, req.version - window)
         self._sample_rows(req.transactions)
         verdicts = self.engine.resolve(req.transactions, req.version, new_oldest)
         reply = ResolveTransactionBatchReply(committed=[int(v) for v in verdicts])

@@ -516,6 +516,11 @@ class StorageServer:
             self._pending_bytes -= nbytes
         del self._pending[:i]
         self.kvs.set(DURABLE_VERSION_KEY, wire.dumps(new_durable))
+        if buggify.buggify():
+            # stall between staging and the engine fsync: reads that
+            # awaited across this window must re-check the floor, and a
+            # crash here loses the whole staged batch (tlog not yet popped)
+            await delay(0.05, TaskPriority.STORAGE)
         await self.kvs.commit()
         self.durable_version = new_durable
         self.store.drop_through(new_durable)
@@ -669,25 +674,39 @@ class StorageServer:
         except BaseException:
             self._adding = None   # master retries; a re-fetch starts clean
             raise
-        if self.kvs is None:
-            # fetched base BEFORE the buffered replay: chains stay monotone
-            for k, v in items:
-                self.store.set(k, v, req.fetch_version)
-        # Replay buffered mutations above the snapshot version. The buffer
-        # may still grow during an atomic op's engine read; the index loop
-        # drains the tail too, and _adding stays active throughout so the
-        # update loop keeps routing new-range mutations here (an older
-        # buffered write can never land after a newer live one).
-        per_version: Dict[Version, list] = {}
-        i = 0
-        while i < len(buf):
-            v, m = buf[i]
-            i += 1
-            if v <= req.fetch_version:
-                continue   # already contained in the fetched snapshot
-            op = await self._apply(m, v, unbounded=True)
-            if self.kvs is not None:
-                per_version.setdefault(v, []).append(op)
+        try:
+            if buggify.buggify():
+                # widen the fetch-to-replay gap: more tag mutations land in
+                # the AddingShard buffer, stressing the replay version merge
+                await delay(0.5, TaskPriority.FETCH_KEYS)
+            if self.kvs is None:
+                # fetched base BEFORE the buffered replay: chains stay monotone
+                for k, v in items:
+                    self.store.set(k, v, req.fetch_version)
+            # Replay buffered mutations above the snapshot version. The
+            # buffer may still grow during an atomic op's engine read; the
+            # index loop drains the tail too, and _adding stays active
+            # throughout so the update loop keeps routing new-range
+            # mutations here (an older buffered write can never land after
+            # a newer live one).
+            per_version: Dict[Version, list] = {}
+            i = 0
+            while i < len(buf):
+                v, m = buf[i]
+                i += 1
+                if v <= req.fetch_version:
+                    continue   # already contained in the fetched snapshot
+                op = await self._apply(m, v, unbounded=True)
+                if self.kvs is not None:
+                    per_version.setdefault(v, []).append(op)
+        except BaseException:
+            # A dangling buffer would reject every retried extend and eat
+            # the incoming range's mutations forever; the retry re-fetches
+            # from a cleared engine range and a fresh buffer. (Replayed
+            # overlay entries beyond the un-widened shard are invisible to
+            # reads and age out with the window.)
+            self._adding = None
+            raise
         self._adding = None
         self.shard = KeyRange(self.shard.begin, req.new_end)
         # Replayed ops enter the durability pipeline at their versions
@@ -714,10 +733,15 @@ class StorageServer:
             await self._make_durable(max(per_version))
         # The fetched rows reflect fetch_version; reads below it in the new
         # range would see the future. Raise the floor (persisted so a
-        # restart keeps the gate) — retries get fresher read versions.
+        # restart keeps the gate) — retries get fresher read versions. The
+        # floor must be engine-durable BEFORE the extended meta syncs: a
+        # crash between the two would otherwise restore the wider shard
+        # with the stale floor and serve the fetch snapshot to reads below
+        # fetch_version (read-from-the-future).
         self._durabilizing_to = max(self._durabilizing_to, req.fetch_version)
         if self.kvs is not None:
             self.kvs.set(READ_FLOOR_KEY, wire.dumps(self._durabilizing_to))
+            await self.kvs.commit()
         if self._disk is not None:
             meta = self._disk.open(self._meta_name() + ".meta")
             await meta.write(0, wire.dumps({
@@ -725,8 +749,6 @@ class StorageServer:
                 "end": self.shard.end,
             }))
             await meta.sync()
-        if self.kvs is not None:
-            await self.kvs.commit()
 
     async def _existing_value(self, key: Key, version: Version) -> Optional[Value]:
         """Current value for an atomic-op read-modify-write: overlay entry

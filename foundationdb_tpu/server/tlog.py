@@ -484,6 +484,10 @@ class TLog:
             await delay(0.05, TaskPriority.TLOG_PEEK)  # slow peek service
         data = self.tag_data.get(req.tag, [])
         horizon = min(self.version.get(), self.known_committed.get())
+        if buggify.buggify() and horizon > req.begin_version:
+            # short peek page: serve a clipped horizon so consumers must
+            # re-peek (the reference's peek reply byte limit)
+            horizon = req.begin_version
         begin = max(req.begin_version, self.popped.get(req.tag, 0) + 1)
         spilled, truncated = await self._spilled_messages(req.tag, begin, horizon)
         if truncated and spilled:
@@ -527,6 +531,10 @@ class TLog:
     # -- epoch end -----------------------------------------------------------
     async def lock(self, req: TLogLockRequest) -> TLogLockReply:
         """reference: tLogLock (TLogServer.actor.cpp:496). Idempotent."""
+        if buggify.buggify():
+            # slow lock ack: the recovering master's lock fan-out completes
+            # ragged, and commits mid-fsync see the stop flag at odd points
+            await delay(0.05, TaskPriority.TLOG_COMMIT)
         self.stopped = True
         if not self._stop_promise.is_set:
             self._stop_promise.send(None)

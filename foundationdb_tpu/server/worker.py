@@ -17,7 +17,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..core import error
+from ..core import buggify, error
 from ..sim.actors import AsyncVar
 from ..sim.loop import TaskPriority, delay, spawn
 from ..sim.network import Endpoint, SimProcess
@@ -217,16 +217,29 @@ class Worker:
                 await delay(REGISTER_INTERVAL, TaskPriority.CLUSTER_CONTROLLER)
                 continue
             if info is not None and info.info_version > known_version:
+                if buggify.buggify():
+                    # broadcast applied late: roles run a beat behind the
+                    # cluster view (stale log_config, stale proxy list)
+                    await delay(0.5, TaskPriority.CLUSTER_CONTROLLER)
                 known_version = info.info_version
                 if info.recovery_count >= self.db_info.get().recovery_count:
                     self.db_info.set(info)
                     if (info.log_config is not None
                             and info.log_config != self.log_view.get()):
                         self.log_view.set(info.log_config)
-            await delay(REGISTER_INTERVAL, TaskPriority.CLUSTER_CONTROLLER)
+            interval = REGISTER_INTERVAL
+            if buggify.buggify():
+                # sluggish registrant: CC liveness/recruitment must not
+                # depend on prompt re-registration
+                interval = REGISTER_INTERVAL * 6
+            await delay(interval, TaskPriority.CLUSTER_CONTROLLER)
 
     # -- role construction -----------------------------------------------------
     async def init_tlog(self, req: InitializeTLogRequest) -> str:
+        if buggify.buggify():
+            # slow role construction: recovery must wait, and a competing
+            # recovery generation may overtake this one mid-initialize
+            await delay(0.3, TaskPriority.CLUSTER_CONTROLLER)
         key = ("tlog", req.gen_id[0], req.gen_id[1], req.replica_index)
         if key not in self.roles:
             disk = self.sim.disk_for(self.proc.address)
@@ -277,6 +290,10 @@ class Worker:
                 # MoveKeys destination: copy the shard BEFORE persisting the
                 # role (a crash mid-fetch leaves no half-alive replica), then
                 # let the update loop drain this tag's buffered mutations.
+                if buggify.buggify():
+                    # stalled fetch start: the donor team serves reads (and
+                    # the tag stream buffers at the tlogs) meanwhile
+                    await delay(0.5, TaskPriority.FETCH_KEYS)
                 await ss.fetch_keys(req.fetch_from, req.fetch_version)
                 await ss.persist_initial()
                 ss.start_update_loop()

@@ -84,6 +84,62 @@ def test_cstate_exclusive_write_conflict():
     assert sim.run_until(sim.sched.spawn(work()), until=30.0)
 
 
+def test_cstate_sequential_writes_survive_reordered_delivery(monkeypatch):
+    """One master's sequential writes (lock cstate, then hand-over, then DD
+    publishes) must be ORDERED on every coordinator: if an earlier write's
+    network frame applies late on one register, it must lose there — under
+    the old same-generation scheme it silently reinstated the stale value
+    at an equal generation, and a later master's quorum read could return
+    it (it then locked an already-retired tlog generation forever; found
+    by the BUGGIFY write-reorder site on MultiProxyAttrition seed 11)."""
+    from foundationdb_tpu.server import coordination as coord_mod
+    from foundationdb_tpu.sim.loop import TaskPriority, delay
+
+    sim = Simulator(seed=7)
+
+    v1 = DBCoreState(recovery_count=5)
+    v2 = DBCoreState(recovery_count=5, generations=("new-gen-marker",))
+
+    orig = coord_mod.CoordinationServer._gen_write
+    victim = {}
+
+    async def reordering_write(self, req):
+        # on ONE coordinator, the FIRST value's frame stalls until after
+        # the second value has been applied
+        if self.proc.address == victim.get("addr") and req.value == v1:
+            await delay(1.0, TaskPriority.COORDINATION)
+        return await orig(self, req)
+
+    # patch BEFORE construction: proc.register captures the bound method
+    monkeypatch.setattr(coord_mod.CoordinationServer, "_gen_write", reordering_write)
+    procs, servers = make_coords(sim)
+    addrs = [p.address for p in procs]
+    client = sim.new_process("m")
+    victim["addr"] = procs[2].address
+
+    async def work():
+        cs = CoordinatedState(sim.net, client.address, addrs, salt=1)
+        await cs.read()
+        await cs.set_exclusive(v1)   # acks from the two undelayed coords
+        await cs.set_exclusive(v2)
+        await delay(3.0)             # let the stale v1 frame land on victim
+        # NO register may end up holding the stale value: a later quorum
+        # read containing only {victim, one-other} would return whichever
+        # value_gen is higher — with same-generation writes that tie was
+        # resolved arbitrarily and could resurrect v1
+        from foundationdb_tpu.server.coordinated_state import CSTATE_KEY
+
+        reg = servers[2].regs.get(CSTATE_KEY)
+        assert reg is not None and reg.value == v2, (
+            f"victim register holds stale cstate: {reg.value}")
+        cs2 = CoordinatedState(sim.net, client.address, addrs, salt=2)
+        got = await cs2.read()
+        assert got == v2, f"stale cstate resurfaced: {got}"
+        return True
+
+    assert sim.run_until(sim.sched.spawn(work()), until=60.0)
+
+
 def test_leader_election_single_winner_and_failover():
     sim = Simulator(seed=4)
     procs, _ = make_coords(sim)
