@@ -44,6 +44,19 @@ _RECORDS: Dict[str, Type] = {}
 _RECORD_NAMES: Dict[Type, str] = {}
 _ENUMS: Dict[str, Type] = {}
 _ENUM_NAMES: Dict[Type, str] = {}
+#: non-dataclass types with explicit (to_state, from_state) codecs, encoded
+#: as single-field records — e.g. KeyShardMap, which is fully described by
+#: its split keys but derives its fields in __init__
+_ADAPTERS: Dict[Type, Tuple[str, Callable]] = {}
+_ADAPTER_DECODERS: Dict[str, Callable] = {}
+
+
+def register_adapter(cls: Type, name: str, to_state: Callable, from_state: Callable) -> Type:
+    """Register a custom codec: `to_state(obj)` must return a wire-encodable
+    value; `from_state(state)` reconstructs the object."""
+    _ADAPTERS[cls] = (name, to_state)
+    _ADAPTER_DECODERS[name] = from_state
+    return cls
 
 #: modules whose import registers every record reachable from disk state;
 #: imported lazily on the first unknown record (a restore may run before
@@ -156,6 +169,15 @@ def _encode(out: bytearray, obj: Any) -> None:
         for x in sorted(obj, key=repr):
             _encode(out, x)
     else:
+        adapter = _ADAPTERS.get(type(obj))
+        if adapter is not None:
+            name, to_state = adapter
+            out.append(_T_RECORD)
+            _encode_str(out, name)
+            _write_varint(out, 1)
+            _encode_str(out, "state")
+            _encode(out, to_state(obj))
+            return
         name = _RECORD_NAMES.get(type(obj))
         if name is None:
             raise TypeError(f"wire cannot encode {type(obj).__name__}: "
@@ -256,6 +278,15 @@ def _decode(raw: bytes, off: int) -> Tuple[Any, int]:
             fname, off = _decode_str(raw, off)
             val, off = _decode(raw, off)
             got[fname] = val
+        dec = _ADAPTER_DECODERS.get(name)
+        if dec is None and name not in _RECORDS:
+            import importlib
+
+            for mod in _LAZY_REGISTRARS:
+                importlib.import_module(mod)
+            dec = _ADAPTER_DECODERS.get(name)
+        if dec is not None:
+            return dec(got["state"]), off
         cls = _resolve_record(name)
         import dataclasses
 

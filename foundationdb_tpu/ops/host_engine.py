@@ -26,62 +26,12 @@ import jax
 import jax.numpy as jnp
 
 from ..core import error
+from ..core.keyshard import KeyShardMap
 from ..core.types import CommitTransaction, Key, TransactionCommitResult, Version
 from . import conflict_kernel as ck
 from . import keypack
 from .conflict_kernel import KernelConfig, build_batch_arrays
 from .oracle import VersionIntervalMap
-
-
-class KeyShardMap:
-    """Static partition of the keyspace into S contiguous spans.
-
-    Span s = [begins[s], begins[s+1]) with begins[0] = b'' and a virtual
-    +inf end for the last span (the analog of the keyResolvers range map,
-    ProxyCommitData:169)."""
-
-    def __init__(self, split_keys: Sequence[Key]):
-        assert list(split_keys) == sorted(split_keys), "split keys must be sorted"
-        assert all(k for k in split_keys), "split keys must be non-empty"
-        self.begins: List[Key] = [b""] + list(split_keys)
-        self.n_shards = len(self.begins)
-
-    @staticmethod
-    def uniform(n_shards: int) -> "KeyShardMap":
-        """Evenly split on the first key byte."""
-        if n_shards == 1:
-            return KeyShardMap([])
-        assert n_shards <= 256, "one-byte granularity cannot split past 256 shards"
-        splits = [bytes([(256 * i) // n_shards]) for i in range(1, n_shards)]
-        return KeyShardMap(splits)
-
-    def span_end(self, s: int) -> Optional[Key]:
-        return self.begins[s + 1] if s + 1 < self.n_shards else None
-
-    def shard_of_key(self, key: Key) -> int:
-        """Shard owning `key` (span containing it)."""
-        return max(bisect.bisect_right(self.begins, key) - 1, 0)
-
-    def shard_of_point_below(self, key: Key) -> int:
-        """Shard owning the interval strictly below `key` (for empty reads:
-        mirrors VersionIntervalMap.version_strictly_below's max(i,0))."""
-        return max(bisect.bisect_left(self.begins, key) - 1, 0)
-
-    def shards_of_range(self, begin: Key, end: Key) -> List[Tuple[int, Key, Key]]:
-        """(shard, clipped_begin, clipped_end) for every span intersecting
-        the non-empty range [begin, end)."""
-        out = []
-        lo = max(bisect.bisect_right(self.begins, begin) - 1, 0)
-        for s in range(lo, self.n_shards):
-            sb = self.begins[s]
-            if sb >= end:
-                break
-            se = self.span_end(s)
-            cb = max(begin, sb)
-            ce = end if se is None else min(end, se)
-            if cb < ce:
-                out.append((s, cb, ce))
-        return out
 
 
 from ..core.types import is_point_range as _is_point

@@ -1,0 +1,105 @@
+"""One real cluster node: `python -m foundationdb_tpu.real.node ...`.
+
+The fdbd() analog (fdbserver/fdbserver.actor.cpp:1607, worker.actor.cpp:997):
+one OS process composing, over the real transport,
+
+  * a coordination server (when this node is in the coordinator list) —
+    durable generation + leader registers on the node's data dir;
+  * a worker — registers with the elected cluster controller, stands for
+    CC leadership itself, and constructs recruited roles (master, proxy,
+    resolver, tlog, storage) on Initialize* RPCs;
+
+all running the UNCHANGED role code on the wall-clock cooperative
+scheduler (real/runtime.py). The conflict engine is the C++ native one
+when the library is built, else the oracle.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+
+
+def make_engine_factory(kind: str):
+    if kind == "native":
+        try:
+            from ..ops.native_engine import NativeConflictEngine
+
+            NativeConflictEngine()   # probe: raises if the lib is missing
+            return NativeConflictEngine
+        except Exception:
+            pass
+    from ..ops.oracle import OracleConflictEngine
+
+    return OracleConflictEngine
+
+
+async def amain(args) -> None:
+    from ..server.cluster import DynamicClusterConfig
+    from ..server.coordination import CoordinationServer
+    from ..server.worker import Worker
+    from ..sim.loop import TaskPriority, set_scheduler
+    from .runtime import (
+        NodeProcess,
+        RealNetClient,
+        RealScheduler,
+        RealWorld,
+        make_dispatcher,
+    )
+
+    sched = RealScheduler(seed=(os.getpid() << 16) ^ args.port)
+    set_scheduler(sched)
+    proc = NodeProcess(args.host, args.port, machine_id=f"m{args.port}", dc_id="dc0")
+    proc.dispatcher = make_dispatcher(sched)
+    await proc.start()
+    net = RealNetClient(sched)
+    world = RealWorld(sched, net, args.datadir)
+
+    coords = args.coordinators.split(",")
+    cfg = DynamicClusterConfig(
+        n_coordinators=len(coords),
+        n_workers=args.workers,
+        n_tlogs=args.tlogs,
+        n_resolvers=args.resolvers,
+        n_proxies=args.proxies,
+        n_storage=args.storage,
+        engine_factory=make_engine_factory(args.engine),
+    )
+
+    async def boot():
+        if proc.address in coords:
+            await CoordinationServer.create(proc, world.disk_for(proc.address))
+        Worker(world, proc, coords, cfg.engine_factory,
+               cc_priority=args.cc_priority, cluster_cfg=cfg)
+
+    sched.spawn(boot(), TaskPriority.CLUSTER_CONTROLLER, name="fdbd-boot")
+    print(f"node up on {proc.address}", flush=True)
+    await sched.run_async()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="one real cluster node (fdbd)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--coordinators", required=True,
+                    help="comma-separated host:port list (the cluster file)")
+    ap.add_argument("--datadir", required=True)
+    ap.add_argument("--cc-priority", type=int, default=None,
+                    help="stand for cluster controllership at this priority")
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--tlogs", type=int, default=2)
+    ap.add_argument("--resolvers", type=int, default=2)
+    ap.add_argument("--proxies", type=int, default=1)
+    ap.add_argument("--storage", type=int, default=2)
+    ap.add_argument("--engine", default="native", choices=["native", "oracle"])
+    args = ap.parse_args(argv)
+    try:
+        asyncio.run(amain(args))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

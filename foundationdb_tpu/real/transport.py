@@ -30,14 +30,30 @@ from ..sim.network import Endpoint
 
 
 def _register_messages() -> None:
-    from ..server import messages as msgs
+    """Wire-register every role-interface dataclass, so the full dynamic
+    cluster's RPC surface (recruitment, coordination, recovery, DD,
+    ratekeeper) serializes — the real-mode analog of the reference's
+    serializable interface structs (fdbclient/*Interface.h)."""
     from ..core import types as t
+    from ..server import cluster_controller as cc
+    from ..server import coordinated_state as cst
+    from ..server import coordination as coord
+    from ..server import log_system as ls
+    from ..server import master as master_mod
+    from ..server import masterserver as ms
+    from ..server import messages as msgs
+    from ..server import proxy as proxy_mod
+    from ..server import ratekeeper as rk
+    from ..server import storage as storage_mod
+    from ..server import worker as worker_mod
+    from ..sim import network as simnet
 
-    for mod in (msgs, t):
+    for mod in (msgs, t, coord, cst, ls, worker_mod, cc, ms, storage_mod,
+                rk, master_mod, proxy_mod, simnet):
         for name in dir(mod):
             obj = getattr(mod, name)
             if dataclasses.is_dataclass(obj) and isinstance(obj, type):
-                if obj not in wire._RECORD_NAMES:
+                if obj not in wire._RECORD_NAMES and obj not in wire._ADAPTERS:
                     wire.register_record(obj)
 
 
@@ -45,6 +61,12 @@ _register_messages()
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 64 << 20
+
+#: wire protocol version, exchanged in the connection handshake (the
+#: FlowTransport ConnectPacket's protocolVersion, FlowTransport.actor.cpp):
+#: both sides must agree before any request crosses the link — a version
+#: skew surfaces as an immediate typed error, never a mis-decoded frame
+PROTOCOL_VERSION = 1
 
 
 async def _read_frame(reader: asyncio.StreamReader) -> Any:
@@ -74,6 +96,23 @@ class _Peer:
     async def connect(self) -> None:
         host, port = self.addr.rsplit(":", 1)
         self.reader, self.writer = await asyncio.open_connection(host, int(port))
+        # protocol-version handshake BEFORE the reply pump owns the reader:
+        # hello out, hello back, versions must match
+        _write_frame(self.writer, {"kind": "hello", "id": 0,
+                                   "token": "", "body": PROTOCOL_VERSION})
+        await self.writer.drain()
+        try:
+            reply = await asyncio.wait_for(_read_frame(self.reader), timeout=5.0)
+        except (asyncio.TimeoutError, asyncio.IncompleteReadError):
+            self.writer.close()
+            self.reader = self.writer = None
+            raise error.connection_failed("handshake timeout")
+        if reply.get("kind") != "hello" or reply.get("body") != PROTOCOL_VERSION:
+            self.writer.close()
+            self.reader = self.writer = None
+            raise error.connection_failed(
+                f"protocol version mismatch: ours {PROTOCOL_VERSION}, "
+                f"theirs {reply.get('body')}")
         self._pump = asyncio.create_task(self._pump_replies())
 
     async def _pump_replies(self) -> None:
@@ -128,6 +167,12 @@ class RealProcess:
         #: strong refs — the loop keeps only weak ones, and a collected
         #: handler task means a silently dropped reply
         self._tasks: set = set()
+        #: how handler coroutines are driven: None = plain asyncio await
+        #: (handlers are asyncio coroutines); the real-cluster runtime
+        #: installs a dispatcher that runs them on the node's cooperative
+        #: scheduler instead (handlers there await scheduler Futures,
+        #: which asyncio cannot drive)
+        self.dispatcher: Optional[Callable] = None
 
     @property
     def address(self) -> str:
@@ -155,9 +200,31 @@ class RealProcess:
     async def _serve(self, reader: asyncio.StreamReader,
                      writer: asyncio.StreamWriter) -> None:
         self._conns.add(writer)
+        shaken = False
         try:
             while True:
                 msg = await _read_frame(reader)
+                if msg["kind"] == "hello":
+                    if msg.get("body") != PROTOCOL_VERSION:
+                        _write_frame(writer, {"kind": "err", "id": 0,
+                                              "body": (error.connection_failed("").code,
+                                                       "protocol_mismatch")})
+                        await writer.drain()
+                        return
+                    _write_frame(writer, {"kind": "hello", "id": 0,
+                                          "token": "", "body": PROTOCOL_VERSION})
+                    await writer.drain()
+                    shaken = True
+                    continue
+                if not shaken:
+                    # no frame is serviced before the version handshake: a
+                    # peer speaking a pre-handshake protocol must fail HERE,
+                    # not be decoded under skew
+                    _write_frame(writer, {"kind": "err", "id": msg.get("id", 0),
+                                          "body": (error.connection_failed("").code,
+                                                   "handshake_required")})
+                    await writer.drain()
+                    return
                 if msg["kind"] == "oneway":
                     handler = self.handlers.get(msg["token"])
                     if handler is not None:
@@ -177,7 +244,10 @@ class RealProcess:
 
     async def _run_oneway(self, handler, body) -> None:
         try:
-            await handler(body)
+            if self.dispatcher is not None:
+                await self.dispatcher(handler, body)
+            else:
+                await handler(body)
         except Exception:
             pass
 
@@ -187,7 +257,10 @@ class RealProcess:
             if handler is None:
                 raise error.FDBError(error.request_maybe_delivered("").code,
                                      "request_maybe_delivered")
-            body = await handler(msg["body"])
+            if self.dispatcher is not None:
+                body = await self.dispatcher(handler, msg["body"])
+            else:
+                body = await handler(msg["body"])
             reply = {"kind": "reply", "id": msg["id"], "body": body}
         except error.FDBError as e:
             reply = {"kind": "err", "id": msg["id"], "body": (e.code, e.name)}

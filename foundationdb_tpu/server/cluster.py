@@ -12,7 +12,7 @@ from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
 from ..core.types import KeyRange
-from ..ops.host_engine import KeyShardMap
+from ..core.keyshard import KeyShardMap
 from ..ops.oracle import OracleConflictEngine
 from ..sim.actors import AsyncVar
 from ..sim.network import Endpoint
@@ -152,6 +152,26 @@ class DynamicClusterConfig:
     rebalance_min_rows: int = 200
     rebalance_interval: float = 5.0
     engine_factory: Callable = OracleConflictEngine
+
+
+import dataclasses as _dc
+
+from ..core import wire as _wire
+
+# wire codec for real-mode recruitment (InitializeMasterRequest carries the
+# cluster shape): every field EXCEPT the process-local engine factory — the
+# receiving worker constructs engines from its OWN factory
+_wire.register_adapter(
+    DynamicClusterConfig, "DynamicClusterConfig",
+    to_state=lambda c: {f.name: getattr(c, f.name)
+                        for f in _dc.fields(c) if f.name != "engine_factory"},
+    # filter to known fields: a payload from a version with fields this
+    # binary dropped must decode, not TypeError (the record path's
+    # schema-evolution contract, wire.py)
+    from_state=lambda d: DynamicClusterConfig(
+        **{k: v for k, v in d.items()
+           if k in {f.name for f in _dc.fields(DynamicClusterConfig)}}),
+)
 
 
 class DynamicCluster:

@@ -163,6 +163,11 @@ class LogSystemClient:
             )
             for req, rep in zip(reqs, self.config.tlogs)
         ])
+        # sim-only durability oracle (fdbrpc/sim_validation.h): this push
+        # fully acked, so no future recovery may pick a version below it
+        from ..sim import validation as sim_validation
+
+        sim_validation.advance_max_committed(version)
         # Every replica is durable at `version`: advance the peek horizon.
         # Unreliable one-ways — the next push carries the same KCV anyway.
         # BUGGIFY: drop them entirely; peeks must survive on the belt

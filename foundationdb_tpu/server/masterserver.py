@@ -35,7 +35,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..core import buggify, error
 from ..core.trace import TraceEvent
-from ..ops.host_engine import KeyShardMap
+from ..core.keyshard import KeyShardMap
 from ..sim.actors import all_of, any_of
 from ..sim.loop import TaskPriority, delay, spawn
 from ..sim.network import Endpoint
@@ -411,6 +411,11 @@ class MasterServer:
                     recovery_version, locked_reps = await lock_generation(
                         self.net, self.proc.address, old_cfg
                     )
+                    # durability oracle: the recovery version must cover
+                    # every fully-acked push (sim_validation.h:20-50)
+                    from ..sim import validation as sim_validation
+
+                    sim_validation.check_restored_version(recovery_version)
                     preload, preload_popped = await fetch_recovery_data(
                         self.net, self.proc.address, old_cfg, locked_reps,
                         recovery_version,

@@ -39,7 +39,7 @@ from ..core.types import (
     Version,
     transform_versionstamp_mutation,
 )
-from ..ops.host_engine import KeyShardMap
+from ..core.keyshard import KeyShardMap
 from ..sim.actors import ActorCollection, NotifiedVersion, PromiseStream, all_of, any_of
 from ..sim.loop import Future, Promise, TaskPriority, delay, spawn
 from ..sim.network import Endpoint, SimProcess

@@ -37,6 +37,9 @@ class Simulator:
         self.sched = Scheduler(seed)
         self.net = SimNetwork(self.sched)
         buggify.enable(self.sched.rng)
+        from . import validation
+
+        validation.enable()
         if randomize_knobs:
             from ..core import knobs
             knobs.randomize_all(self.sched.rng)

@@ -1,0 +1,58 @@
+"""Magic durability assertions, usable only in simulation.
+
+Re-design of fdbrpc/sim_validation.h:20-50 (debug_advanceMaxCommittedVersion
+/ debug_checkRestoredVersion): the simulator tracks, OUT OF BAND, the
+highest commit version whose tlog push fully acked. Every epoch-end
+recovery must pick a recovery version at or above it — a lower one would
+silently discard data the cluster already acknowledged as durable. The
+check is global and unconditional in sim: it rides every spec (attrition
+included) for free, catching recovery-version math bugs that workload
+invariants can miss (a dropped suffix of acked-but-unread writes).
+
+Violations are RECORDED, not raised: a raise inside the master's recovery
+actor would surface as just another master failure and be retried into
+silence. The spec runner asserts the violation list is empty at the end of
+every run (SevError semantics: any violation fails the test).
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+_enabled = False
+_max_committed: int = 0
+#: (recovery_version, max_committed_at_check) for every violation seen
+violations: List[Tuple[int, int]] = []
+
+
+def enable() -> None:
+    """Arm the oracle (the simulator's constructor calls this)."""
+    global _enabled, _max_committed
+    _enabled = True
+    _max_committed = 0
+    violations.clear()
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def advance_max_committed(version: int) -> None:
+    """A commit's log-system push fully acked at `version` (the durability
+    point recovery must honor). No-op outside simulation."""
+    global _max_committed
+    if _enabled and version > _max_committed:
+        _max_committed = version
+
+
+def check_restored_version(recovery_version: int) -> None:
+    """An epoch-end recovery chose `recovery_version`: it must cover every
+    fully-acked push (all-ack means any locked replica bounds it from
+    above, so min(end) over the locked set can never be below a completed
+    push — if it is, the lock/recovery math lost acknowledged data)."""
+    if _enabled and recovery_version < _max_committed:
+        violations.append((recovery_version, _max_committed))
+
+
+def max_committed() -> int:
+    return _max_committed

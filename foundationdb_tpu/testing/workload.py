@@ -197,4 +197,12 @@ def run_spec(spec: Spec, seed: int) -> SpecResult:
         if spec.randomize_knobs:
             from ..core import knobs
             knobs.reset_all()
+    # sim_validation oracle (sim/validation.py): ANY recovery that picked a
+    # version below a fully-acked push fails the spec, whatever the
+    # workload checks said — acked durability is never up for debate.
+    from ..sim import validation as sim_validation
+
+    if sim_validation.violations:
+        ok = False
+        metrics["durability_violations"] = len(sim_validation.violations)
     return SpecResult(ok=ok, metrics=metrics, seed=seed, virtual_time=sim.sched.time)

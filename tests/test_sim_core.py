@@ -400,3 +400,27 @@ def test_seed_determinism():
 
 def test_different_seeds_differ():
     assert trace_of_world(1) != trace_of_world(2)
+
+
+def test_sim_validation_durability_oracle():
+    """sim/validation.py (fdbrpc/sim_validation.h analog): recovery versions
+    below a fully-acked push are recorded as violations and fail the spec
+    runner; legal recoveries are silent."""
+    from foundationdb_tpu.sim import validation
+
+    validation.enable()
+    assert validation.max_committed() == 0
+    validation.advance_max_committed(500)
+    validation.advance_max_committed(300)   # non-monotone input: ignored
+    assert validation.max_committed() == 500
+    validation.check_restored_version(500)  # exactly covering: legal
+    validation.check_restored_version(600)
+    assert validation.violations == []
+    validation.check_restored_version(499)  # below an acked push: violation
+    assert validation.violations == [(499, 500)]
+    validation.enable()                     # re-arm resets state
+    assert validation.violations == [] and validation.max_committed() == 0
+    validation.disable()
+    validation.advance_max_committed(900)   # disabled: inert
+    validation.check_restored_version(1)
+    assert validation.violations == [] and validation.max_committed() == 0
