@@ -1,4 +1,6 @@
 from .container import BlobContainer
 from .agent import BackupAgent
+from .dr import DRAgent, lock_database, unlock_database
 
-__all__ = ["BlobContainer", "BackupAgent"]
+__all__ = ["BlobContainer", "BackupAgent", "DRAgent",
+           "lock_database", "unlock_database"]

@@ -96,6 +96,12 @@ class BackupAgent:
     async def start_backup(self) -> None:
         async def begin(tr):
             tr.set_access_system_keys()
+            # single mutation-log slot (v0): refuse to stomp a running
+            # backup/DR's tag feed
+            active = await tr.get(system_keys.BACKUP_ACTIVE_KEY)
+            if active and system_keys.decode_backup_active(active) is not None:
+                raise error.client_invalid_operation(
+                    "a backup/DR already owns the mutation-log tag")
             seq = int(await tr.get(system_keys.BACKUP_SEQ_KEY) or b"0")
             tag = system_keys.FIRST_BACKUP_TAG - seq
             tr.set(system_keys.BACKUP_SEQ_KEY, str(seq + 1).encode())

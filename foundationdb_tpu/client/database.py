@@ -328,6 +328,7 @@ class Transaction:
         self._backoff = INITIAL_BACKOFF
         self._committing = False
         self._access_system_keys = False
+        self._lock_aware = False
 
     # -- versions ------------------------------------------------------------
     async def get_read_version(self) -> Version:
@@ -662,6 +663,12 @@ class Transaction:
         callers like the master's DD-lite)."""
         self._access_system_keys = True
 
+    def set_lock_aware(self) -> None:
+        """Commit through a database lock (the reference's LOCK_AWARE
+        transaction option; DR's apply transactions use it against the
+        locked destination)."""
+        self._lock_aware = True
+
     def _check_writable(self, key: Key) -> None:
         if self._committing:
             raise error.used_during_commit()
@@ -681,6 +688,10 @@ class Transaction:
             write_conflict_ranges=list(self.write_conflict_ranges),
             mutations=list(self.mutations),
             read_snapshot=await self.get_read_version(),
+            # management/DR transactions commit through a database lock
+            # (system-keys access implies LOCK_AWARE, like the reference's
+            # ManagementAPI callers; DR applies set it explicitly)
+            lock_aware=self._access_system_keys or self._lock_aware,
         )
         try:
             reply = await self.db.net.request(

@@ -202,6 +202,10 @@ class CommitTransaction:
     write_conflict_ranges: List[KeyRange] = field(default_factory=list)
     mutations: List[Mutation] = field(default_factory=list)
     read_snapshot: Version = 0
+    #: commits through a database lock (the LOCK_AWARE transaction option;
+    #: management/DR transactions set it — reference: lockDatabase,
+    #: fdbclient/ManagementAPI.actor.cpp)
+    lock_aware: bool = False
 
     def set(self, key: Key, value: Value) -> None:
         self.mutations.append(Mutation(MutationType.SET_VALUE, key, value))

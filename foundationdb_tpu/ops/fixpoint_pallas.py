@@ -55,8 +55,9 @@ def supported(cfg: KernelConfig) -> bool:
     T = cfg.max_txns
     if T % 32:
         return False
-    # (gid * 2T + txn*2 + isw) and the invalid-row region must fit i32
-    if (cfg.gid_space + 2) * 2 * T + 2 * T >= 2**30:
+    # the prefix-max trick needs gid*2+1 in i32 (the point-row sort is
+    # 2-operand, so gid and txn never share an encoding)
+    if 2 * (cfg.gid_space + 2) >= 2**31:
         return False
     return True
 
@@ -98,23 +99,22 @@ def _prep(cfg: KernelConfig, t_ok, hist_hits, edges, batch):
     base = t_ok & ~(hist_hits > 0)
     base_words = _pack_bits_words(base, TW)
 
-    # ---- point rows sorted by (gid, txn, is_write), one-operand sort ----
+    # ---- point rows sorted by (gid, txn, is_write), 2-operand sort ----
+    # (gid and txn*2+isw as separate keys: a packed single-key encoding
+    # capped T*gid_space at 2^30 and locked the big weak-scaled shard
+    # shapes out of the kernel)
     gid = jnp.concatenate([edges["gid_rp"], edges["gid_wp"]])
     txn = jnp.concatenate([batch["rp_txn"], batch["wp_txn"]])
     isw = jnp.concatenate([
         jnp.zeros((Rp,), I32), jnp.ones((Wp,), I32)])
     valid = jnp.concatenate([batch["rp_valid"], batch["wp_valid"]])
-    key = jnp.where(
-        valid,
-        gid * (2 * T) + txn * 2 + isw,
-        jnp.int32(2**30) + jnp.arange(P, dtype=I32),
-    )
-    skey = lax.sort(key)
+    key1 = jnp.where(valid, gid, jnp.int32(2**30) + jnp.arange(P, dtype=I32))
+    key2 = jnp.where(valid, txn * 2 + isw, 0)
+    skey, srem = lax.sort((key1, key2), num_keys=2)
     s_valid = skey < 2**30
-    rem = skey % (2 * T)
-    s_txn = rem >> 1
-    s_isw = rem & 1
-    s_gid2 = jnp.where(s_valid, (skey // (2 * T)) * 2, 0)
+    s_txn = srem >> 1
+    s_isw = srem & 1
+    s_gid2 = jnp.where(s_valid, skey * 2, 0)
     pp_gid2 = _rows(s_gid2, PR, 0)
     pp_isw = _rows(jnp.where(s_valid, s_isw, 0), PR, 0)
     pp_isread = _rows((s_valid & (s_isw == 0)).astype(I32), PR, 0)

@@ -14,7 +14,6 @@ history-vs-intra-batch classification cannot change any verdict.
 """
 from __future__ import annotations
 
-import bisect
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 

@@ -40,7 +40,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.types import Version
 from ..ops import conflict_kernel as ck
 from ..ops.conflict_kernel import KernelConfig
-from ..ops.host_engine import KeyShardMap, RoutedConflictEngineBase
+from ..core.keyshard import KeyShardMap
+from ..ops.host_engine import RoutedConflictEngineBase
 
 __all__ = ["KeyShardMap", "ShardedConflictEngine", "make_sharded_step"]
 

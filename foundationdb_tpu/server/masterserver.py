@@ -704,6 +704,10 @@ class MasterServer:
                 active = await tr.get(system_keys.BACKUP_ACTIVE_KEY)
                 if active:
                     tr.set(system_keys.BACKUP_ACTIVE_KEY, active)
+                # a database lock equally straddles recoveries
+                locked = await tr.get(system_keys.DB_LOCK_KEY)
+                if locked:
+                    tr.set(system_keys.DB_LOCK_KEY, locked)
             await dd_db.run(seed)
             dd["init_done"].send(None)
 

@@ -46,6 +46,7 @@ from ..sim.network import Endpoint, SimProcess
 from .log_system import LogSystemClient, LogSystemConfig
 from .system_keys import (
     BACKUP_ACTIVE_KEY,
+    DB_LOCK_KEY,
     KEY_SERVERS_PREFIX,
     METADATA_TAG,
     decode_backup_active,
@@ -76,6 +77,9 @@ METADATA_VERSION_TOKEN = "proxy.metadataVersion"
 #: randomize them per simulation (reference: START_TRANSACTION_BATCH_* /
 #: COMMIT_TRANSACTION_BATCH_* knobs, fdbserver/Knobs.cpp)
 MAX_COMMIT_BATCH = 512
+#: verdict sentinel: committed by the resolvers but rejected by the
+#: database lock (never a TransactionCommitResult value)
+_VERDICT_LOCKED = -2
 #: empty-batch tick when idle (reference: the commitBatcher's max interval)
 IDLE_COMMIT_INTERVAL = 0.5
 #: reply timeout on proxy->master/resolver/tlog requests: an alive-but-
@@ -99,6 +103,9 @@ class RoutingState:
         self.extra_tags: List[tuple] = [() for _ in self.teams]
         #: live backup's log tag (None = no backup running)
         self.backup_tag: Optional[int] = None
+        #: database lock (lockDatabase / DR switchover fence): user commits
+        #: are rejected while set; lock-aware transactions pass
+        self.db_locked = False
 
     def write_tags(self, s: int) -> List[int]:
         return [t for t, _a in self.teams[s]] + list(self.extra_tags[s])
@@ -111,6 +118,9 @@ class RoutingState:
             return
         if m.param1 == BACKUP_ACTIVE_KEY:
             self.backup_tag = decode_backup_active(m.param2)
+            return
+        if m.param1 == DB_LOCK_KEY:
+            self.db_locked = m.param2 != b""
             return
         if not m.param1.startswith(KEY_SERVERS_PREFIX):
             return
@@ -677,6 +687,17 @@ class Proxy:
         # ApplyMetadataMutation circuit of the reference.
         await self._drain_metadata(prev_v)
 
+        # Database lock (lockDatabase / DR switchover): authoritative
+        # through prev_v after the drain. User transactions are rejected;
+        # lock-aware (management) transactions pass. A commit sharing the
+        # LOCK transaction's own batch still lands at the fence version and
+        # is drained by DR — nothing a client saw acked is lost.
+        if self.routing.db_locked:
+            for t, (txn, _p) in enumerate(items):
+                if (verdicts[t] == int(TransactionCommitResult.COMMITTED)
+                        and not getattr(txn, "lock_aware", False)):
+                    verdicts[t] = _VERDICT_LOCKED
+
         # Assign committed mutations to storage tags, preserving batch order.
         # Versionstamped mutations become SET_VALUE here, stamped with
         # (commit version, index in batch) — the reference does this while
@@ -746,6 +767,9 @@ class Proxy:
             elif verdict == int(TransactionCommitResult.TOO_OLD):
                 self.stats.add("txn_too_old")
                 p.send_error(error.transaction_too_old())
+            elif verdict == _VERDICT_LOCKED:
+                self.stats.add("txn_rejected_locked")
+                p.send_error(error.database_locked())
             else:
                 self.stats.add("txn_conflicted")
                 p.send_error(error.not_committed())

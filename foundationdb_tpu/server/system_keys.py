@@ -27,6 +27,11 @@ KEY_SERVERS_PREFIX = b"\xff/keyServers/"
 #: backup mutation ranges through ApplyMetadataMutation)
 BACKUP_ACTIVE_KEY = b"\xff/backup/active"
 BACKUP_SEQ_KEY = b"\xff/backup/seq"
+#: non-empty value = database locked: proxies reject user commits with
+#: database_locked; lock-aware (system) transactions pass — the
+#: lockDatabase mechanism DR switchover fences with (reference:
+#: fdbclient/ManagementAPI.actor.cpp lockDatabase, \xff/dbLocked)
+DB_LOCK_KEY = b"\xff/dbLocked"
 
 #: the log-system tag carrying committed system-key mutations to every
 #: proxy (the reference's txsTag, TagPartitionedLogSystem.actor.cpp)

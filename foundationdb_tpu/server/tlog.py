@@ -192,6 +192,13 @@ class TLog:
                 "tags_seen": set(self.tags_seen),
                 "retired": set(self._retired_tags),
                 "spilled": self.spilled_version,
+                # the epoch lock is DURABLE state (reference: the tlog's
+                # persistent stopped flag): a locked replica that reboots
+                # amnesiac would let a deposed generation's straggler proxy
+                # complete an all-ack push of versions the new epoch's
+                # recovery already discarded — acked-then-lost commits
+                # (found by the sim_validation oracle on DiskAttrition)
+                "stopped": self.stopped,
             })
             if self._spill_store is not None:
                 await self._spill_store.commit()   # pending pop clears
@@ -235,6 +242,10 @@ class TLog:
         tlog.tags_seen = set(side.get("tags_seen", set())) | set(tlog.popped)
         tlog._retired_tags = set(side.get("retired", set()))
         tlog.spilled_version = side.get("spilled", 0)
+        if side.get("stopped"):
+            tlog.stopped = True
+            if not tlog._stop_promise.is_set:
+                tlog._stop_promise.send(None)
         if (disk.exists(base + "-spill.manifest") or disk.exists(base + "-spill.dq")):
             from .kvstore import SSTableStore
 
@@ -530,7 +541,10 @@ class TLog:
 
     # -- epoch end -----------------------------------------------------------
     async def lock(self, req: TLogLockRequest) -> TLogLockReply:
-        """reference: tLogLock (TLogServer.actor.cpp:496). Idempotent."""
+        """reference: tLogLock (TLogServer.actor.cpp:496). Idempotent. The
+        lock is made DURABLE before the reply: the recovering master's
+        min(end) math counts on this replica rejecting pushes forever,
+        across its own reboots."""
         if buggify.buggify():
             # slow lock ack: the recovering master's lock fan-out completes
             # ragged, and commits mid-fsync see the stop flag at odd points
@@ -538,6 +552,11 @@ class TLog:
         self.stopped = True
         if not self._stop_promise.is_set:
             self._stop_promise.send(None)
+        # EVERY lock reply waits for a durable stopped flag — a retried or
+        # concurrent lock must not ack off the back of a first caller's
+        # still-in-flight fsync (the persist mutex serializes; re-persisting
+        # an already-durable flag is a no-op-shaped small write)
+        await self._persist_side_state(force=True)
         return TLogLockReply(
             gen_id=self.gen_id,
             known_committed=self.known_committed.get(),

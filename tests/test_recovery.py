@@ -171,3 +171,45 @@ def test_committed_data_survives_tlog_failover():
 
     got = drive(sim, sim.sched.spawn(read_phase(), name="rp"), until=240.0)
     assert got == [b"v%d" % i for i in range(10)]
+
+
+def test_locked_tlog_stays_locked_across_reboot():
+    """The epoch lock is durable (the reference tlog's persistent stopped
+    flag): a locked replica that reboots must keep rejecting pushes, or a
+    deposed generation's straggler proxy could complete an all-ack push of
+    versions the new epoch's recovery already discarded — acked-then-lost
+    commits. Found by the sim_validation oracle on DiskAttrition seed 12."""
+    from foundationdb_tpu.core import error
+    from foundationdb_tpu.server.disk_queue import DiskQueue
+    from foundationdb_tpu.server.messages import TLogCommitRequest, TLogLockRequest
+    from foundationdb_tpu.server.tlog import TLog
+    from foundationdb_tpu.sim.simulator import Simulator
+
+    sim = Simulator(seed=91)
+    proc = sim.new_process("tlog-host")
+    disk = sim.disk_for(proc.address)
+
+    async def scenario():
+        tlog = TLog(proc, gen_id=(1, 7), queue=DiskQueue(disk, "tlog-1.7.0"),
+                    store_name="tlog-1.7.0")
+        await tlog.persist_initial("")
+        await tlog.commit(TLogCommitRequest(
+            prev_version=0, version=5, messages={0: []}, gen_id=(1, 7),
+            known_committed=0))
+        await tlog.lock(TLogLockRequest())
+        with pytest.raises(error.FDBError):
+            await tlog.commit(TLogCommitRequest(
+                prev_version=5, version=6, messages={0: []}, gen_id=(1, 7),
+                known_committed=0))
+        # reboot the role from disk: the lock must survive
+        tlog.unregister()
+        restored = await TLog.restore(proc, disk, "tlog-1.7.0.meta")
+        assert restored is not None
+        assert restored.stopped, "epoch lock forgotten across reboot"
+        with pytest.raises(error.FDBError):
+            await restored.commit(TLogCommitRequest(
+                prev_version=5, version=6, messages={0: []}, gen_id=(1, 7),
+                known_committed=0))
+        return True
+
+    assert sim.run_until(sim.sched.spawn(scenario(), name="s"), until=60.0)
