@@ -40,6 +40,23 @@ USER_END = b"\xff"
 LOG_CHUNK_VERSIONS = 200_000
 
 
+async def claim_backup_tag(tr) -> int:
+    """Claim the (v0 single-slot) mutation-log tag inside `tr`: refuses to
+    stomp a running backup/DR, allocates the next tag, sets the active
+    flag. Shared by the file backup and DR agents — their claim protocols
+    must never diverge."""
+    tr.set_access_system_keys()
+    active = await tr.get(system_keys.BACKUP_ACTIVE_KEY)
+    if active and system_keys.decode_backup_active(active) is not None:
+        raise error.client_invalid_operation(
+            "a backup/DR already owns the mutation-log tag")
+    seq = int(await tr.get(system_keys.BACKUP_SEQ_KEY) or b"0")
+    tag = system_keys.FIRST_BACKUP_TAG - seq
+    tr.set(system_keys.BACKUP_SEQ_KEY, str(seq + 1).encode())
+    tr.set(system_keys.BACKUP_ACTIVE_KEY, system_keys.encode_backup_active(tag))
+    return tag
+
+
 class BackupAgent:
     def __init__(self, sim, db: Database, container_addr: str):
         self.sim = sim
@@ -94,22 +111,7 @@ class BackupAgent:
 
     # -- backup --------------------------------------------------------------
     async def start_backup(self) -> None:
-        async def begin(tr):
-            tr.set_access_system_keys()
-            # single mutation-log slot (v0): refuse to stomp a running
-            # backup/DR's tag feed
-            active = await tr.get(system_keys.BACKUP_ACTIVE_KEY)
-            if active and system_keys.decode_backup_active(active) is not None:
-                raise error.client_invalid_operation(
-                    "a backup/DR already owns the mutation-log tag")
-            seq = int(await tr.get(system_keys.BACKUP_SEQ_KEY) or b"0")
-            tag = system_keys.FIRST_BACKUP_TAG - seq
-            tr.set(system_keys.BACKUP_SEQ_KEY, str(seq + 1).encode())
-            tr.set(system_keys.BACKUP_ACTIVE_KEY,
-                   system_keys.encode_backup_active(tag))
-            return tag
-
-        self.tag = await self.db.run(begin)
+        self.tag = await self.db.run(claim_backup_tag)
         tr = self.db.create_transaction()
         self.start_version = await tr.get_read_version()
         self._log_floor = self.start_version

@@ -98,28 +98,22 @@ class DRAgent:
 
     # -- start: tag + initial sync + tail -------------------------------------
     async def start(self, chunks: int = 8) -> None:
-        async def begin(tr):
-            tr.set_access_system_keys()
-            # single mutation-log slot (v0): a concurrent file backup or DR
-            # would silently lose its tag feed — refuse loudly instead
-            active = await tr.get(system_keys.BACKUP_ACTIVE_KEY)
-            if active and system_keys.decode_backup_active(active) is not None:
-                raise error.client_invalid_operation(
-                    "a backup/DR already owns the mutation-log tag")
-            seq = int(await tr.get(system_keys.BACKUP_SEQ_KEY) or b"0")
-            tag = system_keys.FIRST_BACKUP_TAG - seq
-            tr.set(system_keys.BACKUP_SEQ_KEY, str(seq + 1).encode())
-            tr.set(system_keys.BACKUP_ACTIVE_KEY,
-                   system_keys.encode_backup_active(tag))
-            return tag
+        from .agent import claim_backup_tag
 
-        self.tag = await self.src.run(begin)
+        self.tag = await self.src.run(claim_backup_tag)
         tr = self.src.create_transaction()
         self.start_version = await tr.get_read_version()
         # the destination is a replica while DR runs: lock it so stray
         # writers cannot diverge it (the reference locks the DR dest; the
-        # agent's own applies are lock-aware)
+        # agent's own applies are lock-aware), and CLEAR it — pre-existing
+        # destination keys absent from the source would otherwise survive
+        # replication and surface on the promoted primary
         await lock_database(self.dest)
+
+        async def wipe(tr2):
+            tr2.set_lock_aware()
+            tr2.clear_range(b"", USER_END)
+        await self.dest.run(wipe)
 
         # initial range sync, chunked; each chunk at its own fresh version
         bounds = [b""] + [bytes([(256 * i) // chunks])
@@ -201,11 +195,14 @@ class DRAgent:
 
     async def _tail(self) -> None:
         floor = self.start_version
+        client = None
         while not self._stopped:
-            client = await self._log_client()
+            if client is None:   # re-resolve only after a peek error
+                client = await self._log_client()
             try:
                 reply = await client.peek(self.tag, floor + 1, timeout=2.0)
             except error.FDBError:
+                client = None    # generation turnover / dead replica
                 await delay(0.5)
                 continue
             if reply.messages:

@@ -25,7 +25,7 @@ import dataclasses
 import struct
 from typing import Any, Callable, Dict, Optional, Tuple
 
-from ..core import error, wire
+from ..core import buggify, error, wire
 from ..sim.network import Endpoint
 
 
@@ -252,6 +252,8 @@ class RealProcess:
             pass
 
     async def _answer(self, writer: asyncio.StreamWriter, msg) -> None:
+        if buggify.buggify():
+            await asyncio.sleep(0.05)   # slow service: client timeouts race
         handler = self.handlers.get(msg["token"])
         try:
             if handler is None:
@@ -302,8 +304,13 @@ class RealNetwork:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         p.pending[rid] = fut
         try:
-            _write_frame(p.writer, {"kind": "req", "id": rid,
-                                    "token": ep.token, "body": payload})
+            frame = {"kind": "req", "id": rid, "token": ep.token, "body": payload}
+            _write_frame(p.writer, frame)
+            if buggify.buggify():
+                # duplicate delivery (the transport's redelivery semantics):
+                # the server answers twice; handlers must be idempotent and
+                # the pump drops the orphan reply
+                _write_frame(p.writer, frame)
             await p.writer.drain()
         except (ConnectionError, OSError) as e:
             p.pending.pop(rid, None)
@@ -317,6 +324,8 @@ class RealNetwork:
 
     async def one_way(self, src: str, ep: Endpoint, payload: Any,
                       priority: int = 0) -> None:
+        if buggify.buggify():
+            return   # unreliable by contract: drop outright
         try:
             p = await self._peer(ep.address)
             _write_frame(p.writer, {"kind": "oneway", "id": 0,

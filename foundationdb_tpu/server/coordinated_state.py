@@ -58,6 +58,11 @@ class DBCoreState:
     #: worker addresses excluded from hosting storage (ManagementAPI's
     #: \xff/conf/excluded analog — persisted so recoveries keep them)
     excluded: tuple = ()
+    #: mirror of the committed \xff/conf/ map as sorted (key, value) byte
+    #: pairs: recovery reads role counts from HERE (before any storage is
+    #: reachable), the way the reference reads DatabaseConfiguration out
+    #: of the recovered txnStateStore
+    conf: tuple = ()
 
 
 class CoordinatedState:

@@ -375,6 +375,11 @@ class SSTableStore:
         found, v = self._mem_lookup(key)
         if found:
             return v
+        if buggify.buggify():
+            # slow cold read: stretches the window a concurrent
+            # flush/compaction can interleave into
+            from ..sim.loop import TaskPriority, delay
+            await delay(0.01, TaskPriority.DEFAULT_DELAY)
         runs = list(self._runs)     # snapshot: a flush/compact mid-read
         self._active_reads += 1     # must not shift or delete our levels
         try:

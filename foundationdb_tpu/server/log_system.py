@@ -285,6 +285,11 @@ async def fetch_recovery_data(
             except error.FDBError:
                 continue
         raise error.master_recovery_failed("no locked tlog reachable for recovery data")
+    if buggify.buggify():
+        # a replica dying between lock and fetch is the races this fan-out
+        # must survive; stretch the window they land in
+        from ..sim.loop import delay
+        await delay(0.2, TaskPriority.TLOG_PEEK)
     futures = [
         net.request(
             src_addr, config.ep(rep, "recovery"),

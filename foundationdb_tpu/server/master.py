@@ -48,7 +48,28 @@ class Master:
         self.token = GET_COMMIT_VERSION_TOKEN + token_suffix
         # proxy_id -> {request_num: reply}, trimmed to PROXY_REPLY_WINDOW
         self._proxy_window: Dict[str, "OrderedDict[int, GetCommitVersionReply]"] = {}
+        #: live resolutionBalancing flip: (flip_version, old_splits,
+        #: new_splits) piggybacked on every version reply — versions below
+        #: the flip were all handed out under the old map, versions at or
+        #: above it are only ever handed out carrying the new one
+        self._routing_flip: tuple = (0, (), ())
+        #: future grants never fall below this (armed by a flip): the chain
+        #: itself stays exactly the granted-version sequence — a BURNED
+        #: version would wedge resolvers waiting when_at_least(prev) on a
+        #: version nobody ever resolves
+        self._version_floor: Version = 0
         proc.register(self.token, self.get_commit_version)
+
+    def set_routing_flip(self, old_splits: tuple, new_splits: tuple) -> Version:
+        """Arm a live resolver-map change: strictly newer than any granted
+        version AND any earlier flip (back-to-back flips must not share a
+        version — proxies order flips strictly); every later grant jumps to
+        at least the flip, so no version in no-man's-land is ever handed
+        out under an ambiguous map. Returns the flip version."""
+        flip = max(self.version + 1, self._routing_flip[0] + 1)
+        self._version_floor = flip
+        self._routing_flip = (flip, tuple(old_splits), tuple(new_splits))
+        return flip
 
     def unregister(self) -> None:
         self.proc.unregister(self.token)
@@ -62,9 +83,13 @@ class Master:
         t = now()
         advance = max(1, int((t - self.last_version_time) * SERVER_KNOBS.versions_per_second))
         prev = self.version
-        self.version = prev + advance
+        self.version = max(prev + advance, self._version_floor)
         self.last_version_time = t
-        reply = GetCommitVersionReply(version=self.version, prev_version=prev)
+        flip, olds, news = self._routing_flip
+        reply = GetCommitVersionReply(version=self.version, prev_version=prev,
+                                      routing_version=flip,
+                                      routing_old_splits=olds,
+                                      routing_splits=news)
         window[req.request_num] = reply
         while len(window) > PROXY_REPLY_WINDOW:
             window.popitem(last=False)

@@ -384,6 +384,87 @@ class MasterServer:
         TraceEvent("ShardMergeDone", id=self.salt).detail("Begin", begin1).log()
         return {"begin": begin1, "end": end2}
 
+    async def _grow_team(self, begin, dest, dd, dd_db, log_client, cstate,
+                         ratekeeper) -> None:
+        """Add one replica to the shard at `begin` (the replication fixer's
+        move toward a raised \\xff/conf/replication): double-tag via
+        keyServers, fetch at a post-tag version, flip to the full team —
+        the MoveKeys recruit half without a retire half."""
+        from .storage import SHRINK_SHARD_TOKEN  # noqa: F401  (parity import)
+
+        tags = dd["storage_tags"]
+        team = sorted((t, a) for (t, b, _e, a) in tags if b == begin)
+        if not team:
+            raise error.client_invalid_operation(f"no shard begins at {begin!r}")
+        end = next(e for (_t, b, e, _a) in tags if b == begin)
+        nt = max(t for (t, _b, _e, _a) in tags) + 1
+        TraceEvent("TeamGrowStart", id=self.salt).detail(
+            "Begin", begin).detail("Dest", dest).log()
+
+        async def ph1(tr):
+            tr.set_access_system_keys()
+            tr.set(system_keys.key_servers_key(begin),
+                   system_keys.encode_key_servers(team, (nt,)))
+        await dd_db.run(ph1)
+        try:
+            tr = dd_db.create_transaction()
+            v0 = await tr.get_read_version()
+            await self.net.request(
+                self.proc.address, Endpoint(dest, INIT_STORAGE_TOKEN),
+                InitializeStorageRequest(
+                    tag=nt, begin=begin, end=end,
+                    fetch_from=[a for _t, a in team], fetch_version=v0,
+                ),
+                TaskPriority.MOVE_KEYS, timeout=60.0,
+            )
+
+            async def ph2(tr2):
+                tr2.set_access_system_keys()
+                tr2.set(system_keys.key_servers_key(begin),
+                        system_keys.encode_key_servers(team + [(nt, dest)]))
+            await dd_db.run(ph2)
+        except error.FDBError:
+            TraceEvent("TeamGrowAbort", id=self.salt).detail("Begin", begin).log()
+
+            async def rollback(tr2):
+                tr2.set_access_system_keys()
+                tr2.set(system_keys.key_servers_key(begin),
+                        system_keys.encode_key_servers(team))
+            await dd_db.run(rollback)
+            self.net.one_way(self.proc.address, Endpoint(dest, RETIRE_STORAGE_TOKEN),
+                             RetireStorageRequest(tags=(nt,)),
+                             TaskPriority.MOVE_KEYS)
+            log_client.pop(nt, -1)
+            raise
+        new_tags = list(tags) + [(nt, begin, end, dest)]
+        await self._publish_tags(dd, cstate, ratekeeper, new_tags)
+        TraceEvent("TeamGrowDone", id=self.salt).detail("Begin", begin).log()
+
+    async def _shrink_team(self, begin, dd, dd_db, log_client, cstate,
+                           ratekeeper) -> None:
+        """Drop the shard's highest-tag replica (a lowered replication
+        factor): flip keyServers to the smaller team, publish, retire."""
+        tags = dd["storage_tags"]
+        team = sorted((t, a) for (t, b, _e, a) in tags if b == begin)
+        if len(team) <= 1:
+            raise error.client_invalid_operation("cannot shrink below one replica")
+        victim_t, victim_a = team[-1]
+        keep = team[:-1]
+
+        async def ph(tr):
+            tr.set_access_system_keys()
+            tr.set(system_keys.key_servers_key(begin),
+                   system_keys.encode_key_servers(keep))
+        await dd_db.run(ph)
+        new_tags = [t for t in tags if not (t[0] == victim_t and t[1] == begin)]
+        await self._publish_tags(dd, cstate, ratekeeper, new_tags)
+        self.net.one_way(self.proc.address, Endpoint(victim_a, RETIRE_STORAGE_TOKEN),
+                         RetireStorageRequest(tags=(victim_t,)),
+                         TaskPriority.MOVE_KEYS)
+        log_client.pop(victim_t, -1)
+        TraceEvent("TeamShrinkDone", id=self.salt).detail(
+            "Begin", begin).detail("Victim", victim_a).log()
+
     async def _recover_and_serve(self) -> None:
         cfg = self.cfg
         # -- READING_CSTATE / LOCKING_CSTATE ---------------------------------
@@ -437,11 +518,25 @@ class MasterServer:
         self._state("recruiting", RecoveryVersion=recovery_version)
 
         # -- RECRUITING ------------------------------------------------------
+        # Role counts: the committed configuration (DatabaseConfiguration,
+        # mirrored into cstate by the conf watcher) overrides the boot-time
+        # cluster shape — `configure proxies=3` etc. apply HERE, at the
+        # next recovery after the change committed.
+        from .management import conf_int
+
+        conf = dict(prev.conf)
+        n_tlogs = conf_int(conf, b"logs", cfg.n_tlogs)
+        n_resolvers = conf_int(conf, b"resolvers", cfg.n_resolvers)
+        conf_proxies = conf_int(conf, b"proxies", getattr(cfg, "n_proxies", 1))
+        log_repl = conf_int(conf, b"log_replication",
+                            getattr(cfg, "log_replication_factor", 0))
+        storage_repl = conf_int(conf, b"replication",
+                                max(1, getattr(cfg, "storage_replication", 1)))
         # Storage is stateful: keep it on dedicated workers and recruit the
         # disposable transaction roles on the rest (the reference's
         # process-class fitness, reduced to storage-vs-stateless).
         alive = [w for w in self.workers if not self.net.monitor.is_failed(w)]
-        n_storage_workers = cfg.n_storage * max(1, getattr(cfg, "storage_replication", 1))
+        n_storage_workers = cfg.n_storage * storage_repl
         if first_boot:
             storage_workers = sorted(alive)[-n_storage_workers:]
         else:
@@ -455,10 +550,10 @@ class MasterServer:
         def pick(n: int, offset: int) -> List[str]:
             return [workers[(offset + i) % len(workers)] for i in range(n)]
 
-        tlog_addrs = pick(cfg.n_tlogs, 0)
-        resolver_addrs = pick(cfg.n_resolvers, cfg.n_tlogs)
-        n_proxies = max(1, getattr(cfg, "n_proxies", 1))
-        proxy_addrs = pick(n_proxies, cfg.n_tlogs + cfg.n_resolvers)
+        tlog_addrs = pick(n_tlogs, 0)
+        resolver_addrs = pick(n_resolvers, n_tlogs)
+        n_proxies = max(1, conf_proxies)
+        proxy_addrs = pick(n_proxies, n_tlogs + n_resolvers)
         if len(set(proxy_addrs)) < n_proxies:
             # proxy tokens are per-process: never co-locate two proxies
             proxy_addrs = list(dict.fromkeys(proxy_addrs))
@@ -469,7 +564,7 @@ class MasterServer:
         tlog_reps = tuple((a, f"{suffix}.{i}") for i, a in enumerate(tlog_addrs))
         new_log = LogSystemConfig(
             gen_id=gen_id, tlogs=tlog_reps, start_version=recovery_version,
-            replication_factor=getattr(cfg, "log_replication_factor", 0),
+            replication_factor=log_repl,
         )
         # Seed each new replica with only the tags it will hold (per-tag
         # subsets), and only tags that still EXIST: a tag retired by a
@@ -507,7 +602,7 @@ class MasterServer:
         # shard gets a team of `storage_replication` replicas on distinct
         # workers (storage tokens are per-process, and same-worker replicas
         # would share a fault domain anyway).
-        repl = max(1, getattr(cfg, "storage_replication", 1))
+        repl = storage_repl
         if first_boot:
             storage_shards = KeyShardMap.uniform(cfg.n_storage)
             if len(storage_workers) < cfg.n_storage * repl:
@@ -542,11 +637,11 @@ class MasterServer:
         # epoch bounce: fresh resolvers + the MVCC-window version jump
         # make the empty conflict history safe).
         splits = list(prev.resolver_splits)
-        if len(splits) == cfg.n_resolvers - 1 and splits == sorted(splits) and all(splits):
+        if len(splits) == n_resolvers - 1 and splits == sorted(splits) and all(splits):
             resolver_map = KeyShardMap(splits)
             used_splits = tuple(splits)
         else:
-            resolver_map = KeyShardMap.uniform(cfg.n_resolvers)
+            resolver_map = KeyShardMap.uniform(n_resolvers)
             used_splits = ()
 
         recovery_txn_version = recovery_version + max(first_jump, 1)
@@ -638,6 +733,7 @@ class MasterServer:
             storage_tags=storage_tags,
             resolver_splits=used_splits,  # balanced splits survive epochs
             excluded=prev.excluded,       # exclusions survive epochs too
+            conf=prev.conf,               # the committed configuration
         )
         await cstate.set_exclusive(cstate_val)
 
@@ -909,11 +1005,83 @@ class MasterServer:
         # -- resolutionBalancing (masterserver.actor.cpp:919-977) -------------
         # Poll resolver row counts; on sustained imbalance, persist new
         # split keys (quantiles of the resolvers' key samples) in cstate
-        # and bounce the epoch: the successor recruits resolvers on the new
-        # splits, and the recovery version jump makes their empty conflict
-        # history safe. Handoff-by-bounce trades a recovery (~seconds) for
-        # the reference's in-epoch range transfer.
-        rebalance_p = _Promise()
+        # and flip the routing LIVE — zero recoveries: the master (version
+        # authority) piggybacks (flip_version, old, new splits) on its
+        # version replies, proxies split batches >= flip by the new map,
+        # and each resolver seeds a synthetic whole-span write over its
+        # gained ranges at its first post-flip batch (conservative
+        # conflicts stand in for the donor's unshipped history — the
+        # "rebuild past the MVCC window" handoff; exact once snapshots
+        # pass the flip). The reference ships state via
+        # ResolutionSplitRequest; the conservative seed needs no transfer.
+        conf_p = _Promise()
+
+        async def conf_watcher() -> None:
+            """Watch the committed \\xff/conf/ map (DatabaseConfiguration):
+            a change is mirrored into the coordinated state — where the
+            NEXT recovery reads its role counts — and bounces the epoch to
+            apply it (the reference's configuration-triggered recovery)."""
+            from .management import CONF_END, CONF_PREFIX
+
+            await dd["init_done"].future
+            while True:
+                await delay(1.0, TaskPriority.MOVE_KEYS)
+                try:
+                    async def rd(tr):
+                        return await tr.get_range(CONF_PREFIX, CONF_END,
+                                                  limit=1000, snapshot=True)
+                    rows = await dd_db.run(rd)
+                except error.FDBError:
+                    continue
+                committed = tuple(sorted(
+                    (k[len(CONF_PREFIX):], v) for k, v in rows))
+                if committed == dd["cstate_val"].conf:
+                    continue
+                TraceEvent("ConfigurationChanged", id=self.salt).detail(
+                    "Conf", str(committed)).log()
+                dd["cstate_val"] = replace(dd["cstate_val"], conf=committed)
+                try:
+                    await cstate.set_exclusive(dd["cstate_val"])
+                except error.FDBError:
+                    return   # a successor owns the cstate
+                if not conf_p.is_set:
+                    conf_p.send(None)
+                return
+
+        async def replication_fixer() -> None:
+            """Converge every shard's team size to the configured storage
+            replication (the DD side of `configure single|double|triple`):
+            one grow/shrink at a time, policy-picked spare destinations."""
+            await dd["init_done"].future
+            while True:
+                await delay(1.5, TaskPriority.MOVE_KEYS)
+                if dd["busy"]:
+                    continue
+                want = storage_repl
+                teams = _teams_by_begin(dd["storage_tags"])
+                for begin in sorted(teams):
+                    team = teams[begin]
+                    if len(team) == want:
+                        continue
+                    dd["busy"] = True
+                    try:
+                        if len(team) < want:
+                            dests = pick_spares(1)
+                            if not dests:
+                                TraceEvent("TeamGrowNoSpares", id=self.salt).detail(
+                                    "Begin", begin).log()
+                                break
+                            await self._grow_team(begin, dests[0], dd, dd_db,
+                                                  log_client, cstate, ratekeeper)
+                        else:
+                            await self._shrink_team(begin, dd, dd_db,
+                                                    log_client, cstate, ratekeeper)
+                    except error.FDBError as exc:
+                        TraceEvent("TeamFixFailed", id=self.salt).detail(
+                            "Reason", exc.name).log()
+                    finally:
+                        dd["busy"] = False
+                    break
 
         async def resolution_balancing() -> None:
             from .resolver import RESOLUTION_METRICS_TOKEN
@@ -921,6 +1089,8 @@ class MasterServer:
             interval = float(cfg.rebalance_interval)
             min_rows = int(cfg.rebalance_min_rows)
             ratio = 3.0
+            current_splits = used_splits or tuple(
+                KeyShardMap.uniform(n_resolvers).begins[1:])
             while True:
                 await delay(interval, TaskPriority.RESOLUTION_METRICS)
                 stats = []
@@ -958,26 +1128,35 @@ class MasterServer:
                 new_splits = sorted(set(new_splits))
                 if len(new_splits) != n - 1 or not all(new_splits):
                     continue
-                if tuple(new_splits) == used_splits:
-                    # an unsplittable hot spot (e.g. one hot key): bouncing
-                    # onto identical splits would loop recoveries forever
+                if tuple(new_splits) == current_splits:
+                    # an unsplittable hot spot (e.g. one hot key): identical
+                    # splits would churn flips forever
                     continue
-                splits = new_splits
-                TraceEvent("ResolutionBalancing", id=self.salt).detail(
-                    "Rows", str(rows)).detail("NewSplits", str(splits)).log()
+                # durable FIRST (the next recovery recruits on the new
+                # splits), then flip the live generation with zero downtime
                 dd["cstate_val"] = replace(dd["cstate_val"],
-                                           resolver_splits=tuple(splits))
+                                           resolver_splits=tuple(new_splits))
                 try:
                     await cstate.set_exclusive(dd["cstate_val"])
                 except error.FDBError:
                     return  # a successor owns the cstate; we are done anyway
-                if not rebalance_p.is_set:
-                    rebalance_p.send(None)
-                return
+                flip = self.master.set_routing_flip(current_splits,
+                                                    tuple(new_splits))
+                TraceEvent("ResolutionBalancing", id=self.salt).detail(
+                    "Rows", str(rows)).detail("NewSplits", str(new_splits)).detail(
+                    "FlipVersion", flip).log()
+                current_splits = tuple(new_splits)
+                # keep watching: further imbalance flips again, live
 
         balance_task = spawn(resolution_balancing(), TaskPriority.RESOLUTION_METRICS,
                              name=f"resBalance:{self.salt}")
         self.proc.actors.add(balance_task)
+        conf_task = spawn(conf_watcher(), TaskPriority.MOVE_KEYS,
+                          name=f"confWatch:{self.salt}")
+        self.proc.actors.add(conf_task)
+        fixer_task = spawn(replication_fixer(), TaskPriority.MOVE_KEYS,
+                           name=f"replFixer:{self.salt}")
+        self.proc.actors.add(fixer_task)
 
         # Serve until any recruited role host dies (process-level watch;
         # role death on a live worker only happens when a successor
@@ -992,7 +1171,7 @@ class MasterServer:
             for a in watch_addrs
         ]
         try:
-            which, _ = await any_of([rebalance_p.future] + watchers)
+            which, _ = await any_of([conf_p.future] + watchers)
         finally:
             for w in watchers:
                 w.cancel()
@@ -1001,13 +1180,15 @@ class MasterServer:
             dd_gc_task.cancel()
             dd_tracker_task.cancel()
             balance_task.cancel()
+            conf_task.cancel()
+            fixer_task.cancel()
             self.proc.unregister(rate_token)
             self.proc.unregister(status_token)
             self.proc.unregister(move_token)
             self.proc.unregister(exclude_token)
         self.master.unregister()
         if which == 0:
-            # Deliberate epoch bounce: the successor recruits resolvers on
-            # the rebalanced splits persisted above.
-            raise error.master_recovery_failed("resolution rebalance epoch bounce")
+            # Deliberate epoch bounce: the successor recruits with the new
+            # configuration mirrored into cstate by the conf watcher.
+            raise error.master_recovery_failed("configuration changed epoch bounce")
         raise error.master_tlog_failed("a transaction-role host failed")

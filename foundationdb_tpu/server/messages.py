@@ -27,6 +27,14 @@ class GetCommitVersionRequest:
 class GetCommitVersionReply:
     version: Version
     prev_version: Version
+    #: live resolutionBalancing (masterserver.actor.cpp:919-977 redesigned
+    #: bounce-free): when set, every batch with version >= routing_version
+    #: must split conflict ranges by routing_splits (the new resolver map);
+    #: the master piggybacks the CURRENT flip on every reply, proxies apply
+    #: it before building their batch (phase 1 orders it exactly)
+    routing_version: Version = 0
+    routing_old_splits: tuple = ()
+    routing_splits: tuple = ()
 
 
 # -- resolver ----------------------------------------------------------------
@@ -40,6 +48,15 @@ class ResolveTransactionBatchRequest:
     version: Version
     last_received_version: Version
     transactions: List[CommitTransaction] = field(default_factory=list)
+    #: live split handoff (ResolutionSplitRequest's role): batches at or
+    #: above routing_version were split by the NEW resolver map; on first
+    #: sight (the version chain orders it), the resolver seeds a synthetic
+    #: whole-span write over the ranges it GAINED, so reads with pre-flip
+    #: snapshots conflict conservatively instead of silently missing the
+    #: donor's history (exact again once snapshots pass the flip)
+    routing_version: Version = 0
+    routing_old_splits: tuple = ()
+    routing_splits: tuple = ()
 
 
 @dataclass

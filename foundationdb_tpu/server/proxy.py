@@ -221,6 +221,12 @@ class Proxy:
         self._grv_flush_active = False
         #: dynamic shard routing (seed + applied keyServers metadata)
         self.routing = RoutingState(cfg.storage_shards, cfg.storage_teams)
+        #: live resolutionBalancing flips, version-ascending: (flip_version,
+        #: old_splits, new_splits) learned from the master's version
+        #: replies. A batch splits by the newest flip at or below its
+        #: commit version (never the single latest: with back-to-back
+        #: flips, a batch between them must use the FIRST flip's map)
+        self._routing_flips: List[tuple] = []
         #: metadata stream drained through this version (system_keys.py)
         self._metadata_version = start_version
         self._last_batch_time = 0.0
@@ -616,6 +622,22 @@ class Proxy:
         self._pending_master_req.pop(bn, None)
         prev_v, v = vr.prev_version, vr.version
         self._batch_versions[bn] = (prev_v, v)
+        rv = getattr(vr, "routing_version", 0)
+        if rv and (not self._routing_flips or rv > self._routing_flips[-1][0]):
+            self._routing_flips.append((rv, tuple(vr.routing_old_splits),
+                                        tuple(vr.routing_splits)))
+        # The resolver map THIS batch splits by: the newest flip at or
+        # below its commit version (phase 1 orders flips exactly —
+        # versions >= a flip are only ever handed out carrying it)
+        flip_v, _flip_old, flip_new = 0, (), ()
+        for fv, fo, fn in reversed(self._routing_flips):
+            if v >= fv:
+                flip_v, _flip_old, flip_new = fv, fo, fn
+                break
+        if flip_v:
+            res_shards = KeyShardMap(list(flip_new))
+        else:
+            res_shards = cfg.resolver_shards
 
         # Build per-resolver transaction views (clipped conflict ranges).
         per_res: List[List[CommitTransaction]] = [[] for _ in range(n_res)]
@@ -631,14 +653,14 @@ class Proxy:
 
             for rng in txn.read_conflict_ranges:
                 if rng.begin >= rng.end:
-                    r = cfg.resolver_shards.shard_of_point_below(rng.begin)
+                    r = res_shards.shard_of_point_below(rng.begin)
                     view(r).read_conflict_ranges.append(rng)
                 else:
-                    for r, cb, ce in cfg.resolver_shards.shards_of_range(rng.begin, rng.end):
+                    for r, cb, ce in res_shards.shards_of_range(rng.begin, rng.end):
                         view(r).read_conflict_ranges.append(KeyRange(cb, ce))
             for rng in txn.write_conflict_ranges:
                 if rng.begin < rng.end:
-                    for r, cb, ce in cfg.resolver_shards.shards_of_range(rng.begin, rng.end):
+                    for r, cb, ce in res_shards.shards_of_range(rng.begin, rng.end):
                         view(r).write_conflict_ranges.append(KeyRange(cb, ce))
             placed = []
             for r, vw in views.items():
@@ -651,6 +673,7 @@ class Proxy:
             await delay(0.01, TaskPriority.PROXY_COMMIT)
 
         # ---- Phase 2: resolve everywhere; next batch may start (:417) ----
+        attach_flip = flip_v if (flip_v and v >= flip_v) else 0
         resolve_futures = [
             self.net.request(
                 self.proc.address,
@@ -660,6 +683,9 @@ class Proxy:
                     version=v,
                     last_received_version=prev_v,
                     transactions=per_res[r],
+                    routing_version=attach_flip,
+                    routing_old_splits=_flip_old if attach_flip else (),
+                    routing_splits=flip_new if attach_flip else (),
                 ),
                 TaskPriority.PROXY_RESOLVER_REPLY,
                 timeout=SERVER_REQUEST_TIMEOUT,

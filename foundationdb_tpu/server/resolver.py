@@ -16,7 +16,12 @@ from __future__ import annotations
 from typing import Dict
 
 from ..core import buggify, error
-from ..core.types import MAX_WRITE_TRANSACTION_LIFE_VERSIONS, Version
+from ..core.types import (
+    CommitTransaction,
+    KeyRange,
+    MAX_WRITE_TRANSACTION_LIFE_VERSIONS,
+    Version,
+)
 from ..sim.actors import NotifiedVersion
 from ..sim.network import SimProcess
 from .messages import ResolveTransactionBatchRequest, ResolveTransactionBatchReply
@@ -28,18 +33,48 @@ RESOLUTION_METRICS_TOKEN = "resolver.metrics"
 #: iops TransientStorageMetricSample feeding ResolutionSplitRequest)
 KEY_SAMPLE_SIZE = 64
 
+#: virtual end of the conflict keyspace for whole-span synthetic writes
+#: (above every real key, including the \xff system space and the cluster
+#: shard end \xff\xff\xff)
+CONFLICT_KEYSPACE_END = b"\xff\xff\xff\xff\xff"
+
+
+def _span_of(splits: tuple, i: int) -> tuple:
+    """Resolver i's key span under `splits` (n-1 split keys)."""
+    begins = [b""] + list(splits)
+    b = begins[i] if i < len(begins) else begins[-1]
+    e = begins[i + 1] if i + 1 < len(begins) else CONFLICT_KEYSPACE_END
+    return b, e
+
+
+def gained_ranges(old_splits: tuple, new_splits: tuple, i: int) -> list:
+    """The key ranges resolver i owns under new_splits but not under
+    old_splits — the incoming spans of a live rebalance."""
+    nb, ne = _span_of(new_splits, i)
+    ob, oe = _span_of(old_splits, i)
+    out = []
+    if nb < ob:
+        out.append((nb, min(ne, ob)))
+    if ne > oe:
+        out.append((max(nb, oe), ne))
+    return [(b, e) for b, e in out if b < e]
+
 
 class Resolver:
     def __init__(self, proc: SimProcess, engine, start_version: Version = 0,
-                 token_suffix: str = ""):
+                 token_suffix: str = "", index: int = 0):
         """`engine` implements resolve(transactions, now, new_oldest) and
         clear(version) — OracleConflictEngine, JaxConflictEngine or
         ShardedConflictEngine (ops/, parallel/). token_suffix scopes the
-        endpoint to one recovery generation."""
+        endpoint to one recovery generation; `index` is this resolver's
+        key-shard slot (live rebalancing computes its gained spans)."""
         from ..sim.loop import current_scheduler
 
         self.proc = proc
         self.engine = engine
+        self.index = index
+        #: newest routing flip already seeded into the engine
+        self._flip_seen: Version = 0
         self.version = NotifiedVersion(start_version)
         self.token = RESOLVE_TOKEN + token_suffix
         self.metrics_token = RESOLUTION_METRICS_TOKEN + token_suffix
@@ -105,8 +140,33 @@ class Resolver:
             # replay-window-GC'd paths that normally need huge lag
             window = window // 100
         new_oldest = max(0, req.version - window)
+        transactions = req.transactions
+        prepended = False
+        if (getattr(req, "routing_version", 0)
+                and req.version >= req.routing_version
+                and req.routing_version > self._flip_seen):
+            # Live rebalance handoff (bounce-free resolutionBalancing): this
+            # is the first chained batch at or past the flip. Seed a
+            # synthetic whole-span write over the ranges we GAINED: reads
+            # with pre-flip snapshots conflict conservatively (we lack the
+            # donor's history for them — exactly the reference's
+            # "insufficient history => abort" rule), and everything with a
+            # post-flip snapshot is checked exactly against the complete
+            # history accumulated here from the flip on.
+            self._flip_seen = req.routing_version
+            gained = gained_ranges(tuple(req.routing_old_splits),
+                                   tuple(req.routing_splits), self.index)
+            if gained:
+                synth = CommitTransaction(
+                    read_snapshot=req.version,
+                    write_conflict_ranges=[KeyRange(b, e) for b, e in gained],
+                )
+                transactions = [synth] + list(req.transactions)
+                prepended = True
         self._sample_rows(req.transactions)
-        verdicts = self.engine.resolve(req.transactions, req.version, new_oldest)
+        verdicts = self.engine.resolve(transactions, req.version, new_oldest)
+        if prepended:
+            verdicts = verdicts[1:]   # the synthetic is ours, not a txn
         reply = ResolveTransactionBatchReply(committed=[int(v) for v in verdicts])
         self._recent[req.version] = reply
         # GC the replay window along with the conflict window.

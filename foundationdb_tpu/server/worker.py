@@ -260,6 +260,7 @@ class Worker:
             self.roles[key] = Resolver(
                 self.proc, self.engine_factory(),
                 start_version=req.start_version, token_suffix=req.token_suffix,
+                index=req.replica_index,
             )
         return self.proc.address
 

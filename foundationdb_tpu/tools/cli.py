@@ -31,6 +31,9 @@ commands:
   move BEGIN WORKER [WORKER...]  move the shard at BEGIN to new workers
   exclude ADDR [ADDR...]         drain all shard replicas off workers
   include ADDR [ADDR...]         re-admit excluded workers
+  configure [single|double|triple] [proxies=N] [resolvers=N] [logs=N]
+                       change the database configuration (applies at the
+                       next recovery; replication drives DD team growth)
   help                 this text
   exit                 quit
 Keys/values are text; prefix with 0x for hex bytes."""
@@ -219,6 +222,25 @@ class Cli:
         verb = "excluded" if exclude else "included"
         self._print(f"{verb}: now excluding {reply['excluded'] or 'nothing'}"
                     + (f"; moved shards {reply['moved']}" if reply.get("moved") else ""))
+
+    def do_configure(self, args: List[str]) -> None:
+        from ..server.management import REDUNDANCY_MODES, change_configuration
+
+        if not args:
+            raise ValueError("configure what?")
+        mode = None
+        counts = {}
+        for tok in args:
+            if tok in REDUNDANCY_MODES:
+                mode = tok
+            elif "=" in tok:
+                k, v = tok.split("=", 1)
+                counts[k] = int(v)
+            else:
+                raise ValueError(f"bad configure token {tok!r}")
+        self._drive(change_configuration(self.db, mode=mode, **counts),
+                    timeout=120.0)
+        self._print("configuration committed (applies at the next recovery)")
 
     def do_exclude(self, args: List[str]) -> None:
         self._exclude_cmd(args, exclude=True)

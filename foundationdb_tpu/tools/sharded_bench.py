@@ -1,13 +1,24 @@
-"""Sharded-resolver throughput on the virtual CPU mesh (scaling-shape proxy).
+"""Sharded-resolver scaling on the virtual CPU mesh (total-compute proxy).
 
-Multi-chip hardware is not available in this environment, so the 8-shard
-scaling story is measured the same way it is tested: S key-range shards over
-S virtual CPU devices (xla_force_host_platform_device_count), end-to-end
-through the columnar native router (wire blocks -> per-shard C routing ->
-fused shard_map step with ICI-psum fixpoint). The comparison S=8 vs S=1 on
-identical hardware isolates the sharding overhead: routing pass, smaller
-per-shard tables, psum rounds. bench.py runs this module as a subprocess
-with the CPU platform forced and folds the JSON into its output line.
+Multi-chip hardware is not available here, so the 8-shard story is split
+into two measurements:
+
+  * THIS module (run by bench.py with the CPU platform forced): S=8 key-
+    range shards over 8 virtual CPU devices vs S=1 on the same single
+    core. One core time-shares all 8 "devices", so the txn/s ratio IS the
+    total-compute ratio — sharding is free when it approaches 1.0.
+  * bench.py's `sharded_tpu` section: the per-shard program measured on
+    the real chip (per-shard wall time), which is what parallelizes on a
+    v5e-8.
+
+The round-4 configuration ran the 8-shard engine at the SAME batch size
+as one chip, so each shard paid the step's fixed costs (sort padding,
+[T]-space fixpoint work, table rows) for 1/8 of the rows — a measured
+1.7x total-compute LOSS. The fix is WEAK SCALING, faithful to the north
+star's "1M in-flight": the 8-shard configuration carries an 8x batch, so
+each shard's row load matches a lone chip's sweet spot and the fixed
+costs amortize over 8x the transactions. Both engines below consume the
+IDENTICAL transaction stream; each takes its preferred batch size.
 
 Reference analog: the 8-shard SimulatedCluster config of BASELINE.json and
 the proxy's per-resolver request splitting (MasterProxyServer.actor.cpp:
@@ -37,67 +48,61 @@ def main():
     from foundationdb_tpu.ops.host_engine import JaxConflictEngine
     from foundationdb_tpu.parallel.sharding import KeyShardMap, ShardedConflictEngine
 
-    T = 1024
-    # Per-shard capacities scale with 1/S (+2x headroom for skew): a shard
-    # owns 1/S of the keyspace, so its boundary table and row caps are
-    # pro-rata — that is what makes sharding a throughput win rather than
-    # S copies of the full-size program (the reference's resolvers likewise
-    # each hold only their key range's state).
-    CFG = KernelConfig(
+    T1 = 2048             # the lone engine's batch
+    T8 = 8 * T1           # weak scaling: the mesh carries 8x per batch
+    CFG1 = KernelConfig(
         key_words=4, capacity=8192,
-        max_point_reads=2048, max_point_writes=2048,
-        max_reads=8, max_writes=8, max_txns=T,
+        max_point_reads=4096, max_point_writes=4096,
+        max_reads=8, max_writes=8, max_txns=T1,
     )
+    # per-shard: the same ROW load as CFG1 (2 reads + 2 writes per txn,
+    # 1/8 of the keys of an 8x batch). Headroom is +4 sigma of the
+    # binomial row split (mean 4096, sigma ~60) — padding rides the sort
+    # at full price, so headroom is precision-budgeted, not doubled
     CFG8 = KernelConfig(
-        key_words=4, capacity=2048,
-        max_point_reads=512, max_point_writes=512,
-        max_reads=8, max_writes=8, max_txns=T,
+        key_words=4, capacity=1536,
+        max_point_reads=4352, max_point_writes=4352,
+        max_reads=8, max_writes=8, max_txns=T8,
     )
     POOL = 4096
-    BATCHES = 8
-    REPS = 3
+    N_BATCHES = 4         # of T8 txns each; s1 consumes the same stream
+    REPS = 2
 
     rng = np.random.default_rng(7)
 
-    def synth_batches():
-        out = []
-        for _ in range(BATCHES):
-            txns = []
-            for _ in range(T):
-                t = CommitTransaction()
-                for _ in range(2):
-                    k = b"%06d" % rng.integers(0, POOL)
-                    t.read_conflict_ranges.append(KeyRange(k, k + b"\x00"))
-                for _ in range(2):
-                    k = b"%06d" % rng.integers(0, POOL)
-                    t.write_conflict_ranges.append(KeyRange(k, k + b"\x00"))
-                txns.append(t)
-            out.append(txns)
-        return out
+    def synth(n_txns):
+        txns = []
+        for _ in range(n_txns):
+            t = CommitTransaction()
+            for _ in range(2):
+                k = b"%06d" % rng.integers(0, POOL)
+                t.read_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            for _ in range(2):
+                k = b"%06d" % rng.integers(0, POOL)
+                t.write_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            txns.append(t)
+        return txns
 
-    streams = synth_batches()
-    # Key pool is b"000000".."004095": uniform splits on the generated key
-    # space so all 8 shards carry load.
+    streams = [synth(T8) for _ in range(N_BATCHES)]
     splits = [b"%06d" % ((POOL * i) // 8) for i in range(1, 8)]
 
     def run(engine):
         now = 1000
-        # warm: compile + table fill
-        for txns in streams:
-            engine.resolve(txns, now, max(0, now - 40_000))
-            now += T
+        for txns in streams:            # warm: compile + table fill
+            engine.resolve(txns, now, max(0, now - 200_000))
+            now += T8
         t0 = time.perf_counter()
         total = 0
         for _ in range(REPS):
             for txns in streams:
-                engine.resolve(txns, now, max(0, now - 40_000))
-                now += T
+                engine.resolve(txns, now, max(0, now - 200_000))
+                now += T8
                 total += len(txns)
         return total / (time.perf_counter() - t0)
 
     res = {}
     for name, mk in (
-        ("s1", lambda: JaxConflictEngine(CFG)),
+        ("s1", lambda: JaxConflictEngine(CFG1)),
         ("s8", lambda: ShardedConflictEngine(
             CFG8, KeyShardMap(splits),
             jax.make_mesh((8,), ("shard",), devices=jax.devices()[:8]))),
@@ -106,7 +111,11 @@ def main():
             for tr in t:
                 tr.read_snapshot = 990  # reset snapshots under fresh engine
         res[name] = round(run(mk()), 1)
-    res["speedup"] = round(res["s8"] / res["s1"], 3)
+    # one host core time-shares the 8 virtual devices: txn/s ratio ==
+    # total-compute ratio; >= 1.0 means the 8-shard configuration costs no
+    # more silicon-seconds per transaction than a lone engine
+    res["total_compute_ratio"] = round(res["s8"] / res["s1"], 3)
+    res["batch_txns"] = {"s1": T1, "s8": T8}
     print(json.dumps(res))
 
 

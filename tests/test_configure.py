@@ -1,0 +1,127 @@
+"""`configure` + DatabaseConfiguration (VERDICT r4 missing #2 / next #6).
+
+The configuration lives in \\xff/conf/ (written transactionally by
+ManagementAPI.change_configuration), is mirrored into the coordinated
+state by the serving master's conf watcher, bounces the epoch, and the
+next recovery recruits with the new counts; the DD replication fixer then
+grows/shrinks every shard's team to the configured redundancy.
+reference: fdbclient/ManagementAPI.actor.cpp changeConfig,
+DatabaseConfiguration.cpp, \\xff/conf keyspace."""
+import pytest
+
+from foundationdb_tpu.core import error
+from foundationdb_tpu.server.cluster import (
+    DynamicClusterConfig,
+    build_dynamic_cluster,
+)
+from foundationdb_tpu.server.management import change_configuration
+from foundationdb_tpu.sim.loop import delay
+
+
+def drive(sim, coro, until=600.0):
+    return sim.run_until(sim.sched.spawn(coro), until=until)
+
+
+async def shard_doc(db):
+    doc = await db.get_status()
+    return doc.get("data", {}).get("shards", []), doc
+
+
+def test_configure_double_grows_teams_under_load():
+    """The done-criterion: replication single -> double under live load;
+    every shard ends with 2 healthy replicas and the data is exact."""
+    cfg = DynamicClusterConfig(n_workers=10)   # spares for the new replicas
+    c = build_dynamic_cluster(seed=301, cfg=cfg)
+    sim = c.sim
+    db = c.new_client()
+    done = {"writes": 0}
+
+    async def load():
+        for i in range(60):
+            async def w(tr, i=i):
+                tr.set(b"cfg/%03d" % i, b"v%d" % i)
+            while True:
+                try:
+                    await db.run(w)
+                    break
+                except error.FDBError:
+                    await delay(0.3)   # recovery window: keep trying
+            done["writes"] += 1
+            await delay(0.25)
+        return True
+
+    async def configure():
+        await delay(2.0)
+        await change_configuration(db, mode="double")
+        return True
+
+    t_load = sim.sched.spawn(load(), name="load")
+    t_cfg = sim.sched.spawn(configure(), name="cfg")
+    assert sim.run_until(t_load, until=600.0)
+    assert t_cfg.is_ready and t_cfg.get()
+
+    # let the fixer finish growing every team
+    async def wait_teams():
+        for _ in range(240):
+            shards, _doc = await shard_doc(db)
+            if shards and all(s["replication"] == 2 and s["healthy"]
+                              for s in shards):
+                return True
+            await delay(1.0)
+        return False
+
+    assert drive(sim, wait_teams(), until=sim.sched.time + 400.0), \
+        "teams never reached double replication"
+
+    # ConsistencyCheck-grade readback: all data exact after the bounce+grow
+    async def read_all():
+        async def r(tr):
+            return await tr.get_range(b"cfg/", b"cfg/\xff", limit=1000)
+        return await db.run(r)
+
+    rows = drive(sim, read_all())
+    assert rows == [(b"cfg/%03d" % i, b"v%d" % i) for i in range(60)]
+    assert done["writes"] == 60
+
+
+def test_configure_role_counts_apply_at_next_recovery():
+    """proxies=2 resolvers=1: the conf commit bounces the epoch and the
+    successor generation recruits the configured counts."""
+    c = build_dynamic_cluster(seed=302, cfg=DynamicClusterConfig(n_workers=8))
+    sim = c.sim
+    db = c.new_client()
+
+    async def scenario():
+        async def w(tr):
+            tr.set(b"k", b"v")
+        await db.run(w)
+        await change_configuration(db, proxies=2, resolvers=1)
+        for _ in range(240):
+            doc = await db.get_status()
+            roles = (doc or {}).get("cluster", {}).get("roles")
+            if roles and len(roles.get("proxies", [])) == 2 \
+                    and len(roles.get("resolvers", [])) == 1:
+                # traffic still flows through the new generation
+                async def r(tr):
+                    return await tr.get(b"k")
+                assert await db.run(r) == b"v"
+                return True
+            await delay(1.0)
+        return False
+
+    assert drive(sim, scenario(), until=600.0)
+
+
+def test_configure_rejects_unknown_keys():
+    c = build_dynamic_cluster(seed=303, cfg=DynamicClusterConfig())
+    sim = c.sim
+    db = c.new_client()
+
+    async def scenario():
+        with pytest.raises(error.FDBError):
+            await change_configuration(db, bogus=3)
+        with pytest.raises(error.FDBError):
+            await change_configuration(db, mode="quadruple")
+        return True
+
+    assert drive(sim, scenario(), until=120.0)

@@ -44,6 +44,12 @@ def test_dr_replicates_and_switchover_loses_nothing():
             tr.set(b"ctr", (0).to_bytes(8, "little"))
         await db_a.run(seed)
 
+        # pre-existing destination data the source never had: the initial
+        # sync must WIPE it, or the promoted primary serves ghost keys
+        async def stray(tr):
+            tr.set(b"stray/x", b"1")
+        await db_b.run(stray)
+
         agent = DRAgent(sim, db_a, db_b)
         await agent.start(chunks=4)
 
@@ -112,6 +118,7 @@ def test_dr_replicates_and_switchover_loses_nothing():
             assert b_map.get(b"straddle/%03d" % i) == b"s%d" % i
         for i in locked:
             assert (b"straddle/%03d" % i) not in b_map
+        assert b"stray/x" not in b_map, "destination ghost key survived DR"
         assert b_map[b"ctr"] == (20).to_bytes(8, "little")
         outcome.update(committed=len(committed), locked=len(locked),
                        fence=fence)
