@@ -19,6 +19,10 @@ _rng: Optional[DeterministicRandom] = None
 _sites: Dict[Tuple[str, int], Tuple[bool, float]] = {}
 #: coverage: site/comment -> times condition held
 coverage: Dict[Tuple[str, int, str], int] = {}
+#: buggify sites that actually FIRED (returned True); NOT cleared by
+#: enable(), so a coverage harvest can union firings across many seeds
+#: (the flow/coveragetool role for fault-injection sites)
+fired: set = set()
 
 SITE_ACTIVATED_PROBABILITY = 0.25
 FIRE_PROBABILITY = 0.05
@@ -55,7 +59,10 @@ def buggify() -> bool:
     if site not in _sites:
         _sites[site] = (_rng.random01() < SITE_ACTIVATED_PROBABILITY, FIRE_PROBABILITY)
     activated, p = _sites[site]
-    return activated and _rng.random01() < p
+    hit = activated and _rng.random01() < p
+    if hit:
+        fired.add(site)
+    return hit
 
 
 def test_probe(condition: bool, comment: str) -> bool:

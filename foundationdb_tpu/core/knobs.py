@@ -80,10 +80,18 @@ def _make_server_knobs() -> Knobs:
     #: byte-sample granularity (reference: BYTE_SAMPLING_FACTOR — keys are
     #: sampled with probability size/factor and carry weight `factor`)
     k.init("dd_byte_sample_factor", 200)
-    # DataDistribution (reference: DataDistributionTracker split/merge)
+    # DataDistribution (reference: DataDistributionTracker split/merge +
+    # DataDistributionQueue priorities/parallelism)
     k.init("dd_tracker_interval", 2.0)
     k.init("dd_shard_split_bytes", 100_000, lambda r: r.random_int(4_000, 50_000))
     k.init("dd_shard_merge_bytes", 2_000)
+    #: write-bandwidth split trigger (bytes/sec of applied mutations; the
+    #: reference splits on SHARD_MAX_BYTES_PER_KSEC); a hot-WRITE shard
+    #: splits even while its size is under dd_shard_split_bytes
+    k.init("dd_shard_split_bandwidth", 200_000)
+    #: concurrent relocations the DD queue may run (reference:
+    #: DD_MOVE_KEYS_PARALLELISM)
+    k.init("dd_move_parallelism", 2)
     # Failure detection (reference: CC failureDetectionServer)
     k.init("failure_detection_delay", 1.0, lambda r: 0.2 + r.random01() * 2)
     k.init("heartbeat_interval", 0.25)

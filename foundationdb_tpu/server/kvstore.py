@@ -189,6 +189,16 @@ class SSTableStore:
         #: one clearing _pending ops the other never logged — or race a
         #: _flush into the middle of a _compact's run-list rebuild
         self._commit_mutex = AsyncMutex()
+        #: the background compaction, if one is running (commit() spawns)
+        self._compact_task = None
+        #: single-flight for the merge itself: a direct maintenance
+        #: _compact() call must never overlap the background one (both
+        #: snapshot the run list and reclaim files)
+        self._compact_mutex = AsyncMutex()
+        #: high-water mark of items the streaming merge buffered at once
+        #: (heads + the current block) — the bounded-memory contract; tests
+        #: assert it never approaches the dataset size
+        self.compact_peak_items = 0
 
     # -- lifecycle -----------------------------------------------------------
     @classmethod
@@ -249,10 +259,12 @@ class SSTableStore:
         self._mem_clear(begin, end)
 
     async def commit(self) -> None:
-        """Durability point: WAL frame + fsync; flush/compact as needed
+        """Durability point: WAL frame + fsync; flush as needed
         (IKeyValueStore::commit). Serialized: ops staged after this
         committer's WAL snapshot ride the NEXT commit (and its fsync ack),
-        never a half-logged state."""
+        never a half-logged state. Compaction runs in the BACKGROUND — the
+        commit path never waits for a merge (the reference's btree spreads
+        its page writes the same way)."""
         async with self._commit_mutex:
             if self._pending:
                 ops, self._pending = self._pending, []
@@ -266,36 +278,27 @@ class SSTableStore:
             flush_at = 256 if buggify.buggify() else self.FLUSH_BYTES
             if self._mem_bytes >= flush_at:
                 await self._flush()
-                max_runs = 1 if buggify.buggify() else self.MAX_RUNS
-                if len(self._runs) > max_runs:
-                    await self._compact()
+        max_runs = 1 if buggify.buggify() else self.MAX_RUNS
+        if len(self._runs) > max_runs and self._compact_task is None:
+            from ..sim.loop import TaskPriority, spawn
+
+            t = spawn(self._compact_bg(), TaskPriority.LOW,
+                      name=f"compact:{self.name}")
+            self._compact_task = t
+
+            def done(_f) -> None:
+                self._compact_task = None
+
+            t.on_ready(done)
 
     async def _write_run(self, entries, tombs) -> str:
-        """entries: sorted [(k, v|None)]; returns the installed file name."""
-        self._run_seq += 1
-        rn = f"{self.name}-{self._run_seq}.sst"
-        f = self.disk.open(rn)
-        await f.truncate(0)
-        index = []
-        off = 0
-        i = 0
-        while i < len(entries):
-            blk = []
-            bbytes = 0
-            j = i
-            while j < len(entries) and (bbytes < self.BLOCK_BYTES or j == i):
-                blk.append(entries[j])
-                bbytes += len(entries[j][0]) + len(entries[j][1] or b"") + 8
-                j += 1
-            raw = wire.dumps(blk)
-            await f.write(off, raw)
-            index.append((entries[i][0], off, len(raw)))
-            off += len(raw)
-            i = j
-        foot = wire.dumps({"index": index, "tombs": tombs, "n": len(entries)})
-        await f.write(off, foot + _FOOT.pack(len(foot), zlib.crc32(foot)))
-        await f.sync()
-        return rn
+        """entries: sorted [(k, v|None)]; returns the synced file name.
+        One encoder for the run format: delegates to the streaming writer
+        (which compaction also uses)."""
+        async def gen():
+            for e in entries:
+                yield e
+        return await self._write_run_stream(gen(), tombs)
 
     async def _install_manifest(self, run_names: List[str]) -> None:
         tmp = f"{self.name}.manifest.tmp"
@@ -325,31 +328,127 @@ class SSTableStore:
         # WAL content is fully covered by the installed run.
         await self.wal.pop_to(self.wal.end_offset)
 
-    async def _compact(self) -> None:
-        """Full merge: newest precedence; tombstones drop out entirely."""
-        merged: Dict[Key, Optional[Value]] = {}
-        for level, run in enumerate(self._runs):
-            async for k, v in run.iter_from(b""):
-                if k in merged:
-                    continue
-                if any(self._runs[up].covers_tomb(k) for up in range(level)):
-                    continue
-                merged[k] = v
-        entries = sorted((k, v) for k, v in merged.items() if v is not None)
-        old = [r.name for r in self._runs]
-        rn = await self._write_run(entries, [])
+    async def _write_run_stream(self, entries, tombs) -> str:
+        """Write one sorted run from an ASYNC ITERATOR of (k, v) entries,
+        block by block — the one and only encoder of the on-disk run
+        format (a second inline copy would silently diverge from
+        _Run.open's expectations). Returns the synced file name."""
+        self._run_seq += 1
+        rn = f"{self.name}-{self._run_seq}.sst"
+        f = self.disk.open(rn)
+        await f.truncate(0)
+        index = []
+        off = 0
+        blk: List[Tuple[Key, Value]] = []
+        bbytes = 0
+        n_entries = 0
+
+        async def flush_blk():
+            nonlocal off, blk, bbytes
+            raw = wire.dumps(blk)
+            await f.write(off, raw)
+            index.append((blk[0][0], off, len(raw)))
+            off += len(raw)
+            blk, bbytes = [], 0
+
+        async for k, v in entries:
+            blk.append((k, v))
+            n_entries += 1
+            bbytes += len(k) + len(v or b"") + 8
+            self.compact_peak_items = max(self.compact_peak_items, len(blk))
+            if bbytes >= self.BLOCK_BYTES:
+                await flush_blk()
+                if buggify.buggify():
+                    # mid-write crash window: the half-written run is an
+                    # orphan reopen GCs; the manifest still names the OLD
+                    # runs
+                    from ..sim.loop import TaskPriority, delay
+                    await delay(0.02, TaskPriority.DEFAULT_DELAY)
+        if blk:
+            await flush_blk()
+        foot = wire.dumps({"index": index, "tombs": tombs, "n": n_entries})
+        await f.write(off, foot + _FOOT.pack(len(foot), zlib.crc32(foot)))
+        await f.sync()
+        return rn
+
+    async def _merged_entries(self, snapshot):
+        """Streaming k-way merge over `snapshot` runs (newest first):
+        newest precedence, tombstones of newer runs mask older entries,
+        resolved deletions drop out. Peak memory: one head per run."""
+        iters = [r.iter_from(b"") for r in snapshot]
+        heads: List[Optional[Tuple[Key, Optional[Value]]]] = []
+        for it in iters:
+            try:
+                heads.append(await anext(it))
+            except StopAsyncIteration:
+                heads.append(None)
+        while True:
+            pick: Optional[Key] = None
+            for h in heads:
+                if h is not None and (pick is None or h[0] < pick):
+                    pick = h[0]
+            if pick is None:
+                return
+            val: Optional[Value] = None
+            taken = None
+            for i, h in enumerate(heads):
+                if h is not None and h[0] == pick:
+                    if taken is None:
+                        taken = i
+                        val = h[1]
+                    try:
+                        heads[i] = await anext(iters[i])
+                    except StopAsyncIteration:
+                        heads[i] = None
+            if taken is not None and any(
+                snapshot[up].covers_tomb(pick) for up in range(taken)
+            ):
+                val = None
+            if val is not None:
+                yield pick, val
+
+    async def _compact_bg(self) -> None:
+        """Background full compaction of a SNAPSHOT of the current runs:
+        streaming k-way merge (newest precedence, tombstones resolved and
+        dropped), blocks written incrementally — peak memory is one block
+        plus one head per run, NEVER the dataset ("the dataset does not
+        live in process memory" holds through its own maintenance).
+        Commits keep flushing new runs meanwhile; the install swaps only
+        the snapshotted suffix of the run list. Single-flight under the
+        compact mutex (a direct _compact() call serializes behind us)."""
+        async with self._compact_mutex:
+            await self._compact_locked()
+
+    async def _compact_locked(self) -> None:
+        snapshot = list(self._runs)
+        if len(snapshot) < 2:
+            return
+        rn = await self._write_run_stream(self._merged_entries(snapshot), [])
         run = await _Run.open(self.disk, rn, self._cache, self.CACHE_BLOCKS)
-        self._runs = [run]
         if buggify.buggify():
             # crash window: merged run durable but manifest not installed —
             # reopen must GC the orphan and serve the OLD manifest's runs
             from ..sim.loop import TaskPriority, delay
             await delay(0.02, TaskPriority.DEFAULT_DELAY)
-        await self._install_manifest([rn])
+        # swap ONLY the snapshotted suffix: runs flushed during the merge
+        # stay in front (they are newer than the merged result). The
+        # install shares the commit mutex so a concurrent flush's manifest
+        # write cannot interleave with ours on the tmp file.
+        async with self._commit_mutex:
+            keep = self._runs[: len(self._runs) - len(snapshot)]
+            assert self._runs[len(self._runs) - len(snapshot):] == snapshot
+            old = [r.name for r in snapshot]
+            self._runs = keep + [run]
+            await self._install_manifest([r.name for r in self._runs])
         for name in old:
             for ck in [c for c in self._cache if c[0] == name]:
                 del self._cache[ck]
         self._reclaim(old)
+
+    async def _compact(self) -> None:
+        """Synchronous full merge (tests and maintenance entry): the same
+        streaming path, serialized behind any background merge."""
+        await self._compact_bg()
 
     def _reclaim(self, names: List[str]) -> None:
         """Delete run files now, or park them until in-flight reads drain
@@ -490,6 +589,9 @@ class SSTableStore:
     # -- maintenance ---------------------------------------------------------
     def destroy(self) -> None:
         """Delete every on-disk artifact (IKeyValueStore::dispose)."""
+        if self._compact_task is not None:
+            self._compact_task.cancel()
+            self._compact_task = None
         for rn in [r.name for r in self._runs] + self._defer_delete:
             self.disk.delete(rn)
         self._defer_delete = []

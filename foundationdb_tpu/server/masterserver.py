@@ -161,7 +161,8 @@ class MasterServer:
             raise error.client_invalid_operation("dest already hosts storage")
         if any(d in dd["excluded"] for d in dests):
             raise error.client_invalid_operation("dest is excluded")
-        next_tag = max(t for (t, _b, _e, _a) in tags) + 1
+        next_tag = dd["next_tag"]                 # monotone allocator:
+        dd["next_tag"] += len(dests)              # unique across CONCURRENT
         new_team = [(next_tag + i, d) for i, d in enumerate(dests)]
         TraceEvent("MoveShardStart", id=self.salt).detail(
             "Begin", req.begin).detail("NewTeam", str(new_team)).log()
@@ -215,11 +216,10 @@ class MasterServer:
             raise
 
         # (4) durable authority + cleanup
-        new_tags = (
-            [(t, b, e, a) for (t, b, e, a) in tags if b != req.begin]
+        await self._publish_tags(dd, cstate, ratekeeper, lambda cur: (
+            [(t, b, e, a) for (t, b, e, a) in cur if b != req.begin]
             + [(nt, req.begin, end, d) for nt, d in new_team]
-        )
-        await self._publish_tags(dd, cstate, ratekeeper, new_tags)
+        ))
         for t, a in team:
             self.net.one_way(self.proc.address, Endpoint(a, RETIRE_STORAGE_TOKEN),
                              RetireStorageRequest(tags=(t,)),
@@ -228,22 +228,28 @@ class MasterServer:
         TraceEvent("MoveShardDone", id=self.salt).detail("Begin", req.begin).log()
         return {"begin": req.begin, "team": new_team}
 
-    async def _publish_tags(self, dd, cstate, ratekeeper, new_tags) -> None:
+    async def _publish_tags(self, dd, cstate, ratekeeper, transform) -> None:
         """Persist a storage-map change in cstate (the recovery authority)
-        and fan the new map out to ratekeeper + the CC status document."""
+        and fan the new map out to ratekeeper + the CC status document.
+        `transform(cur_tags) -> new_tags` is applied to the CURRENT map
+        UNDER the publish mutex: concurrent relocations of disjoint shards
+        compose instead of overwriting each other's publishes (a
+        precomputed list would lose whichever write landed first)."""
         from dataclasses import replace
         from .cluster_controller import CC_MASTER_RECOVERED_TOKEN
 
-        new_tags = sorted(new_tags)
-        dd["cstate_val"] = replace(dd["cstate_val"], storage_tags=tuple(new_tags))
-        await cstate.set_exclusive(dd["cstate_val"])
-        dd["storage_tags"][:] = new_tags
-        ratekeeper.storage_tags = list(new_tags)
-        dd["info"] = replace(dd["info"], storage_tags=tuple(new_tags),
-                             dd_version=dd["info"].dd_version + 1)
-        self.net.one_way(self.proc.address,
-                         Endpoint(self.cc_addr, CC_MASTER_RECOVERED_TOKEN),
-                         dd["info"], TaskPriority.CLUSTER_CONTROLLER)
+        async with dd["publish_mutex"]:
+            new_tags = sorted(transform(list(dd["storage_tags"])))
+            dd["cstate_val"] = replace(dd["cstate_val"],
+                                       storage_tags=tuple(new_tags))
+            await cstate.set_exclusive(dd["cstate_val"])
+            dd["storage_tags"][:] = new_tags
+            ratekeeper.storage_tags = list(new_tags)
+            dd["info"] = replace(dd["info"], storage_tags=tuple(new_tags),
+                                 dd_version=dd["info"].dd_version + 1)
+            self.net.one_way(self.proc.address,
+                             Endpoint(self.cc_addr, CC_MASTER_RECOVERED_TOKEN),
+                             dd["info"], TaskPriority.CLUSTER_CONTROLLER)
 
     async def _split_shard(self, begin, split_key, dests, dd, dd_db,
                            log_client, cstate, ratekeeper):
@@ -260,7 +266,8 @@ class MasterServer:
         end = next(e for (_t, b, e, _a) in tags if b == begin)
         if not (begin < split_key < end):
             raise error.client_invalid_operation("split key outside shard")
-        next_tag = max(t for (t, _b, _e, _a) in tags) + 1
+        next_tag = dd["next_tag"]                 # monotone allocator
+        dd["next_tag"] += len(dests)
         new_team = [(next_tag + i, d) for i, d in enumerate(dests)]
         TraceEvent("ShardSplitStart", id=self.salt).detail(
             "Begin", begin).detail("SplitKey", split_key).log()
@@ -308,12 +315,11 @@ class MasterServer:
 
         # durable authority BEFORE shrinking: a crash after this point
         # recovers with the split map and both teams intact
-        new_tags = (
+        await self._publish_tags(dd, cstate, ratekeeper, lambda cur: (
             [(t, b, split_key if b == begin else e, a)
-             for (t, b, e, a) in tags]
+             for (t, b, e, a) in cur]
             + [(nt, split_key, end, d) for nt, d in new_team]
-        )
-        await self._publish_tags(dd, cstate, ratekeeper, new_tags)
+        ))
         await all_of([
             self.net.request(
                 self.proc.address, Endpoint(a, SHRINK_SHARD_TOKEN),
@@ -371,11 +377,10 @@ class MasterServer:
                    system_keys.encode_key_servers([]))   # remove boundary
         await dd_db.run(ph2)
 
-        new_tags = (
+        await self._publish_tags(dd, cstate, ratekeeper, lambda cur: (
             [(t, b, end2 if b == begin1 else e, a)
-             for (t, b, e, a) in tags if b != begin2]
-        )
-        await self._publish_tags(dd, cstate, ratekeeper, new_tags)
+             for (t, b, e, a) in cur if b != begin2]
+        ))
         for t, a in team2:
             self.net.one_way(self.proc.address, Endpoint(a, RETIRE_STORAGE_TOKEN),
                              RetireStorageRequest(tags=(t,)),
@@ -397,7 +402,8 @@ class MasterServer:
         if not team:
             raise error.client_invalid_operation(f"no shard begins at {begin!r}")
         end = next(e for (_t, b, e, _a) in tags if b == begin)
-        nt = max(t for (t, _b, _e, _a) in tags) + 1
+        nt = dd["next_tag"]                       # monotone allocator
+        dd["next_tag"] += 1
         TraceEvent("TeamGrowStart", id=self.salt).detail(
             "Begin", begin).detail("Dest", dest).log()
 
@@ -436,8 +442,9 @@ class MasterServer:
                              TaskPriority.MOVE_KEYS)
             log_client.pop(nt, -1)
             raise
-        new_tags = list(tags) + [(nt, begin, end, dest)]
-        await self._publish_tags(dd, cstate, ratekeeper, new_tags)
+        await self._publish_tags(
+            dd, cstate, ratekeeper,
+            lambda cur: list(cur) + [(nt, begin, end, dest)])
         TraceEvent("TeamGrowDone", id=self.salt).detail("Begin", begin).log()
 
     async def _shrink_team(self, begin, dd, dd_db, log_client, cstate,
@@ -456,8 +463,10 @@ class MasterServer:
             tr.set(system_keys.key_servers_key(begin),
                    system_keys.encode_key_servers(keep))
         await dd_db.run(ph)
-        new_tags = [t for t in tags if not (t[0] == victim_t and t[1] == begin)]
-        await self._publish_tags(dd, cstate, ratekeeper, new_tags)
+        await self._publish_tags(
+            dd, cstate, ratekeeper,
+            lambda cur: [t for t in cur
+                         if not (t[0] == victim_t and t[1] == begin)])
         self.net.one_way(self.proc.address, Endpoint(victim_a, RETIRE_STORAGE_TOKEN),
                          RetireStorageRequest(tags=(victim_t,)),
                          TaskPriority.MOVE_KEYS)
@@ -766,12 +775,20 @@ class MasterServer:
 
         from ..sim.loop import Promise as _Promise
 
+        from ..sim.actors import AsyncMutex as _AsyncMutex
+
         dd = {
             "storage_tags": list(storage_tags),
             "cstate_val": cstate_val,
             "busy": False,
             "info": info,
             "init_done": _Promise(),
+            # monotone storage-tag allocator: concurrent queue relocations
+            # taking max(tags)+1 would mint DUPLICATE tags
+            "next_tag": max((t for (t, _b, _e, _a) in storage_tags),
+                            default=-1) + 1,
+            # read-transform-write publishes compose under this
+            "publish_mutex": _AsyncMutex(),
         }
         dd_db = ClientDatabase(self.net, self.proc.address, list(proxy_addrs))
         move_token = MOVE_SHARD_TOKEN + suffix
@@ -831,18 +848,37 @@ class MasterServer:
 
         async def move_shard(req: MoveShardRequest):
             await dd["init_done"].future  # serialize vs the seed transaction
-            if dd["busy"]:
-                raise error.client_invalid_operation("a shard move is already running")
+            # the external move joins the DD queue's shard-exclusion
+            # discipline: wait (bounded) for any queued relocation of this
+            # shard to finish, then hold the shard for the move's duration
+            deadline = 120
+            while req.begin in dd["busy_shards"] or dd["busy"]:
+                deadline -= 1
+                if deadline <= 0:
+                    raise error.client_invalid_operation(
+                        "shard is being relocated; retry later")
+                await delay(0.5, TaskPriority.MOVE_KEYS)
+            if set(req.dest_workers) & dd["reserved"]:
+                raise error.client_invalid_operation(
+                    "a destination is reserved by a concurrent relocation")
             dd["busy"] = True
+            dd["busy_shards"].add(req.begin)
+            dd["reserved"] |= set(req.dest_workers)
             try:
                 return await self._move_shard(req, dd, dd_db, log_client, cstate,
                                               ratekeeper)
             finally:
                 dd["busy"] = False
+                dd["busy_shards"].discard(req.begin)
+                dd["reserved"] -= set(req.dest_workers)
+
+        dd["reserved"] = set()   # in-flight relocation destinations
 
         def pick_spares(n: int):
             """Policy-selected destination workers: alive, not hosting
-            storage, not excluded, spread across machines
+            storage, not excluded, not already RESERVED by a concurrent
+            relocation (two parallel ops landing on one worker would alias
+            its per-process storage tokens), spread across machines
             (DDTeamCollection's team builder behind PolicyAcross)."""
             from .replication_policy import PolicyAcross
 
@@ -851,16 +887,71 @@ class MasterServer:
                 w for w in self.workers
                 if not self.net.monitor.is_failed(w)
                 and w not in hosts and w not in dd["excluded"]
+                and w not in dd["reserved"]
             )
             return PolicyAcross(n, "machine_id").select(cands, self.localities)
 
+        # -- DataDistributionQueue (DataDistributionQueue.actor.cpp) ---------
+        # A prioritized relocation queue with bounded parallelism: the
+        # tracker/fixer DECIDE (fast polls), runner actors EXECUTE (slow
+        # fetches overlap across disjoint shards; metadata commits and
+        # cstate publishes serialize through their own paths). Lower
+        # priority value = more urgent (the reference's move priorities:
+        # team health above load balancing above space reclamation).
+        PRI_TEAM, PRI_SPLIT, PRI_MERGE = 0, 1, 2
+        dd["queue"] = []              # [(priority, seq, key, shards, fn)]
+        dd["queued_keys"] = set()     # dedupe: one pending op per key
+        dd["busy_shards"] = set()     # shard begins under relocation
+        dd["qseq"] = 0
+
+        def dd_enqueue(priority: int, key: tuple, shards: tuple, fn) -> None:
+            if key in dd["queued_keys"]:
+                return
+            dd["qseq"] += 1
+            dd["queue"].append((priority, dd["qseq"], key, shards, fn))
+            dd["queued_keys"].add(key)
+
+        async def dd_queue_runner(slot: int) -> None:
+            await dd["init_done"].future
+            while True:
+                await delay(0.3, TaskPriority.DATA_DISTRIBUTION_LAUNCH)
+                if buggify.buggify():
+                    # a stalled runner: the other slots must carry the queue
+                    await delay(2.0, TaskPriority.DATA_DISTRIBUTION_LAUNCH)
+                best = None
+                for item in sorted(dd["queue"]):
+                    _p, _s, _k, shards, _fn = item
+                    if not (set(shards) & dd["busy_shards"]):
+                        best = item
+                        break
+                if best is None:
+                    continue
+                dd["queue"].remove(best)
+                priority, _seq, key, shards, fn = best
+                dd["busy_shards"] |= set(shards)
+                try:
+                    await fn()
+                except error.FDBError as exc:
+                    # the op re-validates against the live map; a stale
+                    # decision (shard gone, team changed) drops out here
+                    TraceEvent("DDQueueOpFailed", id=self.salt).detail(
+                        "Key", str(key)).detail("Reason", exc.name).log()
+                finally:
+                    dd["busy_shards"] -= set(shards)
+                    # only NOW may the key re-enqueue: releasing at dequeue
+                    # would let the decision loops queue a duplicate that
+                    # re-applies a finished op (e.g. growing a team past
+                    # the configured replication)
+                    dd["queued_keys"].discard(key)
+
         async def dd_tracker() -> None:
-            """Shard size tracking + split/merge decisions, the
-            DataDistributionTracker loop: poll each team's byte sample,
-            split the largest over-threshold shard at its sample median
-            onto a policy-picked fresh team, merge adjacent dwarf shards.
-            One relocation at a time (the move queue's parallelism limit;
-            DataDistributionQueue.actor.cpp)."""
+            """Shard size + write-bandwidth tracking and split/merge
+            DECISIONS (DataDistributionTracker): poll each team's byte
+            sample and applied-write bandwidth; a shard over the size
+            threshold OR the bandwidth threshold (a hot-WRITE shard whose
+            size alone would never trigger) splits at its sample median
+            onto policy-picked spares; adjacent dwarf shards merge.
+            Execution goes through the DD queue."""
             from ..core.knobs import SERVER_KNOBS
             from .storage import STORAGE_METRICS_TOKEN
 
@@ -872,8 +963,6 @@ class MasterServer:
                     # moves and each other's metadata transactions
                     interval = interval / 8
                 await delay(interval, TaskPriority.MOVE_KEYS)
-                if dd["busy"]:
-                    continue
                 tags = list(dd["storage_tags"])
                 teams = _teams_by_begin(tags)
                 ranges = sorted({(b, e) for (_t, b, e, _a) in tags})
@@ -892,32 +981,32 @@ class MasterServer:
                 if not ok:
                     continue
                 split_bytes = SERVER_KNOBS.dd_shard_split_bytes
-                did = False
+                split_bw = SERVER_KNOBS.dd_shard_split_bandwidth
                 for b, e in sorted(ranges, key=lambda r: -metrics[r[0]]["bytes"]):
                     m = metrics[b]
                     k = m.get("split_key")
-                    if m["bytes"] <= split_bytes or not k or not (b < k < e):
+                    hot = (m["bytes"] > split_bytes
+                           or m.get("write_bw", 0.0) > split_bw)
+                    if not hot or not k or not (b < k < e):
                         continue
-                    dests = pick_spares(len(teams[b]))
-                    if not dests:
-                        TraceEvent("ShardSplitNoSpares", id=self.salt).detail(
-                            "Begin", b).log()
-                        break
-                    if dd["busy"]:
-                        break
-                    dd["busy"] = True
-                    try:
-                        await self._split_shard(b, k, dests, dd, dd_db,
-                                                log_client, cstate, ratekeeper)
-                    except error.FDBError as exc:
-                        TraceEvent("ShardSplitFailed", id=self.salt).detail(
-                            "Reason", exc.name).log()
-                    finally:
-                        dd["busy"] = False
-                    did = True
-                    break
-                if did:
-                    continue
+                    n_repl = len(teams[b])
+
+                    def mk_split(b=b, k=k, n_repl=n_repl):
+                        async def run():
+                            dests = pick_spares(n_repl)
+                            if not dests:
+                                TraceEvent("ShardSplitNoSpares",
+                                           id=self.salt).detail("Begin", b).log()
+                                return
+                            dd["reserved"] |= set(dests)
+                            try:
+                                await self._split_shard(b, k, dests, dd, dd_db,
+                                                        log_client, cstate,
+                                                        ratekeeper)
+                            finally:
+                                dd["reserved"] -= set(dests)
+                        return run
+                    dd_enqueue(PRI_SPLIT, ("split", b), (b,), mk_split())
                 merge_bytes = SERVER_KNOBS.dd_shard_merge_bytes
                 if len(ranges) <= self.cfg.n_storage:
                     # merge only what splitting created: the seeded shard
@@ -931,19 +1020,15 @@ class MasterServer:
                             and metrics[b2]["bytes"] < merge_bytes
                             and metrics[b1]["bytes"] + metrics[b2]["bytes"]
                             < split_bytes // 4):
-                        if dd["busy"]:
-                            break
-                        dd["busy"] = True
-                        try:
-                            await self._merge_shards(b1, b2, dd, dd_db,
-                                                     log_client, cstate,
-                                                     ratekeeper)
-                        except error.FDBError as exc:
-                            TraceEvent("ShardMergeFailed", id=self.salt).detail(
-                                "Reason", exc.name).log()
-                        finally:
-                            dd["busy"] = False
-                        break
+
+                        def mk_merge(b1=b1, b2=b2):
+                            async def run():
+                                await self._merge_shards(b1, b2, dd, dd_db,
+                                                         log_client, cstate,
+                                                         ratekeeper)
+                            return run
+                        dd_enqueue(PRI_MERGE, ("merge", b1, b2), (b1, b2),
+                                   mk_merge())
 
         dd["excluded"] = set(cstate_val.excluded)
         exclude_token = EXCLUDE_TOKEN + suffix
@@ -972,22 +1057,37 @@ class MasterServer:
                 if victim is None:
                     break
                 _t, begin, _e, _a = victim
-                team = sorted((t, a) for (t, b2, _e2, a) in tags if b2 == begin)
-                # whole-team drain onto policy-picked spares (spread across
-                # machines; trackExcludedServers + team builder)
+                # join the queue's shard-exclusion discipline (a queued
+                # relocation of this shard finishes first)
+                deadline = 240
+                while begin in dd["busy_shards"] or dd["busy"]:
+                    deadline -= 1
+                    if deadline <= 0:
+                        raise error.client_invalid_operation(
+                            "shard is being relocated; retry later")
+                    await delay(0.5, TaskPriority.MOVE_KEYS)
+                # pick AFTER the wait (the map may have changed) and
+                # RESERVE: a concurrent queued relocation must not land on
+                # the same spare worker
+                team = sorted((t, a) for (t, b2, _e2, a)
+                              in dd["storage_tags"] if b2 == begin)
+                if not team:
+                    continue   # the shard was merged/moved away meanwhile
                 dests = pick_spares(len(team))
                 if not dests:
                     raise error.recruitment_failed(
                         "not enough non-excluded spare workers to drain onto")
-                if dd["busy"]:
-                    raise error.client_invalid_operation("a shard move is already running")
                 dd["busy"] = True
+                dd["busy_shards"].add(begin)
+                dd["reserved"] |= set(dests)
                 try:
                     await self._move_shard(
                         MoveShardRequest(begin=begin, dest_workers=dests),
                         dd, dd_db, log_client, cstate, ratekeeper)
                 finally:
                     dd["busy"] = False
+                    dd["busy_shards"].discard(begin)
+                    dd["reserved"] -= set(dests)
                 moved.append(begin)
             return {"excluded": sorted(dd["excluded"]), "moved": moved}
 
@@ -1001,6 +1101,15 @@ class MasterServer:
         dd_tracker_task = spawn(dd_tracker(), TaskPriority.MOVE_KEYS,
                                 name=f"ddTracker:{self.salt}")
         self.proc.actors.add(dd_tracker_task)
+        from ..core.knobs import SERVER_KNOBS as _SK
+
+        runner_tasks = [
+            spawn(dd_queue_runner(i), TaskPriority.DATA_DISTRIBUTION_LAUNCH,
+                  name=f"ddQueue:{self.salt}.{i}")
+            for i in range(max(1, int(_SK.dd_move_parallelism)))
+        ]
+        for t in runner_tasks:
+            self.proc.actors.add(t)
 
         # -- resolutionBalancing (masterserver.actor.cpp:919-977) -------------
         # Poll resolver row counts; on sustained imbalance, persist new
@@ -1051,37 +1160,48 @@ class MasterServer:
         async def replication_fixer() -> None:
             """Converge every shard's team size to the configured storage
             replication (the DD side of `configure single|double|triple`):
-            one grow/shrink at a time, policy-picked spare destinations."""
+            DECISIONS here, execution through the DD queue at team
+            priority (above load-balancing splits/merges — the reference's
+            unhealthy-team precedence)."""
             await dd["init_done"].future
             while True:
                 await delay(1.5, TaskPriority.MOVE_KEYS)
-                if dd["busy"]:
-                    continue
                 want = storage_repl
                 teams = _teams_by_begin(dd["storage_tags"])
                 for begin in sorted(teams):
                     team = teams[begin]
                     if len(team) == want:
                         continue
-                    dd["busy"] = True
-                    try:
-                        if len(team) < want:
-                            dests = pick_spares(1)
-                            if not dests:
-                                TraceEvent("TeamGrowNoSpares", id=self.salt).detail(
-                                    "Begin", begin).log()
-                                break
-                            await self._grow_team(begin, dests[0], dd, dd_db,
-                                                  log_client, cstate, ratekeeper)
-                        else:
-                            await self._shrink_team(begin, dd, dd_db,
-                                                    log_client, cstate, ratekeeper)
-                    except error.FDBError as exc:
-                        TraceEvent("TeamFixFailed", id=self.salt).detail(
-                            "Reason", exc.name).log()
-                    finally:
-                        dd["busy"] = False
-                    break
+                    grow = len(team) < want
+
+                    def mk_fix(begin=begin, grow=grow):
+                        async def run():
+                            # re-validate: the decision may be stale by the
+                            # time a runner slot frees (another fix ran, a
+                            # split re-teamed the shard)
+                            cur = _teams_by_begin(dd["storage_tags"]).get(begin)
+                            if cur is None or len(cur) == want                                     or (len(cur) < want) != grow:
+                                return
+                            if grow:
+                                dests = pick_spares(1)
+                                if not dests:
+                                    TraceEvent("TeamGrowNoSpares",
+                                               id=self.salt).detail(
+                                        "Begin", begin).log()
+                                    return
+                                dd["reserved"] |= set(dests)
+                                try:
+                                    await self._grow_team(begin, dests[0], dd,
+                                                          dd_db, log_client,
+                                                          cstate, ratekeeper)
+                                finally:
+                                    dd["reserved"] -= set(dests)
+                            else:
+                                await self._shrink_team(begin, dd, dd_db,
+                                                        log_client, cstate,
+                                                        ratekeeper)
+                        return run
+                    dd_enqueue(PRI_TEAM, ("team", begin), (begin,), mk_fix())
 
         async def resolution_balancing() -> None:
             from .resolver import RESOLUTION_METRICS_TOKEN
@@ -1182,6 +1302,8 @@ class MasterServer:
             balance_task.cancel()
             conf_task.cancel()
             fixer_task.cancel()
+            for t in runner_tasks:
+                t.cancel()
             self.proc.unregister(rate_token)
             self.proc.unregister(status_token)
             self.proc.unregister(move_token)

@@ -301,6 +301,11 @@ class StorageServer:
         #: the sample, never from scanning the dataset
         self.byte_sample: Dict[Key, int] = {}
         self.sampled_bytes: int = 0
+        #: write-bandwidth sample (StorageMetrics' bytesPerKSecond role):
+        #: bytes of applied mutations since the last DD poll; the tracker
+        #: divides by the poll gap for a rate
+        self._bw_bytes: int = 0
+        self._bw_last_poll: float = 0.0
         self._disk = disk
         self._update_task = None
         self._tokens = [GET_VALUE_TOKEN, GET_KEY_VALUES_TOKEN, WATCH_VALUE_TOKEN,
@@ -593,9 +598,17 @@ class StorageServer:
             self.sampled_bytes -= self.byte_sample.pop(k)
 
     async def storage_metrics(self, _req) -> dict:
-        """Per-shard size estimate + a median split point from the byte
-        sample (the DD tracker's WaitMetrics/SplitMetrics, reduced to
-        polling; reference: StorageMetrics.actor.h)."""
+        """Per-shard size estimate, a median split point from the byte
+        sample, and the applied-write bandwidth since the last poll (the
+        DD tracker's WaitMetrics/SplitMetrics + bytesPerKSecond, reduced
+        to polling; reference: StorageMetrics.actor.h)."""
+        from ..sim.loop import now as _now
+
+        t = _now()
+        gap = max(t - self._bw_last_poll, 1e-6)
+        write_bw = self._bw_bytes / gap if self._bw_last_poll else 0.0
+        self._bw_bytes = 0
+        self._bw_last_poll = t
         split = None
         if self.byte_sample:
             keys = sorted(self.byte_sample)
@@ -613,6 +626,7 @@ class StorageServer:
             "begin": self.shard.begin,
             "end": self.shard.end,
             "bytes": self.sampled_bytes,
+            "write_bw": write_bw,
             "mutations": self.stats.as_dict().get("mutations", 0),
             "split_key": split,
         }
@@ -784,6 +798,7 @@ class StorageServer:
             if not unbounded and not self.shard.contains(m.param1):
                 return (0, b"", None)    # straggler for a shrunk-away range
             self.store.set(m.param1, m.param2, version)
+            self._bw_bytes += len(m.param1) + len(m.param2)
             self._sample_set(m.param1, m.param2)
             self._fire_watches(m.param1, m.param2)
             return (0, m.param1, m.param2)
@@ -796,6 +811,7 @@ class StorageServer:
             if b >= e:
                 return (0, b"", None)
             self.store.clear_range(b, e, version)
+            self._bw_bytes += len(b) + len(e)
             self._sample_clear(b, e)
             for k in [k for k in self._watches if b <= k < e]:
                 self._fire_watches(k, None)
@@ -806,6 +822,7 @@ class StorageServer:
             existing = await self._existing_value(m.param1, version)
             new = apply_atomic_op(m.type, existing, m.param2)
             self.store.set(m.param1, new, version)
+            self._bw_bytes += len(m.param1) + len(new)
             self._sample_set(m.param1, new)
             self._fire_watches(m.param1, new)
             return (0, m.param1, new)

@@ -1227,3 +1227,34 @@ class ThroughputWorkload(TestWorkload):
                 pass
         self.ctx.count("throughput_txns", done)
         self.ctx.count("txns_per_sec", round(done / (now() - t0), 1))
+
+
+class FullClusterRebootWorkload(TestWorkload):
+    """The restarting-test shape (tests/restarting/ + SaveAndKill.actor.cpp):
+    after `delay_before`, REBOOT-kill EVERY process in the cluster at once —
+    coordinators included. The whole database must re-form from disks alone
+    (coordination registers, tlog queues + spill, the storage LSM engines),
+    and the surrounding workloads' invariants must hold across the gap."""
+
+    name = "FullClusterReboot"
+    anti_quiescence = True
+
+    async def start(self, db: Database) -> None:
+        from ..sim.simulator import KillType
+
+        if self.ctx.client_id != 0:
+            return
+        await delay(float(self.ctx.options.get("delay_before", 6.0)))
+        cluster = self.ctx.cluster
+        sim = cluster.sim
+        for p in getattr(cluster, "coord_procs", []) + cluster.worker_procs:
+            if p.alive:
+                sim.kill_process(p, KillType.REBOOT)
+        self.ctx.count("full_reboots")
+        rounds = int(self.ctx.options.get("rounds", 1))
+        for _ in range(rounds - 1):
+            await delay(float(self.ctx.options.get("interval", 12.0)))
+            for p in getattr(cluster, "coord_procs", []) + cluster.worker_procs:
+                if p.alive:
+                    sim.kill_process(p, KillType.REBOOT)
+            self.ctx.count("full_reboots")

@@ -1,0 +1,66 @@
+"""BUGGIFY breadth + coverage harvest (VERDICT r4 #4).
+
+The reference's correctness runs depend on fault-injection sites actually
+FIRING across seeds (flow/coveragetool harvests which did). This harvest
+runs a diverse spec battery across seeds in one process and asserts a
+healthy majority of the statically-declared sim-reachable sites fired —
+a site that never fires under a grinder battery is dead weight, and a
+shrinking count flags accidentally disabled injection."""
+import re
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from foundationdb_tpu.core import buggify
+from foundationdb_tpu.testing.specs import SPECS
+from foundationdb_tpu.testing.workload import run_spec
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def static_sites():
+    """(file, line) of every buggify.buggify() call in the tree."""
+    out = []
+    pkg = REPO / "foundationdb_tpu"
+    for path in pkg.rglob("*.py"):
+        for i, line in enumerate(path.read_text().splitlines(), 1):
+            if "buggify.buggify()" in line and "def " not in line:
+                out.append((str(path), i))
+    return out
+
+
+def test_site_count_floor():
+    """At least 60 sites (the round-4 ask; the reference has 182)."""
+    sites = static_sites()
+    assert len(sites) >= 60, f"only {len(sites)} BUGGIFY sites"
+
+
+BATTERY = [
+    ("DurableCycleAttrition", 11), ("DurableCycleAttrition", 17),
+    ("DataDistributionAttrition", 12), ("CycleTestRestart", 13),
+    ("MultiProxyAttrition", 14), ("CycleLogSubsets", 15),
+    ("BackupCorrectness", 16), ("DiskAttrition", 18),
+]
+
+
+def test_coverage_harvest_battery():
+    buggify.fired.clear()
+    for name, seed in BATTERY:
+        res = run_spec(SPECS[name](), seed)
+        assert res.ok, (name, seed)
+    fired_lines = {(f, l) for (f, l) in buggify.fired}
+    total = static_sites()
+    # real-transport sites can only fire in real mode; everything else is
+    # sim-reachable
+    reachable = [(f, l) for (f, l) in total if "/real/" not in f]
+    hit = [s for s in reachable if s in fired_lines]
+    missed = sorted(set(reachable) - fired_lines)
+    # a majority bar, not an every-site bar: per-seed activation is 25%,
+    # so full coverage needs far more seeds than CI affords — the runner
+    # CLI covers that; here the bar catches systemic breakage
+    frac = len(hit) / max(len(reachable), 1)
+    assert frac >= 0.5, (
+        f"only {len(hit)}/{len(reachable)} sim-reachable BUGGIFY sites "
+        f"fired across the battery; never fired: "
+        f"{[(Path(f).name, l) for f, l in missed][:20]}")

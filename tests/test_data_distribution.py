@@ -139,6 +139,78 @@ def test_cleared_shards_merge_back(dd_knobs):
     assert drive(sim, read_all()) == []
 
 
+def test_hot_write_shard_splits_on_bandwidth(monkeypatch):
+    """DataDistributionQueue (VERDICT r4 #9): a shard hammered with
+    OVERWRITES never grows in bytes, but its applied-write bandwidth must
+    trigger a split — and concurrent relocations stay within the
+    configured parallelism."""
+    from foundationdb_tpu.server.masterserver import MasterServer
+    from foundationdb_tpu.sim.loop import delay
+
+    monkeypatch.setitem(SERVER_KNOBS._values, "dd_shard_split_bytes", 10**9)
+    monkeypatch.setitem(SERVER_KNOBS._values, "dd_shard_split_bandwidth", 2_000)
+    monkeypatch.setitem(SERVER_KNOBS._values, "dd_shard_merge_bytes", 0)
+    monkeypatch.setitem(SERVER_KNOBS._values, "dd_tracker_interval", 1.0)
+    monkeypatch.setitem(SERVER_KNOBS._values, "dd_byte_sample_factor", 64)
+    monkeypatch.setitem(SERVER_KNOBS._values, "dd_move_parallelism", 2)
+
+    # instrument: the concurrent-relocation high-water mark
+    conc = {"now": 0, "max": 0}
+    for name in ("_split_shard", "_merge_shards", "_grow_team"):
+        orig = getattr(MasterServer, name)
+
+        def wrap(orig=orig):
+            async def run(self, *a, **k):
+                conc["now"] += 1
+                conc["max"] = max(conc["max"], conc["now"])
+                try:
+                    return await orig(self, *a, **k)
+                finally:
+                    conc["now"] -= 1
+            return run
+        monkeypatch.setattr(MasterServer, name, wrap())
+
+    cfg = DynamicClusterConfig()
+    cfg.n_workers = getattr(cfg, "n_workers", 8) + 4
+    c = build_dynamic_cluster(seed=104, cfg=cfg)
+    sim = c.sim
+    db = c.new_client()
+
+    async def hammer():
+        # overwrite the same keys: size flat, bandwidth hot
+        for round_ in range(120):
+            async def w(tr, round_=round_):
+                for i in range(12):
+                    tr.set(b"hotw/%02d" % i, VAL + b"%04d.%03d" % (i, round_))
+            await db.run(w)
+            await delay(0.2)
+        return True
+
+    async def wait_boot():
+        while True:
+            doc = await db.get_status()
+            if doc is not None and doc.get("data", {}).get("shards"):
+                return True
+            await delay(0.5)
+
+    assert drive(sim, wait_boot(), until=120.0)
+    before = drive(sim, shard_ranges(c))
+    t = sim.sched.spawn(hammer(), name="hammer")
+    assert sim.run_until(t, until=600.0)
+    after = drive(sim, shard_ranges(c))
+    assert len(after) > len(before), (
+        f"hot-write shard never split on bandwidth: {before} -> {after}")
+    assert conc["max"] <= 2, f"relocation parallelism exceeded: {conc['max']}"
+
+    async def read_all():
+        async def r(tr):
+            return await tr.get_range(b"hotw/", b"hotw/\xff")
+        return await db.run(r)
+
+    got = drive(sim, read_all())
+    assert [k for k, _v in got] == [b"hotw/%02d" % i for i in range(12)]
+
+
 def test_merge_keeps_writes_committed_during_fetch(dd_knobs, monkeypatch):
     """Writes committed while extend_shard's paged fetch is in flight land
     in the absorbed range AFTER the fetch snapshot version: without the
