@@ -1,0 +1,197 @@
+"""fdbmonitor: the process supervisor for real clusters.
+
+Re-design of fdbmonitor/fdbmonitor.cpp (:267 Command struct, fd watching,
+conf hot-reload): a plain (non-scheduler) daemon that reads an ini-style
+conf, spawns one real.node process per [node.PORT] section, restarts dead
+children with exponential backoff (reset after a stable-uptime window),
+re-reads the conf on mtime change (added sections spawn, removed sections
+stop, changed sections restart), and tears everything down on SIGTERM.
+
+    python -m foundationdb_tpu.real.monitor --conf cluster.conf
+
+conf format:
+
+    [general]
+    coordinators = 127.0.0.1:4500,127.0.0.1:4501,127.0.0.1:4502
+    datadir = /var/lib/fdb_tpu
+    workers = 4
+    engine = native
+
+    [node.4500]
+    cc_priority = 0
+
+    [node.4501]
+    cc_priority = 1
+"""
+from __future__ import annotations
+
+import argparse
+import configparser
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, Optional
+
+INITIAL_BACKOFF = 1.0
+MAX_BACKOFF = 60.0
+#: uptime after which a child's backoff resets (fdbmonitor's
+#: restart_backoff reset window)
+STABLE_SECONDS = 10.0
+
+
+class Child:
+    def __init__(self, section: str, argv: list):
+        self.section = section
+        self.argv = argv
+        self.proc: Optional[subprocess.Popen] = None
+        self.backoff = INITIAL_BACKOFF
+        self.started_at = 0.0
+        self.restart_at = 0.0   # 0 = running or start now
+
+    def spawn(self, log_dir: str) -> None:
+        log = open(os.path.join(log_dir, f"{self.section}.log"), "ab")
+        try:
+            self.proc = subprocess.Popen(
+                self.argv, stdout=log, stderr=subprocess.STDOUT)
+        finally:
+            log.close()   # the child holds its own dup; keeping ours open
+            #               would leak one fd per restart of a crash-looper
+        self.started_at = time.monotonic()
+        self.restart_at = 0.0
+        print(f"fdbmonitor: started {self.section} (pid {self.proc.pid})",
+              flush=True)
+
+    def stop(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+        self.proc = None
+
+
+def parse_conf(path: str):
+    cp = configparser.ConfigParser()
+    cp.read(path)
+    if "general" not in cp:
+        raise ValueError(f"{path}: missing [general] section")
+    g = cp["general"]
+    coordinators = g.get("coordinators")
+    datadir = g.get("datadir")
+    workers = g.getint("workers")
+    if not coordinators or not datadir or workers is None:
+        raise ValueError(
+            f"{path}: [general] must set coordinators, datadir, workers")
+    engine = g.get("engine", "native")
+    nodes: Dict[str, list] = {}
+    for section in cp.sections():
+        if not section.startswith("node."):
+            continue
+        port = section[len("node."):]
+        s = cp[section]
+        argv = [
+            sys.executable, "-m", "foundationdb_tpu.real.node",
+            "--port", port,
+            "--coordinators", coordinators,
+            "--datadir", os.path.join(datadir, port),
+            "--workers", str(workers),
+            "--engine", s.get("engine", engine),
+        ]
+        if s.get("cc_priority") is not None:
+            argv += ["--cc-priority", s.get("cc_priority")]
+        nodes[section] = argv
+    return datadir, nodes
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="process supervisor (fdbmonitor)")
+    ap.add_argument("--conf", required=True)
+    ap.add_argument("--once", action="store_true",
+                    help="exit when every child has exited (testing)")
+    args = ap.parse_args(argv)
+
+    datadir, node_argvs = parse_conf(args.conf)
+    os.makedirs(datadir, exist_ok=True)
+    log_dir = os.path.join(datadir, "logs")
+    os.makedirs(log_dir, exist_ok=True)
+    children: Dict[str, Child] = {}
+    conf_mtime = os.path.getmtime(args.conf)
+    stopping = {"flag": False}
+
+    def on_term(_sig, _frm):
+        stopping["flag"] = True
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+
+    for section, node_argv in node_argvs.items():
+        c = Child(section, node_argv)
+        c.spawn(log_dir)
+        children[section] = c
+
+    while not stopping["flag"]:
+        time.sleep(0.5)
+        now = time.monotonic()
+        # conf hot-reload (fdbmonitor's kqueue/inotify, reduced to mtime)
+        try:
+            mt = os.path.getmtime(args.conf)
+        except OSError:
+            mt = conf_mtime
+        if mt != conf_mtime:
+            conf_mtime = mt
+            try:
+                _dd, new_argvs = parse_conf(args.conf)
+            except (ValueError, configparser.Error) as e:
+                # a half-written or malformed conf must never take the
+                # supervisor down; keep running on the previous config
+                print(f"fdbmonitor: conf reload failed ({e}); keeping old",
+                      flush=True)
+                continue
+            for section in list(children):
+                if section not in new_argvs:
+                    print(f"fdbmonitor: section {section} removed; stopping",
+                          flush=True)
+                    children.pop(section).stop()
+                elif children[section].argv != new_argvs[section]:
+                    print(f"fdbmonitor: section {section} changed; restarting",
+                          flush=True)
+                    children[section].stop()
+                    children[section].argv = new_argvs[section]
+                    children[section].backoff = INITIAL_BACKOFF
+                    children[section].spawn(log_dir)
+            for section, node_argv in new_argvs.items():
+                if section not in children:
+                    c = Child(section, node_argv)
+                    c.spawn(log_dir)
+                    children[section] = c
+        # child liveness + backoff restarts
+        any_alive = False
+        for c in children.values():
+            if c.proc is not None and c.proc.poll() is None:
+                any_alive = True
+                if now - c.started_at > STABLE_SECONDS:
+                    c.backoff = INITIAL_BACKOFF
+                continue
+            if c.proc is not None:
+                rc = c.proc.returncode
+                c.proc = None
+                c.restart_at = now + c.backoff
+                print(f"fdbmonitor: {c.section} exited rc={rc}; "
+                      f"restart in {c.backoff:.1f}s", flush=True)
+                c.backoff = min(c.backoff * 2, MAX_BACKOFF)
+            if c.restart_at and now >= c.restart_at:
+                c.spawn(log_dir)
+                any_alive = True
+        if args.once and not any_alive:
+            break
+
+    for c in children.values():
+        c.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

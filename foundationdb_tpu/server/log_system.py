@@ -117,6 +117,7 @@ class LogSystemConfig:
             "lock": tlog_mod.LOCK_TOKEN,
             "kcv": tlog_mod.KCV_TOKEN,
             "recovery": tlog_mod.RECOVERY_DATA_TOKEN,
+            "queue_info": tlog_mod.QUEUE_INFO_TOKEN,
         }[kind]
         addr, suffix = replica
         return Endpoint(addr, base + suffix)
@@ -164,10 +165,11 @@ class LogSystemClient:
             for req, rep in zip(reqs, self.config.tlogs)
         ])
         # sim-only durability oracle (fdbrpc/sim_validation.h): this push
-        # fully acked, so no future recovery may pick a version below it
+        # fully acked, so no recovery of THIS generation may pick a
+        # version below it
         from ..sim import validation as sim_validation
 
-        sim_validation.advance_max_committed(version)
+        sim_validation.advance_max_committed(self.config.gen_id, version)
         # Every replica is durable at `version`: advance the peek horizon.
         # Unreliable one-ways — the next push carries the same KCV anyway.
         # BUGGIFY: drop them entirely; peeks must survive on the belt

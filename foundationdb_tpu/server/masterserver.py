@@ -502,10 +502,12 @@ class MasterServer:
                         self.net, self.proc.address, old_cfg
                     )
                     # durability oracle: the recovery version must cover
-                    # every fully-acked push (sim_validation.h:20-50)
+                    # every fully-acked push to the generation we locked
+                    # (sim_validation.h:20-50)
                     from ..sim import validation as sim_validation
 
-                    sim_validation.check_restored_version(recovery_version)
+                    sim_validation.check_restored_version(
+                        old_cfg.gen_id, recovery_version)
                     preload, preload_popped = await fetch_recovery_data(
                         self.net, self.proc.address, old_cfg, locked_reps,
                         recovery_version,
@@ -683,6 +685,7 @@ class MasterServer:
         ratekeeper = Ratekeeper(
             self.net, self.proc.address, storage_tags,
             lambda: self.master.version,
+            log_config=new_log,
         )
         rate_token = GET_RATE_INFO_TOKEN + suffix
         self.proc.register(rate_token, ratekeeper.get_rate_info)

@@ -46,6 +46,16 @@ class StorageQueueInfo:
 
 
 @dataclass
+class TLogQueueInfo:
+    """One tlog replica's queue state (the reference's TLogQueueInfo):
+    in-memory index bytes + the spill-tier debt a slow consumer built."""
+
+    mem_bytes: int = 0
+    spilled_version: int = 0
+    version: int = 0
+
+
+@dataclass
 class GetRateInfoRequest:
     proxy_id: str
 
@@ -58,13 +68,18 @@ class GetRateInfoReply:
 class Ratekeeper:
     """Polls storage; computes the cluster TPS limit (rateKeeper:509)."""
 
-    def __init__(self, net, src_addr: str, storage_tags, committed_version_fn):
+    def __init__(self, net, src_addr: str, storage_tags, committed_version_fn,
+                 log_config=None):
         self.net = net
         self.src = src_addr
         self.storage_tags = storage_tags            # (tag, begin, end, addr)
         self.committed_version_fn = committed_version_fn
+        #: LogSystemConfig of the serving generation: the tlog queue-depth
+        #: signal polls its replicas (None = storage signals only)
+        self.log_config = log_config
         self.tps_limit: float = float(SERVER_KNOBS.max_transactions_per_second)
         self.worst_lag: int = 0
+        self.worst_tlog_bytes: int = 0
 
     async def run(self) -> None:
         from ..core import buggify
@@ -77,50 +92,85 @@ class Ratekeeper:
                 # cluster state moves — metering must degrade gracefully
                 tick = interval * 10
             await delay(tick, TaskPriority.RATEKEEPER)
-            infos: List[StorageQueueInfo] = []
-            for tag, _b, _e, addr in self.storage_tags:
-                try:
-                    info = await self.net.request(
-                        self.src, Endpoint(addr, STORAGE_QUEUE_INFO_TOKEN), None,
-                        TaskPriority.RATEKEEPER, timeout=interval * 2,
+            # concurrent polls: a partition must cost ONE timeout window,
+            # not one per unreachable replica (the published rate would
+            # otherwise go stale by many update intervals)
+            s_futs = [
+                self.net.request(
+                    self.src, Endpoint(addr, STORAGE_QUEUE_INFO_TOKEN), None,
+                    TaskPriority.RATEKEEPER, timeout=interval * 2,
+                )
+                for _tag, _b, _e, addr in self.storage_tags
+            ]
+            t_futs = []
+            if self.log_config is not None:
+                t_futs = [
+                    self.net.request(
+                        self.src, self.log_config.ep(rep, "queue_info"),
+                        None, TaskPriority.RATEKEEPER, timeout=interval * 2,
                     )
+                    for rep in self.log_config.tlogs
+                ]
+            infos: List[StorageQueueInfo] = []
+            for f in s_futs:
+                try:
+                    infos.append(await f)
                 except error.FDBError:
                     continue  # an unreachable storage doesn't stall the loop
-                infos.append(info)
-            self.tps_limit = self._update_rate(infos)
+            tlog_infos: List[TLogQueueInfo] = []
+            for f in t_futs:
+                try:
+                    tlog_infos.append(await f)
+                except error.FDBError:
+                    continue
+            self.tps_limit = self._update_rate(infos, tlog_infos)
 
-    def _update_rate(self, infos: List[StorageQueueInfo]) -> float:
-        """The core of updateRate: the worst storage FETCH lag (committed -
-        applied version: how far the update loop trails the tlogs) and the
-        worst un-durable queue depth each map to a TPS limit; the minimum
-        wins. Durable-version lag is NOT a signal — the durability cycle
-        trails by storage_durability_lag_versions on purpose (the MVCC
-        window lives above the engine), exactly like the reference's
-        updateStorage (updateRate:251-430 throttles on queue bytes and
-        version lag, not on durability's designed offset)."""
+    def _update_rate(self, infos: List[StorageQueueInfo],
+                     tlog_infos: Optional[List[TLogQueueInfo]] = None) -> float:
+        """The core of updateRate (Ratekeeper.actor.cpp:251-430): three
+        signals, the minimum wins —
+          * worst storage FETCH lag (committed - applied: how far the
+            update loop trails the tlogs);
+          * worst storage un-durable queue depth (overlay bytes above the
+            engine);
+          * worst TLOG queue depth (in-memory index bytes — a tlog buried
+            in spill debt is exactly the signal the spill tier used to
+            hide from admission control; round-4 weak #8).
+        Durable-version lag is NOT a signal — the durability cycle trails
+        by storage_durability_lag_versions on purpose."""
         max_tps = float(SERVER_KNOBS.max_transactions_per_second)
-        if not infos:
-            return max_tps
-        committed = self.committed_version_fn()
-        self.worst_lag = max(max(0, committed - i.version) for i in infos)
-        tps_lag = max_tps
-        if self.worst_lag >= MAX_STORAGE_LAG_VERSIONS:
-            tps_lag = 1.0   # never fully zero: progress lets the lag drain
-        elif self.worst_lag > TARGET_STORAGE_LAG_VERSIONS:
-            frac = (MAX_STORAGE_LAG_VERSIONS - self.worst_lag) / (
-                MAX_STORAGE_LAG_VERSIONS - TARGET_STORAGE_LAG_VERSIONS
-            )
-            tps_lag = max(1.0, max_tps * frac)
-        worst_bytes = max(i.queue_bytes for i in infos)
-        target_b = SERVER_KNOBS.target_storage_queue_bytes
-        spring_b = SERVER_KNOBS.spring_storage_queue_bytes
-        tps_bytes = max_tps
-        if worst_bytes >= target_b:
-            tps_bytes = 1.0
-        elif worst_bytes > target_b - spring_b:
-            frac = (target_b - worst_bytes) / spring_b
-            tps_bytes = max(1.0, max_tps * frac)
-        return min(tps_lag, tps_bytes)
+        tps_lag = tps_bytes = max_tps
+        if infos:   # no storage reply = no storage signal; the TLOG signal
+            #         below must still bite (a buried tlog during a storage
+            #         partition is exactly when admission must slow)
+            committed = self.committed_version_fn()
+            self.worst_lag = max(max(0, committed - i.version) for i in infos)
+            if self.worst_lag >= MAX_STORAGE_LAG_VERSIONS:
+                tps_lag = 1.0  # never fully zero: progress drains the lag
+            elif self.worst_lag > TARGET_STORAGE_LAG_VERSIONS:
+                frac = (MAX_STORAGE_LAG_VERSIONS - self.worst_lag) / (
+                    MAX_STORAGE_LAG_VERSIONS - TARGET_STORAGE_LAG_VERSIONS
+                )
+                tps_lag = max(1.0, max_tps * frac)
+            worst_bytes = max(i.queue_bytes for i in infos)
+            target_b = SERVER_KNOBS.target_storage_queue_bytes
+            spring_b = SERVER_KNOBS.spring_storage_queue_bytes
+            if worst_bytes >= target_b:
+                tps_bytes = 1.0
+            elif worst_bytes > target_b - spring_b:
+                frac = (target_b - worst_bytes) / spring_b
+                tps_bytes = max(1.0, max_tps * frac)
+        tps_tlog = max_tps
+        if tlog_infos:
+            self.worst_tlog_bytes = max(t.mem_bytes for t in tlog_infos)
+            target_t = SERVER_KNOBS.target_tlog_queue_bytes
+            spring_t = max(target_t // 2, 1)
+            if self.worst_tlog_bytes >= target_t:
+                tps_tlog = 1.0
+            elif self.worst_tlog_bytes > target_t - spring_t:
+                frac = (target_t - self.worst_tlog_bytes) / spring_t
+                tps_tlog = max(1.0, max_tps * frac)
+        return min(tps_lag, tps_bytes, tps_tlog)
 
     async def get_rate_info(self, req: GetRateInfoRequest) -> GetRateInfoReply:
         from ..core import buggify

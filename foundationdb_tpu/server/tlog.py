@@ -44,6 +44,7 @@ POP_TOKEN = "tlog.pop"
 LOCK_TOKEN = "tlog.lock"
 KCV_TOKEN = "tlog.knownCommitted"
 RECOVERY_DATA_TOKEN = "tlog.recoveryData"
+QUEUE_INFO_TOKEN = "tlog.queueInfo"
 
 FSYNC_SECONDS = 0.0005
 
@@ -111,6 +112,7 @@ class TLog:
             "lock": LOCK_TOKEN + token_suffix,
             "kcv": KCV_TOKEN + token_suffix,
             "recovery": RECOVERY_DATA_TOKEN + token_suffix,
+            "queue_info": QUEUE_INFO_TOKEN + token_suffix,
         }
         proc.register(self.tokens["commit"], self.commit)
         proc.register(self.tokens["peek"], self.peek)
@@ -118,6 +120,7 @@ class TLog:
         proc.register(self.tokens["lock"], self.lock)
         proc.register(self.tokens["kcv"], self.advance_known_committed)
         proc.register(self.tokens["recovery"], self.recovery_data)
+        proc.register(self.tokens["queue_info"], self.queue_info)
 
     def unregister(self) -> None:
         for tok in self.tokens.values():
@@ -478,6 +481,15 @@ class TLog:
             stop_f.remove_callback(wake)
         if self.version.get() < version:
             raise error.tlog_stopped("locked while awaiting version")
+
+    async def queue_info(self, _req):
+        """Queue depth for the ratekeeper (the reference's TLogQueueInfo
+        via getQueuingMetrics): in-memory index bytes + spill watermark."""
+        from .ratekeeper import TLogQueueInfo
+
+        return TLogQueueInfo(mem_bytes=self._mem_bytes,
+                             spilled_version=self.spilled_version,
+                             version=self.version.get())
 
     async def advance_known_committed(self, req: TLogKnownCommittedRequest) -> None:
         """The proxy reports all replicas acked `version` (the reference

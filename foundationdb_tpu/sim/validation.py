@@ -19,40 +19,68 @@ from __future__ import annotations
 from typing import List, Tuple
 
 _enabled = False
-_max_committed: int = 0
-#: (recovery_version, max_committed_at_check) for every violation seen
-violations: List[Tuple[int, int]] = []
+#: per-GENERATION acked-push watermark: gen_id -> max fully-acked version.
+#: Scoped by generation (recovery_count, master_salt — globally unique in
+#: a sim), because (a) the min(end) invariant binds a recovery to the
+#: generation it LOCKED, and (b) one simulation can host several clusters
+#: (backup/DR specs) whose version chains are unrelated
+_max_committed: dict = {}
+#: gen_id -> the recovery version its epoch END chose: any LATER
+#: fully-acked push above it is a zombie ack (a deposed generation's
+#: straggler completing after recovery discarded those versions)
+_recovered: dict = {}
+#: (gen_id, recovery_version, max_committed_at_check) per violation
+violations: List[Tuple] = []
 
 
 def enable() -> None:
     """Arm the oracle (the simulator's constructor calls this)."""
-    global _enabled, _max_committed
+    global _enabled
     _enabled = True
-    _max_committed = 0
+    _max_committed.clear()
+    _recovered.clear()
     violations.clear()
 
 
 def disable() -> None:
     global _enabled
     _enabled = False
+    _max_committed.clear()
+    _recovered.clear()
 
 
-def advance_max_committed(version: int) -> None:
-    """A commit's log-system push fully acked at `version` (the durability
-    point recovery must honor). No-op outside simulation."""
-    global _max_committed
-    if _enabled and version > _max_committed:
-        _max_committed = version
+def advance_max_committed(gen_id, version: int) -> None:
+    """A commit's log-system push to generation `gen_id` fully acked at
+    `version` (the durability point recovery must honor). An ack landing
+    ABOVE a recovery that already ended this generation's epoch is itself
+    a violation (zombie push: the commit is acked, the versions are
+    discarded — the durable-tlog-lock bug's exact shape). No-op outside
+    simulation."""
+    if not _enabled:
+        return
+    if version > _max_committed.get(gen_id, 0):
+        _max_committed[gen_id] = version
+    rec = _recovered.get(gen_id)
+    if rec is not None and version > rec:
+        violations.append((gen_id, rec, version))
 
 
-def check_restored_version(recovery_version: int) -> None:
-    """An epoch-end recovery chose `recovery_version`: it must cover every
-    fully-acked push (all-ack means any locked replica bounds it from
-    above, so min(end) over the locked set can never be below a completed
-    push — if it is, the lock/recovery math lost acknowledged data)."""
-    if _enabled and recovery_version < _max_committed:
-        violations.append((recovery_version, _max_committed))
+def check_restored_version(gen_id, recovery_version: int) -> None:
+    """An epoch-end recovery of generation `gen_id` chose
+    `recovery_version`: it must cover every fully-acked push to that
+    generation (all-ack means any locked replica bounds it from above, so
+    min(end) over the locked set can never be below a completed push — if
+    it is, the lock/recovery math lost acknowledged data)."""
+    if not _enabled:
+        return
+    if recovery_version < _max_committed.get(gen_id, 0):
+        violations.append((gen_id, recovery_version, _max_committed[gen_id]))
+    prev = _recovered.get(gen_id)
+    if prev is None or recovery_version < prev:
+        # min over competing recoveries of the same generation (a lower
+        # later choice is the binding one)
+        _recovered[gen_id] = recovery_version
 
 
-def max_committed() -> int:
-    return _max_committed
+def max_committed(gen_id) -> int:
+    return _max_committed.get(gen_id, 0)

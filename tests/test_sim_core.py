@@ -408,19 +408,55 @@ def test_sim_validation_durability_oracle():
     runner; legal recoveries are silent."""
     from foundationdb_tpu.sim import validation
 
+    g1, g2 = (1, 111), (1, 222)             # two generations / clusters
     validation.enable()
-    assert validation.max_committed() == 0
-    validation.advance_max_committed(500)
-    validation.advance_max_committed(300)   # non-monotone input: ignored
-    assert validation.max_committed() == 500
-    validation.check_restored_version(500)  # exactly covering: legal
-    validation.check_restored_version(600)
+    assert validation.max_committed(g1) == 0
+    validation.advance_max_committed(g1, 500)
+    validation.advance_max_committed(g1, 300)   # non-monotone input: ignored
+    assert validation.max_committed(g1) == 500
+    validation.check_restored_version(g1, 500)  # exactly covering: legal
+    validation.check_restored_version(g1, 600)
+    # PER-GENERATION scope: another cluster's tiny versions are unrelated
+    validation.check_restored_version(g2, 3)
     assert validation.violations == []
-    validation.check_restored_version(499)  # below an acked push: violation
-    assert validation.violations == [(499, 500)]
-    validation.enable()                     # re-arm resets state
-    assert validation.violations == [] and validation.max_committed() == 0
+    validation.check_restored_version(g1, 499)  # below an acked push
+    assert validation.violations == [(g1, 499, 500)]
+    validation.enable()
+    # zombie ack: a push completing ABOVE a recovery that already ended
+    # the generation's epoch (the durable-tlog-lock bug's shape)
+    validation.advance_max_committed(g1, 100)
+    validation.check_restored_version(g1, 100)
+    validation.advance_max_committed(g1, 150)
+    assert validation.violations == [(g1, 100, 150)]
+    validation.enable()                         # re-arm resets state
+    assert validation.violations == [] and validation.max_committed(g1) == 0
     validation.disable()
-    validation.advance_max_committed(900)   # disabled: inert
-    validation.check_restored_version(1)
-    assert validation.violations == [] and validation.max_committed() == 0
+    validation.advance_max_committed(g1, 900)   # disabled: inert
+    validation.check_restored_version(g1, 1)
+    assert validation.violations == [] and validation.max_committed(g1) == 0
+
+
+def test_ratekeeper_throttles_on_tlog_queue_depth():
+    """updateRate's tlog signal (VERDICT r4 weak #8): a tlog buried in
+    queue bytes must pull the TPS limit down even when every storage
+    signal is healthy."""
+    from foundationdb_tpu.core.knobs import SERVER_KNOBS
+    from foundationdb_tpu.server.ratekeeper import (
+        Ratekeeper,
+        StorageQueueInfo,
+        TLogQueueInfo,
+    )
+
+    rk = Ratekeeper(net=None, src_addr="x", storage_tags=[],
+                    committed_version_fn=lambda: 1000)
+    healthy = [StorageQueueInfo(tag=0, version=1000, durable_version=900,
+                                queue_bytes=0)]
+    max_tps = float(SERVER_KNOBS.max_transactions_per_second)
+    assert rk._update_rate(healthy, []) == max_tps
+    target = SERVER_KNOBS.target_tlog_queue_bytes
+    # half-way into the spring: throttled but not floored
+    mid = rk._update_rate(healthy, [TLogQueueInfo(mem_bytes=int(target * 0.8))])
+    assert 1.0 < mid < max_tps
+    # at/over target: floored to minimum admission
+    low = rk._update_rate(healthy, [TLogQueueInfo(mem_bytes=target)])
+    assert low == 1.0

@@ -159,3 +159,22 @@ def test_counters_in_status():
         s.get("counters", {}).get("mutations", 0) for s in doc["storage"]
     )
     assert total_mutations >= 5
+
+
+def test_fdbbackup_personalities():
+    """fdbbackup (backup.actor.cpp:75 personalities): backup, restore-and-
+    verify, and DR-switchover drivers all succeed end-to-end."""
+    import json
+
+    from foundationdb_tpu.tools import fdbbackup
+
+    import io
+    import contextlib
+
+    for personality in ("restore", "dr"):
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = fdbbackup.main([personality, "--seed", "5"])
+        assert rc == 0, buf.getvalue()
+        out = json.loads(buf.getvalue().strip().splitlines()[-1])
+        assert out.get("verified") is True, out
