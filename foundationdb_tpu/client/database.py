@@ -110,12 +110,20 @@ class Database:
         MonitorLeader + openDatabase, NativeAPI/MonitorLeader.actor.cpp)."""
         self.net = net
         self.client_addr = client_addr
+        from ..core.keyrangemap import KeyRangeMap as _KRM
+
         self.proxy_addrs = list(proxy_addrs or [])
         self.coordinator_addrs = list(coordinator_addrs or [])
-        # location cache: sorted [(range, [storage addrs])]
-        self._locations: List[Tuple[KeyRange, List[str]]] = []
+        # location cache: a coalescing KeyRangeMap (the reference's
+        # locationCache KeyRangeMap, NativeAPI.actor.cpp:1028); value =
+        # tuple of storage addrs, None = unknown
+        self._locations = _KRM(default=None)
         # rotates reads across a shard's replica team (loadBalance)
         self._lb_counter: int = 0
+        # QueueModel (fdbrpc/QueueModel.cpp): per-replica latency EWMA +
+        # failure penalty; the preferred replica is the model's best, with
+        # periodic exploration so a recovered replica re-earns traffic
+        self._queue_model: Dict[str, float] = {}
 
     def _proxy(self) -> str:
         rng = current_scheduler().rng
@@ -191,7 +199,7 @@ class Database:
 
     # -- location cache ------------------------------------------------------
     def invalidate_cache(self) -> None:
-        self._locations = []
+        self._locations.clear(default=None)
 
     async def get_locations(self, begin: Key, end: Key) -> List[Tuple[KeyRange, List[str]]]:
         from ..core import buggify
@@ -219,20 +227,14 @@ class Database:
 
     def _cached_locations(self, begin: Key, end: Key) -> Optional[List[Tuple[KeyRange, List[str]]]]:
         out = []
-        at = begin
-        for rng, addrs in self._locations:
-            if rng.begin <= at < rng.end:
-                out.append((rng, addrs))
-                at = rng.end
-                if at >= end:
-                    return out
-        return None
+        for cb, ce, addrs in self._locations.intersecting(begin, end):
+            if addrs is None or ce is None:
+                return None   # a gap: the whole span must re-resolve
+            out.append((KeyRange(cb, ce), list(addrs)))
+        return out or None
 
     def _insert_location(self, rng: KeyRange, addrs: List[str]) -> None:
-        kept = [(r, a) for (r, a) in self._locations if not r.intersects(rng)]
-        kept.append((rng, addrs))
-        kept.sort(key=lambda x: x[0].begin)
-        self._locations = kept
+        self._locations.insert(rng.begin, rng.end, tuple(addrs))
 
     # -- replica load balancing ---------------------------------------------
     async def storage_request(self, addrs: List[str], token: str, req,
@@ -249,20 +251,57 @@ class Database:
         from ..core import buggify
 
         self._lb_counter += 1
-        start = self._lb_counter % len(addrs)
+        # QueueModel ordering: lowest expected latency first; every 8th
+        # request explores round-robin so a slow-marked replica that
+        # recovered re-earns traffic (the reference decays its penalties)
+        if self._lb_counter % 8 == 0 or all(
+            a not in self._queue_model for a in addrs
+        ):
+            start = self._lb_counter % len(addrs)
+            order = [addrs[(start + i) % len(addrs)] for i in range(len(addrs))]
+        else:
+            order = sorted(addrs, key=lambda a: self._queue_model.get(a, 0.0))
         if buggify.buggify():
             # sticky replica preference: all reads pile onto one replica,
             # exercising hedging and server-side shedding instead of the
             # rotation hiding them
-            start = 0
+            order = sorted(addrs)
         to = timeout or REQUEST_TIMEOUT
+        from ..sim.loop import now as _now
+
+        def _observe(addr: str, dt: float) -> None:
+            old_v = self._queue_model.get(addr, dt)
+            self._queue_model[addr] = 0.75 * old_v + 0.25 * dt
 
         def send(i: int):
-            return self.net.request(
-                self.client_addr,
-                Endpoint(addrs[(start + i) % len(addrs)], token), req,
+            addr = order[i % len(order)]
+            t0 = _now()
+            f = self.net.request(
+                self.client_addr, Endpoint(addr, token), req,
                 priority, timeout=to,
             )
+
+            def done(fut) -> None:
+                if fut.is_error:
+                    try:
+                        fut.get()
+                    except error.FDBError as e:
+                        if e.code in (_MAYBE_DELIVERED, _CONNECTION_FAILED):
+                            # transport loss: heavy penalty pushes the
+                            # replica back until it recovers
+                            _observe(addr, to)
+                        else:
+                            # wrong_shard/future_version etc. came from a
+                            # LIVE replica answering promptly: its latency
+                            # is the reply time, not a penalty
+                            _observe(addr, _now() - t0)
+                    except BaseException:
+                        pass
+                else:
+                    _observe(addr, _now() - t0)
+
+            f.on_ready(done)
+            return f
 
         if hedge and len(addrs) > 1:
             from ..sim.actors import any_of, ready_or_error
@@ -280,7 +319,7 @@ class Database:
                 except error.FDBError as e:
                     if e.code not in (_MAYBE_DELIVERED, _CONNECTION_FAILED):
                         raise
-                start += 1
+                order = order[1:] + order[:1]
             else:
                 # slow replica: race a hedge on the next one
                 second = send(1)
@@ -302,7 +341,7 @@ class Database:
                 except error.FDBError as e:
                     if e.code not in (_MAYBE_DELIVERED, _CONNECTION_FAILED):
                         raise
-                start += 2
+                order = order[2:] + order[:2]
 
         last: Optional[error.FDBError] = None
         for i in range(len(addrs)):

@@ -31,12 +31,16 @@ class Counter:
 
 
 class CounterCollection:
-    """reference: CounterCollection + traceCounters (flow/Stats.h:112)."""
+    """reference: CounterCollection + traceCounters (flow/Stats.h:112).
+    With `tdmetrics` attached (a TDMetricCollection), every periodic
+    trace also records each counter's level into the time-series registry
+    — one hookup instruments every role for the MetricLogger."""
 
-    def __init__(self, role: str, id: object = None):
+    def __init__(self, role: str, id: object = None, tdmetrics=None):
         self.role = role
         self.id = id
         self.counters: Dict[str, Counter] = {}
+        self.tdmetrics = tdmetrics
 
     def counter(self, name: str) -> Counter:
         c = self.counters.get(name)
@@ -55,6 +59,9 @@ class CounterCollection:
         for name, c in sorted(self.counters.items()):
             ev.detail(name, c.value)
             ev.detail(f"{name}Rate", round(c.rate_since_last(dt), 2))
+            if self.tdmetrics is not None:
+                mid = f".{self.id}" if self.id is not None else ""
+                self.tdmetrics.int64(f"{self.role}{mid}.{name}").set(c.value)
         ev.log()
 
     async def run_logger(self, interval: float = 5.0):

@@ -231,8 +231,15 @@ class Proxy:
         self._metadata_version = start_version
         self._last_batch_time = 0.0
         self._commit_queue: PromiseStream = PromiseStream()
-        #: reference: ProxyStats (MasterProxyServer.actor.cpp:48-80)
-        self.stats = CounterCollection("Proxy", proc.address)
+        #: reference: ProxyStats (MasterProxyServer.actor.cpp:48-80);
+        #: counters ALSO feed the per-process TDMetric time-series, which
+        #: a MetricLogger can persist into \xff/metrics/
+        from ..core.tdmetric import TDMetricCollection
+        from ..sim.loop import now as _sim_now
+
+        self.tdmetrics = TDMetricCollection(now=_sim_now)
+        self.stats = CounterCollection("Proxy", proc.address,
+                                       tdmetrics=self.tdmetrics)
         #: ratekeeper admission (transactionStarter:947): GRVs are released
         #: from a budget replenished at tps_limit per second
         self._tps_limit: float = float("inf")

@@ -308,6 +308,9 @@ class ActorCollection:
         for t in tasks:
             t.cancel()
 
+    def __len__(self) -> int:
+        return len(self._tasks)
+
 
 async def recurring(fn: Callable[[], None], interval: float, priority: int = TaskPriority.DEFAULT_DELAY):
     """Call fn every `interval` seconds forever (flow: recurring)."""

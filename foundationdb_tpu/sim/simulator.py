@@ -134,6 +134,14 @@ class Simulator:
             if other.address != proc.address:
                 self.net.clog_pair(proc.address, other.address, seconds)
 
+    def start_system_monitor(self, interval: float = 5.0):
+        """Spawn the per-process gauge sampler (flow/SystemMonitor.cpp's
+        role); returns the task."""
+        from .system_monitor import system_monitor
+
+        return self.sched.spawn(system_monitor(self, interval),
+                                TaskPriority.LOW, name="systemMonitor")
+
     # -- running --------------------------------------------------------------
     def run(self, until: Optional[float] = None) -> None:
         self.sched.run(until=until)
