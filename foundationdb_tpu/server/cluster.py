@@ -151,6 +151,17 @@ class DynamicClusterConfig:
     #: interval; tests lower them to provoke rebalances quickly
     rebalance_min_rows: int = 200
     rebalance_interval: float = 5.0
+    #: multi-region (reference: region config in SimulatedCluster:706,
+    #: satellite tlogs + DC-preference recovery): workers/coordinators are
+    #: spread over n_dcs datacenters; satellite_logs tlog replicas are
+    #: placed OUTSIDE the primary DC (synchronous satellites — dc0's total
+    #: loss still leaves a complete log); recruitment prefers the DC with
+    #: the most live workers, so losing the primary FAILS OVER
+    n_dcs: int = 1
+    satellite_logs: int = 0
+    #: extra one-way latency between processes in different DCs (the
+    #: DCN tier; 0 keeps single-region runs byte-identical)
+    inter_dc_latency: float = 0.0
     engine_factory: Callable = OracleConflictEngine
 
 
@@ -188,14 +199,31 @@ class DynamicCluster:
         self.sim = sim
         self.cfg = cfg or DynamicClusterConfig()
 
+        ndc = max(1, self.cfg.n_dcs)
+        if self.cfg.inter_dc_latency:
+            sim.net.inter_dc_latency = self.cfg.inter_dc_latency
+
         def coord_boot(simu, proc):
             async def go():
                 await CoordinationServer.create(proc, simu.disk_for(proc.address))
             return go()
 
+        # coordinator MAJORITY outside the primary DC (dc0) for ANY
+        # coordinator count: losing dc0 entirely must leave a coordination
+        # quorum (the reference's 3-site coordinator guidance). The first
+        # floor(n/2)+1 coordinators round-robin over the non-primary DCs;
+        # the remainder live in dc0.
+        nco = self.cfg.n_coordinators
+        if ndc > 1:
+            maj = nco // 2 + 1
+            non_primary = [f"dc{d}" for d in range(1, ndc)]
+            coord_dcs = [non_primary[i % len(non_primary)] for i in range(maj)]
+            coord_dcs += ["dc0"] * (nco - maj)
+        else:
+            coord_dcs = ["dc0"] * nco
         self.coord_procs = [
-            sim.new_process(f"coord{i}", boot_fn=coord_boot)
-            for i in range(self.cfg.n_coordinators)
+            sim.new_process(f"coord{i}", boot_fn=coord_boot, dc_id=coord_dcs[i])
+            for i in range(nco)
         ]
         self.coordinators = [p.address for p in self.coord_procs]
 
@@ -208,7 +236,8 @@ class DynamicCluster:
             return boot
 
         self.worker_procs = [
-            sim.new_process(f"worker{i}", boot_fn=worker_boot(i))
+            sim.new_process(f"worker{i}", boot_fn=worker_boot(i),
+                            dc_id=f"dc{i % ndc}")
             for i in range(self.cfg.n_workers)
         ]
         self._n_clients = 0

@@ -232,11 +232,29 @@ class ClusterController:
         # RecruitFromConfiguration before starting a master).
         min_workers = min(self.cluster_cfg.n_workers,
                           self.cluster_cfg.n_storage + 2)
+        ndc = max(1, getattr(self.cluster_cfg, "n_dcs", 1))
+        dc_grace_until = None
         while True:
             candidates = self._alive_workers()
             if len(candidates) < min_workers:
                 await delay(0.5, TaskPriority.CLUSTER_CONTROLLER)
                 continue
+            # placement quality: wait (bounded) for the FULL fleet — and in
+            # multi-region, for every DC — to register before recruiting a
+            # master; a partial registry places tlogs/satellites/teams
+            # blind. The bound keeps dead workers or a dead DC from
+            # wedging recovery (failover recruits with whoever is left).
+            dcs = {self.worker_locality.get(a, ("", "dc0"))[1]
+                   for a in candidates}
+            complete = (len(candidates) >= self.cluster_cfg.n_workers
+                        and len(dcs) >= ndc)
+            if not complete:
+                if dc_grace_until is None:
+                    dc_grace_until = now() + 5.0
+                if now() < dc_grace_until:
+                    await delay(0.5, TaskPriority.CLUSTER_CONTROLLER)
+                    continue
+            dc_grace_until = None
             # Prefer not to co-locate the master with the CC when possible
             # (the reference's fitness preference, reduced to its core).
             others = [a for a in candidates if a != self.proc.address]

@@ -548,20 +548,66 @@ class MasterServer:
         # process-class fitness, reduced to storage-vs-stateless).
         alive = [w for w in self.workers if not self.net.monitor.is_failed(w)]
         n_storage_workers = cfg.n_storage * storage_repl
-        if first_boot:
+        if first_boot or not (prev.storage_tags if prev else ()):
             storage_workers = sorted(alive)[-n_storage_workers:]
         else:
             storage_workers = sorted({t[3] for t in prev.storage_tags})
-        workers = [w for w in alive if w not in storage_workers] or alive
-        if len(workers) < 1:
+        # Multi-region (SimulatedCluster:706 region config): the PRIMARY
+        # DC is wherever the most live workers are — when dc0 dies
+        # wholesale, the next recovery recruits the transaction system in
+        # the surviving DC (DC-preference failover); satellites below keep
+        # the log reachable across that flip.
+        def dc_of(w: str) -> str:
+            loc = self.localities.get(w)
+            return loc[1] if loc else "dc0"
+
+        txn_pool = [w for w in alive if w not in storage_workers] or alive
+        by_dc: Dict[str, List[str]] = {}
+        for w in sorted(txn_pool):
+            by_dc.setdefault(dc_of(w), []).append(w)
+        if not by_dc:
+            # typed failure the recovery loop retries — an IndexError here
+            # would crash the master actor instead
             raise error.recruitment_failed("no live workers")
+        primary_dc = sorted(by_dc, key=lambda d: (-len(by_dc[d]), d))[0]
+        primary_workers = by_dc[primary_dc]
+        workers = primary_workers + [w for d in sorted(by_dc)
+                                     if d != primary_dc for w in by_dc[d]]
         gen_id = (rc, self.salt)
         suffix = f":{rc}.{self.salt}"
 
         def pick(n: int, offset: int) -> List[str]:
-            return [workers[(offset + i) % len(workers)] for i in range(n)]
+            # wrap WITHIN the primary DC: resolvers/proxies must not spill
+            # into the secondary just because tlogs consumed the primary
+            # prefix (co-location beats a cross-DC hop on every commit)
+            pool = primary_workers or workers
+            return [pool[(offset + i) % len(pool)] for i in range(n)]
 
         tlog_addrs = pick(n_tlogs, 0)
+        # satellite tlog replicas OUTSIDE the primary DC: the commit
+        # quorum spans DCs, so total primary loss cannot lose acked data
+        # (the reference's synchronous satellite logs)
+        n_sat = min(int(getattr(cfg, "satellite_logs", 0)),
+                    max(n_tlogs - 1, 0))
+        if n_sat > 0:
+            if log_repl:
+                # partitioned tags can exclude the satellite index from a
+                # tag's subset, voiding the durability point of satellites;
+                # this generation runs unpartitioned instead
+                TraceEvent("SatelliteForcesFullLogReplication",
+                           id=self.salt).log()
+                log_repl = 0
+            kept = tlog_addrs[: n_tlogs - n_sat]
+            sat_pool = [w for w in workers
+                        if dc_of(w) != primary_dc and w not in kept]
+            sats = sat_pool[:n_sat]
+            if sats:
+                tlog_addrs = kept + sats
+        TraceEvent("RecruitPlacement", id=self.salt).detail(
+            "PrimaryDC", primary_dc).detail(
+            "TLogDCs", str([dc_of(a) for a in tlog_addrs])).detail(
+            "TxnPoolDCs", str(sorted((d, len(ws)) for d, ws in by_dc.items()))).detail(
+            "Localities", len(self.localities)).log()
         resolver_addrs = pick(n_resolvers, n_tlogs)
         n_proxies = max(1, conf_proxies)
         proxy_addrs = pick(n_proxies, n_tlogs + n_resolvers)
@@ -614,7 +660,11 @@ class MasterServer:
         # workers (storage tokens are per-process, and same-worker replicas
         # would share a fault domain anyway).
         repl = storage_repl
-        if first_boot:
+        # seed when there IS no storage map — including the crash window
+        # where a previous first-boot master locked the cstate but died
+        # before the WRITING_CSTATE hand-over (its seeded servers, if any,
+        # are re-initialized idempotently by tag)
+        if first_boot or not prev.storage_tags:
             storage_shards = KeyShardMap.uniform(cfg.n_storage)
             if len(storage_workers) < cfg.n_storage * repl:
                 raise error.recruitment_failed(
@@ -623,11 +673,20 @@ class MasterServer:
                 )
             storage_tags = []
             tag = 0
+            # team placement: spread each shard's replicas across DCs when
+            # there are several (a dc-wide loss keeps every shard served),
+            # else across machines (DDTeamCollection's policy ladder)
+            from .replication_policy import PolicyAcross
+
+            field = "dc_id" if getattr(cfg, "n_dcs", 1) > 1 else "machine_id"
+            pool = list(storage_workers)
             for s in range(cfg.n_storage):
                 begin = storage_shards.begins[s]
                 end = storage_shards.span_end(s) or b"\xff\xff\xff"
-                for r in range(repl):
-                    addr = storage_workers[(s * repl + r) % len(storage_workers)]
+                team = (PolicyAcross(repl, field).select(pool, self.localities)
+                        if repl > 1 else None) or pool[:repl]
+                for addr in team:
+                    pool.remove(addr)
                     await self._init_role(addr, INIT_STORAGE_TOKEN,
                                           InitializeStorageRequest(tag=tag, begin=begin, end=end))
                     storage_tags.append((tag, begin, end, addr))

@@ -66,6 +66,9 @@ class SimNetwork:
         # (src, dst) -> virtual time until which packets are held (SimClogging)
         self._clogged_until: Dict[Tuple[str, str], float] = {}
         self._partitioned: Set[Tuple[str, str]] = set()
+        #: extra one-way latency between processes in DIFFERENT DCs (the
+        #: DCN tier of a multi-region topology; 0 = single-region exact)
+        self.inter_dc_latency: float = 0.0
 
     # -- topology ------------------------------------------------------------
     def add_process(self, proc: SimProcess) -> None:
@@ -94,6 +97,10 @@ class SimNetwork:
         if (src, dst) in self._partitioned:
             return None
         base = self.sched.time + self._latency()
+        if self.inter_dc_latency:
+            ps, pd = self.processes.get(src), self.processes.get(dst)
+            if ps is not None and pd is not None and ps.dc_id != pd.dc_id:
+                base += self.inter_dc_latency
         clog = self._clogged_until.get((src, dst), 0.0)
         return max(base, clog) - self.sched.time
 

@@ -1258,3 +1258,37 @@ class FullClusterRebootWorkload(TestWorkload):
                 if p.alive:
                     sim.kill_process(p, KillType.REBOOT)
             self.ctx.count("full_reboots")
+
+
+class DatacenterKillWorkload(TestWorkload):
+    """Kill EVERY process of one datacenter at once (the multi-region
+    failure the topology exists to survive): coordinators, txn roles, and
+    storage replicas of `dc` all go down; with satellite logs + cross-DC
+    teams + a surviving coordinator majority, the next recovery recruits
+    in the other DC and nothing acknowledged is lost. With `revive` the
+    DC returns later (REBOOT), rejoining as the secondary."""
+
+    name = "DatacenterKill"
+    anti_quiescence = True
+
+    async def start(self, db: Database) -> None:
+        from ..sim.simulator import KillType
+
+        if self.ctx.client_id != 0:
+            return
+        await delay(float(self.ctx.options.get("delay_before", 5.0)))
+        dc = self.ctx.options.get("dc", "dc0")
+        cluster = self.ctx.cluster
+        sim = cluster.sim
+        victims = [p for p in (getattr(cluster, "coord_procs", [])
+                               + cluster.worker_procs)
+                   if p.alive and p.dc_id == dc]
+        for p in victims:
+            sim.kill_process(p, KillType.KILL_INSTANTLY)
+        self.ctx.count("dc_killed", len(victims))
+        revive_after = float(self.ctx.options.get("revive_after", 0.0))
+        if revive_after > 0:
+            await delay(revive_after)
+            for p in victims:
+                sim.revive_process(p)
+            self.ctx.count("dc_revived")

@@ -1,0 +1,107 @@
+"""Multi-region v0 (VERDICT r4 missing #4, reduced to the load-bearing
+shape): DC-spread workers/coordinators, a satellite tlog replica outside
+the primary DC, cross-DC storage teams, DCN latency on inter-DC hops, and
+DC-preference recovery — the primary datacenter dying WHOLESALE fails the
+transaction system over to the survivor without losing anything acked.
+reference: TagPartitionedLogSystem satellites, LogRouter's role, region
+config in SimulatedCluster.actor.cpp:706."""
+import pytest
+
+from foundationdb_tpu.core import error
+from foundationdb_tpu.server.cluster import (
+    DynamicClusterConfig,
+    build_dynamic_cluster,
+)
+from foundationdb_tpu.sim.loop import delay
+from foundationdb_tpu.sim.simulator import KillType
+
+REGION_CFG = dict(n_workers=10, n_coordinators=5, n_tlogs=3, satellite_logs=1,
+                  n_resolvers=2, n_storage=2, storage_replication=2,
+                  n_dcs=2, inter_dc_latency=0.003)
+
+
+def test_dc0_loss_fails_over_and_loses_nothing():
+    c = build_dynamic_cluster(seed=511, cfg=DynamicClusterConfig(**REGION_CFG))
+    sim = c.sim
+    db = c.new_client()
+    out = {}
+
+    async def scenario():
+        # committed data BEFORE the outage — some of it acked milliseconds
+        # before the kill
+        for i in range(20):
+            async def w(tr, i=i):
+                tr.set(b"r/%03d" % i, b"v%d" % i)
+            await db.run(w)
+
+        victims = [p for p in (c.coord_procs + c.worker_procs)
+                   if p.alive and p.dc_id == "dc0"]
+        assert victims, "no dc0 processes?"
+        for p in victims:
+            sim.kill_process(p, KillType.KILL_INSTANTLY)
+        t_kill = sim.sched.time
+        out["killed"] = len(victims)
+
+        # while dc0 is DOWN: the cluster must recover in dc1 and serve both
+        # reads (cross-DC storage replicas) and writes (satellite log held
+        # the acked history; new generation recruits in dc1)
+        async def rw(tr):
+            got = await tr.get(b"r/000")
+            assert got == b"v0", got
+            tr.set(b"r/after", b"survived")
+        while True:
+            try:
+                await db.run(rw)
+                break
+            except error.FDBError:
+                await delay(0.5)
+        out["failover_seconds"] = round(sim.sched.time - t_kill, 2)
+
+        # read back EVERYTHING acked pre-outage, from dc1 replicas only
+        async def readall(tr):
+            return await tr.get_range(b"r/", b"r/\xff", limit=1000)
+        rows = await db.run(readall)
+        want = sorted([(b"r/%03d" % i, b"v%d" % i) for i in range(20)]
+                      + [(b"r/after", b"survived")])
+        assert rows == want, rows
+
+        # the DC returns and rejoins as secondary; the database stays exact
+        for p in victims:
+            sim.revive_process(p)
+        await delay(5.0)
+        rows2 = await db.run(readall)
+        assert rows2 == want
+        return True
+
+    assert sim.run_until(sim.sched.spawn(scenario(), name="region"), until=900.0)
+    # failover must complete while dc0 is DOWN (the revive above happens
+    # only after the write succeeded), in bounded time
+    assert out["failover_seconds"] < 60, out
+
+    # the sim_validation oracle rode the whole run: no recovery ever chose
+    # a version below an acked push
+    from foundationdb_tpu.sim import validation
+
+    assert validation.violations == []
+
+
+def test_satellite_placement_spans_dcs():
+    """The recruited generation puts tlog + storage replicas across DCs."""
+    c = build_dynamic_cluster(seed=512, cfg=DynamicClusterConfig(**REGION_CFG))
+    sim = c.sim
+    db = c.new_client()
+
+    async def wait_status():
+        while True:
+            doc = await db.get_status()
+            if doc and doc.get("cluster", {}).get("roles"):
+                return doc
+            await delay(0.5)
+
+    doc = sim.run_until(sim.sched.spawn(wait_status(), name="s"), until=240.0)
+    by_addr = {p.address: p.dc_id for p in c.worker_procs}
+    tlog_dcs = {by_addr[a] for a in doc["cluster"]["roles"]["tlogs"]}
+    assert len(tlog_dcs) == 2, f"no satellite tlog: {tlog_dcs}"
+    for sh in doc["data"]["shards"]:
+        dcs = {by_addr[a] for a in sh["replicas"]}
+        assert len(dcs) == 2, f"storage team not cross-DC: {sh}"
