@@ -1,0 +1,168 @@
+"""IndexedSet: an ordered map with metric sums (order statistics).
+
+Re-design of flow/IndexedSet.h (1114 LoC): a balanced search tree where
+every node carries a METRIC and every subtree its metric sum, so
+"total metric", "metric of everything below k", and "the key where the
+running metric crosses m" are O(log n) — the primitives behind the
+reference's byte samples and range accounting (StorageMetrics,
+KeyRangeMap's metric uses).
+
+Implementation: a treap with DETERMINISTIC priorities (a hash of the
+key), so tree shape — and thus iteration cost and any tie-sensitive
+query — is identical across runs and processes (the repo's determinism
+rule; a random-priority treap would not be)."""
+from __future__ import annotations
+
+import zlib
+from typing import Iterator, List, Optional, Tuple
+
+from .types import key_after
+
+
+class _Node:
+    __slots__ = ("key", "metric", "prio", "left", "right", "sum")
+
+    def __init__(self, key: bytes, metric: int):
+        self.key = key
+        self.metric = metric
+        # deterministic pseudo-priority from the key bytes
+        self.prio = zlib.crc32(key, 0x9E3779B9)
+        self.left: Optional[_Node] = None
+        self.right: Optional[_Node] = None
+        self.sum = metric
+
+    def pull(self) -> None:
+        s = self.metric
+        if self.left is not None:
+            s += self.left.sum
+        if self.right is not None:
+            s += self.right.sum
+        self.sum = s
+
+
+def _split(n: Optional[_Node], key: bytes) -> Tuple[Optional[_Node], Optional[_Node]]:
+    """(everything < key, everything >= key)."""
+    if n is None:
+        return None, None
+    if n.key < key:
+        a, b = _split(n.right, key)
+        n.right = a
+        n.pull()
+        return n, b
+    a, b = _split(n.left, key)
+    n.left = b
+    n.pull()
+    return a, n
+
+
+def _merge(a: Optional[_Node], b: Optional[_Node]) -> Optional[_Node]:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    if a.prio >= b.prio:
+        a.right = _merge(a.right, b)
+        a.pull()
+        return a
+    b.left = _merge(a, b.left)
+    b.pull()
+    return b
+
+
+class IndexedSet:
+    def __init__(self) -> None:
+        self._root: Optional[_Node] = None
+        self._n = 0
+
+    def __len__(self) -> int:
+        return self._n
+
+    def total(self) -> int:
+        return self._root.sum if self._root is not None else 0
+
+    def get(self, key: bytes) -> Optional[int]:
+        n = self._root
+        while n is not None:
+            if key == n.key:
+                return n.metric
+            n = n.left if key < n.key else n.right
+        return None
+
+    def insert(self, key: bytes, metric: int) -> Optional[int]:
+        """Set key's metric; returns the previous metric (None if new)."""
+        old = self.erase(key)
+        node = _Node(key, metric)
+        a, b = _split(self._root, key)
+        self._root = _merge(_merge(a, node), b)
+        self._n += 1
+        return old
+
+    def erase(self, key: bytes) -> Optional[int]:
+        """Remove key; returns its metric (None if absent)."""
+        a, rest = _split(self._root, key)
+        mid, b = _split(rest, key_after(key))
+        self._root = _merge(a, b)
+        if mid is None:
+            return None
+        self._n -= 1
+        return mid.metric
+
+    def erase_range(self, begin: bytes, end: bytes) -> int:
+        """Remove every key in [begin, end); returns the erased metric sum."""
+        a, rest = _split(self._root, begin)
+        mid, b = _split(rest, end)
+        self._root = _merge(a, b)
+        if mid is None:
+            return 0
+        # count erased nodes
+        def count(n):
+            return 0 if n is None else 1 + count(n.left) + count(n.right)
+        self._n -= count(mid)
+        return mid.sum
+
+    def sum_below(self, key: bytes) -> int:
+        """Metric sum of every entry with key < `key` (sumTo)."""
+        n = self._root
+        acc = 0
+        while n is not None:
+            if n.key < key:
+                acc += n.metric
+                if n.left is not None:
+                    acc += n.left.sum
+                n = n.right
+            else:
+                n = n.left
+        return acc
+
+    def split_key(self) -> Optional[bytes]:
+        """The FIRST key (ascending) whose inclusive prefix sum doubles to
+        at least the total — the byte-sample median split point
+        (StorageMetrics' splitEstimate)."""
+        total = self.total()
+        if total <= 0 or self._root is None:
+            return None
+        n = self._root
+        acc = 0   # metric strictly left of the current subtree
+        best: Optional[bytes] = None
+        while n is not None:
+            left_sum = n.left.sum if n.left is not None else 0
+            inclusive = acc + left_sum + n.metric
+            if 2 * inclusive >= total:
+                best = n.key
+                n = n.left
+            else:
+                acc += left_sum + n.metric
+                n = n.right
+        return best
+
+    def items(self) -> Iterator[Tuple[bytes, int]]:
+        """Ascending (key, metric) pairs (iterative; no recursion limit)."""
+        stack: List[_Node] = []
+        n = self._root
+        while stack or n is not None:
+            while n is not None:
+                stack.append(n)
+                n = n.left
+            n = stack.pop()
+            yield n.key, n.metric
+            n = n.right

@@ -299,8 +299,12 @@ class StorageServer:
         #: each written key is sampled with probability size/FACTOR and
         #: carries weight FACTOR — total bytes and split points come from
         #: the sample, never from scanning the dataset
-        self.byte_sample: Dict[Key, int] = {}
-        self.sampled_bytes: int = 0
+        from ..core.indexedset import IndexedSet
+
+        #: order-statistic byte sample (flow/IndexedSet.h backing
+        #: StorageMetrics): metric sums give the total and the median
+        #: split key in O(log n), not a per-poll sort
+        self.byte_sample = IndexedSet()
         #: write-bandwidth sample (StorageMetrics' bytesPerKSecond role):
         #: bytes of applied mutations since the last DD poll; the tracker
         #: divides by the poll gap for a rate
@@ -581,21 +585,23 @@ class StorageServer:
         from ..core.knobs import SERVER_KNOBS
         from ..sim.loop import current_scheduler
 
-        old = self.byte_sample.pop(key, 0)
-        self.sampled_bytes -= old
         if value is None:
+            self.byte_sample.erase(key)
             return
         size = len(key) + len(value)
         factor = max(1, SERVER_KNOBS.dd_byte_sample_factor)
         # deterministic per seed: the sim RNG drives sampling
         if size >= factor or current_scheduler().rng.random01() < size / factor:
-            w = max(size, factor)
-            self.byte_sample[key] = w
-            self.sampled_bytes += w
+            self.byte_sample.insert(key, max(size, factor))   # replaces
+        else:
+            self.byte_sample.erase(key)   # re-rolled OUT of the sample
+
+    @property
+    def sampled_bytes(self) -> int:
+        return self.byte_sample.total()
 
     def _sample_clear(self, begin: Key, end: Key) -> None:
-        for k in [k for k in self.byte_sample if begin <= k < end]:
-            self.sampled_bytes -= self.byte_sample.pop(k)
+        self.byte_sample.erase_range(begin, end)
 
     async def storage_metrics(self, _req) -> dict:
         """Per-shard size estimate, a median split point from the byte
@@ -609,18 +615,11 @@ class StorageServer:
         write_bw = self._bw_bytes / gap if self._bw_last_poll else 0.0
         self._bw_bytes = 0
         self._bw_last_poll = t
-        split = None
-        if self.byte_sample:
-            keys = sorted(self.byte_sample)
-            total = sum(self.byte_sample[k] for k in keys)
-            acc = 0
-            for k in keys:
-                acc += self.byte_sample[k]
-                if acc * 2 >= total:
-                    # a split at the very first key would produce an empty
-                    # lower half; shard begin is excluded
-                    split = k if k > self.shard.begin else None
-                    break
+        split = self.byte_sample.split_key()
+        if split is not None and split <= self.shard.begin:
+            # a split at the very first key would produce an empty lower
+            # half; shard begin is excluded
+            split = None
         return {
             "tag": self.tag,
             "begin": self.shard.begin,

@@ -22,6 +22,8 @@ import enum
 import heapq
 from typing import Any, Callable, Coroutine, List, Optional
 
+import time as _wall
+
 from ..core import error
 from ..core.error import FDBError
 from ..core.rng import DeterministicRandom
@@ -277,6 +279,11 @@ class Scheduler:
         self._seq = 0
         self._stopped = False
         self.tasks_run = 0
+        #: slow-task profiling (flow/Profiler.actor.cpp's slow-task side):
+        #: a single cooperative step burning more WALL time than this
+        #: blocks the whole world — trace it. 0 disables.
+        self.slow_task_threshold: float = 0.0
+        self.slow_tasks: List = []   # (virtual_time, wall_seconds, fn_name)
 
     # -- core queue ---------------------------------------------------------
     def at(self, when: float, fn: Callable[[], None], priority: int = TaskPriority.DEFAULT_DELAY) -> None:
@@ -313,9 +320,40 @@ class Scheduler:
             heapq.heappop(self._queue)
             self.time = when
             self.tasks_run += 1
-            fn()
+            if self.slow_task_threshold > 0.0:
+                t0 = _wall.perf_counter()
+                fn()
+                dt = _wall.perf_counter() - t0
+                if dt >= self.slow_task_threshold:
+                    self._trace_slow_task(dt, fn)
+            else:
+                fn()
             if max_tasks is not None and self.tasks_run >= max_tasks:
                 return
+
+    def _trace_slow_task(self, wall_seconds: float, fn) -> None:
+        """Record + trace a cooperative step that hogged the (real) CPU —
+        the deterministic world's analog of the reference's SlowTask
+        profiling (FLOW_KNOBS->SLOWTASK_PROFILING_*): one long step stalls
+        every simulated process at once."""
+        name = getattr(fn, "__qualname__", None) or repr(fn)
+        closure = getattr(fn, "__closure__", None)
+        if closure:   # the step lambda closes over the Task: name it
+            for cell in closure:
+                try:
+                    obj = cell.cell_contents
+                except ValueError:
+                    continue   # unbound cell: a crash here would abort
+                    #            the whole run loop for a LOG line
+                if isinstance(obj, Task):
+                    name = f"task:{obj.name}"
+                    break
+        self.slow_tasks.append((self.time, wall_seconds, name))
+        del self.slow_tasks[:-100]
+        from ..core.trace import TraceEvent
+
+        TraceEvent("SlowTask").detail("WallSeconds", round(wall_seconds, 4)).detail(
+            "Fn", name).log()
 
     def run_until(self, fut: Future, until: Optional[float] = None) -> Any:
         """Drive the loop until `fut` resolves; returns its value."""

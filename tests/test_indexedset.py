@@ -1,0 +1,78 @@
+"""IndexedSet (flow/IndexedSet.h): ordered map with metric sums, O(log n)
+totals / prefix sums / median split. Deterministic treap priorities so
+tree shape is identical across runs. Backs the storage byte sample."""
+import random
+
+from foundationdb_tpu.core.indexedset import IndexedSet
+
+
+def test_basic_ops_and_sums():
+    s = IndexedSet()
+    assert s.total() == 0 and len(s) == 0 and s.split_key() is None
+    s.insert(b"b", 10)
+    s.insert(b"d", 30)
+    s.insert(b"a", 5)
+    assert s.total() == 45 and len(s) == 3
+    assert s.get(b"d") == 30 and s.get(b"zz") is None
+    assert s.sum_below(b"d") == 15
+    assert s.insert(b"d", 7) == 30      # replace returns old
+    assert s.total() == 22
+    assert s.erase(b"a") == 5 and s.erase(b"a") is None
+    assert list(s.items()) == [(b"b", 10), (b"d", 7)]
+    assert s.erase_range(b"a", b"z") == 17
+    assert s.total() == 0 and len(s) == 0
+
+
+def test_split_key_matches_linear_rule():
+    """split_key == first ascending key whose inclusive prefix sum doubles
+    to >= total (the byte-sample median the storage server used to find
+    with a full sort)."""
+    rng = random.Random(5)
+    for _ in range(50):
+        s = IndexedSet()
+        model = {}
+        for _k in range(rng.randrange(1, 60)):
+            k = b"%04d" % rng.randrange(200)
+            w = rng.randrange(1, 500)
+            s.insert(k, w)
+            model[k] = w
+        total = sum(model.values())
+        acc = 0
+        want = None
+        for k in sorted(model):
+            acc += model[k]
+            if acc * 2 >= total:
+                want = k
+                break
+        assert s.split_key() == want
+        assert s.total() == total
+        # prefix sums agree everywhere
+        for probe in sorted(model)[:10]:
+            assert s.sum_below(probe) == sum(
+                v for k, v in model.items() if k < probe)
+
+
+def test_randomized_vs_model_with_range_erase():
+    rng = random.Random(9)
+    s = IndexedSet()
+    model = {}
+    for _ in range(500):
+        r = rng.random()
+        if r < 0.55:
+            k = b"%04d" % rng.randrange(150)
+            w = rng.randrange(1, 100)
+            assert s.insert(k, w) == model.get(k)
+            model[k] = w
+        elif r < 0.8:
+            k = b"%04d" % rng.randrange(150)
+            assert s.erase(k) == model.pop(k, None)
+        else:
+            a, b = sorted([b"%04d" % rng.randrange(150),
+                           b"%04d" % rng.randrange(150)])
+            want = sum(v for k, v in model.items() if a <= k < b)
+            assert s.erase_range(a, b) == want
+            for k in [k for k in model if a <= k < b]:
+                del model[k]
+        assert s.total() == sum(model.values())
+        assert len(s) == len(model)
+    assert list(s.items()) == sorted(model.items())

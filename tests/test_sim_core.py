@@ -494,3 +494,27 @@ def test_system_monitor_emits_process_metrics():
     assert {"Address", "Actors", "Handlers", "DiskBytes", "Reboots"} <= set(sample)
     # a coordinator's durable registers give it a non-zero disk footprint
     assert any(e["DiskBytes"] > 0 for e in procs)
+
+
+def test_slow_task_profiler():
+    """The slow-task side of flow/Profiler.actor.cpp: a cooperative step
+    that burns real CPU stalls the whole simulated world — the scheduler
+    traces it with the owning task's name."""
+    import time as wall
+
+    from foundationdb_tpu.sim.simulator import Simulator
+
+    sim = Simulator(seed=5)
+    sim.sched.slow_task_threshold = 0.02
+
+    async def hog():
+        t0 = wall.perf_counter()
+        while wall.perf_counter() - t0 < 0.05:
+            pass   # a synchronous stretch no other actor can preempt
+        return True
+
+    assert sim.run_until(sim.sched.spawn(hog(), name="cpuHog"), until=5.0)
+    assert sim.sched.slow_tasks, "slow step not detected"
+    _vt, dt, name = sim.sched.slow_tasks[-1]
+    assert dt >= 0.02
+    assert "cpuHog" in name, name
