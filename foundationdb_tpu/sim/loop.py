@@ -338,14 +338,20 @@ class Scheduler:
         every simulated process at once."""
         name = getattr(fn, "__qualname__", None) or repr(fn)
         closure = getattr(fn, "__closure__", None)
-        if closure:   # the step lambda closes over the Task: name it
-            for cell in closure:
+        code = getattr(fn, "__code__", None)
+        if closure and code is not None:
+            # the step lambda closes over the RUNNING Task as 'task'; it
+            # may also close over 'fut' — which is itself a Task when the
+            # step resumed from awaiting one, so match cells by freevar
+            # name rather than taking the first Task-typed cell (cells
+            # are ordered alphabetically: 'fut' would win)
+            for var, cell in zip(code.co_freevars, closure):
                 try:
                     obj = cell.cell_contents
                 except ValueError:
                     continue   # unbound cell: a crash here would abort
                     #            the whole run loop for a LOG line
-                if isinstance(obj, Task):
+                if var == "task" and isinstance(obj, Task):
                     name = f"task:{obj.name}"
                     break
         self.slow_tasks.append((self.time, wall_seconds, name))

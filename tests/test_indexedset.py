@@ -52,6 +52,27 @@ def test_split_key_matches_linear_rule():
                 v for k, v in model.items() if k < probe)
 
 
+def test_degenerate_priority_chain_no_recursion(monkeypatch):
+    """Monotone priorities make the treap a pure chain; every op must
+    still work (the implementation is iterative — a recursive treap
+    would RecursionError here long before 20k nodes)."""
+    import foundationdb_tpu.core.indexedset as mod
+    counter = iter(range(10 ** 9))
+    monkeypatch.setattr(mod, "_priority", lambda key: next(counter))
+    s = IndexedSet()
+    n = 20000
+    for i in range(n):
+        s.insert(b"%06d" % i, 1)
+    assert len(s) == n and s.total() == n
+    assert s.sum_below(b"%06d" % (n // 2)) == n // 2
+    assert s.split_key() == b"%06d" % (n // 2 - 1)
+    assert s.get(b"%06d" % (n - 1)) == 1
+    assert s.erase(b"%06d" % 3) == 1
+    assert s.erase_range(b"%06d" % 100, b"%06d" % 15000) == 14900
+    assert len(s) == n - 1 - 14900
+    assert next(iter(s.items())) == (b"000000", 1)
+
+
 def test_randomized_vs_model_with_range_erase():
     rng = random.Random(9)
     s = IndexedSet()
