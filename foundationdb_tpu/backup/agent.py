@@ -57,6 +57,9 @@ async def claim_backup_tag(tr) -> int:
     return tag
 
 
+BLOBSTORE_SCHEME = "blobstore://"
+
+
 class BackupAgent:
     def __init__(self, sim, db: Database, container_addr: str):
         self.sim = sim
@@ -68,19 +71,84 @@ class BackupAgent:
         self.end_version: Optional[int] = None
         self._log_floor: Optional[int] = None
         self._mover = None
+        self._mover_error: Optional[BaseException] = None
+        # container_addr is either a process address hosting BlobContainer
+        # endpoints (sim and real transport alike), or a
+        # "blobstore://host:port" HTTPBlobServer (backup/http_blob.py)
+        # reached over asyncio — the latter only under the RealScheduler,
+        # whose run loop lives inside an asyncio event loop
+        self._http = None
+        self._http_tasks: set = set()
+        if container_addr.startswith(BLOBSTORE_SCHEME):
+            from .http_blob import HTTPBlobClient
+            self._http = HTTPBlobClient(container_addr[len(BLOBSTORE_SCHEME):])
 
     # -- container io --------------------------------------------------------
+    def _aio(self, coro):
+        """Bridge an HTTP container call into a scheduler Future (lazy
+        import: the sim path never touches the real runtime). Deadlines
+        live INSIDE HTTPBlobClient (per attempt, after its connection
+        lock) — a wrapper timeout here would count queue wait behind
+        other transfers against each request's wire-time budget."""
+        from ..real.runtime import aio_to_sim
+
+        return aio_to_sim(self._classify(coro), self._http_tasks)
+
+    async def _classify(self, coro):
+        """Map blob HTTP statuses onto FDBError vocabulary BEFORE the
+        bridge collapses everything else into retryable connection_failed:
+        a 4xx (oversized body, bad request) can never succeed on retry —
+        the mover must die loudly, not re-send the same body forever. A
+        5xx is the server's own transient trouble (momentary ENOSPC, an
+        fsync hiccup answered as 500) and stays retryable, same as a
+        dropped connection at the same moment would be."""
+        from .http_blob import BlobHTTPError
+        try:
+            return await coro
+        except BlobHTTPError as e:
+            if 400 <= e.status < 500:
+                raise error.client_invalid_operation(str(e)) from e
+            raise error.connection_failed(str(e)) from e
+
+    def close(self) -> None:
+        """Release the container connection (blobstore:// targets keep a
+        persistent one; the RPC path holds no state)."""
+        if self._http is not None:
+            self._http.close()
+
     async def _put(self, name: str, data: bytes) -> None:
+        if self._http is not None:
+            from .http_blob import io_timeout
+
+            # the deadline scales with body size — a near-MAX_BODY chunk
+            # can't clear a flat 5s cap, and cancel-reconnect-resend on a
+            # legitimately slow large PUT would loop forever
+            await self._aio(self._http.put(name, data,
+                                           timeout=io_timeout(len(data))))
+            return
         await self.db.net.request(
             self.db.client_addr, Endpoint(self.container_addr, blob.PUT_TOKEN),
             blob.BlobPut(name, data), TaskPriority.DEFAULT_ENDPOINT, timeout=5.0)
 
     async def _get(self, name: str) -> Optional[bytes]:
+        if self._http is not None:
+            from .http_blob import MAX_BODY, io_timeout
+
+            # response size is unknown up front: budget for the largest
+            # object the server can hold — a restore must be able to read
+            # back anything a scaled-deadline put managed to write
+            return await self._aio(self._http.get(
+                name, timeout=io_timeout(MAX_BODY)))
         return await self.db.net.request(
             self.db.client_addr, Endpoint(self.container_addr, blob.GET_TOKEN),
             blob.BlobGet(name), TaskPriority.DEFAULT_ENDPOINT, timeout=5.0)
 
     async def _list(self, prefix: str) -> List[str]:
+        if self._http is not None:
+            from .http_blob import MAX_BODY, io_timeout
+
+            return await self._aio(self._http.list(
+                prefix, timeout=io_timeout(MAX_BODY)))
         return await self.db.net.request(
             self.db.client_addr, Endpoint(self.container_addr, blob.LIST_TOKEN),
             blob.BlobList(prefix), TaskPriority.DEFAULT_ENDPOINT, timeout=5.0)
@@ -115,11 +183,23 @@ class BackupAgent:
         tr = self.db.create_transaction()
         self.start_version = await tr.get_read_version()
         self._log_floor = self.start_version
+        self._mover_error: Optional[BaseException] = None
         self._mover = spawn(self._log_mover(), TaskPriority.DEFAULT_ENDPOINT,
                             name="backupLogMover")
 
     async def _log_mover(self) -> None:
-        """Continuously drain the backup tag into log/<version> objects."""
+        """Continuously drain the backup tag into log/<version> objects.
+        A permanent failure is RECORDED, not just raised — a spawned
+        task's exception is unobserved, and finish_backup's wait on
+        _log_floor would otherwise wedge silently."""
+        try:
+            await self._log_mover_loop()
+        except Exception as e:  # noqa: BLE001 — ANY unobserved death wedges
+            # finish_backup; OperationCancelled (BaseException) still
+            # propagates so mover.cancel() stays silent
+            self._mover_error = e
+
+    async def _log_mover_loop(self) -> None:
         floor = self._log_floor
         while True:
             client = await self._log_client()
@@ -134,7 +214,16 @@ class BackupAgent:
                     # tlogs (spill pressure) and restorability lags
                     await delay(1.0)
                 name = "log/%020d" % reply.messages[0][0]
-                await self._put(name, wire.dumps(list(reply.messages)))
+                try:
+                    await self._put(name, wire.dumps(list(reply.messages)))
+                except error.FDBError as e:
+                    if not e.is_retryable():
+                        raise   # permanent (e.g. 4xx): recorded by the
+                        #         wrapper, surfaced by finish_backup
+                    # transient container loss: nothing was popped, so the
+                    # next peek re-serves the same messages — retry
+                    await delay(0.5)
+                    continue
                 if buggify.buggify():
                     # crash-shaped duplicate: object written but pop lost —
                     # the next peek re-serves; restore must dedupe by version
@@ -237,6 +326,8 @@ class BackupAgent:
         tr = self.db.create_transaction()
         self.end_version = await tr.get_read_version()
         while self._log_floor < self.end_version:
+            if self._mover_error is not None:
+                raise self._mover_error
             await delay(0.25)
 
         async def stop(tr2):

@@ -4,10 +4,11 @@ Re-design of fdbrpc/HTTP.actor.cpp + BlobStore.actor.cpp reduced to the
 load-bearing surface: a persistent-connection HTTP/1.1 client speaking
 PUT/GET/DELETE on objects and a prefix LIST, and a matching asyncio
 server storing objects under a directory (each object a file; names
-escaped). This is the standalone `blobstore://host:port` tier for
-real-transport deployments; the backup/DR agents currently drive the
-sim's in-process container (backup/container.py) — this module is its
-wire-real sibling, not yet wired into the fdbbackup tooling.
+escaped). A BackupAgent pointed at `blobstore://host:port` drives its
+container IO through this client (bridged from the cooperative scheduler
+into asyncio) — the real-transport backup target, wire-real sibling of
+the sim's in-process container (backup/container.py). End-to-end:
+`python -m foundationdb_tpu.real.cluster --backup`.
 
 Protocol (a strict, tiny subset of S3-ish semantics):
 
@@ -28,6 +29,14 @@ MAX_BODY = 64 << 20
 # in-flight writes live one directory down; _esc escapes '.' precisely so
 # no object name ('.tmp', '.', '..') can alias this entry or escape root
 _TMP_DIR = ".tmp"
+
+
+def io_timeout(nbytes: int) -> float:
+    """Wire-time deadline for transferring `nbytes`: a 5s floor plus
+    ~4MB/s of headroom, so a near-MAX_BODY object gets ~21s instead of a
+    flat cap it can never clear. Callers that don't know the response
+    size ahead of time budget for MAX_BODY."""
+    return 5.0 + nbytes / (4 << 20)
 
 
 def _esc(name: str) -> str:
@@ -195,6 +204,16 @@ class HTTPBlobServer:
             os.close(fd)
 
 
+class BlobHTTPError(IOError):
+    """A non-200 answered by the blob server; `.status` lets callers
+    separate permanent refusals (4xx: oversized body, bad request) from
+    server-side failures — retrying a 413 forever can never succeed."""
+
+    def __init__(self, op: str, name: str, status: int):
+        super().__init__(f"blob {op} {name!r}: HTTP {status}")
+        self.status = status
+
+
 class HTTPBlobClient:
     """Persistent-connection blob client (the BlobStore client's role)."""
 
@@ -214,53 +233,80 @@ class HTTPBlobClient:
                 host, int(port))
         return self._reader, self._writer
 
-    async def _request(self, method: str, target: str, body: bytes = b""):
+    async def _once(self, method: str, target: str, body: bytes):
+        r, w = await self._conn()
+        w.write(b"%s %s HTTP/1.1\r\ncontent-length: %d\r\n\r\n"
+                % (method.encode(), target.encode(), len(body)))
+        if body:
+            w.write(body)
+        await w.drain()
+        status_line = await r.readline()
+        status = int(status_line.split()[1])
+        length = await _read_headers(r)
+        out = await r.readexactly(length) if length else b""
+        return status, out
+
+    async def _request(self, method: str, target: str, body: bytes = b"",
+                       timeout: Optional[float] = None):
         async with self._lock:
             for attempt in (0, 1):   # one transparent reconnect
                 try:
-                    r, w = await self._conn()
-                    w.write(b"%s %s HTTP/1.1\r\ncontent-length: %d\r\n\r\n"
-                            % (method.encode(), target.encode(), len(body)))
-                    if body:
-                        w.write(body)
-                    await w.drain()
-                    status_line = await r.readline()
-                    status = int(status_line.split()[1])
-                    length = await _read_headers(r)
-                    out = await r.readexactly(length) if length else b""
-                    return status, out
-                except (ConnectionError, OSError, asyncio.IncompleteReadError,
-                        IndexError, ValueError):
+                    # the deadline starts HERE, after the lock: queue wait
+                    # behind other transfers on the shared connection must
+                    # not eat a request's wire-time budget
+                    coro = self._once(method, target, body)
+                    if timeout is not None:
+                        return await asyncio.wait_for(coro, timeout)
+                    return await coro
+                except asyncio.CancelledError:
+                    # a cancelled half-read would leave the persistent
+                    # connection desynced (every later response off by
+                    # one) — drop it before propagating
+                    self.close()
+                    raise
+                except (ConnectionError, OSError, asyncio.TimeoutError,
+                        asyncio.IncompleteReadError, IndexError, ValueError):
+                    # asyncio.TimeoutError is spelled explicitly: it only
+                    # became an OSError on 3.11+, and a deadline that
+                    # skipped close() would leave the connection desynced
                     self.close()
                     if attempt:
                         raise
             raise ConnectionError("unreachable")
 
-    async def put(self, name: str, data: bytes) -> None:
-        status, _ = await self._request("PUT", "/obj/" + _esc(name), data)
+    async def put(self, name: str, data: bytes,
+                  timeout: Optional[float] = None) -> None:
+        status, _ = await self._request("PUT", "/obj/" + _esc(name), data,
+                                        timeout=timeout)
         if status != 200:
-            raise IOError(f"blob put {name!r}: HTTP {status}")
+            raise BlobHTTPError("put", name, status)
 
-    async def get(self, name: str) -> Optional[bytes]:
-        status, body = await self._request("GET", "/obj/" + _esc(name))
+    async def get(self, name: str,
+                  timeout: Optional[float] = None) -> Optional[bytes]:
+        status, body = await self._request("GET", "/obj/" + _esc(name),
+                                           timeout=timeout)
         if status == 404:
             return None
         if status != 200:
-            raise IOError(f"blob get {name!r}: HTTP {status}")
+            raise BlobHTTPError("get", name, status)
         return body
 
-    async def delete(self, name: str) -> None:
-        status, _ = await self._request("DELETE", "/obj/" + _esc(name))
+    async def delete(self, name: str,
+                     timeout: Optional[float] = None) -> None:
+        status, _ = await self._request("DELETE", "/obj/" + _esc(name),
+                                        timeout=timeout)
         if status != 200:
             # a swallowed 500 here would make retention loops believe
             # the object is gone while it still exists
-            raise IOError(f"blob delete {name!r}: HTTP {status}")
+            raise BlobHTTPError("delete", name, status)
 
-    async def list(self, prefix: str = "") -> List[str]:
+    async def list(self, prefix: str = "",
+                   timeout: Optional[float] = None) -> List[str]:
         status, body = await self._request(
-            "GET", "/list?prefix=" + urllib.parse.quote(prefix))
+            "GET", "/list?prefix=" + urllib.parse.quote(prefix),
+            timeout=timeout)
         if status != 200:
-            raise IOError(f"blob list: HTTP {status}")
+            raise BlobHTTPError("list", prefix, status)
         return [urllib.parse.unquote(n) for n in body.decode().split("\n") if n]
 
     def close(self) -> None:

@@ -147,7 +147,11 @@ class IndexedSet:
         return mid.metric if mid is not None else None
 
     def erase(self, key: bytes) -> Optional[int]:
-        """Remove key; returns its metric (None if absent)."""
+        """Remove key; returns its metric (None if absent). A miss costs
+        one non-mutating descent, not the split/merge spine surgery —
+        erase-of-absent is the common case on the storage sampling path."""
+        if self.get(key) is None:
+            return None
         a, rest = _split(self._root, key)
         mid, b = _split(rest, key_after(key))
         self._root = _merge(a, b)

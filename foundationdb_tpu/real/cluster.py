@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import contextlib
 import os
 import shutil
 import socket
@@ -37,71 +38,163 @@ def free_ports(n: int) -> list:
     return ports
 
 
-async def client_main(coords, n_keys: int, n_txns: int) -> None:
+@contextlib.asynccontextmanager
+async def client_session(coords, seed: int):
+    """Boot a real client (scheduler + net + Database + the asyncio task
+    driving the cooperative loop) and tear it down in the one correct
+    order: sockets closed, loop stopped, driver cancelled."""
     from ..client.database import Database
-    from ..sim.loop import TaskPriority, set_scheduler
-    from .runtime import RealNetClient, RealScheduler, sim_to_aio
+    from ..sim.loop import set_scheduler
+    from .runtime import RealNetClient, RealScheduler
 
-    sched = RealScheduler(seed=1)
+    sched = RealScheduler(seed=seed)
     set_scheduler(sched)
     net = RealNetClient(sched)
     db = Database(net, "client:0", coordinator_addrs=coords)
-
-    async def work():
-        # setup: the identity ring
-        async def init(tr):
-            for i in range(n_keys):
-                tr.set(b"cyc/%04d" % i, b"%04d" % ((i + 1) % n_keys))
-        await db.run(init)
-
-        # rotate random adjacent links (the Cycle workload's transaction)
-        from ..sim.loop import current_scheduler
-
-        rng = current_scheduler().rng
-        for _ in range(n_txns):
-            start = rng.random_int(0, n_keys)
-
-            async def rotate(tr, s=start):
-                a = b"cyc/%04d" % s
-                b = await tr.get(a)
-                assert b is not None, f"missing link {a}"
-                c = await tr.get(b"cyc/" + b)
-                assert c is not None
-                d = await tr.get(b"cyc/" + c)
-                assert d is not None
-                # a->b->c->d becomes a->c->b->d
-                tr.set(a, c)
-                tr.set(b"cyc/" + c, b)
-                tr.set(b"cyc/" + b, d)
-            await db.run(rotate)
-
-        # check: one cycle visiting every node exactly once
-        async def read_ring(tr):
-            out = {}
-            for i in range(n_keys):
-                v = await tr.get(b"cyc/%04d" % i)
-                assert v is not None
-                out[i] = int(v)
-            return out
-        ring = await db.run(read_ring)
-        seen = set()
-        at = 0
-        for _ in range(n_keys):
-            assert at not in seen, "ring collapsed: revisited node"
-            seen.add(at)
-            at = ring[at]
-        assert at == 0 and len(seen) == n_keys, "broken ring permutation"
-        return True
-
     run_task = asyncio.ensure_future(sched.run_async())
-    t = sched.spawn(work(), TaskPriority.DEFAULT_ENDPOINT, name="smoke")
     try:
-        ok = await asyncio.wait_for(sim_to_aio(t), timeout=180.0)
-        assert ok is True
+        yield sched, db
     finally:
         net.raw.close()
         sched.shutdown()
         run_task.cancel()
+
+
+async def client_main(coords, n_keys: int, n_txns: int) -> None:
+    from ..sim.loop import TaskPriority
+    from .runtime import sim_to_aio
+
+    async with client_session(coords, seed=1) as (sched, db):
+
+        async def work():
+            # setup: the identity ring
+            async def init(tr):
+                for i in range(n_keys):
+                    tr.set(b"cyc/%04d" % i, b"%04d" % ((i + 1) % n_keys))
+            await db.run(init)
+
+            # rotate random adjacent links (the Cycle workload's txn)
+            from ..sim.loop import current_scheduler
+
+            rng = current_scheduler().rng
+            for _ in range(n_txns):
+                start = rng.random_int(0, n_keys)
+
+                async def rotate(tr, s=start):
+                    a = b"cyc/%04d" % s
+                    b = await tr.get(a)
+                    assert b is not None, f"missing link {a}"
+                    c = await tr.get(b"cyc/" + b)
+                    assert c is not None
+                    d = await tr.get(b"cyc/" + c)
+                    assert d is not None
+                    # a->b->c->d becomes a->c->b->d
+                    tr.set(a, c)
+                    tr.set(b"cyc/" + c, b)
+                    tr.set(b"cyc/" + b, d)
+                await db.run(rotate)
+
+            # check: one cycle visiting every node exactly once
+            async def read_ring(tr):
+                out = {}
+                for i in range(n_keys):
+                    v = await tr.get(b"cyc/%04d" % i)
+                    assert v is not None
+                    out[i] = int(v)
+                return out
+            ring = await db.run(read_ring)
+            seen = set()
+            at = 0
+            for _ in range(n_keys):
+                assert at not in seen, "ring collapsed: revisited node"
+                seen.add(at)
+                at = ring[at]
+            assert at == 0 and len(seen) == n_keys, "broken ring permutation"
+            return True
+
+        t = sched.spawn(work(), TaskPriority.DEFAULT_ENDPOINT, name="smoke")
+        ok = await asyncio.wait_for(sim_to_aio(t), timeout=180.0)
+        assert ok is True
+
+
+async def backup_client_main(coords, blob_root: str) -> None:
+    """End-to-end backup→wipe→restore against a REAL cluster with a
+    blobstore:// target (backup/http_blob.py): seed rows, start the live
+    backup, mutate (sets + a clear) so the mutation log carries real
+    traffic past the snapshot, snapshot + finish, wipe the keyspace, then
+    restore into the same cluster and verify byte-for-byte."""
+    from ..backup.agent import BackupAgent
+    from ..backup.http_blob import HTTPBlobServer
+    from ..sim.loop import TaskPriority
+    from .runtime import sim_to_aio
+
+    srv = HTTPBlobServer(blob_root)
+    await srv.start()
+    agent = None
+    try:
+        async with client_session(coords, seed=2) as (sched, db):
+            agent = BackupAgent(None, db, f"blobstore://127.0.0.1:{srv.port}")
+            await _backup_drill(sched, db, agent, sim_to_aio, TaskPriority)
+    finally:
+        if agent is not None:
+            agent.close()
+        await srv.stop()
+
+
+async def _backup_drill(sched, db, agent, sim_to_aio, TaskPriority) -> None:
+    async def read_user_rows(tr):
+        out = []
+        cur = b""
+        while True:
+            rows = await tr.get_range(cur, b"\xff", limit=200)
+            out.extend(rows)
+            if len(rows) < 200:
+                return out
+            cur = rows[-1][0] + b"\x00"
+
+    def _stage(msg: str) -> None:
+        print(f"backup-smoke: {msg}", flush=True)
+
+    async def work():
+        async def seed(tr):
+            for i in range(40):
+                tr.set(b"bk/%04d" % i, b"v%04d" % i)
+        await db.run(seed)
+        _stage("seeded")
+
+        await agent.start_backup()
+        _stage("backup started")
+
+        async def live(tr):
+            for i in range(10):
+                tr.set(b"bk/live/%02d" % i, b"L%02d" % i)
+            tr.clear_range(b"bk/0000", b"bk/0005")
+        await db.run(live)
+        _stage("live mutations committed")
+
+        await agent.snapshot(chunks=4, workers=2)
+        _stage("snapshot done")
+        await agent.finish_backup()
+        _stage("backup finished")
+
+        expected = await db.run(read_user_rows)
+        assert len(expected) == 45, len(expected)   # 40 - 5 + 10
+
+        async def wipe(tr):
+            tr.clear_range(b"", b"\xff")
+        await db.run(wipe)
+        assert await db.run(read_user_rows) == []
+        _stage("wiped")
+
+        await agent.restore(db)
+        _stage("restored")
+        got = await db.run(read_user_rows)
+        assert got == expected, (len(got), len(expected))
+        return True
+
+    t = sched.spawn(work(), TaskPriority.DEFAULT_ENDPOINT, name="backup-smoke")
+    ok = await asyncio.wait_for(sim_to_aio(t), timeout=180.0)
+    assert ok is True
 
 
 def main(argv=None) -> int:
@@ -111,6 +204,9 @@ def main(argv=None) -> int:
     ap.add_argument("--txns", type=int, default=30)
     ap.add_argument("--engine", default="native", choices=["native", "oracle"])
     ap.add_argument("--keep-datadir", action="store_true")
+    ap.add_argument("--backup", action="store_true",
+                    help="run the backup->wipe->restore smoke against a "
+                         "blobstore:// HTTP container instead of Cycle")
     args = ap.parse_args(argv)
 
     n = max(args.procs, 4)   # recruitment needs storage + txn workers
@@ -149,9 +245,15 @@ def main(argv=None) -> int:
                 except OSError:
                     time.sleep(0.3)
 
-        asyncio.run(client_main(coords, args.keys, args.txns))
-        print(f"REAL CLUSTER OK: {n} nodes, {args.txns} cycle txns, "
-              f"ring intact", flush=True)
+        if args.backup:
+            asyncio.run(backup_client_main(
+                coords, os.path.join(datadir, "blobstore")))
+            print(f"REAL CLUSTER OK: {n} nodes, backup->wipe->restore "
+                  f"via blobstore verified", flush=True)
+        else:
+            asyncio.run(client_main(coords, args.keys, args.txns))
+            print(f"REAL CLUSTER OK: {n} nodes, {args.txns} cycle txns, "
+                  f"ring intact", flush=True)
         return 0
     except BaseException as e:  # noqa: BLE001 — report, then tear down
         print(f"REAL CLUSTER FAILED: {type(e).__name__}: {e}", flush=True)

@@ -109,6 +109,33 @@ def sim_to_aio(fut: Future) -> "asyncio.Future":
     return af
 
 
+def aio_to_sim(coro, tasks: set) -> Future:
+    """Bridge an asyncio coroutine to a scheduler Future (sim_to_aio's
+    inverse). `tasks` must outlive the call and holds a strong ref until
+    completion — asyncio keeps only weak ones, and a GC'd task would
+    strand the Future unresolved forever. FDBErrors relay verbatim;
+    anything else surfaces as transport loss."""
+    out = Future()
+
+    async def go() -> None:
+        try:
+            r = await coro
+        except error.FDBError as e:
+            if not out.is_ready:
+                out._set_error(e)
+        except Exception as e:  # noqa: BLE001 — surface as transport loss
+            if not out.is_ready:
+                out._set_error(error.connection_failed(str(e)))
+        else:
+            if not out.is_ready:
+                out._set(r)
+
+    t = asyncio.ensure_future(go())
+    tasks.add(t)
+    t.add_done_callback(tasks.discard)
+    return out
+
+
 class RealNetClient:
     """The sim network's request/one_way surface over real sockets,
     returning scheduler Futures so role code can await them. One instance
@@ -139,24 +166,10 @@ class RealNetClient:
     def request(self, src: str, ep, payload: Any,
                 priority: int = TaskPriority.DEFAULT_ENDPOINT,
                 timeout: Optional[float] = None) -> Future:
-        out = Future()
-
-        async def go() -> None:
-            try:
-                r = await self.raw.request(src, ep, payload, priority,
-                                           timeout=timeout or 5.0)
-            except error.FDBError as e:
-                if not out.is_ready:
-                    out._set_error(e)
-            except Exception as e:  # noqa: BLE001 — surface as transport loss
-                if not out.is_ready:
-                    out._set_error(error.connection_failed(str(e)))
-            else:
-                if not out.is_ready:
-                    out._set(r)
-
-        self._track(asyncio.ensure_future(go()))
-        return out
+        return aio_to_sim(
+            self.raw.request(src, ep, payload, priority,
+                             timeout=timeout or 5.0),
+            self._tasks)
 
     def one_way(self, src: str, ep, payload: Any,
                 priority: int = TaskPriority.DEFAULT_ENDPOINT) -> None:

@@ -586,19 +586,15 @@ class StorageServer:
         from ..sim.loop import current_scheduler
 
         if value is None:
-            if self.byte_sample.get(key) is not None:
-                self.byte_sample.erase(key)
+            self.byte_sample.erase(key)
             return
         size = len(key) + len(value)
         factor = max(1, SERVER_KNOBS.dd_byte_sample_factor)
         # deterministic per seed: the sim RNG drives sampling
         if size >= factor or current_scheduler().rng.random01() < size / factor:
             self.byte_sample.insert(key, max(size, factor))   # replaces
-        elif self.byte_sample.get(key) is not None:
-            # re-rolled OUT of the sample; the miss guard keeps the ~99%
-            # unsampled-write path a non-mutating O(log n) descent instead
-            # of a no-op erase's two splits + merge
-            self.byte_sample.erase(key)
+        else:
+            self.byte_sample.erase(key)   # re-rolled OUT of the sample
 
     @property
     def sampled_bytes(self) -> int:

@@ -148,6 +148,67 @@ def test_concurrent_requests_one_client():
     assert asyncio.run(go())
 
 
+def test_blob_http_error_classification():
+    """4xx maps to a non-retryable FDBError (the mover dies loudly); 5xx
+    maps to retryable connection_failed — the server's own transient
+    trouble is retried exactly like a dropped connection."""
+    from foundationdb_tpu.backup import http_blob
+    from foundationdb_tpu.backup.agent import BackupAgent
+    from foundationdb_tpu.core import error
+
+    agent = BackupAgent(None, None, "blobstore://127.0.0.1:1")
+
+    async def boom(status):
+        raise http_blob.BlobHTTPError("put", "x", status)
+
+    async def go():
+        with pytest.raises(error.FDBError) as e4:
+            await agent._classify(boom(413))
+        assert not e4.value.is_retryable()
+        with pytest.raises(error.FDBError) as e5:
+            await agent._classify(boom(500))
+        assert e5.value.is_retryable()   # server-side trouble: retry,
+        return True                      # exactly like a dropped conn
+
+    assert asyncio.run(go())
+
+
+def test_backup_agent_blobstore_container_io():
+    """A BackupAgent pointed at blobstore://host:port drives its container
+    reads/writes through HTTPBlobClient, bridged from the cooperative
+    RealScheduler loop into asyncio."""
+    from foundationdb_tpu.backup.agent import BackupAgent
+    from foundationdb_tpu.real.runtime import RealScheduler, sim_to_aio
+
+    async def go():
+        root = tempfile.mkdtemp(prefix="blob_")
+        srv = HTTPBlobServer(root)
+        await srv.start()
+        sched = RealScheduler(seed=0)
+        agent = BackupAgent(None, None, f"blobstore://127.0.0.1:{srv.port}")
+
+        async def work():
+            await agent._put("range/0001", b"rows")
+            await agent._put("log/0001", b"muts")
+            assert await agent._get("range/0001") == b"rows"
+            assert await agent._get("range/none") is None
+            assert await agent._list("range/") == ["range/0001"]
+            return True
+
+        run = asyncio.ensure_future(sched.run_async())
+        try:
+            ok = await asyncio.wait_for(
+                sim_to_aio(sched.spawn(work())), timeout=30.0)
+        finally:
+            sched.shutdown()
+            await asyncio.wait([run])
+            agent.close()
+            await srv.stop()
+        return ok
+
+    assert asyncio.run(go())
+
+
 def test_many_small_objects_one_connection():
     async def go():
         root = tempfile.mkdtemp(prefix="blob_")
