@@ -204,6 +204,11 @@ class HTTPBlobServer:
             os.close(fd)
 
 
+class BlobClientShutdown(ConnectionError):
+    """Raised by a client whose shutdown() has run: PERMANENT, unlike the
+    transient connection errors the retry paths are allowed to chew on."""
+
+
 class BlobHTTPError(IOError):
     """A non-200 answered by the blob server; `.status` lets callers
     separate permanent refusals (4xx: oversized body, bad request) from
@@ -221,16 +226,27 @@ class HTTPBlobClient:
         self.address = address
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
+        self._shutdown = False
         # one connection, one in-flight request: concurrent callers
         # (asyncio.gather of puts) serialize here instead of interleaving
         # reads on the shared stream and desyncing every later response
         self._lock = asyncio.Lock()
 
     async def _conn(self):
+        if self._shutdown:
+            # a final shutdown() must stick: without this, an in-flight
+            # request's transparent-reconnect path would resurrect the
+            # connection after teardown and leak it
+            raise BlobClientShutdown("client is shut down")
         if self._writer is None or self._writer.is_closing():
             host, port = self.address.rsplit(":", 1)
-            self._reader, self._writer = await asyncio.open_connection(
-                host, int(port))
+            r, w = await asyncio.open_connection(host, int(port))
+            if self._shutdown:
+                # shutdown() ran while open_connection was in flight and
+                # saw nothing to close — don't adopt the new socket
+                w.close()
+                raise BlobClientShutdown("client is shut down")
+            self._reader, self._writer = r, w
         return self._reader, self._writer
 
     async def _once(self, method: str, target: str, body: bytes):
@@ -258,6 +274,8 @@ class HTTPBlobClient:
                     if timeout is not None:
                         return await asyncio.wait_for(coro, timeout)
                     return await coro
+                except BlobClientShutdown:
+                    raise   # permanent by contract: retrying is pointless
                 except asyncio.CancelledError:
                     # a cancelled half-read would leave the persistent
                     # connection desynced (every later response off by
@@ -310,6 +328,13 @@ class HTTPBlobClient:
         return [urllib.parse.unquote(n) for n in body.decode().split("\n") if n]
 
     def close(self) -> None:
+        """Drop the current connection; the next request reconnects."""
         if self._writer is not None:
             self._writer.close()
         self._reader = self._writer = None
+
+    def shutdown(self) -> None:
+        """Final close: drops the connection AND refuses reconnects, so
+        an in-flight retry can't bring the socket back."""
+        self._shutdown = True
+        self.close()

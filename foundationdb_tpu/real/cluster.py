@@ -125,8 +125,6 @@ async def backup_client_main(coords, blob_root: str) -> None:
     restore into the same cluster and verify byte-for-byte."""
     from ..backup.agent import BackupAgent
     from ..backup.http_blob import HTTPBlobServer
-    from ..sim.loop import TaskPriority
-    from .runtime import sim_to_aio
 
     srv = HTTPBlobServer(blob_root)
     await srv.start()
@@ -134,23 +132,20 @@ async def backup_client_main(coords, blob_root: str) -> None:
     try:
         async with client_session(coords, seed=2) as (sched, db):
             agent = BackupAgent(None, db, f"blobstore://127.0.0.1:{srv.port}")
-            await _backup_drill(sched, db, agent, sim_to_aio, TaskPriority)
+            await _backup_drill(sched, db, agent)
     finally:
         if agent is not None:
             agent.close()
         await srv.stop()
 
 
-async def _backup_drill(sched, db, agent, sim_to_aio, TaskPriority) -> None:
+async def _backup_drill(sched, db, agent) -> None:
+    from ..sim.loop import TaskPriority
+    from .runtime import sim_to_aio
+    from ..layers import read_all
+
     async def read_user_rows(tr):
-        out = []
-        cur = b""
-        while True:
-            rows = await tr.get_range(cur, b"\xff", limit=200)
-            out.extend(rows)
-            if len(rows) < 200:
-                return out
-            cur = rows[-1][0] + b"\x00"
+        return await read_all(tr, b"", b"\xff", page=200)
 
     def _stage(msg: str) -> None:
         print(f"backup-smoke: {msg}", flush=True)

@@ -17,6 +17,18 @@ from .loop import Future, Promise, Task, TaskPriority, current_scheduler, delay,
 T = TypeVar("T")
 
 
+async def all_of_cancelling(tasks: List[Task]) -> List[Any]:
+    """all_of, but a fail-fast error also CANCELS the sibling tasks —
+    without this, the survivors keep running (committing, writing)
+    underneath the caller's error handling."""
+    try:
+        return await all_of(tasks)
+    except BaseException:
+        for t in tasks:
+            t.cancel()
+        raise
+
+
 def all_of(futures: List[Future]) -> Future:
     """Resolves with the list of values when every input resolves; errors as
     soon as any input errors (flow: waitForAll)."""

@@ -1057,8 +1057,13 @@ class BackupCorrectnessWorkload(TestWorkload):
             # capture window missed (counted above): restore ran, equality
             # unverifiable this run
             return True
-        tr2 = db2.create_transaction()
-        rows2 = await tr2.get_range(b"", b"\xff", limit=100_000, snapshot=True)
+        # through run(): a recovery straddling this read (cluster churn
+        # continues during restore) surfaces as retryable
+        # transaction_too_old, not a spec failure
+        async def read_dst(tr2):
+            return await tr2.get_range(b"", b"\xff", limit=100_000,
+                                       snapshot=True)
+        rows2 = await db2.run(read_dst)
         if rows2 != src_rows:
             self.ctx.count("restore_mismatch")
             return False

@@ -93,6 +93,94 @@ def test_backup_restore_under_load():
     assert sim.run_until(sim.sched.spawn(scenario(), name="s"), until=600.0)
 
 
+def test_backup_restore_with_tiny_object_cap(monkeypatch):
+    """Snapshot chunks and log groups split below the container object
+    cap (versioned part sets + '-done' markers; log objects named by
+    first version) and restore reassembles them exactly — the path that
+    keeps a >MAX_BODY peek reply or range chunk from drawing a fatal
+    413 from the blobstore."""
+    import foundationdb_tpu.backup.agent as agent_mod
+
+    monkeypatch.setattr(agent_mod, "CONTAINER_OBJECT_BYTES", 256)
+    sim, src, dst, container = build_two_clusters(seed=139)
+    db = src.new_client()
+    db2 = dst.new_client()
+
+    async def scenario():
+        async def seed(tr):
+            for i in range(30):
+                tr.set(b"pre/%03d" % i, b"x" * 40)   # forces many parts
+        await db.run(seed)
+
+        agent = BackupAgent(sim, db, container.proc.address)
+        await agent.start_backup()
+
+        async def live(tr):
+            for i in range(20):
+                tr.set(b"live/%03d" % i, b"y" * 40)  # forces log groups
+        await db.run(live)
+
+        await agent.snapshot(chunks=3, workers=2)
+        await agent.finish_backup()
+
+        # the split actually happened: multi-part sets + markers exist
+        names = await agent._list("range/")
+        assert any(n.endswith("-done") for n in names)
+        assert sum(1 for n in names if not n.endswith("-done")) > 3
+
+        vend = await agent.restore(db2)
+        assert vend == agent.end_version
+
+        async def read_all(d, version=None):
+            tr = d.create_transaction()
+            if version is not None:
+                tr.read_version = version
+            return await tr.get_range(b"", USER_END, limit=100_000,
+                                      snapshot=True)
+        src_rows = await read_all(db, agent.end_version)
+        dst_rows = await read_all(db2)
+        assert dst_rows == src_rows, (len(dst_rows), len(src_rows))
+        return True
+
+    assert sim.run_until(sim.sched.spawn(scenario(), name="s"), until=600.0)
+
+
+def test_failed_backup_releases_tag():
+    """finish_backup's mover-error edge aborts the backup: the mutation
+    -log slot is released (a new backup can claim it) instead of staying
+    pinned forever with the tlogs spilling an orphaned tag."""
+    sim, src, dst, container = build_two_clusters(seed=141)
+    db = src.new_client()
+
+    async def scenario():
+        agent = BackupAgent(sim, db, container.proc.address)
+        await agent.start_backup()
+
+        async def w(tr):
+            for i in range(5):
+                tr.set(b"k%d" % i, b"v")
+        await db.run(w)
+
+        # simulate a mover that died permanently (e.g. escalated 4xx)
+        agent._mover.cancel()
+        agent._mover_error = error.client_invalid_operation("injected")
+        agent._log_floor = 0
+        try:
+            await agent.finish_backup()
+            return False   # finish must raise the recorded mover error
+        except error.FDBError:
+            pass
+
+        # the slot is free again: a fresh backup claims, runs, finishes
+        agent2 = BackupAgent(sim, db, container.proc.address)
+        await agent2.start_backup()
+        await agent2.snapshot(chunks=2, workers=1)
+        await agent2.finish_backup()
+        return True
+
+    assert sim.run_until(sim.sched.spawn(scenario(), name="s"), until=600.0)
+
+
 def test_backup_tag_is_retired_after_finish():
     """After finish_backup, no tlog retains or accepts the backup tag's
     data (the disk-queue front must not pin)."""

@@ -163,12 +163,41 @@ def test_blob_http_error_classification():
 
     async def go():
         with pytest.raises(error.FDBError) as e4:
-            await agent._classify(boom(413))
+            await agent._container._classify(boom(413))
         assert not e4.value.is_retryable()
         with pytest.raises(error.FDBError) as e5:
-            await agent._classify(boom(500))
+            await agent._container._classify(boom(500))
         assert e5.value.is_retryable()   # server-side trouble: retry,
         return True                      # exactly like a dropped conn
+
+    assert asyncio.run(go())
+
+
+def test_shutdown_is_permanent_and_nonretryable():
+    """After shutdown(): no reconnect resurrects the socket, and the
+    agent classification reports it as non-retryable (a still-running
+    mover must die loudly, not retry forever)."""
+    from foundationdb_tpu.backup import http_blob
+    from foundationdb_tpu.backup.agent import BackupAgent
+    from foundationdb_tpu.core import error
+
+    async def go():
+        root = tempfile.mkdtemp(prefix="blob_")
+        srv = HTTPBlobServer(root)
+        await srv.start()
+        cli = HTTPBlobClient(f"127.0.0.1:{srv.port}")
+        await cli.put("a", b"1")
+        cli.shutdown()
+        with pytest.raises(http_blob.BlobClientShutdown):
+            await cli.get("a")
+        agent = BackupAgent(None, None, "blobstore://127.0.0.1:1")
+        agent.close()
+        with pytest.raises(error.FDBError) as ei:
+            await agent._container._classify(
+                agent._container.client.get("a"))
+        assert not ei.value.is_retryable()
+        await srv.stop()
+        return True
 
     assert asyncio.run(go())
 
