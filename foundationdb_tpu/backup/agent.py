@@ -178,8 +178,11 @@ class _HTTPContainer:
         # needs) the real runtime / HTTP modules
         from .http_blob import MAX_BODY, HTTPBlobClient, io_timeout
         from ..real.runtime import aio_to_sim
+        from ..real.tls import client_context
 
-        self.client = HTTPBlobClient(address)
+        # the blob path inherits the process TLS policy: mutual auth via
+        # the shared CA (the subject DSL stays on the RPC transport)
+        self.client = HTTPBlobClient(address, ssl_context=client_context())
         self._tasks: set = set()
         self._aio_to_sim = aio_to_sim
         self._io_timeout = io_timeout

@@ -68,10 +68,12 @@ async def _read_headers(reader: asyncio.StreamReader) -> int:
 class HTTPBlobServer:
     """Objects-on-disk blob server; address is host:port."""
 
-    def __init__(self, root: str, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, root: str, host: str = "127.0.0.1", port: int = 0,
+                 ssl_context=None):
         self.root = root
         self.host = host
         self.port = port
+        self._ssl = ssl_context   # mutual-TLS listener when provided
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: Set[asyncio.StreamWriter] = set()
         self._tmp_seq = itertools.count()
@@ -83,7 +85,8 @@ class HTTPBlobServer:
             os.unlink(os.path.join(tmp, leftover))
 
     async def start(self) -> None:
-        self._server = await asyncio.start_server(self._serve, self.host, self.port)
+        self._server = await asyncio.start_server(
+            self._serve, self.host, self.port, ssl=self._ssl)
         self.port = self._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
@@ -222,8 +225,9 @@ class BlobHTTPError(IOError):
 class HTTPBlobClient:
     """Persistent-connection blob client (the BlobStore client's role)."""
 
-    def __init__(self, address: str):
+    def __init__(self, address: str, ssl_context=None):
         self.address = address
+        self._ssl = ssl_context
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._shutdown = False
@@ -240,7 +244,8 @@ class HTTPBlobClient:
             raise BlobClientShutdown("client is shut down")
         if self._writer is None or self._writer.is_closing():
             host, port = self.address.rsplit(":", 1)
-            r, w = await asyncio.open_connection(host, int(port))
+            r, w = await asyncio.open_connection(host, int(port),
+                                                 ssl=self._ssl)
             if self._shutdown:
                 # shutdown() ran while open_connection was in flight and
                 # saw nothing to close — don't adopt the new socket

@@ -125,8 +125,11 @@ async def backup_client_main(coords, blob_root: str) -> None:
     restore into the same cluster and verify byte-for-byte."""
     from ..backup.agent import BackupAgent
     from ..backup.http_blob import HTTPBlobServer
+    from . import tls
 
-    srv = HTTPBlobServer(blob_root)
+    # the blobstore rides the same TLS policy as the cluster when one is
+    # set — `--tls --backup` must not leak the keyspace in plaintext
+    srv = HTTPBlobServer(blob_root, ssl_context=tls.server_context())
     await srv.start()
     agent = None
     try:
@@ -202,6 +205,9 @@ def main(argv=None) -> int:
     ap.add_argument("--backup", action="store_true",
                     help="run the backup->wipe->restore smoke against a "
                          "blobstore:// HTTP container instead of Cycle")
+    ap.add_argument("--tls", action="store_true",
+                    help="mutual TLS on every connection: generated CA + "
+                         "shared node cert, subject-checked both ways")
     args = ap.parse_args(argv)
 
     n = max(args.procs, 4)   # recruitment needs storage + txn workers
@@ -210,6 +216,11 @@ def main(argv=None) -> int:
     datadir = tempfile.mkdtemp(prefix="fdb_tpu_real_")
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")   # nodes never touch the TPU
+    tls_cfg = None
+    if args.tls:
+        from .tls import generate_test_credentials, set_tls
+        tls_cfg = generate_test_credentials(os.path.join(datadir, "tls"))
+        set_tls(tls_cfg)   # the smoke client speaks TLS too
     procs = []
     try:
         for i, port in enumerate(ports):
@@ -221,6 +232,11 @@ def main(argv=None) -> int:
                 "--workers", str(n),
                 "--engine", args.engine,
             ]
+            if tls_cfg is not None:
+                cmd += ["--tls-cert", tls_cfg.cert_path,
+                        "--tls-key", tls_cfg.key_path,
+                        "--tls-ca", tls_cfg.ca_path,
+                        "--tls-verify", tls_cfg.verify_rules]
             if i < len(coords):
                 cmd += ["--cc-priority", str(i)]
             procs.append(subprocess.Popen(

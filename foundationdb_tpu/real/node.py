@@ -93,7 +93,22 @@ def main(argv=None) -> int:
     ap.add_argument("--proxies", type=int, default=1)
     ap.add_argument("--storage", type=int, default=2)
     ap.add_argument("--engine", default="native", choices=["native", "oracle"])
+    ap.add_argument("--tls-cert", default=None)
+    ap.add_argument("--tls-key", default=None)
+    ap.add_argument("--tls-ca", default=None)
+    ap.add_argument("--tls-verify", default="",
+                    help='subject DSL, e.g. "Check.Valid=1,O=MyOrg"')
     args = ap.parse_args(argv)
+    if args.tls_cert or args.tls_key or args.tls_ca or args.tls_verify:
+        if not (args.tls_cert and args.tls_key and args.tls_ca):
+            # --tls-verify alone must not silently run plaintext while
+            # the operator believes subject checks are enforced
+            ap.error("--tls-cert, --tls-key and --tls-ca must be "
+                     "given together (required for any TLS option)")
+        from .tls import TLSConfig, set_tls
+        set_tls(TLSConfig(cert_path=args.tls_cert, key_path=args.tls_key,
+                          ca_path=args.tls_ca,
+                          verify_rules=args.tls_verify))
     try:
         asyncio.run(amain(args))
     except KeyboardInterrupt:

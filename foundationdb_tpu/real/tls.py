@@ -1,0 +1,187 @@
+"""TLS for the real transport: mutual auth + a subject-check DSL.
+
+Re-design of FDBLibTLS (FDBLibTLS/*.cpp, ~2.6k LoC over libtls): every
+connection is MUTUALLY authenticated against a shared CA, and an
+optional verification DSL constrains the peer certificate's subject
+(the reference's `Check.Valid=1,O=...` strings,
+FDBLibTLS/FDBLibTLSVerify.cpp). Python's ssl module supplies the
+handshake; this module supplies context construction, the DSL, and
+self-signed test credentials (via `cryptography`).
+
+Process-wide configuration (`set_tls`) mirrors the reference's plugin
+model: fdbserver loads one TLS policy per process, not per connection.
+"""
+from __future__ import annotations
+
+import os
+import ssl
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+
+@dataclass
+class TLSConfig:
+    cert_path: str           # this process's PEM cert chain
+    key_path: str            # its private key
+    ca_path: str             # the CA bundle peers must chain to
+    verify_rules: str = ""   # e.g. "Check.Valid=1,O=TestCluster"
+
+
+def _base_context(cfg: TLSConfig, server: bool) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(
+        ssl.PROTOCOL_TLS_SERVER if server else ssl.PROTOCOL_TLS_CLIENT)
+    ctx.load_cert_chain(cfg.cert_path, cfg.key_path)
+    ctx.load_verify_locations(cfg.ca_path)
+    # identity comes from the CA plus the subject DSL, not hostnames
+    # (cluster members are addressed by ip:port) — FDB's model
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_REQUIRED   # MUTUAL: both sides present
+    return ctx
+
+
+class ActiveTLS:
+    """An immutable snapshot of the process TLS policy: the config plus
+    BOTH contexts built once (PEMs parsed at set_tls time, not per
+    connection). Callers grab one snapshot and use it for a whole
+    connection, so a concurrent set_tls() can't desync the context a
+    socket was opened with from the rules its peer is checked against."""
+
+    def __init__(self, cfg: TLSConfig):
+        self.cfg = cfg
+        self.client_ctx = _base_context(cfg, server=False)
+        self.server_ctx = _base_context(cfg, server=True)
+
+
+_active: Optional[ActiveTLS] = None
+
+
+def set_tls(cfg: Optional[TLSConfig]) -> None:
+    global _active
+    _active = ActiveTLS(cfg) if cfg is not None else None
+
+
+def current() -> Optional[ActiveTLS]:
+    return _active
+
+
+def client_context() -> Optional[ssl.SSLContext]:
+    return _active.client_ctx if _active is not None else None
+
+
+def server_context() -> Optional[ssl.SSLContext]:
+    return _active.server_ctx if _active is not None else None
+
+
+_SUBJECT_KEYS = {
+    "O": "organizationName",
+    "OU": "organizationalUnitName",
+    "CN": "commonName",
+    "C": "countryName",
+}
+
+
+def check_peer(peercert: Optional[dict], rules: str = "") -> bool:
+    """Apply the verification DSL to a peer cert as returned by
+    `SSLObject.getpeercert()`. Rules: comma-separated `Field=value`
+    pairs; `Check.Valid=1` asserts a cert is present (chain validity is
+    already enforced by the handshake), `O=`/`OU=`/`CN=`/`C=` match the
+    subject. Empty rules accept any CA-validated peer."""
+    if not rules:
+        return True
+    import re
+
+    # multi-valued attributes (two OU= RDNs) collect into sets: a rule
+    # matches if ANY value matches, like the reference's verifier
+    subject: Dict[str, set] = {}
+    for rdn in (peercert or {}).get("subject", ()):
+        for key, value in rdn:
+            subject.setdefault(key, set()).add(value)
+    # backslash-escaped commas let a subject value contain one
+    # ("O=Acme\, Inc."), matching FDBLibTLSVerify's escape syntax
+    for clause in re.split(r"(?<!\\),", rules):
+        clause = clause.replace("\\,", ",").strip()
+        if not clause:
+            continue
+        field, _, want = clause.partition("=")
+        field = field.strip()
+        want = want.strip()
+        if field == "Check.Valid":
+            if want not in ("0", "1"):
+                return False   # malformed security input: fail closed
+            if want == "1" and not peercert:
+                return False
+        elif field in _SUBJECT_KEYS:
+            if want not in subject.get(_SUBJECT_KEYS[field], ()):
+                return False
+        else:
+            return False   # unknown clause: fail closed
+    return True
+
+
+def verify_peer(writer, snap: ActiveTLS) -> bool:
+    """Apply `snap`'s subject DSL to the peer behind an established TLS
+    stream — the ONE verification sequence both directions of the mutual
+    check share, so the client- and server-side policies can't drift."""
+    ssl_obj = writer.get_extra_info("ssl_object")
+    return ssl_obj is not None and check_peer(ssl_obj.getpeercert(),
+                                              snap.cfg.verify_rules)
+
+
+def generate_test_credentials(out_dir: str,
+                              org: str = "TestCluster") -> TLSConfig:
+    """Self-signed CA + one leaf cert (subject O=`org`) shared by every
+    process — enough for mutual-auth tests and dev clusters. PEM files
+    land under `out_dir`."""
+    import datetime
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    os.makedirs(out_dir, exist_ok=True)
+    now = datetime.datetime(2020, 1, 1)
+    until = datetime.datetime(2120, 1, 1)
+
+    def _key():
+        return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+    ca_key = _key()
+    ca_name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "fdb-tpu-ca")])
+    ca_cert = (x509.CertificateBuilder()
+               .subject_name(ca_name).issuer_name(ca_name)
+               .public_key(ca_key.public_key())
+               .serial_number(x509.random_serial_number())
+               .not_valid_before(now).not_valid_after(until)
+               .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                              critical=True)
+               .sign(ca_key, hashes.SHA256()))
+
+    leaf_key = _key()
+    leaf_name = x509.Name([
+        x509.NameAttribute(NameOID.COMMON_NAME, "fdb-tpu-node"),
+        x509.NameAttribute(NameOID.ORGANIZATION_NAME, org),
+    ])
+    leaf_cert = (x509.CertificateBuilder()
+                 .subject_name(leaf_name).issuer_name(ca_name)
+                 .public_key(leaf_key.public_key())
+                 .serial_number(x509.random_serial_number())
+                 .not_valid_before(now).not_valid_after(until)
+                 .sign(ca_key, hashes.SHA256()))
+
+    paths = {}
+    for fname, data in (
+        ("ca.pem", ca_cert.public_bytes(serialization.Encoding.PEM)),
+        ("cert.pem", leaf_cert.public_bytes(serialization.Encoding.PEM)),
+        ("key.pem", leaf_key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption())),
+    ):
+        paths[fname] = os.path.join(out_dir, fname)
+        with open(paths[fname], "wb") as f:
+            f.write(data)
+    os.chmod(paths["key.pem"], 0o600)   # the one shared private key
+    return TLSConfig(cert_path=paths["cert.pem"], key_path=paths["key.pem"],
+                     ca_path=paths["ca.pem"],
+                     verify_rules=f"Check.Valid=1,O={org}")

@@ -94,8 +94,18 @@ class _Peer:
         self._pump: Optional[asyncio.Task] = None
 
     async def connect(self) -> None:
+        from . import tls
+
+        # ONE snapshot for the whole connection: a concurrent set_tls()
+        # can't desync the handshake context from the subject rules
+        snap = tls.current()
         host, port = self.addr.rsplit(":", 1)
-        self.reader, self.writer = await asyncio.open_connection(host, int(port))
+        self.reader, self.writer = await asyncio.open_connection(
+            host, int(port), ssl=snap.client_ctx if snap else None)
+        if snap is not None and not tls.verify_peer(self.writer, snap):
+            self.writer.close()
+            self.reader = self.writer = None
+            raise error.connection_failed("peer failed TLS subject check")
         # protocol-version handshake BEFORE the reply pump owns the reader:
         # hello out, hello back, versions must match
         _write_frame(self.writer, {"kind": "hello", "id": 0,
@@ -103,10 +113,22 @@ class _Peer:
         await self.writer.drain()
         try:
             reply = await asyncio.wait_for(_read_frame(self.reader), timeout=5.0)
-        except (asyncio.TimeoutError, asyncio.IncompleteReadError):
+        except asyncio.TimeoutError:
             self.writer.close()
             self.reader = self.writer = None
             raise error.connection_failed("handshake timeout")
+        except asyncio.IncompleteReadError:
+            # no timeout happened: the peer CLOSED mid-handshake — the
+            # classic symptom of a plaintext/TLS listener mismatch
+            self.writer.close()
+            self.reader = self.writer = None
+            raise error.connection_failed(
+                "connection closed during handshake (TLS mismatch?)")
+        if reply.get("kind") == "err":
+            self.writer.close()
+            self.reader = self.writer = None
+            raise error.connection_failed(
+                f"peer refused connection: {reply.get('body')}")
         if reply.get("kind") != "hello" or reply.get("body") != PROTOCOL_VERSION:
             self.writer.close()
             self.reader = self.writer = None
@@ -164,6 +186,7 @@ class RealProcess:
         self.handlers: Dict[str, Callable] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()
+        self._tls = None   # TLS snapshot, captured at start()
         #: strong refs — the loop keeps only weak ones, and a collected
         #: handler task means a silently dropped reply
         self._tasks: set = set()
@@ -185,7 +208,14 @@ class RealProcess:
         self.handlers.pop(token, None)
 
     async def start(self) -> None:
-        self._server = await asyncio.start_server(self._serve, self.host, self.port)
+        from . import tls
+
+        # snapshot at listen time; _serve checks peers against the SAME
+        # policy the listener's handshake context came from
+        self._tls = tls.current()
+        self._server = await asyncio.start_server(
+            self._serve, self.host, self.port,
+            ssl=self._tls.server_ctx if self._tls else None)
         self.port = self._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
@@ -199,9 +229,30 @@ class RealProcess:
 
     async def _serve(self, reader: asyncio.StreamReader,
                      writer: asyncio.StreamWriter) -> None:
+        from . import tls
+
         self._conns.add(writer)
         shaken = False
         try:
+            if self._tls is not None and not tls.verify_peer(writer,
+                                                             self._tls):
+                # consume the client's in-flight hello first — closing
+                # with unread bytes degenerates to an RST that destroys
+                # the diagnostic frame below
+                try:
+                    await asyncio.wait_for(_read_frame(reader), 5.0)
+                except (asyncio.TimeoutError, asyncio.IncompleteReadError,
+                        ConnectionError, OSError):
+                    pass
+                # tell the peer WHY before dropping — a silent close
+                # reads as a spurious transport failure and sends the
+                # operator chasing the network instead of the certs
+                _write_frame(writer, {
+                    "kind": "err", "id": 0,
+                    "body": (error.connection_failed("").code,
+                             "tls_subject_rejected")})
+                await writer.drain()
+                return
             while True:
                 msg = await _read_frame(reader)
                 if msg["kind"] == "hello":
