@@ -238,6 +238,7 @@ def main():
     parity_ok = parity_measurement_set()
     weak8 = sharded_tpu_weak_scale()
     curve = latency_curve(host_pack_ms)
+    under_load = latency_under_load(host_pack_ms, curve)
     # Sequential estimate (host pack, then device) and the pipelined rate: a
     # production resolver packs batch i+1 on the host while the device runs
     # batch i (JAX async dispatch gives the overlap for free — the host-side
@@ -265,6 +266,7 @@ def main():
         "sharded_cpu_mesh": sharded,
         "sharded_tpu_weak_scale": weak8,
         "latency_curve": curve,
+        "latency_under_load": under_load,
         "device": str(dev),
     }))
 
@@ -340,6 +342,125 @@ def latency_curve(host_pack_ms_at_headline: float):
     fitting = [p for p in out if p["total_ms"] <= 1.5]
     chosen = max(fitting, key=lambda p: p["txns_per_sec"]) if fitting else None
     return {"points": out, "production_point": chosen}
+
+
+#: client-observed p99 commit budget for the production point: the
+#: resolver-inclusive share of the reference's < 3ms end-to-end commit
+#: target (performance.rst:36,49), matching BASELINE.md's 1.5-2.5ms window.
+LATENCY_BUDGET_P99_MS = 2.5
+#: batch shapes the pipelined service is scanned over. 512 is the serial
+#: latency_curve production point (the comparison baseline); the
+#: intermediate shapes are where depth>=2 converts device speed into
+#: sustained in-budget throughput.
+HARNESS_SHAPES = (512, 768, 832, 896, 1024)
+HARNESS_SCAN_STEPS = 4096   # tunnel RTT amortized to < 0.04 ms/batch
+
+
+def latency_under_load(host_pack_ms_at_headline: float, curve: dict):
+    """Client-observed commit latency under open-loop load through the e2e
+    sim cluster, with THIS chip's measured pack/device service times
+    injected into the pipelined resolver service (pipeline/): the
+    measurement VERDICT r5 asked for — what a client sees, at what
+    sustained rate, when `depth` batches are in flight.
+
+    For each compiled batch shape the device time is measured with the
+    scan methodology at HARNESS_SCAN_STEPS (long enough that the dev
+    tunnel's dispatch RTT inflates the per-batch figure by < 0.15 ms;
+    production resolvers sit next to their chip). The sim cluster then
+    runs an open-loop Poisson arrival process against serial (depth 1) and
+    pipelined (depth >= 2) resolver configurations, offered loads at 90%
+    and 96% of each shape's device-paced capacity T / interval. The
+    production point is the highest sustained-throughput depth >= 2 point
+    whose p99 stays inside LATENCY_BUDGET_P99_MS."""
+    from foundationdb_tpu.pipeline.latency_harness import run_latency_under_load
+
+    pack_per_txn = host_pack_ms_at_headline / CFG.max_txns
+    device_ms_by_shape = {}
+    for T in HARNESS_SHAPES:
+        cfg = ck.KernelConfig(
+            key_words=4, capacity=CFG.capacity,
+            max_point_reads=2 * T, max_point_writes=2 * T,
+            max_reads=64, max_writes=64, max_txns=T, fixpoint="pallas",
+        )
+        try:
+            device_ms_by_shape[T] = measure_scan(cfg, scan_steps=HARNESS_SCAN_STEPS)
+        except Exception:
+            continue
+    if not device_ms_by_shape:
+        return None
+
+    points = []
+
+    def run_point(depth: int, T: int, offered: float, util: float) -> dict:
+        r = run_latency_under_load(
+            depth=depth, batch_txns=T, device_ms=device_ms_by_shape[T],
+            pack_ms_per_txn=pack_per_txn,
+            offered_txns_per_sec=offered, n_txns=12_000,
+        )
+        d = r.as_dict()
+        d["utilization"] = util
+        points.append(d)
+        return d
+
+    # Serial baseline: the latency_curve production shape, one batch at a
+    # time end to end (what today's resolver role delivers to a client).
+    # Its capacity is the UN-overlapped cycle: pack + device + commit path.
+    if 512 in device_ms_by_shape:
+        serial_cycle_ms = device_ms_by_shape[512] + pack_per_txn * 512 + 0.25
+        for util in (0.75, 0.85):
+            run_point(1, 512, util * 512 / (serial_cycle_ms / 1e3), util)
+    # Pipelined: double buffering across the candidate shapes, offered at
+    # and just around the device-paced capacity T / interval (open-loop —
+    # overload shows up as latency, and the budget filter rejects it).
+    for T in HARNESS_SHAPES:
+        if T != 512 and T in device_ms_by_shape:
+            capacity = T / (max(0.2, device_ms_by_shape[T]) / 1e3)
+            for util in (0.97, 1.0, 1.03):
+                run_point(2, T, util * capacity, util)
+
+    def in_budget(p):
+        return p["errors"] == 0 and p["p99_ms"] <= LATENCY_BUDGET_P99_MS
+
+    candidates = [p for p in points if p["depth"] >= 2 and in_budget(p)]
+    production = max(candidates, key=lambda p: p["sustained_txns_per_sec"]) \
+        if candidates else None
+    # Triple buffering probed at the winning shape: shows whether more
+    # in-flight batches buy anything once the device is the bottleneck.
+    if production is not None:
+        run_point(3, production["batch_txns"],
+                  production["offered_txns_per_sec"],
+                  production["utilization"])
+        candidates = [p for p in points if p["depth"] >= 2 and in_budget(p)]
+        production = max(candidates, key=lambda p: p["sustained_txns_per_sec"])
+    serial_points = [p for p in points if p["depth"] == 1 and in_budget(p)]
+    serial_best = max(serial_points, key=lambda p: p["sustained_txns_per_sec"]) \
+        if serial_points else None
+
+    out = {
+        "budget_p99_ms": LATENCY_BUDGET_P99_MS,
+        "scan_steps": HARNESS_SCAN_STEPS,
+        "device_ms_by_shape": {str(t): round(v, 4)
+                               for t, v in sorted(device_ms_by_shape.items())},
+        "points": points,
+        "serial_point": serial_best,
+        "production_point": production,
+    }
+    curve_512 = next((p for p in curve.get("points", [])
+                      if p.get("batch_txns") == 512), None)
+    if production is not None and curve_512 is not None:
+        # the acceptance quantity: sustained in-budget txn/s/chip of the
+        # pipelined service vs the serial 512-batch latency_curve point.
+        # NOTE the curve's device times come from shorter scans (more
+        # dispatch-RTT amortized into the serial denominator on a tunneled
+        # dev chip); vs_serial_harness below is the methodology-matched
+        # ratio (both sides at HARNESS_SCAN_STEPS device times).
+        out["vs_serial_512_curve"] = round(
+            production["sustained_txns_per_sec"] / curve_512["txns_per_sec"], 3)
+    if production is not None and serial_best is not None:
+        out["vs_serial_harness"] = round(
+            production["sustained_txns_per_sec"]
+            / serial_best["sustained_txns_per_sec"], 3)
+    return out
 
 
 def sharded_cpu_numbers():

@@ -553,7 +553,11 @@ class BackupAgent:
         range's chunk version — a mutation already reflected in a chunk's
         snapshot (v <= chunk version) is never applied twice, which is
         what keeps atomic ops exact."""
-        manifest = wire.loads(await self._get("manifest"))
+        raw_manifest = await self._get("manifest")
+        if raw_manifest is None:
+            raise error.client_invalid_operation(
+                "container has no manifest — backup not finished?")
+        manifest = wire.loads(raw_manifest)
         vend = manifest["end_version"]
 
         # pick, per chunk id, the NEWEST complete part set: a re-executed

@@ -73,6 +73,10 @@ def _make_server_knobs() -> Knobs:
     k.init("target_tlog_queue_bytes", 1 << 30)
     # TLog spill (reference: updatePersistentData, TLogServer.actor.cpp:539)
     k.init("tlog_spill_bytes", 2 << 20, lambda r: r.random_int(2_000, 200_000))
+    #: simulated fsync for diskless tlog roles (the static sim cluster);
+    #: the default models a conservative SSD — benchmark profiles
+    #: (pipeline/latency_harness.py) set a datacenter-NVMe figure
+    k.init("tlog_fsync_seconds", 0.0005)
     k.init("max_transactions_per_second", 1e7)
     # Storage
     k.init("storage_durability_lag_versions", 2_000_000)

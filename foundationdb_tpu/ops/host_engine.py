@@ -36,6 +36,20 @@ from .oracle import VersionIntervalMap
 from ..core.types import is_point_range as _is_point
 
 
+def donate_state_kwargs() -> dict:
+    """jit kwargs donating the engine-state argument — only off-CPU.
+
+    On the CPU backend the donation is unusable anyway (XLA warns the
+    buffers cannot be aliased), and executing a DESERIALIZED persistently
+    cached program with donated inputs corrupts the glibc heap (double
+    free, jaxlib 0.4.36) — a fresh engine whose jit hits the compilation
+    cache aborts the process a few batches in. The real accelerator path
+    keeps the in-place state aliasing."""
+    if jax.default_backend() == "cpu":
+        return {}
+    return {"donate_argnums": (0,)}
+
+
 @dataclass
 class _RoutedTxn:
     """One transaction's conflict ranges, clipped per shard (computed once).
@@ -313,6 +327,21 @@ class RoutedConflictEngineBase:
         """Fused detect+fix+apply (the fast path; no host tier involved)."""
         raise NotImplementedError
 
+    def _run_step_async(self, per_shard: List[Dict[str, np.ndarray]]):
+        """Fused step, dispatch-only: returns (status, overflow, keepalive)
+        WITHOUT forcing device values to the host. The default runs the
+        synchronous step (already-forced numpy arrays force trivially);
+        device engines override to return unmaterialized device arrays so
+        the host is free to pack the next batch while this one runs.
+
+        `keepalive` is whatever host memory the dispatched program may
+        still be reading — CPU-backend jax aliases well-aligned numpy
+        inputs ZERO-COPY, so the batch arrays handed to the jit must stay
+        referenced until the program's outputs are forced, or the async
+        program races a freed buffer (flaky verdicts / segfaults)."""
+        status, overflow = self._run_step(per_shard)
+        return status, np.asarray(overflow), None
+
     def _run_detect(self, per_shard: List[Dict[str, np.ndarray]]):
         """Phases 1-2; returns an opaque device context for _run_fix/_run_apply."""
         raise NotImplementedError
@@ -495,18 +524,37 @@ class RoutedConflictEngineBase:
         now: Version,
         new_oldest: Version,
     ) -> Optional[List[TransactionCommitResult]]:
-        """Columnar fast path over conflict-wire blocks (any shard count):
-        when every range is a short-key POINT row, batch assembly is two
-        native passes + numpy (no per-range Python); for S > 1 the C pass
-        routes each point row to its owning shard (a point range never
-        straddles a split key, so no clipping is needed). Point reads of
-        in-window keys never couple with the host long-key tier (keypack.py:
-        short-key membership is device-exact), so the fused device step is
-        always safe here.
-        Returns None (before any state change) when preconditions fail."""
+        """Columnar fast path = pack + dispatch + force, in one call."""
+        plan = self.columnar_pack(transactions, now, new_oldest)
+        if plan is None:
+            return None
+        return self.columnar_dispatch(plan)()
+
+    def columnar_pack(
+        self,
+        transactions: Sequence[CommitTransaction],
+        now: Version,
+        new_oldest: Version,
+    ) -> Optional[dict]:
+        """Host half of the columnar fast path over conflict-wire blocks
+        (any shard count): when every range is a short-key POINT row, batch
+        assembly is two native passes + numpy (no per-range Python); for
+        S > 1 the C pass routes each point row to its owning shard (a point
+        range never straddles a split key, so no clipping is needed). Point
+        reads of in-window keys never couple with the host long-key tier
+        (keypack.py: short-key membership is device-exact), so the fused
+        device step is always safe here.
+
+        Returns an opaque plan for columnar_dispatch, or None when
+        preconditions fail (the general router must handle the batch).
+        Mutates NO engine state, but the packed arrays embed base-relative
+        versions: the matching columnar_dispatch must run before any LATER
+        batch packs (the ResolverPipeline keeps this ordering)."""
         cfg = self.cfg
         S = self.n_shards
         ntx = len(transactions)
+        if ntx == 0:
+            return None
         blocks = []
         for tr in transactions:
             blk, all_point, max_len = tr.conflict_wire_info()
@@ -547,7 +595,7 @@ class RoutedConflictEngineBase:
         cw = np.cumsum(eff_w, axis=0)
 
         now_rel = self._rel(now)
-        results: List[TransactionCommitResult] = []
+        chunks: List[Tuple[List[Dict[str, np.ndarray]], int]] = []
         i = 0
         while i < ntx:
             r0 = cr[i - 1] if i else np.zeros_like(cr[0])
@@ -582,18 +630,51 @@ class RoutedConflictEngineBase:
                     cfg, blob, offs, i, j, skip, snap_rel, eff_r, now_rel,
                     gc_rel, self._splits_blob, self._splits_offs, S,
                 )
-            status, overflow = self._run_step(per)
-            if overflow:
-                raise error.conflict_capacity_exceeded(
-                    f"a shard's boundary table needs > {cfg.capacity} rows"
-                )
-            results.extend(TransactionCommitResult(int(v)) for v in status[: j - i])
+            chunks.append((per, j - i))
             i = j
+        return {"chunks": chunks, "new_oldest": new_oldest}
+
+    def columnar_dispatch(self, plan: dict):
+        """Device half of the columnar fast path: dispatch every chunk's
+        program via JAX ASYNC dispatch (nothing is forced to the host) and
+        advance the host version bookkeeping. Returns force() ->
+        List[TransactionCommitResult], which blocks on the device values.
+
+        The ResolverPipeline keeps several dispatched batches in flight —
+        the host packs batch i+1 while the device still runs batch i — and
+        forces them in commit-version order, so abort sets are bit-identical
+        to the serial resolve() path (the device programs run in dispatch
+        order on one device queue either way). One observable difference:
+        a boundary-table overflow raises at force() time, after any later
+        chunks of the SAME batch were already dispatched (the serial path
+        stops at the overflowing chunk); overflow is a fatal capacity error
+        in both cases."""
+        outs = []
+        for per, n in plan["chunks"]:
+            status_dev, overflow_dev, keepalive = self._run_step_async(per)
+            # keepalive pins the host arrays the async program may be
+            # reading zero-copy; it rides in `outs` until force() has
+            # blocked on the program's outputs (see _run_step_async).
+            outs.append((status_dev, overflow_dev, n, keepalive))
+        new_oldest = plan["new_oldest"]
         if new_oldest > self.oldest_version:
             self.tier_map.gc(new_oldest)
             self.oldest_version = new_oldest
             self.base += max(0, new_oldest - self.base)
-        return results
+        capacity = self.cfg.capacity
+
+        def force() -> List[TransactionCommitResult]:
+            results: List[TransactionCommitResult] = []
+            for status_dev, overflow_dev, n, _keepalive in outs:
+                status = np.asarray(status_dev)
+                if bool(np.asarray(overflow_dev)):
+                    raise error.conflict_capacity_exceeded(
+                        f"a shard's boundary table needs > {capacity} rows"
+                    )
+                results.extend(TransactionCommitResult(int(v)) for v in status[:n])
+            return results
+
+        return force
 
     def _resolve_chunk(
         self, routed: Sequence[_RoutedTxn], now: Version, new_oldest: Version
@@ -794,12 +875,12 @@ class SubshardedConflictEngine(RoutedConflictEngineBase):
         self.tier_map = VersionIntervalMap(initial_version)
         self._step = jax.jit(
             functools.partial(ck.resolve_step_stacked, cfg),
-            donate_argnums=(0,),
+            **donate_state_kwargs(),
         )
         self._detect = jax.jit(functools.partial(ck.detect_step_stacked, cfg))
         self._fix = jax.jit(functools.partial(ck.fix_step_stacked, cfg))
         self._apply = jax.jit(
-            functools.partial(ck.apply_step_stacked, cfg), donate_argnums=(0,))
+            functools.partial(ck.apply_step_stacked, cfg), **donate_state_kwargs())
 
     def _reset_device_state(self, version_rel: int) -> None:
         per = [
@@ -818,6 +899,11 @@ class SubshardedConflictEngine(RoutedConflictEngineBase):
         batch = self._stack(per_shard)
         self.state, out = self._step(self.state, batch)
         return np.asarray(out["status"]), bool(out["overflow"])
+
+    def _run_step_async(self, per_shard: List[Dict[str, np.ndarray]]):
+        batch = self._stack(per_shard)
+        self.state, out = self._step(self.state, batch)
+        return out["status"], out["overflow"], batch
 
     def _run_detect(self, per_shard):
         batch = self._stack(per_shard)
@@ -849,13 +935,13 @@ class JaxConflictEngine(RoutedConflictEngineBase):
         self.tier_map = VersionIntervalMap(initial_version)
         self._step = jax.jit(
             functools.partial(ck.resolve_step, cfg),
-            donate_argnums=(0,),
+            **donate_state_kwargs(),
         )
         # Split-step programs for the long-key tier path, compiled lazily
         # (short-key-only workloads never pay for them).
         self._detect = jax.jit(functools.partial(ck.detect_step, cfg))
         self._fix = jax.jit(functools.partial(ck.fix_step, cfg))
-        self._apply = jax.jit(functools.partial(ck.apply_step, cfg), donate_argnums=(0,))
+        self._apply = jax.jit(functools.partial(ck.apply_step, cfg), **donate_state_kwargs())
 
     def _reset_device_state(self, version_rel: int) -> None:
         self.state = ck.initial_state(self.cfg, version_rel=version_rel)
@@ -865,6 +951,12 @@ class JaxConflictEngine(RoutedConflictEngineBase):
         batch = {k: jnp.asarray(v) for k, v in arrays.items()}
         self.state, out = self._step(self.state, batch)
         return np.asarray(out["status"]), bool(out["overflow"])
+
+    def _run_step_async(self, per_shard: List[Dict[str, np.ndarray]]):
+        (arrays,) = per_shard
+        batch = {k: jnp.asarray(v) for k, v in arrays.items()}
+        self.state, out = self._step(self.state, batch)
+        return out["status"], out["overflow"], (arrays, batch)
 
     def _run_detect(self, per_shard):
         (arrays,) = per_shard

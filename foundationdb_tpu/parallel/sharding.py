@@ -37,11 +37,27 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax >= 0.4.35 exposes shard_map at top level; 0.4.3x still keeps it in
+# experimental — accept either so the pinned container jax keeps working.
+# check_rep=False: the replication checker has no rule for while_loop (the
+# commit fixpoint) on these jax versions; the step's own psum discipline is
+# what guarantees replicated verdicts, so the static check is advisory here.
+_raw_shard_map = getattr(jax, "shard_map", None)
+if _raw_shard_map is None:
+    from jax.experimental.shard_map import shard_map as _raw_shard_map
+
+
+def _shard_map(f, **kw):
+    try:
+        return _raw_shard_map(f, check_rep=False, **kw)
+    except TypeError:   # newer jax dropped/renamed check_rep
+        return _raw_shard_map(f, **kw)
+
 from ..core.types import Version
 from ..ops import conflict_kernel as ck
 from ..ops.conflict_kernel import KernelConfig
 from ..core.keyshard import KeyShardMap
-from ..ops.host_engine import RoutedConflictEngineBase
+from ..ops.host_engine import RoutedConflictEngineBase, donate_state_kwargs
 
 __all__ = ["KeyShardMap", "ShardedConflictEngine", "make_sharded_step"]
 
@@ -80,8 +96,8 @@ def make_sharded_step(cfg: KernelConfig, mesh: Mesh, axis: str = "shard"):
         }
         return jax.tree.map(lambda x: jnp.asarray(x)[None], (new_state, out))
 
-    mapped = jax.shard_map(step, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P(axis))
-    return jax.jit(mapped, donate_argnums=(0,))
+    mapped = _shard_map(step, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P(axis))
+    return jax.jit(mapped, **donate_state_kwargs())
 
 
 def make_sharded_split_steps(cfg: KernelConfig, mesh: Mesh, axis: str = "shard"):
@@ -116,15 +132,15 @@ def make_sharded_split_steps(cfg: KernelConfig, mesh: Mesh, axis: str = "shard")
         new_state, overflow = ck.apply_writes_and_gc(cfg, state, batch, committed, wpos)
         return jax.tree.map(lambda x: jnp.asarray(x)[None], (new_state, overflow))
 
-    detect_m = jax.jit(jax.shard_map(
+    detect_m = jax.jit(_shard_map(
         detect, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P(axis)))
-    fix_m = jax.jit(jax.shard_map(
+    fix_m = jax.jit(_shard_map(
         fix, mesh=mesh, in_specs=(P(axis), P(axis), P(axis), P(axis)),
         out_specs=P(axis)))
-    apply_m = jax.jit(jax.shard_map(
+    apply_m = jax.jit(_shard_map(
         apply, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis)), out_specs=P(axis)),
-        donate_argnums=(0,))
+        **donate_state_kwargs())
     return detect_m, fix_m, apply_m
 
 

@@ -1,0 +1,25 @@
+"""Pipelined resolver service: multi-batch in-flight conflict resolution.
+
+Three pieces (see docs/pipeline.md):
+
+  * ResolverPipeline — wall-clock engine pipeline: host packing (inline or
+    executor) overlapped with JAX async device dispatch, a configurable
+    in-flight window, results forced in commit-version order.
+  * PipelineConfig / PipelinedResolverService — the sim-cluster resolver's
+    virtual-time twin: same window/stage structure with measured pack and
+    device times injected as delays (server/resolver.py drains its queue
+    through it instead of blocking per batch).
+  * latency_harness (imported lazily — it pulls in the whole sim cluster):
+    open-loop arrivals through the e2e sim cluster, reporting
+    client-observed commit-latency percentiles + sustained throughput for
+    bench.py's `latency_under_load` section.
+"""
+from .resolver_pipeline import PendingResolve, ResolverPipeline
+from .service import PipelineConfig, PipelinedResolverService
+
+__all__ = [
+    "PendingResolve",
+    "ResolverPipeline",
+    "PipelineConfig",
+    "PipelinedResolverService",
+]

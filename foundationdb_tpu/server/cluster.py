@@ -14,6 +14,7 @@ from typing import Callable, List, Optional
 from ..core.types import KeyRange
 from ..core.keyshard import KeyShardMap
 from ..ops.oracle import OracleConflictEngine
+from ..pipeline.service import PipelineConfig
 from ..sim.actors import AsyncVar
 from ..sim.network import Endpoint
 from ..sim.simulator import Simulator
@@ -36,6 +37,14 @@ class ClusterConfig:
     #: lambda: JaxConflictEngine(...) for the TPU path.
     engine_factory: Callable = OracleConflictEngine
     start_version: int = 1
+    #: pipelined resolver service (pipeline/service.py): depth/pack/device
+    #: knobs; None keeps the serial one-batch-at-a-time resolver
+    resolver_pipeline: Optional["PipelineConfig"] = None
+    #: proxy commit batch cap (None = proxy default); size it to the
+    #: resolver kernel's compiled T when pipelining
+    max_commit_batch: Optional[int] = None
+    #: proxy in-flight commit window (None = unbounded)
+    commit_pipeline_window: Optional[int] = None
 
 
 class Cluster:
@@ -59,7 +68,9 @@ class Cluster:
         self.resolver_shards = KeyShardMap.uniform(cfg.n_resolvers)
         self.resolver_procs = [sim.new_process(f"resolver{i}") for i in range(cfg.n_resolvers)]
         self.resolvers = [
-            Resolver(p, cfg.engine_factory(), start_version=sv) for p in self.resolver_procs
+            Resolver(p, cfg.engine_factory(), start_version=sv, index=i,
+                     pipeline=cfg.resolver_pipeline)
+            for i, p in enumerate(self.resolver_procs)
         ]
 
         self.storage_shards = KeyShardMap.uniform(cfg.n_storage)
@@ -109,6 +120,8 @@ class Cluster:
                     storage_teams=self.storage_teams,
                     storage_shards=self.storage_shards,
                     peer_grv_eps=peer_grv_eps,
+                    max_commit_batch=cfg.max_commit_batch,
+                    commit_pipeline_window=cfg.commit_pipeline_window,
                 ),
                 start_version=sv,
             )
@@ -162,6 +175,10 @@ class DynamicClusterConfig:
     #: extra one-way latency between processes in different DCs (the
     #: DCN tier; 0 keeps single-region runs byte-identical)
     inter_dc_latency: float = 0.0
+    #: pipelined resolver service knobs as a plain dict (wire-friendly for
+    #: real-mode recruitment): PipelineConfig(**resolver_pipeline); None
+    #: keeps the serial resolver
+    resolver_pipeline: Optional[dict] = None
     engine_factory: Callable = OracleConflictEngine
 
 

@@ -164,6 +164,9 @@ class ClusterController:
                 doc["qos"] = {
                     "transactions_per_second_limit": frag["tps_limit"],
                     "worst_storage_lag_versions": frag["worst_storage_lag_versions"],
+                    # stale = every storage poll timed out; worst_lag is a
+                    # reset placeholder, not a healthy 0 (ratekeeper.py)
+                    "storage_lag_stale": frag.get("storage_lag_stale", False),
                 }
             except error.FDBError:
                 doc["cluster"]["version"] = None

@@ -597,10 +597,20 @@ class MasterServer:
                 TraceEvent("SatelliteForcesFullLogReplication",
                            id=self.salt).log()
                 log_repl = 0
-            kept = tlog_addrs[: n_tlogs - n_sat]
             sat_pool = [w for w in workers
-                        if dc_of(w) != primary_dc and w not in kept]
+                        if dc_of(w) != primary_dc
+                        and w not in tlog_addrs[: n_tlogs - n_sat]]
             sats = sat_pool[:n_sat]
+            if len(sats) < n_sat:
+                # Thin non-primary pool: backfill the shortfall from the
+                # primary so the generation still runs n_tlogs replicas —
+                # reduced satellite coverage, never reduced replication.
+                TraceEvent("SatelliteRecruitmentShort", id=self.salt).detail(
+                    "Requested", n_sat).detail(
+                    "Recruited", len(sats)).detail(
+                    "BackfilledFromPrimary", n_sat - len(sats)).log()
+            # keep enough primary tlogs that kept + sats == n_tlogs
+            kept = tlog_addrs[: n_tlogs - len(sats)]
             if sats:
                 tlog_addrs = kept + sats
         TraceEvent("RecruitPlacement", id=self.salt).detail(
@@ -762,6 +772,7 @@ class MasterServer:
                 "recovery_version": recovery_version,
                 "tps_limit": ratekeeper.tps_limit,
                 "worst_storage_lag_versions": ratekeeper.worst_lag,
+                "storage_lag_stale": ratekeeper.lag_stale,
                 "tlogs": list(tlog_addrs),
                 "resolvers": list(resolver_addrs),
                 "proxies": list(proxy_addrs),

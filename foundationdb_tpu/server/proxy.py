@@ -190,6 +190,17 @@ class ProxyConfig:
     #: (including this one); GRVs confirm the max committed version across
     #: all of them (getLiveCommittedVersion, MasterProxyServer.actor.cpp:897)
     peer_grv_eps: List[Endpoint] = field(default_factory=list)
+    #: transactions per commit batch (None = MAX_COMMIT_BATCH); a pipelined
+    #: resolver is fed batches sized to its compiled kernel shape T
+    max_commit_batch: Optional[int] = None
+    #: in-flight commit window (None = unbounded, today's behavior): at
+    #: most this many batches between dispatch and fully-logged. While the
+    #: window is full the batcher KEEPS ACCUMULATING arrivals, so resolver
+    #: backpressure turns into larger batches — the feed a multi-batch
+    #: in-flight resolver pipeline needs — instead of a deeper queue of
+    #: tiny batches stalled at the version chain. Size it to the resolver
+    #: pipeline depth + 1 (one batch accumulating, `depth` in service).
+    commit_pipeline_window: Optional[int] = None
 
 
 class Proxy:
@@ -437,6 +448,12 @@ class Proxy:
             await delay(interval, TaskPriority.PROXY_COMMIT_BATCHER)
             if now() - self._last_batch_time < IDLE_COMMIT_INTERVAL:
                 continue
+            W = self.cfg.commit_pipeline_window
+            if W and self.batch_logging.get() < self._batch_num + 1 - W:
+                # in-flight window full: an empty batch can't advance the
+                # KCV horizon (phase-4 pushes are ordered behind the stall)
+                # and would breach the bound the window exists to enforce
+                continue
             self._batch_num += 1
             self._last_batch_time = now()
             self._spawn(
@@ -455,7 +472,8 @@ class Proxy:
             batch = [first]
             deadline = delay(SERVER_KNOBS.commit_transaction_batch_interval,
                              TaskPriority.PROXY_COMMIT_BATCHER)
-            cap = min(MAX_COMMIT_BATCH, SERVER_KNOBS.commit_transaction_batch_count_max)
+            cap = min(self.cfg.max_commit_batch or MAX_COMMIT_BATCH,
+                      SERVER_KNOBS.commit_transaction_batch_count_max)
             if buggify.buggify():
                 cap = 1  # force single-transaction batches: deep pipelines
             while len(batch) < cap:
@@ -464,6 +482,21 @@ class Proxy:
                     break
                 batch.append(pending.get())
                 pending = self._commit_queue.stream.pop()
+            W = self.cfg.commit_pipeline_window
+            # In-flight window gate: dispatch only when fewer than W batches
+            # sit between dispatch and fully-logged; keep filling the batch
+            # (up to cap) while waiting so backpressure becomes batch size,
+            # not queue depth. Re-checked against a fresh _batch_num each
+            # pass — the idle committer may claim numbers while we wait.
+            while W and self.batch_logging.get() < self._batch_num + 1 - W:
+                gate = self.batch_logging.when_at_least(self._batch_num + 1 - W)
+                while not gate.is_ready and len(batch) < cap:
+                    which, _ = await any_of([pending, gate])
+                    if which == 0:
+                        batch.append(pending.get())
+                        pending = self._commit_queue.stream.pop()
+                if not gate.is_ready:
+                    await gate
             self._batch_num += 1
             from ..sim.loop import now as _now
 
@@ -530,7 +563,14 @@ class Proxy:
             if buggify.buggify():
                 # stall the drain: later batches pile up behind phase 3.5
                 await delay(0.05, TaskPriority.PROXY_COMMIT)
+            floor_before = self._metadata_version
             try:
+                # Advertise our committed version first: the peek blocks on
+                # the tlog's known-committed horizon, and when no later push
+                # is in flight to carry the KCV forward (an idle or sparse
+                # commit pipeline), the replica would otherwise sit at the
+                # full peek timeout before the retry path advertises it.
+                self.log.send_kcv(self.committed_version.get())
                 reply = await self.log.peek(
                     METADATA_TAG, self._metadata_version + 1, timeout=1.0)
             except error.FDBError as e:
@@ -546,14 +586,23 @@ class Proxy:
                 for m in muts:
                     self.routing.apply_mutation(m)
             new_floor = min(reply.end_version, upto)
-            if new_floor <= self._metadata_version:
+            if new_floor <= floor_before:
+                if self._metadata_version > floor_before:
+                    # A concurrent drain (phase 3.5 of an overlapping batch)
+                    # advanced the floor while our peek was in flight: that
+                    # is progress, not a stall — re-check immediately. The
+                    # backoff below would otherwise park this batch (and,
+                    # through the ordered phase-4 push, every batch behind
+                    # it) for the full retry interval.
+                    continue
                 attempts += 1
                 if attempts >= int(SERVER_REQUEST_TIMEOUT * 4):
                     raise error.timed_out("metadata drain stalled")
                 self.log.send_kcv(self.committed_version.get())
                 await delay(0.25, TaskPriority.PROXY_COMMIT)
                 continue
-            self._metadata_version = new_floor
+            attempts = 0
+            self._metadata_version = max(self._metadata_version, new_floor)
 
     async def _repair_unknown_version(self, request_num: int) -> None:
         """Recover the version pair for a lost GetCommitVersion exchange and

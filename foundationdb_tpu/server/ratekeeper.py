@@ -79,6 +79,10 @@ class Ratekeeper:
         self.log_config = log_config
         self.tps_limit: float = float(SERVER_KNOBS.max_transactions_per_second)
         self.worst_lag: int = 0
+        #: True while NO storage poll has answered in the last update window:
+        #: worst_lag is then a reset placeholder, not a live measurement —
+        #: status/telemetry must show signal loss, never a frozen reading
+        self.lag_stale: bool = True
         self.worst_tlog_bytes: int = 0
 
     async def run(self) -> None:
@@ -140,9 +144,16 @@ class Ratekeeper:
         by storage_durability_lag_versions on purpose."""
         max_tps = float(SERVER_KNOBS.max_transactions_per_second)
         tps_lag = tps_bytes = max_tps
-        if infos:   # no storage reply = no storage signal; the TLOG signal
+        if not infos:
+            # Every storage poll timed out: the prior worst_lag no longer
+            # corresponds to any live measurement. Reset it and mark it
+            # stale rather than publishing a frozen reading.
+            self.worst_lag = 0
+            self.lag_stale = True
+        else:       # no storage reply = no storage signal; the TLOG signal
             #         below must still bite (a buried tlog during a storage
             #         partition is exactly when admission must slow)
+            self.lag_stale = False
             committed = self.committed_version_fn()
             self.worst_lag = max(max(0, committed - i.version) for i in infos)
             if self.worst_lag >= MAX_STORAGE_LAG_VERSIONS:

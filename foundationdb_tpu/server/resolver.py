@@ -13,16 +13,19 @@ which keeps runs deterministic (SURVEY.md §5 race-detection strategy).
 """
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, Optional
 
 from ..core import buggify, error
+from ..core.stats import CounterCollection
 from ..core.types import (
     CommitTransaction,
     KeyRange,
     MAX_WRITE_TRANSACTION_LIFE_VERSIONS,
     Version,
 )
+from ..pipeline.service import PipelineConfig, PipelinedResolverService
 from ..sim.actors import NotifiedVersion
+from ..sim.loop import Promise, TaskPriority, spawn
 from ..sim.network import SimProcess
 from .messages import ResolveTransactionBatchRequest, ResolveTransactionBatchReply
 
@@ -62,12 +65,17 @@ def gained_ranges(old_splits: tuple, new_splits: tuple, i: int) -> list:
 
 class Resolver:
     def __init__(self, proc: SimProcess, engine, start_version: Version = 0,
-                 token_suffix: str = "", index: int = 0):
+                 token_suffix: str = "", index: int = 0,
+                 pipeline: Optional[PipelineConfig] = None):
         """`engine` implements resolve(transactions, now, new_oldest) and
         clear(version) — OracleConflictEngine, JaxConflictEngine or
         ShardedConflictEngine (ops/, parallel/). token_suffix scopes the
         endpoint to one recovery generation; `index` is this resolver's
-        key-shard slot (live rebalancing computes its gained spans)."""
+        key-shard slot (live rebalancing computes its gained spans).
+        `pipeline` turns the one-batch-at-a-time path into the windowed
+        multi-batch in-flight service (pipeline/service.py): up to
+        `pipeline.depth` batches overlap pack/device stages, verdicts stay
+        bit-identical to the serial path."""
         from ..sim.loop import current_scheduler
 
         self.proc = proc
@@ -81,6 +89,12 @@ class Resolver:
         # replay window: version -> reply, for proxy retries after
         # request_maybe_delivered (reference keeps recentStateTransactions)
         self._recent: Dict[Version, ResolveTransactionBatchReply] = {}
+        #: versions accepted into the pipeline but not yet resolved: a
+        #: duplicate delivery awaits the in-flight future instead of
+        #: missing the replay window
+        self._inflight: Dict[Version, Promise] = {}
+        self._service = (PipelinedResolverService(pipeline, engine)
+                         if pipeline is not None else None)
         #: conflict-range rows since the last metrics poll + a reservoir
         #: sample of range-begin keys (reference: ResolutionMetricsRequest /
         #: ResolutionSplitRequest, Resolver.actor.cpp:276-284)
@@ -88,12 +102,21 @@ class Resolver:
         self._rows_total = 0
         self._key_sample: list = []
         self._sample_rng = current_scheduler().rng
+        #: reference: Resolver.actor.cpp's resolverCounters via traceCounters
+        #: — the logger is a real scheduled task (cancelled on unregister),
+        #: not a dropped coroutine, so resolver counters actually trace
+        self.stats = CounterCollection("Resolver", proc.address)
+        self._stats_task = spawn(self.stats.run_logger(),
+                                 TaskPriority.RESOLUTION_METRICS,
+                                 name="resolverStats")
+        proc.actors.add(self._stats_task)
         proc.register(self.token, self.resolve_batch)
         proc.register(self.metrics_token, self.resolution_metrics)
 
     def unregister(self) -> None:
         self.proc.unregister(self.token)
         self.proc.unregister(self.metrics_token)
+        self._stats_task.cancel()
 
     def _sample_rows(self, transactions) -> None:
         rng = self._sample_rng
@@ -122,18 +145,18 @@ class Resolver:
         """reference: resolveBatch, Resolver.actor.cpp:71-260."""
         if req.version <= self.version.get():
             # Already resolved (proxy retry): replay the recorded verdicts.
-            return self._replay(req.version)
+            return await self._replay(req.version)
         await self.version.when_at_least(req.prev_version)
         if req.version <= self.version.get():
             # A duplicate delivery resolved this version while we waited.
-            return self._replay(req.version)
+            return await self._replay(req.version)
         if buggify.buggify():
             # slow resolve: batches queue up behind the version chain, so
             # proxies see deep pipelining + retry races
-            from ..sim.loop import TaskPriority, delay
+            from ..sim.loop import delay
             await delay(0.05, TaskPriority.PROXY_COMMIT)
             if req.version <= self.version.get():
-                return self._replay(req.version)
+                return await self._replay(req.version)
         window = MAX_WRITE_TRANSACTION_LIFE_VERSIONS
         if buggify.buggify():
             # tight replay/conflict window: drives the too-old and
@@ -164,22 +187,81 @@ class Resolver:
                 transactions = [synth] + list(req.transactions)
                 prepended = True
         self._sample_rows(req.transactions)
-        verdicts = self.engine.resolve(transactions, req.version, new_oldest)
+
+        if self._service is None:
+            # Serial path: one batch at a time, the chain advances when the
+            # batch is fully resolved.
+            verdicts = self.engine.resolve(transactions, req.version, new_oldest)
+            return self._finish(req.version, verdicts, prepended, new_oldest)
+
+        # Pipelined path: acquire a window slot, ADVANCE THE CHAIN AT
+        # ACCEPT so the next batch enters its pack stage while this one is
+        # still on the device (multi-batch in flight), and resolve through
+        # the service — which runs engine.resolve strictly in commit-version
+        # order, so abort sets are bit-identical to the serial path.
+        await self._service.acquire()
+        if req.version <= self.version.get():
+            # A duplicate delivery accepted this version while we waited
+            # for a slot; hand the slot back and follow the replay path.
+            self._service.release()
+            return await self._replay(req.version)
+        p = Promise()
+        self._inflight[req.version] = p
+        self.version.set(req.version)
+        try:
+            verdicts = await self._service.resolve(
+                transactions, req.version, new_oldest)
+        except BaseException:
+            self._inflight.pop(req.version, None)
+            if not p.is_set:
+                # duplicates waiting on this version get the honest answer:
+                # the batch died in service; the proxy absorbs it as
+                # commit_unknown_result + chain repair
+                p.send_error(error.please_reboot(
+                    f"resolve {req.version} failed in pipeline"))
+            raise
+        reply = self._finish(req.version, verdicts, prepended, new_oldest,
+                             advance_chain=False)
+        self._inflight.pop(req.version, None)
+        p.send(reply)
+        return reply
+
+    def _finish(self, version: Version, verdicts, prepended: bool,
+                new_oldest: Version,
+                advance_chain: bool = True) -> ResolveTransactionBatchReply:
+        from ..core.types import TransactionCommitResult
+
         if prepended:
             verdicts = verdicts[1:]   # the synthetic is ours, not a txn
         reply = ResolveTransactionBatchReply(committed=[int(v) for v in verdicts])
-        self._recent[req.version] = reply
-        # GC the replay window along with the conflict window.
+        self._recent[version] = reply
+        # GC the replay window along with the conflict window (completions
+        # are version-ordered even when pipelined, so this stays monotone).
         for v in [v for v in self._recent if v < new_oldest]:
             del self._recent[v]
-        self.version.set(req.version)
+        if advance_chain:
+            self.version.set(version)
+        self.stats.add("batches_resolved")
+        self.stats.add("txns_in", len(reply.committed))
+        for v in reply.committed:
+            if v == int(TransactionCommitResult.COMMITTED):
+                self.stats.add("txns_committed")
+            elif v == int(TransactionCommitResult.TOO_OLD):
+                self.stats.add("txns_too_old")
+            else:
+                self.stats.add("txns_conflicted")
         return reply
 
-    def _replay(self, version: Version) -> ResolveTransactionBatchReply:
+    async def _replay(self, version: Version) -> ResolveTransactionBatchReply:
         """A sufficiently delayed duplicate may ask for a version already
         GC'd from the replay window; that is a typed error the proxy's
-        commit_unknown_result path absorbs, never a process crash."""
+        commit_unknown_result path absorbs, never a process crash. A
+        version still in the pipeline's in-flight window answers with the
+        in-flight result once it completes."""
         cached = self._recent.get(version)
-        if cached is None:
-            raise error.please_reboot(f"resolve replay window GC'd version {version}")
-        return cached
+        if cached is not None:
+            return cached
+        inflight = self._inflight.get(version)
+        if inflight is not None:
+            return await inflight.future
+        raise error.please_reboot(f"resolve replay window GC'd version {version}")

@@ -46,8 +46,6 @@ KCV_TOKEN = "tlog.knownCommitted"
 RECOVERY_DATA_TOKEN = "tlog.recoveryData"
 QUEUE_INFO_TOKEN = "tlog.queueInfo"
 
-FSYNC_SECONDS = 0.0005
-
 
 def _spill_key(tag: int, version: Version) -> bytes:
     """Order-preserving (tag, version) key for the spill store. Tags can be
@@ -428,7 +426,8 @@ class TLog:
             self._mem_bytes += len(payload)
             await self.queue.commit()
         else:
-            await delay(FSYNC_SECONDS, TaskPriority.TLOG_COMMIT)
+            from ..core.knobs import SERVER_KNOBS
+            await delay(SERVER_KNOBS.tlog_fsync_seconds, TaskPriority.TLOG_COMMIT)
         # Chained waiters run only after this version is durable.
         self._inflight.discard(req.version)
         if self.stopped:

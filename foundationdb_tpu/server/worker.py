@@ -257,10 +257,16 @@ class Worker:
     async def init_resolver(self, req: InitializeResolverRequest) -> str:
         key = ("resolver", req.gen_id[0], req.gen_id[1], req.replica_index)
         if key not in self.roles:
+            pipe = None
+            pipe_knobs = getattr(self.cluster_cfg, "resolver_pipeline", None)
+            if pipe_knobs:
+                from ..pipeline.service import PipelineConfig
+
+                pipe = PipelineConfig(**pipe_knobs)
             self.roles[key] = Resolver(
                 self.proc, self.engine_factory(),
                 start_version=req.start_version, token_suffix=req.token_suffix,
-                index=req.replica_index,
+                index=req.replica_index, pipeline=pipe,
             )
         return self.proc.address
 

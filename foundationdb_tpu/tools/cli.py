@@ -90,7 +90,9 @@ class Cli:
             self._print(f"  version            - {c['version']}")
         if doc.get("qos"):
             self._print(f"  tps limit          - {doc['qos'].get('transactions_per_second_limit')}")
-            self._print(f"  worst storage lag  - {doc['qos'].get('worst_storage_lag_versions')} versions")
+            stale = " (STALE — no storage poll answered)" \
+                if doc['qos'].get('storage_lag_stale') else ""
+            self._print(f"  worst storage lag  - {doc['qos'].get('worst_storage_lag_versions')} versions{stale}")
         for s in doc.get("storage", []):
             state = "unreachable" if s.get("unreachable") else f"v={s.get('durable_version')}"
             self._print(f"  storage tag {s['tag']}      - {s['address']} ({state})")

@@ -106,3 +106,22 @@ def test_unthrottled_cluster_is_fast():
 
     elapsed = sim.run_until(sim.sched.spawn(work(), name="w"), until=120.0)
     assert elapsed < 1.0, elapsed
+
+
+def test_total_storage_timeout_marks_lag_stale():
+    """ADVICE: when EVERY storage poll times out, the ratekeeper must not
+    keep publishing the last worst_lag as if it were live — the reading is
+    reset and flagged stale until a poll answers again."""
+    rk = Ratekeeper(None, "x", [], lambda: 10_000_000)
+    assert rk.lag_stale  # no poll has ever answered
+    infos = [StorageQueueInfo(0, 10_000_000 - 2 * MAX_STORAGE_LAG_VERSIONS, 0)]
+    rk._update_rate(infos)
+    assert not rk.lag_stale
+    assert rk.worst_lag >= 2 * MAX_STORAGE_LAG_VERSIONS
+    # every storage poll timed out: frozen reading must not survive
+    rk._update_rate([])
+    assert rk.lag_stale
+    assert rk.worst_lag == 0
+    # signal returns -> live again
+    rk._update_rate([StorageQueueInfo(0, 10_000_000, 0)])
+    assert not rk.lag_stale

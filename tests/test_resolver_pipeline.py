@@ -1,0 +1,264 @@
+"""Parity of the pipelined resolver vs the serial path.
+
+The pipeline's correctness claim (pipeline/resolver_pipeline.py,
+pipeline/service.py) is that moving the host's blocking points — packing
+batch i+1 while batch i runs on the device, several batches in flight —
+changes NOTHING about the verdicts: abort sets are bit-identical to the
+one-batch-at-a-time resolver, because device programs still run in
+commit-version order. These tests assert that equality
+
+  * for the wall-clock ResolverPipeline over the real columnar engine,
+    across depths {1,2,3}, inline and executor packing, including batches
+    that fall off the columnar fast path (range rows);
+  * for the sim-cluster resolver role across depths {1,2,3} under
+    BUGGIFY'd batch arrival jitter, duplicate deliveries (proxy retries)
+    and a kill/restart of the resolver role mid-window;
+  * end-to-end: a dynamic cluster with the pipelined resolver recovers
+    through a resolver-role kill and keeps committing.
+"""
+import random
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from foundationdb_tpu.core import buggify, error
+from foundationdb_tpu.core.types import CommitTransaction, KeyRange
+from foundationdb_tpu.ops.conflict_kernel import KernelConfig
+from foundationdb_tpu.ops.host_engine import JaxConflictEngine
+from foundationdb_tpu.ops.oracle import OracleConflictEngine
+from foundationdb_tpu.pipeline import PipelineConfig, ResolverPipeline
+from foundationdb_tpu.server.messages import ResolveTransactionBatchRequest
+from foundationdb_tpu.server.resolver import Resolver
+from foundationdb_tpu.sim.loop import TaskPriority, delay, set_scheduler
+from foundationdb_tpu.sim.simulator import Simulator
+
+SMALL = KernelConfig(key_words=2, capacity=1024, max_reads=64, max_writes=64,
+                     max_txns=32)
+
+
+@pytest.fixture(autouse=True)
+def reset():
+    yield
+    buggify.disable()
+    set_scheduler(None)
+
+
+def make_batches(seed: int, n_batches: int = 14, pool: int = 96,
+                 range_every: int = 5):
+    """Deterministic conflicting batch stream: point reads/writes over a
+    hot pool, snapshots lagging enough to produce real aborts; every
+    `range_every`th batch carries a true range row, which knocks it off
+    the columnar fast path (plan=None) mid-pipeline."""
+    rng = random.Random(seed)
+    batches = []
+    v = 0
+    for b in range(n_batches):
+        v += rng.randrange(40, 200)
+        txns = []
+        for _ in range(rng.randrange(3, SMALL.max_txns // 2)):
+            t = CommitTransaction(
+                read_snapshot=max(0, v - rng.randrange(1, 400)))
+            for _ in range(rng.randrange(1, 3)):
+                k = b"pp/%04d" % rng.randrange(pool)
+                t.read_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            for _ in range(rng.randrange(1, 3)):
+                k = b"pp/%04d" % rng.randrange(pool)
+                t.write_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            if range_every and b % range_every == range_every - 1 \
+                    and rng.random() < 0.5:
+                a, z = sorted([b"pp/%04d" % rng.randrange(pool),
+                               b"pp/%04d" % rng.randrange(pool)])
+                t.read_conflict_ranges.append(KeyRange(a, z + b"\xff"))
+            txns.append(t)
+        batches.append((txns, v, max(0, v - 2000)))
+    return batches
+
+
+def serial_verdicts(batches, engine_factory):
+    eng = engine_factory()
+    return [[int(x) for x in eng.resolve(txns, v, old)]
+            for txns, v, old in batches]
+
+
+# ---------------------------------------------------------------------------
+# wall-clock ResolverPipeline
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("depth", [1, 2, 3])
+@pytest.mark.parametrize("use_executor", [False, True])
+def test_wallclock_pipeline_parity(depth, use_executor):
+    batches = make_batches(seed=601 + depth)
+    want = serial_verdicts(batches, lambda: JaxConflictEngine(SMALL))
+
+    ex = ThreadPoolExecutor(2) if use_executor else None
+    try:
+        pipe = ResolverPipeline(JaxConflictEngine(SMALL), depth=depth,
+                                executor=ex)
+        handles = [pipe.submit(txns, v, old) for txns, v, old in batches]
+        got = [[int(x) for x in h.result()] for h in handles]
+    finally:
+        if ex is not None:
+            ex.shutdown()
+    assert got == want
+    assert pipe.in_flight == 0
+
+
+@pytest.mark.parametrize("depth", [2, 3])
+def test_wallclock_pipeline_interleaved_forcing(depth):
+    """result() of a late batch first still forces in version order."""
+    batches = make_batches(seed=77, range_every=0)
+    want = serial_verdicts(batches, lambda: JaxConflictEngine(SMALL))
+    pipe = ResolverPipeline(JaxConflictEngine(SMALL), depth=depth)
+    handles = [pipe.submit(txns, v, old) for txns, v, old in batches]
+    got = [None] * len(handles)
+    got[-1] = [int(x) for x in handles[-1].result()]   # youngest first
+    for i, h in enumerate(handles[:-1]):
+        got[i] = [int(x) for x in h.result()]
+    assert got == want
+
+
+def test_wallclock_pipeline_opaque_engine_fallback():
+    """Engines without the pack/dispatch split resolve synchronously but
+    keep producing identical verdicts through the pipeline."""
+    batches = make_batches(seed=31)
+    want = serial_verdicts(batches, OracleConflictEngine)
+    pipe = ResolverPipeline(OracleConflictEngine(), depth=3)
+    got = [[int(x) for x in pipe.submit(txns, v, old).result()]
+           for txns, v, old in batches]
+    assert got == want
+
+
+# ---------------------------------------------------------------------------
+# sim resolver role: jitter, duplicates, kill/restart mid-window
+# ---------------------------------------------------------------------------
+
+def drive_resolver_role(depth, kill_at=None, seed=902):
+    """Feed the deterministic batch stream through a sim Resolver role and
+    return {version: verdict list}. Arrival jitter is BUGGIFY'd; a couple
+    of versions are delivered twice (proxy retry); with `kill_at`, the
+    role is killed once version `batches[kill_at]` has resolved — with the
+    later batches of the window still in flight — and a fresh role
+    (recovery semantics: new engine, chain restarted at the kill point)
+    serves every later version.
+    """
+    batches = make_batches(seed=seed, range_every=0)
+    sim = Simulator(seed)
+    buggify.enable(sim.sched.rng)
+    pipeline = (PipelineConfig(depth=depth, pack_ms_per_txn=0.02,
+                               device_ms_per_batch=0.4)
+                if depth is not None else None)
+    proc = sim.new_process("res0")
+    res = Resolver(proc, OracleConflictEngine(), start_version=0,
+                   pipeline=pipeline)
+    replies = {}
+    rng = sim.sched.rng
+
+    def req_for(i):
+        txns, v, old = batches[i]
+        prev = batches[i - 1][1] if i else 0
+        return ResolveTransactionBatchRequest(
+            prev_version=prev, version=v, last_received_version=prev,
+            transactions=txns)
+
+    async def send(role, i, tag=""):
+        try:
+            reply = await role.resolve_batch(req_for(i))
+            replies.setdefault(batches[i][1], list(reply.committed))
+        except error.FDBError:
+            pass   # killed mid-flight; the retry against the new role wins
+
+    async def feeder():
+        nonlocal res
+        kill_version = batches[kill_at][1] if kill_at is not None else None
+        tasks = []
+        for i in range(len(batches)):
+            if buggify.buggify():
+                await delay(rng.random01() * 0.01, TaskPriority.PROXY_COMMIT)
+            tasks.append(sim.sched.spawn(send(res, i), TaskPriority.PROXY_COMMIT))
+            if i % 4 == 3:   # duplicate delivery (request_maybe_delivered)
+                tasks.append(sim.sched.spawn(send(res, i, "dup"),
+                                             TaskPriority.PROXY_COMMIT))
+            if kill_version is not None and i >= (kill_at or 0) + (depth or 1):
+                while res.version.get() < kill_version:
+                    await delay(0.005, TaskPriority.PROXY_COMMIT)
+                # kill mid-window: later batches are in flight in the
+                # service; cancel everything this role owns
+                for t in tasks:
+                    t.cancel()
+                res.unregister()
+                kill_version = None
+                proc2 = sim.new_process("res1")
+                res2 = Resolver(proc2, OracleConflictEngine(),
+                                start_version=batches[kill_at][1],
+                                token_suffix="gen2", pipeline=pipeline)
+                # recovery: replay every version after the kill point
+                for j in range(kill_at + 1, i + 1):
+                    replies.pop(batches[j][1], None)
+                    sim.sched.spawn(send(res2, j), TaskPriority.PROXY_COMMIT)
+                res = res2          # rebind for later sends
+                return await feeder_rest(res2, i + 1)
+
+    async def feeder_rest(role, start):
+        for i in range(start, len(batches)):
+            if buggify.buggify():
+                await delay(rng.random01() * 0.01, TaskPriority.PROXY_COMMIT)
+            sim.sched.spawn(send(role, i), TaskPriority.PROXY_COMMIT)
+            if i % 4 == 3:
+                sim.sched.spawn(send(role, i, "dup"), TaskPriority.PROXY_COMMIT)
+
+    sim.sched.spawn(feeder(), TaskPriority.PROXY_COMMIT)
+    sim.run(until=30.0)
+    set_scheduler(None)
+    assert len(replies) == len(batches), "not every version resolved"
+    return replies
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3])
+def test_sim_role_parity_under_jitter(depth):
+    assert drive_resolver_role(depth) == drive_resolver_role(None)
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3])
+def test_sim_role_parity_kill_restart_mid_window(depth):
+    got = drive_resolver_role(depth, kill_at=6)
+    want = drive_resolver_role(None, kill_at=6)
+    assert got == want
+
+
+# ---------------------------------------------------------------------------
+# e2e: pipelined resolver through a real recovery
+# ---------------------------------------------------------------------------
+
+def test_e2e_pipelined_cluster_survives_resolver_kill():
+    from foundationdb_tpu.server.cluster import (DynamicClusterConfig,
+                                                 build_dynamic_cluster)
+    from foundationdb_tpu.sim.simulator import KillType
+
+    c = build_dynamic_cluster(seed=4117, cfg=DynamicClusterConfig(
+        resolver_pipeline=dict(depth=2, pack_ms_per_txn=0.02,
+                               device_ms_per_batch=0.2)))
+    sim = c.sim
+    db = c.new_client()
+
+    async def work():
+        n = 0
+        while n < 12:
+            async def bump(tr):
+                v = await tr.get(b"k")
+                m = int(v or b"0") + 1
+                tr.set(b"k", str(m).encode())
+                return m
+            n = await db.run(bump)
+        return n
+
+    task = sim.sched.spawn(work(), name="w")
+    sim.run(until=10.0)
+    victim = None
+    for p in c.worker_procs:
+        if any(tok.startswith("resolver.resolve") for tok in p.handlers):
+            victim = p
+            break
+    assert victim is not None, "no live resolver role found"
+    sim.kill_process(victim, KillType.REBOOT)
+    got = sim.run_until(task, until=240.0)
+    assert got >= 12
