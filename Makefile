@@ -1,9 +1,16 @@
 # Gate before every commit/snapshot: the deterministic-sim methodology is
 # the product — a red suite must never ship (round-3 lesson).
 check:
-	python -m pytest tests/ -q
+	python -m pytest tests/ -q -m 'not slow'
 
 bench:
 	python bench.py
 
-.PHONY: check bench
+# Device-fault chaos: the full multi-seed nemesis campaign (slow tier; the
+# 3-seed smoke rides `check`) + the buggify coverage report over the
+# grinder battery (docs/fault_tolerance.md).
+chaos:
+	python -m pytest tests/test_device_nemesis.py -q -m slow
+	python -m foundationdb_tpu.tools.buggify_coverage --seeds 4 --min-frac 0.5
+
+.PHONY: check bench chaos

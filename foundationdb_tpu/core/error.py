@@ -96,6 +96,9 @@ client_invalid_operation = _define(2000, "client_invalid_operation", "Invalid AP
 conflict_capacity_exceeded = _define(
     2101, "conflict_capacity_exceeded", "Device conflict table capacity exceeded"
 )
+device_fault = _define(
+    2103, "device_fault", "Conflict engine device dispatch failed", retryable=True
+)
 key_too_large = _define(2102, "key_too_large", "Key exceeds the engine's exact-compare width")
 end_of_stream = _define(1, "end_of_stream", "End of stream")
 internal_error = _define(4100, "internal_error", "An internal error occurred")

@@ -99,6 +99,25 @@ def _make_server_knobs() -> Knobs:
     # Failure detection (reference: CC failureDetectionServer)
     k.init("failure_detection_delay", 1.0, lambda r: 0.2 + r.random01() * 2)
     k.init("heartbeat_interval", 0.25)
+    # Device-fault tolerance (fault/resilient.py; docs/fault_tolerance.md).
+    # Deliberately no BUGGIFY randomizers: the nemesis campaign stresses
+    # these directly, and randomizer draws would shift every sim's rng
+    # stream for knobs that only matter when a device is sick.
+    #: watchdog: a dispatch outstanding longer than this is a fault
+    k.init("resolver_dispatch_timeout", 0.5)
+    #: retries after the first failed dispatch before failing over
+    k.init("resolver_retry_budget", 2)
+    #: initial retry backoff (exponential, jittered x[0.5, 1.5))
+    k.init("resolver_retry_backoff", 0.05)
+    #: fraction of healthy device batches cross-validated against a
+    #: shadow-rebuilt oracle (corruption detector)
+    k.init("resolver_probe_rate", 0.05)
+    #: clean device-vs-oracle batches required to swap back after re-warm
+    k.init("resolver_probation_batches", 4)
+    #: batches served on the failover oracle before attempting a re-warm
+    k.init("resolver_failover_min_batches", 4)
+    #: admission fraction while any resolver engine is degraded
+    k.init("resolver_degraded_tps_fraction", 0.25)
     # TPU conflict engine capacities (ours)
     k.init("conflict_table_capacity", 1 << 16)
     k.init("conflict_key_words", 4)

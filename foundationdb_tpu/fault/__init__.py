@@ -1,0 +1,94 @@
+"""Device-fault tolerance for the resolver's conflict engine.
+
+The north-star accelerator boundary is not infallible: real TPU serving
+sees preemptions, hung dispatches, XLA runtime errors and (rarely) silent
+corruption. Harmonia (arXiv:1904.08964) keeps its in-network conflict
+accelerator trustworthy by pairing it with a replicated authoritative
+path; we pair the device engine with the reference-exact CPU oracle
+(ops/oracle.py), which already pins every engine bit-for-bit — so it can
+serve as a live failover target, not just a test fixture.
+
+Two pieces:
+
+  * FaultInjectingEngine (inject.py) — a deterministic, seed-driven
+    wrapper over any conflict engine that injects dispatch exceptions,
+    never-completing hangs, slow batches, bursty outages (the preemption
+    model) and flipped verdict bits.
+  * ResilientEngine (resilient.py) — the supervisor: per-dispatch
+    watchdog, bounded retries with jittered exponential backoff, a
+    health state machine (healthy -> suspect -> failed -> probation),
+    a host-side shadow of the committed write-history window that
+    rebuilds the CPU oracle mid-stream with bit-identical verdicts, and
+    a sampled cross-validation probe that quarantines a corrupting
+    device.
+
+The module-level registry lets test harnesses find every supervisor a
+simulation created (including ones whose processes have since died);
+Simulator.__init__ resets it per run, like sim/validation.py.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .inject import FaultInjectingEngine, FaultRates
+from .resilient import (
+    HEALTHY,
+    SUSPECT,
+    FAILED,
+    PROBATION,
+    QUARANTINED,
+    ResilienceConfig,
+    ResilientEngine,
+)
+
+#: every ResilientEngine constructed since the last reset (sim-wide; the
+#: nemesis validation workload audits journals/health of dead generations'
+#: engines through this, the way sim/validation.py records violations).
+#: Recording is armed by Simulator.__init__ via reset_registry() — a
+#: real-mode cluster never arms it, so dead generations' engines are not
+#: pinned in memory outside simulation.
+_registry: List["ResilientEngine"] = []
+_recording = False
+
+
+def register_engine(engine: "ResilientEngine") -> None:
+    if _recording:
+        _registry.append(engine)
+
+
+def registered_engines() -> List["ResilientEngine"]:
+    return list(_registry)
+
+
+def reset_registry() -> None:
+    global _recording
+    _recording = True
+    del _registry[:]
+
+
+def maybe_wrap(engine, cluster_cfg):
+    """The one wrap decision for role wiring (server/worker.py recruitment
+    and the static server/cluster.py assembly): supervise the factory's
+    engine when the cluster config asks for it and the factory didn't
+    already build a supervised engine."""
+    if (getattr(cluster_cfg, "resilient_resolver", False)
+            and not hasattr(engine, "health_stats")):
+        engine = ResilientEngine(engine)
+    return engine
+
+
+__all__ = [
+    "FaultInjectingEngine",
+    "FaultRates",
+    "ResilienceConfig",
+    "ResilientEngine",
+    "maybe_wrap",
+    "HEALTHY",
+    "SUSPECT",
+    "FAILED",
+    "PROBATION",
+    "QUARANTINED",
+    "register_engine",
+    "registered_engines",
+    "reset_registry",
+]

@@ -1,0 +1,161 @@
+"""Deterministic device-fault injection at the conflict-engine boundary.
+
+The analog of the reference's machine-level fault injection
+(sim2.actor.cpp's AsyncFileNonDurable, clogging, kills) applied to OUR
+new failure domain: the accelerator dispatch. A FaultInjectingEngine
+wraps any conflict engine and, from its own seeded rng (one draw off the
+simulation stream at construction, so per-dispatch draws never perturb
+the rest of the world), injects the fault menagerie a real TPU serving
+path sees:
+
+  * dispatch exceptions   — XLA runtime errors, transfer failures;
+  * hangs                 — a dispatch that never completes (the watchdog
+                            in fault/resilient.py must fire);
+  * slow batches          — stragglers that complete late;
+  * outages               — bursty windows (the preemption model) where
+                            EVERY dispatch fails until the device returns;
+  * flipped verdict bits  — silent corruption (off by default: an escaped
+                            flip is data loss; the supervisor's sampled
+                            probe exists to catch exactly this).
+
+Faults that surface after the inner engine ran (`applied_fraction`) model
+the nastiest shape: the dispatch landed on the device, only the reply was
+lost — device state holds the batch, the host does not know. The
+supervisor must re-warm device state before any retry or the batch's own
+writes would alias into its history and change verdicts.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..core import error
+from ..core.rng import DeterministicRandom
+from ..core.types import TransactionCommitResult
+from ..sim.loop import TaskPriority, current_scheduler, delay, never, now
+
+
+@dataclass
+class FaultRates:
+    """Per-dispatch fault probabilities (the nemesis campaign's defaults).
+
+    The acceptance bar (ISSUE 2) runs exceptions, hangs and slow batches at
+    these rates; `flip` defaults to 0 because a flipped verdict that the
+    sampled probe misses is emitted — corruption coverage lives in the
+    supervisor unit tests with probe_rate=1, not in cluster sims."""
+
+    exception: float = 0.01
+    hang: float = 0.008
+    slow: float = 0.04
+    flip: float = 0.0
+    #: probability of entering a bursty outage window in which every
+    #: dispatch faults until it expires (TPU preemption / runtime restart)
+    outage: float = 0.02
+    #: outage length in virtual seconds, uniform in [0.5x, 1.5x]
+    outage_seconds: float = 1.5
+    #: mean straggler delay, uniform in [0.5x, 1.5x]
+    slow_seconds: float = 0.2
+    #: fraction of exception/hang faults where the inner engine RAN before
+    #: the fault surfaced (dispatch landed, reply lost)
+    applied_fraction: float = 0.5
+
+
+class FaultInjectingEngine:
+    """Seed-driven fault wrapper over any ConflictSet engine."""
+
+    name = "fault-injecting"
+
+    def __init__(self, inner, rates: Optional[FaultRates] = None,
+                 rng: Optional[DeterministicRandom] = None):
+        self.inner = inner
+        self.rates = rates or FaultRates()
+        if rng is None:
+            rng = DeterministicRandom(
+                current_scheduler().rng.random_int(0, 2**31 - 1))
+        self.rng = rng
+        self.injected = {"exceptions": 0, "hangs": 0, "slow": 0, "flips": 0,
+                         "outages": 0}
+        self._outage_until = 0.0
+
+    # -- engine interface ----------------------------------------------------
+    def clear(self, version) -> None:
+        self.inner.clear(version)
+
+    def rewarm_target(self):
+        """State-rebuild bypass: re-warming device state goes through the
+        trusted host-side path (a real system DMAs the rebuilt table rather
+        than re-running every historical program through the flaky dispatch
+        queue). The supervisor still models re-warm failure via its own
+        buggify site."""
+        return self.inner
+
+    def resolve(self, transactions, now_v, new_oldest):
+        """Synchronous dispatch: exceptions and flips only (a sync call
+        cannot hang or straggle in zero virtual time)."""
+        kind = self._fault_kind()
+        if kind in (None, "slow"):
+            return self.inner.resolve(transactions, now_v, new_oldest)
+        if kind == "flip":
+            return self._flipped(transactions, now_v, new_oldest)
+        self._maybe_apply(transactions, now_v, new_oldest)
+        self.injected["exceptions"] += 1
+        raise error.device_fault(f"injected dispatch {kind} at {now_v}")
+
+    async def resolve_async(self, transactions, now_v, new_oldest):
+        """Asynchronous dispatch: the full fault menagerie. The supervisor
+        awaits this under its watchdog."""
+        kind = self._fault_kind()
+        if kind is None:
+            return self.inner.resolve(transactions, now_v, new_oldest)
+        if kind == "slow":
+            self.injected["slow"] += 1
+            await delay(self.rates.slow_seconds * (0.5 + self.rng.random01()),
+                        TaskPriority.PROXY_RESOLVER_REPLY)
+            return self.inner.resolve(transactions, now_v, new_oldest)
+        if kind == "flip":
+            return self._flipped(transactions, now_v, new_oldest)
+        applied = self._maybe_apply(transactions, now_v, new_oldest)
+        if kind == "hang":
+            self.injected["hangs"] += 1
+            await never()
+        self.injected["exceptions"] += 1
+        raise error.device_fault(
+            f"injected dispatch exception at {now_v} (applied={applied})")
+
+    # -- internals -----------------------------------------------------------
+    def _fault_kind(self) -> Optional[str]:
+        r, rng = self.rates, self.rng
+        t = now()
+        if t < self._outage_until:
+            # device down wholesale: nothing completes until it returns
+            return "hang" if rng.random01() < 0.5 else "exception"
+        if r.outage > 0 and rng.random01() < r.outage:
+            self.injected["outages"] += 1
+            self._outage_until = t + r.outage_seconds * (0.5 + rng.random01())
+            return "exception"
+        x = rng.random01()
+        for kind, p in (("exception", r.exception), ("hang", r.hang),
+                        ("slow", r.slow), ("flip", r.flip)):
+            if x < p:
+                return kind
+            x -= p
+        return None
+
+    def _maybe_apply(self, transactions, now_v, new_oldest) -> bool:
+        applied = self.rng.random01() < self.rates.applied_fraction
+        if applied:
+            self.inner.resolve(transactions, now_v, new_oldest)
+        return applied
+
+    def _flipped(self, transactions, now_v, new_oldest):
+        """Silent corruption: the device computed (and applied) the true
+        verdicts; one reported bit flips on the way back."""
+        verdicts = list(self.inner.resolve(transactions, now_v, new_oldest))
+        if verdicts:
+            self.injected["flips"] += 1
+            i = self.rng.random_int(0, len(verdicts))
+            flip = (TransactionCommitResult.CONFLICT
+                    if int(verdicts[i]) == int(TransactionCommitResult.COMMITTED)
+                    else TransactionCommitResult.COMMITTED)
+            verdicts[i] = flip
+        return verdicts

@@ -1,0 +1,387 @@
+"""ResilientEngine: the resolver survives a misbehaving device with
+bit-identical abort sets.
+
+The supervisor wraps the production conflict engine ("the device") and
+pairs it with the reference-exact CPU oracle (ops/oracle.py) as a live
+failover target, the Harmonia pattern (arXiv:1904.08964): the accelerated
+path is fast, the authoritative path is always reconstructible.
+
+Health state machine::
+
+            dispatch fault                 retry budget exhausted
+  HEALTHY ----------------> SUSPECT -----------------------------> FAILED
+     ^       (retrying with jittered backoff,                        |
+     |        device re-warmed before each retry)                    |
+     |                                                               |
+     |  probation_batches clean       failover_min_batches on the    |
+     |  (device vs oracle equal)      oracle, then re-warm device    |
+     +------------------- PROBATION <--------------------------------+
+                              |
+                              | device/oracle verdict mismatch
+                              v                   (also from a sampled
+                         QUARANTINED               probe in HEALTHY)
+
+Why verdicts stay bit-identical through every transition: the supervisor
+keeps a host-side shadow of the committed write history — one entry per
+resolved batch, (version, committed write ranges, new_oldest), trimmed to
+the window >= oldest_version. The oracle's own GC proof (ops/oracle.py:
+any read passing the too-old gate has snapshot >= oldestVersion, so
+intervals last written below the horizon can never conflict) means that
+window is sufficient to rebuild the OBSERVABLE conflict state of any
+engine from scratch: replaying the shadow's writes into a fresh oracle
+(or back into a cleared device) yields the same verdict for every future
+batch as an engine that lived through the whole history. Failover
+mid-stream therefore changes nothing about abort sets, and the sampled
+cross-validation probe (re-resolving a device batch on a shadow-rebuilt
+oracle) is an exact corruption detector, not a heuristic.
+
+Retries re-warm the device first because a failed dispatch may have
+half-applied — or fully applied with the reply lost (the injector's
+`applied_fraction` models this): re-running the batch against state that
+already contains it would alias the batch's own writes into its history
+and flip verdicts.
+"""
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, List, Optional, Tuple
+
+from ..core import buggify, error
+from ..core.knobs import SERVER_KNOBS
+from ..core.rng import DeterministicRandom
+from ..core.trace import Severity, TraceEvent
+from ..core.types import CommitTransaction, KeyRange, TransactionCommitResult
+from ..ops.oracle import OracleConflictEngine
+from ..sim.actors import any_of
+from ..sim.loop import TaskPriority, current_scheduler, delay, spawn
+
+HEALTHY = "healthy"
+SUSPECT = "suspect"
+FAILED = "failed"
+PROBATION = "probation"
+QUARANTINED = "quarantined"
+
+
+@dataclass
+class ResilienceConfig:
+    """Supervisor knobs (docs/fault_tolerance.md). No field defaults: the
+    single source of default values is the resolver_* knob registry
+    (core/knobs.py), read at engine construction via from_knobs() so
+    per-run knob overrides apply."""
+
+    dispatch_timeout: float
+    retry_budget: int
+    retry_backoff: float
+    probe_rate: float
+    probation_batches: int
+    failover_min_batches: int
+
+    @classmethod
+    def from_knobs(cls) -> "ResilienceConfig":
+        k = SERVER_KNOBS
+        return cls(
+            dispatch_timeout=k.resolver_dispatch_timeout,
+            retry_budget=k.resolver_retry_budget,
+            retry_backoff=k.resolver_retry_backoff,
+            probe_rate=k.resolver_probe_rate,
+            probation_batches=k.resolver_probation_batches,
+            failover_min_batches=k.resolver_failover_min_batches,
+        )
+
+
+class ResilientEngine:
+    """Fault-tolerant supervisor over a device conflict engine."""
+
+    name = "resilient"
+
+    def __init__(self, device, cfg: Optional[ResilienceConfig] = None,
+                 record_journal: bool = False,
+                 oracle_factory=OracleConflictEngine):
+        self.device = device
+        self.cfg = cfg or ResilienceConfig.from_knobs()
+        # own rng stream (one draw off the world's): per-batch probe and
+        # backoff draws must not perturb the rest of the simulation
+        self.rng = DeterministicRandom(
+            current_scheduler().rng.random_int(0, 2**31 - 1))
+        self.state = HEALTHY
+        self.stats = {"batches": 0, "dispatch_faults": 0, "retries": 0,
+                      "failovers": 0, "swap_backs": 0, "rewarm_failures": 0,
+                      "probes": 0, "probe_mismatches": 0, "oracle_batches": 0}
+        #: committed write history window: (version, ((begin, end), ...),
+        #: new_oldest) per batch, trimmed to version >= the GC horizon
+        self._shadow: Deque[Tuple] = deque()
+        self._oldest = 0
+        self._oracle_factory = oracle_factory
+        self._failover: Optional[OracleConflictEngine] = None
+        self._failed_batches = 0
+        self._probation_left = 0
+        #: (version, transactions, new_oldest, verdicts) per batch when
+        #: journaling — the nemesis check replays it through a clean oracle
+        #: to assert the emitted abort sets are bit-identical to a fault-free
+        #: engine's. Off by default: the journal is unbounded by design
+        #: (test-harness memory), so only sim campaigns opt in.
+        self.journal: Optional[List[Tuple]] = [] if record_journal else None
+        from . import register_engine
+
+        register_engine(self)
+
+    # -- public surface ------------------------------------------------------
+    @property
+    def degraded(self) -> bool:
+        """True while the device is not serving cleanly: the pipeline
+        collapses its window to depth 1 and the ratekeeper throttles."""
+        return self.state != HEALTHY
+
+    def health_stats(self) -> dict:
+        return {"state": self.state, "degraded": self.degraded,
+                "device": getattr(self.device, "name", type(self.device).__name__),
+                "shadow_entries": len(self._shadow), **self.stats}
+
+    def clear(self, version) -> None:
+        self.device.clear(version)
+        if self._failover is not None:
+            self._failover.clear(version)
+        self._shadow.clear()
+
+    async def resolve(self, transactions, now_v, new_oldest):
+        """One batch through the supervisor; callers (server/resolver.py,
+        pipeline/service.py) enter strictly in commit-version order."""
+        self.stats["batches"] += 1
+        if self.state == FAILED:
+            # re-warm BEFORE resolving this batch: the shadow and the
+            # failover oracle are both exactly one-batch-behind states, so
+            # the rebuilt device enters probation in lockstep
+            self._maybe_rewarm()
+        if self.state in (FAILED, QUARANTINED):
+            verdicts = self._oracle_resolve(transactions, now_v, new_oldest)
+            self._failed_batches += 1
+        elif self.state == PROBATION:
+            verdicts = await self._probation_batch(transactions, now_v, new_oldest)
+        else:
+            verdicts = await self._healthy_batch(transactions, now_v, new_oldest)
+        self._record(now_v, transactions, new_oldest, verdicts)
+        return verdicts
+
+    # -- state machine -------------------------------------------------------
+    def _set_state(self, state: str) -> None:
+        if state != self.state:
+            TraceEvent("ResolverEngineHealth",
+                       severity=(Severity.WARN if state != HEALTHY
+                                 else Severity.INFO)) \
+                .detail("From", self.state).detail("To", state).log()
+            self.state = state
+
+    async def _healthy_batch(self, transactions, now_v, new_oldest):
+        try:
+            got = await self._attempt(transactions, now_v, new_oldest,
+                                      1 + max(0, self.cfg.retry_budget))
+        except error.FDBError as e:
+            self._fail_over(now_v, e)
+            return self._oracle_resolve(transactions, now_v, new_oldest)
+        if self.state == SUSPECT:
+            self._set_state(HEALTHY)   # a retry recovered the device
+        if self.cfg.probe_rate > 0 and self.rng.random01() < self.cfg.probe_rate:
+            self.stats["probes"] += 1
+            probe = self._rebuild_oracle()   # pre-batch: shadow excludes this batch
+            want = probe.resolve(transactions, now_v, new_oldest)
+            if [int(x) for x in got] != [int(x) for x in want]:
+                self._quarantine(now_v, got, want)
+                self._failover = probe       # already advanced past this batch
+                return want
+        return got
+
+    async def _probation_batch(self, transactions, now_v, new_oldest):
+        # the oracle stays authoritative: a device relapse mid-probation
+        # cannot corrupt the emitted stream
+        want = self._oracle_resolve(transactions, now_v, new_oldest)
+        try:
+            got = await self._attempt(transactions, now_v, new_oldest, 1)
+        except error.FDBError as e:
+            TraceEvent("ResolverEngineProbationFault").error(e).log()
+            self._failed_batches = 0
+            self._set_state(FAILED)
+            return want
+        self.stats["probes"] += 1
+        if [int(x) for x in got] != [int(x) for x in want]:
+            self._quarantine(now_v, got, want)
+            return want
+        self._probation_left -= 1
+        if self._probation_left <= 0:
+            self.stats["swap_backs"] += 1
+            self._failover = None
+            self._set_state(HEALTHY)
+            TraceEvent("ResolverEngineSwapBack").detail("Version", now_v).log()
+        return want
+
+    async def _attempt(self, transactions, now_v, new_oldest, attempts: int):
+        """Bounded watchdog-guarded dispatch attempts with jittered
+        exponential backoff; device state is re-warmed from the shadow
+        before every retry (the failed attempt may have applied)."""
+        last: Optional[error.FDBError] = None
+        for i in range(attempts):
+            if i:
+                self.stats["retries"] += 1
+                backoff = (self.cfg.retry_backoff * (2 ** (i - 1))
+                           * (0.5 + self.rng.random01()))
+                await delay(backoff, TaskPriority.PROXY_RESOLVER_REPLY)
+                try:
+                    self._rewarm_device()
+                except error.FDBError as e:
+                    self.stats["rewarm_failures"] += 1
+                    last = e
+                    continue
+            try:
+                return await self._dispatch_once(transactions, now_v, new_oldest)
+            except error.FDBError as e:
+                self.stats["dispatch_faults"] += 1
+                if self.state == HEALTHY:
+                    self._set_state(SUSPECT)
+                last = e
+        raise last if last is not None else error.device_fault("no attempts")
+
+    async def _dispatch_once(self, transactions, now_v, new_oldest):
+        if buggify.buggify():
+            # engine-boundary fault: every sim spec (attrition, clogging,
+            # recovery) exercises the watchdog/retry path for free
+            raise error.device_fault("buggify: dispatch failed at engine boundary")
+        if buggify.buggify():
+            # straggling device: completes, but late
+            await delay(self.cfg.dispatch_timeout * 0.5,
+                        TaskPriority.PROXY_RESOLVER_REPLY)
+        eng = self.device
+        if not hasattr(eng, "resolve_async"):
+            # synchronous engine: runs inline in zero virtual time (cannot
+            # hang); exceptions propagate to the retry loop
+            try:
+                return eng.resolve(transactions, now_v, new_oldest)
+            except error.FDBError:
+                raise
+            except Exception as e:
+                raise error.device_fault(f"device dispatch raised: {e}") from e
+        task = spawn(self._run_async(eng, transactions, now_v, new_oldest),
+                     TaskPriority.PROXY_RESOLVER_REPLY, name="deviceDispatch")
+        timer = delay(self.cfg.dispatch_timeout, TaskPriority.PROXY_RESOLVER_REPLY)
+        try:
+            idx, value = await any_of([task, timer])
+        except BaseException:
+            # our own cancellation (role killed mid-dispatch) must not
+            # leave a hung device task orphaned behind the dead role
+            task.cancel()
+            raise
+        if idx == 1:
+            task.cancel()
+            raise error.device_fault(
+                f"dispatch watchdog: no completion in {self.cfg.dispatch_timeout}s")
+        return value
+
+    async def _run_async(self, eng, transactions, now_v, new_oldest):
+        try:
+            return await eng.resolve_async(transactions, now_v, new_oldest)
+        except error.FDBError:
+            raise
+        except Exception as e:
+            raise error.device_fault(f"device dispatch raised: {e}") from e
+
+    def _fail_over(self, now_v, err) -> None:
+        """Persistent device failure: rebuild the CPU oracle from the
+        shadow (one-batch-behind state) and serve from it mid-stream."""
+        self.stats["failovers"] += 1
+        self._failover = self._rebuild_oracle()
+        self._failed_batches = 0
+        self._set_state(FAILED)
+        TraceEvent("ResolverEngineFailover", severity=Severity.WARN) \
+            .detail("Version", now_v).detail("ShadowEntries", len(self._shadow)) \
+            .error(err).log()
+
+    def _maybe_rewarm(self) -> None:
+        """After enough batches on the oracle, try to re-warm device state
+        from the shadow and enter probation; a re-warm failure leaves us on
+        the oracle for another round."""
+        if self._failed_batches < max(1, self.cfg.failover_min_batches):
+            return
+        self._failed_batches = 0
+        try:
+            self._rewarm_device()
+        except error.FDBError as e:
+            self.stats["rewarm_failures"] += 1
+            TraceEvent("ResolverEngineRewarmFailed").error(e).log()
+            return
+        self._probation_left = max(1, self.cfg.probation_batches)
+        self._set_state(PROBATION)
+
+    def _quarantine(self, now_v, got, want) -> None:
+        """The probe caught the device disagreeing with the shadow-rebuilt
+        oracle: silent corruption. SevError — a correctness event — and the
+        device is never trusted again this incarnation."""
+        self.stats["probe_mismatches"] += 1
+        self._set_state(QUARANTINED)
+        TraceEvent("ResolverEngineQuarantine", severity=Severity.ERROR) \
+            .detail("Version", now_v) \
+            .detail("Got", [int(x) for x in got]) \
+            .detail("Want", [int(x) for x in want]).log()
+
+    # -- shadow history ------------------------------------------------------
+    def _oracle_resolve(self, transactions, now_v, new_oldest):
+        self.stats["oracle_batches"] += 1
+        return self._failover.resolve(transactions, now_v, new_oldest)
+
+    def _record(self, now_v, transactions, new_oldest, verdicts) -> None:
+        committed = int(TransactionCommitResult.COMMITTED)
+        writes = tuple(
+            (r.begin, r.end)
+            for t, txn in enumerate(transactions)
+            if int(verdicts[t]) == committed
+            for r in txn.write_conflict_ranges
+            if r.begin < r.end
+        )
+        self._shadow.append((now_v, writes, new_oldest))
+        if new_oldest > self._oldest:
+            self._oldest = new_oldest
+        while self._shadow and self._shadow[0][0] < self._oldest:
+            self._shadow.popleft()
+        if self.journal is not None:
+            self.journal.append((now_v, tuple(transactions), new_oldest,
+                                 tuple(int(v) for v in verdicts)))
+
+    def _rebuild_oracle(self) -> OracleConflictEngine:
+        o = self._oracle_factory()
+        self._replay_shadow(o)
+        return o
+
+    def _rewarm_device(self) -> None:
+        if buggify.buggify():
+            # re-warm itself can fail (the device is, after all, sick)
+            raise error.device_fault("buggify: device re-warm failed")
+        target = self.device
+        fn = getattr(target, "rewarm_target", None)
+        if fn is not None:
+            target = fn()
+        try:
+            self._replay_shadow(target)
+        except error.FDBError:
+            raise
+        except Exception as e:
+            raise error.device_fault(f"device re-warm raised: {e}") from e
+
+    def _replay_shadow(self, eng) -> None:
+        """Rebuild an engine's observable conflict state from the shadow.
+
+        Sufficiency: any read that passes the too-old gate has
+        read_snapshot >= oldest_version, so intervals last written below
+        the horizon compare <= snapshot and can never conflict — only the
+        window >= oldest_version (exactly what the shadow keeps) decides
+        verdicts (the same argument that makes the oracle's GC
+        representation-only)."""
+        eng.clear(0)
+        if self._oldest:
+            # pin the too-old gate first; per-entry horizons below it are
+            # then no-ops and GC timing differences are representation-only
+            eng.resolve([], self._oldest, self._oldest)
+        for version, writes, new_oldest in self._shadow:
+            if not writes:
+                continue
+            txn = CommitTransaction(
+                read_snapshot=version,
+                write_conflict_ranges=[KeyRange(b, e) for b, e in writes])
+            eng.resolve([txn], version, new_oldest)

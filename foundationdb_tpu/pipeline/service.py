@@ -65,7 +65,7 @@ class PipelinedResolverService:
     def __init__(self, cfg: PipelineConfig, engine):
         self.cfg = cfg
         self.engine = engine
-        self._free = max(1, cfg.depth)
+        self._in_use = 0
         self._waiters: deque = deque()
         self._seq = 0
         #: sequence number of the newest batch whose device stage finished
@@ -73,32 +73,42 @@ class PipelinedResolverService:
 
     @property
     def in_flight(self) -> int:
-        return max(1, self.cfg.depth) - self._free
+        return self._in_use
+
+    def _capacity(self) -> int:
+        """Effective window: a degraded engine (fault/resilient.py —
+        retrying, failed over, or on probation) collapses the pipeline to
+        depth 1 so we stop piling dispatches onto a sick device; the full
+        window re-opens on swap-back."""
+        if getattr(self.engine, "degraded", False):
+            return 1
+        return max(1, self.cfg.depth)
 
     async def acquire(self) -> None:
-        """Take a window slot; blocks while `depth` batches are in service
+        """Take a window slot; blocks while the effective window is full
         (the resolver's backpressure onto the proxy's commit window)."""
-        if self._free > 0:
-            self._free -= 1
-            return
-        p = Promise()
-        self._waiters.append(p)
-        try:
-            await p.future   # the slot passes directly from release()
-        except BaseException:
-            if p.is_set:
-                # release() handed us the slot while we were being
-                # cancelled: pass it on rather than leaking it
-                self.release()
-            else:
-                self._waiters.remove(p)
-            raise
+        while self._in_use >= self._capacity():
+            p = Promise()
+            self._waiters.append(p)
+            try:
+                await p.future   # woken by release(); capacity re-checked
+            except BaseException:
+                if p.is_set:
+                    # release() woke us while we were being cancelled:
+                    # pass the wake-up on rather than losing it
+                    self._wake()
+                else:
+                    self._waiters.remove(p)
+                raise
+        self._in_use += 1
 
     def release(self) -> None:
-        if self._waiters:
+        self._in_use -= 1
+        self._wake()
+
+    def _wake(self) -> None:
+        if self._waiters and self._in_use < self._capacity():
             self._waiters.popleft().send(None)
-        else:
-            self._free += 1
 
     async def resolve(self, transactions, version, new_oldest):
         """Run one accepted batch through pack -> device -> verdicts.
@@ -117,6 +127,10 @@ class PipelinedResolverService:
                 await delay(pack_ms / 1e3, TaskPriority.PROXY_RESOLVER_REPLY)
             await self._device_done.when_at_least(seq - 1)
             verdicts = self.engine.resolve(transactions, version, new_oldest)
+            if hasattr(verdicts, "__await__"):
+                # supervised engine (fault/resilient.py): the dispatch may
+                # retry/fail over under its watchdog before verdicts land
+                verdicts = await verdicts
             if self.cfg.device_ms_per_batch > 0:
                 await delay(self.cfg.device_ms_per_batch / 1e3,
                             TaskPriority.PROXY_RESOLVER_REPLY)

@@ -45,6 +45,10 @@ class ClusterConfig:
     max_commit_batch: Optional[int] = None
     #: proxy in-flight commit window (None = unbounded)
     commit_pipeline_window: Optional[int] = None
+    #: wrap each resolver's engine in the device-fault supervisor
+    #: (fault/resilient.py: watchdog, retries, CPU-oracle failover). Off in
+    #: the static assembly so engine-level unit suites see the raw engine.
+    resilient_resolver: bool = False
 
 
 class Cluster:
@@ -65,10 +69,15 @@ class Cluster:
         )
         self.log_view = AsyncVar(self.log_config)
 
+        def make_engine():
+            from ..fault import maybe_wrap
+
+            return maybe_wrap(cfg.engine_factory(), cfg)
+
         self.resolver_shards = KeyShardMap.uniform(cfg.n_resolvers)
         self.resolver_procs = [sim.new_process(f"resolver{i}") for i in range(cfg.n_resolvers)]
         self.resolvers = [
-            Resolver(p, cfg.engine_factory(), start_version=sv, index=i,
+            Resolver(p, make_engine(), start_version=sv, index=i,
                      pipeline=cfg.resolver_pipeline)
             for i, p in enumerate(self.resolver_procs)
         ]
@@ -179,6 +188,12 @@ class DynamicClusterConfig:
     #: real-mode recruitment): PipelineConfig(**resolver_pipeline); None
     #: keeps the serial resolver
     resolver_pipeline: Optional[dict] = None
+    #: wrap recruited resolver engines in the device-fault supervisor
+    #: (fault/resilient.py). Default ON: every dynamic spec — attrition,
+    #: clogging, recovery — then exercises the watchdog/retry/failover
+    #: machinery for free through its buggify sites, and a misbehaving
+    #: device degrades instead of wedging the commit pipeline.
+    resilient_resolver: bool = True
     engine_factory: Callable = OracleConflictEngine
 
 

@@ -167,6 +167,10 @@ class ClusterController:
                     # stale = every storage poll timed out; worst_lag is a
                     # reset placeholder, not a healthy 0 (ratekeeper.py)
                     "storage_lag_stale": frag.get("storage_lag_stale", False),
+                    # conflict-engine health (fault/resilient.py): degraded
+                    # = some resolver is retrying/failed over/on probation
+                    "resolver_degraded": frag.get("resolvers_degraded", False),
+                    "resolver_health": frag.get("resolver_health", {}),
                 }
             except error.FDBError:
                 doc["cluster"]["version"] = None

@@ -45,7 +45,7 @@ from .log_system import LogSystemConfig, fetch_recovery_data, lock_generation
 from .master import GET_COMMIT_VERSION_TOKEN, Master, RECOVERY_VERSION_JUMP
 from .proxy import ProxyConfig, teams_from_storage_tags
 from .ratekeeper import GET_RATE_INFO_TOKEN, Ratekeeper
-from .resolver import RESOLVE_TOKEN
+from .resolver import RESOLVE_TOKEN, RESOLVER_HEALTH_TOKEN
 from .wait_failure import WAIT_FAILURE_TOKEN, wait_failure_client
 from .worker import (
     InitializeProxyRequest,
@@ -755,6 +755,12 @@ class MasterServer:
             self.net, self.proc.address, storage_tags,
             lambda: self.master.version,
             log_config=new_log,
+            # degraded conflict engines (device faults, failover to the CPU
+            # oracle — fault/resilient.py) are an admission-control signal
+            resolver_eps=[
+                Endpoint(a, RESOLVER_HEALTH_TOKEN + f"{suffix}.{i}")
+                for i, a in enumerate(resolver_addrs)
+            ],
         )
         rate_token = GET_RATE_INFO_TOKEN + suffix
         self.proc.register(rate_token, ratekeeper.get_rate_info)
@@ -773,6 +779,8 @@ class MasterServer:
                 "tps_limit": ratekeeper.tps_limit,
                 "worst_storage_lag_versions": ratekeeper.worst_lag,
                 "storage_lag_stale": ratekeeper.lag_stale,
+                "resolvers_degraded": ratekeeper.resolver_degraded,
+                "resolver_health": dict(ratekeeper.resolver_health),
                 "tlogs": list(tlog_addrs),
                 "resolvers": list(resolver_addrs),
                 "proxies": list(proxy_addrs),

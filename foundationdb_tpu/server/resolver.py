@@ -31,6 +31,7 @@ from .messages import ResolveTransactionBatchRequest, ResolveTransactionBatchRep
 
 RESOLVE_TOKEN = "resolver.resolve"
 RESOLUTION_METRICS_TOKEN = "resolver.metrics"
+RESOLVER_HEALTH_TOKEN = "resolver.health"
 
 #: reservoir size for the split-key sample (the analog of the resolver's
 #: iops TransientStorageMetricSample feeding ResolutionSplitRequest)
@@ -86,6 +87,7 @@ class Resolver:
         self.version = NotifiedVersion(start_version)
         self.token = RESOLVE_TOKEN + token_suffix
         self.metrics_token = RESOLUTION_METRICS_TOKEN + token_suffix
+        self.health_token = RESOLVER_HEALTH_TOKEN + token_suffix
         # replay window: version -> reply, for proxy retries after
         # request_maybe_delivered (reference keeps recentStateTransactions)
         self._recent: Dict[Version, ResolveTransactionBatchReply] = {}
@@ -112,11 +114,24 @@ class Resolver:
         proc.actors.add(self._stats_task)
         proc.register(self.token, self.resolve_batch)
         proc.register(self.metrics_token, self.resolution_metrics)
+        proc.register(self.health_token, self.engine_health)
 
     def unregister(self) -> None:
         self.proc.unregister(self.token)
         self.proc.unregister(self.metrics_token)
+        self.proc.unregister(self.health_token)
         self._stats_task.cancel()
+
+    async def engine_health(self, _req) -> dict:
+        """Engine-health fragment (the device-fault analog of
+        ResolutionMetricsRequest): the ratekeeper polls it as a throttle
+        signal and the status document surfaces it (tools/cli.py)."""
+        out = {"state": "healthy", "degraded": False}
+        fn = getattr(self.engine, "health_stats", None)
+        if fn is not None:
+            out.update(fn())
+        out["resolve_errors"] = self.stats.counter("resolve_errors").value
+        return out
 
     def _sample_rows(self, transactions) -> None:
         rng = self._sample_rng
@@ -163,6 +178,14 @@ class Resolver:
             # replay-window-GC'd paths that normally need huge lag
             window = window // 100
         new_oldest = max(0, req.version - window)
+        inflight = self._inflight.get(req.version)
+        if inflight is not None:
+            # A duplicate delivery of a version still in dispatch (possible
+            # once the engine awaits: pipeline slots, watchdogs, failover)
+            # waits for the first delivery's outcome — checked BEFORE
+            # sampling, so retried batches don't bias the split-key
+            # reservoir twice.
+            return await inflight.future
         transactions = req.transactions
         prepended = False
         if (getattr(req, "routing_version", 0)
@@ -190,9 +213,41 @@ class Resolver:
 
         if self._service is None:
             # Serial path: one batch at a time, the chain advances when the
-            # batch is fully resolved.
-            verdicts = self.engine.resolve(transactions, req.version, new_oldest)
-            return self._finish(req.version, verdicts, prepended, new_oldest)
+            # batch is fully resolved. Once the engine can await (watchdog,
+            # retries, failover — fault/resilient.py), duplicates of the
+            # in-flight version are caught by the _inflight check above
+            # (nothing awaits between it and the registration here).
+            p = Promise()
+            self._inflight[req.version] = p
+            try:
+                verdicts = await self._engine_resolve(
+                    transactions, req.version, new_oldest)
+            except Exception as e:
+                # Typed wrapping (the serial analog of the pipelined
+                # except below): an engine/device fault must reach the
+                # proxy as an FDBError it absorbs as commit_unknown_result
+                # + chain repair, never an untyped exception that kills
+                # the resolver actor mid-chain.
+                self.stats.add("resolve_errors")
+                self._inflight.pop(req.version, None)
+                if not p.is_set:
+                    p.send_error(error.please_reboot(
+                        f"resolve {req.version} failed in engine"))
+                if isinstance(e, error.FDBError):
+                    raise
+                raise error.please_reboot(
+                    f"resolve {req.version} failed in engine: {e}") from e
+            except BaseException:
+                # cancellation (role killed): waiters get the honest answer
+                self._inflight.pop(req.version, None)
+                if not p.is_set:
+                    p.send_error(error.please_reboot(
+                        f"resolve {req.version} cancelled"))
+                raise
+            reply = self._finish(req.version, verdicts, prepended, new_oldest)
+            self._inflight.pop(req.version, None)
+            p.send(reply)
+            return reply
 
         # Pipelined path: acquire a window slot, ADVANCE THE CHAIN AT
         # ACCEPT so the next batch enters its pack stage while this one is
@@ -211,7 +266,7 @@ class Resolver:
         try:
             verdicts = await self._service.resolve(
                 transactions, req.version, new_oldest)
-        except BaseException:
+        except BaseException as e:
             self._inflight.pop(req.version, None)
             if not p.is_set:
                 # duplicates waiting on this version get the honest answer:
@@ -219,12 +274,32 @@ class Resolver:
                 # commit_unknown_result + chain repair
                 p.send_error(error.please_reboot(
                     f"resolve {req.version} failed in pipeline"))
+            if isinstance(e, Exception):
+                self.stats.add("resolve_errors")
+                if not isinstance(e, error.FDBError):
+                    # typed wrapping: an untyped engine exception would
+                    # escape the handler and crash the whole run loop
+                    raise error.please_reboot(
+                        f"resolve {req.version} failed in pipeline: {e}") from e
             raise
         reply = self._finish(req.version, verdicts, prepended, new_oldest,
                              advance_chain=False)
         self._inflight.pop(req.version, None)
         p.send(reply)
         return reply
+
+    async def _engine_resolve(self, transactions, version: Version,
+                              new_oldest: Version):
+        """Dispatch one batch to the conflict engine, awaiting engines whose
+        resolve is a coroutine (fault/resilient.py's supervisor). Device
+        faults under sim come from the supervisor's engine-boundary buggify
+        sites (every dynamic spec wraps engines by default) — not here,
+        where a raw-engine fault would need the proxy's retry machinery to
+        absorb (direct resolver harnesses have none)."""
+        r = self.engine.resolve(transactions, version, new_oldest)
+        if hasattr(r, "__await__"):
+            r = await r
+        return r
 
     def _finish(self, version: Version, verdicts, prepended: bool,
                 new_oldest: Version,

@@ -259,12 +259,18 @@ class Worker:
         if key not in self.roles:
             pipe = None
             pipe_knobs = getattr(self.cluster_cfg, "resolver_pipeline", None)
-            if pipe_knobs:
+            if pipe_knobs is not None:   # {} = pipeline with all defaults
                 from ..pipeline.service import PipelineConfig
 
                 pipe = PipelineConfig(**pipe_knobs)
+            # device-fault supervisor (fault/resilient.py): watchdog +
+            # retries + bit-identical CPU-oracle failover around whatever
+            # engine the factory built
+            from ..fault import maybe_wrap
+
+            engine = maybe_wrap(self.engine_factory(), self.cluster_cfg)
             self.roles[key] = Resolver(
-                self.proc, self.engine_factory(),
+                self.proc, engine,
                 start_version=req.start_version, token_suffix=req.token_suffix,
                 index=req.replica_index, pipeline=pipe,
             )

@@ -40,6 +40,9 @@ class Simulator:
         from . import validation
 
         validation.enable()
+        from .. import fault
+
+        fault.reset_registry()
         if randomize_knobs:
             from ..core import knobs
             knobs.randomize_all(self.sched.rng)

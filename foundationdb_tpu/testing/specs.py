@@ -21,6 +21,7 @@ from .workloads import (
     ConsistencyCheckWorkload,
     CycleWorkload,
     DatacenterKillWorkload,
+    DeviceFaultValidationWorkload,
     FullClusterRebootWorkload,
     FuzzApiCorrectnessWorkload,
     IncrementWorkload,
@@ -63,7 +64,49 @@ def _sharded_engine_factory():
     return ShardedConflictEngine(cfg, KeyShardMap.uniform(n))
 
 
+def _nemesis_engine_factory():
+    """The device-nemesis resolver engine: the reference oracle behind a
+    seed-driven fault injector (exceptions, hangs, slow batches, bursty
+    outages at FaultRates defaults; verdict flips off — see fault/inject.py),
+    supervised by ResilientEngine, which must keep the emitted abort sets
+    bit-identical throughout. The supervisor runs a tightened failover /
+    probation cycle: resolver generations only live a few seconds between
+    attrition kills, and the campaign needs full failover -> re-warm ->
+    swap-back round trips inside one generation, not just the failover
+    half."""
+    from ..fault import FaultInjectingEngine, ResilienceConfig, ResilientEngine
+    from ..ops.oracle import OracleConflictEngine
+
+    return ResilientEngine(
+        FaultInjectingEngine(OracleConflictEngine()),
+        ResilienceConfig(dispatch_timeout=0.3, retry_budget=1,
+                         retry_backoff=0.05, probe_rate=0.1,
+                         probation_batches=2, failover_min_batches=2),
+        record_journal=True,   # the check replays it for abort-set parity
+    )
+
+
 SPECS: Dict[str, Callable[[], Spec]] = {
+    # the device nemesis (ISSUE 2): machine kills + clogging + a faulting
+    # conflict engine, all at once. The check asserts workload invariants,
+    # zero durability violations (run_spec's sim_validation gate), and that
+    # every supervised engine's journal replays bit-identically through a
+    # clean oracle — failover and swap-back included.
+    "DeviceNemesis": lambda: Spec(
+        title="DeviceNemesis",
+        workloads=[
+            (CycleWorkload, {"nodes": 8, "transactions": 18, "think_time": 0.8}),
+            (MachineAttritionWorkload, {"interval": 9.0, "delay_before": 4.0}),
+            (RandomCloggingWorkload, {"scale": 0.02}),
+            (DeviceFaultValidationWorkload, {}),
+            (ConsistencyCheckWorkload, {}),
+        ],
+        dynamic=DynamicClusterConfig(n_workers=5, n_tlogs=2, n_resolvers=2,
+                                     n_storage=2,
+                                     engine_factory=_nemesis_engine_factory),
+        client_count=2,
+        timeout=900.0,
+    ),
     # tests/fast/CycleTest.txt with Attrition: Cycle churn while workers
     # hosting transaction roles are killed + rebooted — every kill forces a
     # full epoch recovery (the reference's core correctness strategy)

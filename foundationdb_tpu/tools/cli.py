@@ -93,6 +93,12 @@ class Cli:
             stale = " (STALE — no storage poll answered)" \
                 if doc['qos'].get('storage_lag_stale') else ""
             self._print(f"  worst storage lag  - {doc['qos'].get('worst_storage_lag_versions')} versions{stale}")
+            health = doc["qos"].get("resolver_health") or {}
+            if doc["qos"].get("resolver_degraded"):
+                det = ", ".join(f"{a}: {s}" for a, s in sorted(health.items()))
+                self._print(f"  resolver engines   - DEGRADED ({det})")
+            elif health:
+                self._print(f"  resolver engines   - healthy ({len(health)})")
         for s in doc.get("storage", []):
             state = "unreachable" if s.get("unreachable") else f"v={s.get('durable_version')}"
             self._print(f"  storage tag {s['tag']}      - {s['address']} ({state})")

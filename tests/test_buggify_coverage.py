@@ -5,9 +5,9 @@ FIRING across seeds (flow/coveragetool harvests which did). This harvest
 runs a diverse spec battery across seeds in one process and asserts a
 healthy majority of the statically-declared sim-reachable sites fired —
 a site that never fires under a grinder battery is dead weight, and a
-shrinking count flags accidentally disabled injection."""
-import re
-import subprocess
+shrinking count flags accidentally disabled injection. The site scanner
+lives in tools/buggify_coverage.py (the operator-facing report consumes
+the same inventory)."""
 from pathlib import Path
 
 import pytest
@@ -15,19 +15,7 @@ import pytest
 from foundationdb_tpu.core import buggify
 from foundationdb_tpu.testing.specs import SPECS
 from foundationdb_tpu.testing.workload import run_spec
-
-REPO = Path(__file__).resolve().parent.parent
-
-
-def static_sites():
-    """(file, line) of every buggify.buggify() call in the tree."""
-    out = []
-    pkg = REPO / "foundationdb_tpu"
-    for path in pkg.rglob("*.py"):
-        for i, line in enumerate(path.read_text().splitlines(), 1):
-            if "buggify.buggify()" in line and "def " not in line:
-                out.append((str(path), i))
-    return out
+from foundationdb_tpu.tools.buggify_coverage import sim_reachable, static_sites
 
 
 def test_site_count_floor():
@@ -41,6 +29,7 @@ BATTERY = [
     ("DataDistributionAttrition", 12), ("CycleTestRestart", 13),
     ("MultiProxyAttrition", 14), ("CycleLogSubsets", 15),
     ("BackupCorrectness", 16), ("DiskAttrition", 18),
+    ("DeviceNemesis", 19),   # engine-boundary sites (fault/resilient.py)
 ]
 
 
@@ -50,10 +39,7 @@ def test_coverage_harvest_battery():
         res = run_spec(SPECS[name](), seed)
         assert res.ok, (name, seed)
     fired_lines = {(f, l) for (f, l) in buggify.fired}
-    total = static_sites()
-    # real-transport sites can only fire in real mode; everything else is
-    # sim-reachable
-    reachable = [(f, l) for (f, l) in total if "/real/" not in f]
+    reachable = sim_reachable(static_sites())
     hit = [s for s in reachable if s in fired_lines]
     missed = sorted(set(reachable) - fired_lines)
     # a majority bar, not an every-site bar: per-seed activation is 25%,

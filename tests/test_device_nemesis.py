@@ -1,0 +1,61 @@
+"""The device-nemesis campaign (ISSUE 2 acceptance).
+
+DeviceNemesis composes MachineAttrition + RandomClogging with a resolver
+conflict engine behind the seed-driven fault injector (exceptions, hangs,
+slow batches, bursty outages at FaultRates defaults) under the
+ResilientEngine supervisor. Per seed, run_spec asserts:
+
+  (a) workload invariants hold (cycle ring, replica consistency);
+  (b) sim/validation.py records zero durability violations (run_spec
+      fails the spec on any);
+  (c) every supervised engine's final abort sets are bit-identical to a
+      clean-engine replay of the same batch stream — the
+      DeviceFaultValidationWorkload replays each engine's journal through
+      a fresh reference oracle.
+
+The 3-seed smoke rides tier-1; the full multi-seed campaign is marked
+`slow` and runs via `make chaos`. Both assert, via engine health stats
+aggregated into the spec metrics, that failover AND swap-back each
+occurred at least once across their seeds.
+"""
+import pytest
+
+from foundationdb_tpu.testing.specs import SPECS
+from foundationdb_tpu.testing.workload import run_spec
+
+SMOKE_SEEDS = (31, 32, 33)
+CAMPAIGN_SEEDS = tuple(range(31, 39))
+
+
+def _run(seed):
+    res = run_spec(SPECS["DeviceNemesis"](), seed)
+    assert res.ok, (
+        f"replay: python -m foundationdb_tpu.testing.runner "
+        f"--spec DeviceNemesis --seed {seed}")
+    assert not res.metrics.get("parity_mismatches"), res.metrics
+    assert not res.metrics.get("engine_probe_mismatches"), res.metrics
+    return res.metrics
+
+
+def _assert_coverage(per_seed):
+    failovers = sum(m.get("engine_failovers", 0) for m in per_seed)
+    swap_backs = sum(m.get("engine_swap_backs", 0) for m in per_seed)
+    faults = sum(m.get("engine_dispatch_faults", 0) for m in per_seed)
+    assert faults > 0, "fault injection never fired"
+    assert failovers >= 1, "no failover across the campaign"
+    assert swap_backs >= 1, "no swap-back across the campaign"
+
+
+def test_device_nemesis_smoke():
+    """3-seed tier-1 variant: spec passes, abort sets bit-identical, and
+    the failover/swap-back round trip happens at least once."""
+    _assert_coverage([_run(seed) for seed in SMOKE_SEEDS])
+
+
+@pytest.mark.slow
+def test_device_nemesis_campaign():
+    """The full multi-seed campaign (`make chaos`): every seed passes with
+    bit-identical abort sets; failover and swap-back coverage across the
+    set."""
+    per_seed = [_run(seed) for seed in CAMPAIGN_SEEDS]
+    _assert_coverage(per_seed)

@@ -1,0 +1,408 @@
+"""Unit suite for the device-fault supervisor (fault/resilient.py).
+
+Covers the health state machine end to end — timeout -> retry -> failover
+-> probation -> swap-back — plus oracle-rebuild parity from the shadow
+history window, probe-detected corruption quarantine, degraded pipeline
+depth collapse, and the serial resolver path's typed engine-exception
+wrapping (ISSUE 2 satellites)."""
+import random
+
+import pytest
+
+from foundationdb_tpu.core import buggify, error
+from foundationdb_tpu.core.trace import g_trace
+from foundationdb_tpu.core.types import CommitTransaction, KeyRange, TransactionCommitResult
+from foundationdb_tpu.fault import (
+    FAILED,
+    HEALTHY,
+    PROBATION,
+    QUARANTINED,
+    FaultInjectingEngine,
+    FaultRates,
+    ResilienceConfig,
+    ResilientEngine,
+)
+from foundationdb_tpu.ops.oracle import OracleConflictEngine
+from foundationdb_tpu.sim.loop import delay, never, set_scheduler
+from foundationdb_tpu.sim.simulator import Simulator
+
+CFG = ResilienceConfig(dispatch_timeout=0.2, retry_budget=2, retry_backoff=0.02,
+                       probe_rate=0.0, probation_batches=2, failover_min_batches=2)
+
+
+@pytest.fixture
+def sim():
+    s = Simulator(11)
+    buggify.disable()   # exact per-call behavior: no background injection
+    g_trace.clear()     # trace assertions must see this test's events only
+    yield s
+    buggify.disable()
+    set_scheduler(None)
+
+
+class ScriptedEngine:
+    """Device double: an inner oracle behind a per-dispatch behavior script
+    ('ok' | 'raise' | 'hang' | 'flip'); past the script end, always 'ok'."""
+
+    name = "scripted"
+
+    def __init__(self, script=()):
+        self.inner = OracleConflictEngine()
+        self.script = list(script)
+        self.calls = 0
+
+    def clear(self, version):
+        self.inner.clear(version)
+
+    def rewarm_target(self):
+        return self.inner
+
+    def _next(self):
+        self.calls += 1
+        return self.script.pop(0) if self.script else "ok"
+
+    async def resolve_async(self, transactions, now_v, new_oldest):
+        b = self._next()
+        if b == "hang":
+            await never()
+        if b == "raise":
+            raise error.device_fault("scripted dispatch failure")
+        verdicts = list(self.inner.resolve(transactions, now_v, new_oldest))
+        if b == "flip" and verdicts:
+            verdicts[0] = (TransactionCommitResult.CONFLICT
+                           if int(verdicts[0]) == int(TransactionCommitResult.COMMITTED)
+                           else TransactionCommitResult.COMMITTED)
+        return verdicts
+
+
+def batch_stream(seed, n, pool=40, writes=True):
+    """Deterministic conflicting batches: (txns, version, new_oldest)."""
+    rng = random.Random(seed)
+    v = 0
+    out = []
+    for _ in range(n):
+        v += rng.randrange(20, 100)
+        txns = []
+        for _ in range(rng.randrange(1, 6)):
+            t = CommitTransaction(read_snapshot=max(0, v - rng.randrange(1, 300)))
+            for _ in range(rng.randrange(1, 3)):
+                k = b"k/%03d" % rng.randrange(pool)
+                t.read_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            if writes:
+                for _ in range(rng.randrange(0, 3)):
+                    k = b"k/%03d" % rng.randrange(pool)
+                    t.write_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            txns.append(t)
+        out.append((txns, v, max(0, v - 1500)))
+    return out
+
+
+def drive(sim, coro):
+    return sim.sched.run_until(sim.sched.spawn(coro), until=100000)
+
+
+def assert_parity(eng, batches, **kwargs):
+    """Serve `batches` through the supervisor and assert every verdict
+    equals a clean full-history oracle's."""
+    clean = OracleConflictEngine()
+
+    async def go():
+        for txns, v, old in batches:
+            got = await eng.resolve(txns, v, old)
+            want = clean.resolve(txns, v, old)
+            assert [int(x) for x in got] == [int(x) for x in want], (v, got, want)
+    return go()
+
+
+# -- state machine ----------------------------------------------------------
+
+def test_timeout_retry_recovers(sim):
+    """A hung dispatch trips the watchdog; the retry (after a device
+    re-warm) succeeds and the engine returns to healthy."""
+    dev = ScriptedEngine(["hang"])
+    eng = ResilientEngine(dev, CFG)
+    drive(sim, assert_parity(eng, batch_stream(1, 10)))
+    st = eng.health_stats()
+    assert st["state"] == HEALTHY
+    assert st["dispatch_faults"] == 1 and st["retries"] == 1
+    assert st["failovers"] == 0
+
+
+def test_retry_exhaustion_fails_over_bit_identical(sim):
+    """Persistent faults exhaust the retry budget: the supervisor rebuilds
+    the CPU oracle from the shadow mid-stream and verdicts stay
+    bit-identical on the failover path."""
+    warm, n = 6, 12
+    dev = ScriptedEngine(["ok"] * warm + ["raise"] * 1000)
+    eng = ResilientEngine(dev, CFG)
+    drive(sim, assert_parity(eng, batch_stream(2, warm + n)))
+    st = eng.health_stats()
+    assert st["failovers"] >= 1
+    assert st["oracle_batches"] >= n - 1
+    assert st["state"] in (FAILED, PROBATION)
+    assert st["swap_backs"] == 0
+
+
+def test_failover_probation_swap_back(sim):
+    """The full round trip: healthy -> (faults) -> failed -> re-warm ->
+    probation -> swap-back -> healthy, with bit-identical verdicts
+    throughout."""
+    # 1 initial + 2 retries per batch: 9 raises = three failed batches,
+    # comfortably past the retry budget and failover_min_batches window
+    dev = ScriptedEngine(["ok"] * 5 + ["raise"] * 9)
+    eng = ResilientEngine(dev, CFG)
+    drive(sim, assert_parity(eng, batch_stream(3, 30)))
+    st = eng.health_stats()
+    assert st["failovers"] >= 1
+    assert st["swap_backs"] >= 1
+    assert st["state"] == HEALTHY
+    # swap-back really dropped the failover oracle
+    assert eng._failover is None
+
+
+def test_probation_relapse_returns_to_failed(sim):
+    """A device that faults during probation goes back to the failover
+    oracle without corrupting the verdict stream."""
+    dev = ScriptedEngine(["raise"] * 12 + ["raise"])
+    eng = ResilientEngine(dev, ResilienceConfig(
+        dispatch_timeout=0.2, retry_budget=0, retry_backoff=0.02,
+        probe_rate=0.0, probation_batches=3, failover_min_batches=1))
+    drive(sim, assert_parity(eng, batch_stream(4, 14)))
+    st = eng.health_stats()
+    assert st["failovers"] >= 1
+    assert st["swap_backs"] == 0
+    # probation attempts relapsed into FAILED (device still raising)
+    assert g_trace.find("ResolverEngineProbationFault")
+    assert st["dispatch_faults"] >= 5
+
+
+# -- shadow rebuild ---------------------------------------------------------
+
+def test_shadow_rebuild_parity(sim):
+    """An oracle rebuilt from the shadow window answers every future batch
+    exactly like an engine that lived through the whole history — the
+    property that makes failover (and the probe) exact."""
+    eng = ResilientEngine(ScriptedEngine(), CFG)
+    full = OracleConflictEngine()
+    history = batch_stream(5, 40)
+    future = batch_stream(6, 25)
+    # continue the version chain past the history
+    last_v = history[-1][1]
+    future = [(t, last_v + v, max(0, last_v + v - 1500)) for t, v, _ in future]
+
+    async def go():
+        for txns, v, old in history:
+            want = full.resolve(txns, v, old)
+            got = await eng.resolve(txns, v, old)
+            assert [int(x) for x in got] == [int(x) for x in want]
+        rebuilt = eng._rebuild_oracle()
+        for txns, v, old in future:
+            want = full.resolve(txns, v, old)
+            got = rebuilt.resolve(txns, v, old)
+            assert [int(x) for x in got] == [int(x) for x in want], v
+    drive(sim, go())
+    # the shadow really is a window, not the whole history
+    assert len(eng._shadow) < len(history)
+
+
+def test_journal_replays_clean(sim):
+    """The journal (what the nemesis check consumes) replays bit-identically
+    through a fresh oracle even across a failover."""
+    dev = ScriptedEngine(["ok"] * 4 + ["raise"] * 9)
+    eng = ResilientEngine(dev, CFG, record_journal=True)
+    drive(sim, assert_parity(eng, batch_stream(7, 20)))
+    clean = OracleConflictEngine()
+    for version, txns, new_oldest, verdicts in eng.journal:
+        want = clean.resolve(list(txns), version, new_oldest)
+        assert list(verdicts) == [int(v) for v in want]
+
+
+# -- corruption probe -------------------------------------------------------
+
+def test_probe_detects_corruption_and_quarantines(sim):
+    """A device flipping verdict bits is caught by the cross-validation
+    probe: SevError TraceEvent, quarantine, and the oracle's (correct)
+    verdicts are what the resolver emits."""
+    dev = FaultInjectingEngine(
+        OracleConflictEngine(),
+        rates=FaultRates(exception=0, hang=0, slow=0, outage=0, flip=0.5))
+    eng = ResilientEngine(dev, ResilienceConfig(
+        dispatch_timeout=0.2, retry_budget=0, retry_backoff=0.02,
+        probe_rate=1.0, probation_batches=2, failover_min_batches=2))
+    drive(sim, assert_parity(eng, batch_stream(8, 30)))
+    st = eng.health_stats()
+    assert st["state"] == QUARANTINED
+    assert st["probe_mismatches"] >= 1
+    assert g_trace.find("ResolverEngineQuarantine")
+
+
+def test_fault_injector_menagerie_parity(sim):
+    """All fault kinds at elevated rates (flips off): the supervisor keeps
+    the emitted stream bit-identical and completes failover round trips."""
+    dev = FaultInjectingEngine(
+        OracleConflictEngine(),
+        rates=FaultRates(exception=0.05, hang=0.03, slow=0.1, outage=0.03,
+                         outage_seconds=1.0))
+    eng = ResilientEngine(dev, ResilienceConfig(
+        dispatch_timeout=0.2, retry_budget=2, retry_backoff=0.02,
+        probe_rate=0.1, probation_batches=3, failover_min_batches=2))
+    drive(sim, assert_parity(eng, batch_stream(9, 250)))
+    st = eng.health_stats()
+    assert st["dispatch_faults"] > 0
+    assert st["failovers"] >= 1 and st["swap_backs"] >= 1
+    assert st["probe_mismatches"] == 0
+
+
+# -- pipeline depth collapse ------------------------------------------------
+
+def test_degraded_engine_collapses_pipeline_depth(sim):
+    """pipeline/service.py: a degraded engine caps the in-flight window at
+    1; a healthy engine uses the configured depth."""
+    from foundationdb_tpu.pipeline.service import PipelineConfig, PipelinedResolverService
+
+    class Eng:
+        degraded = False
+
+        def __init__(self):
+            self.inner = OracleConflictEngine()
+
+        def resolve(self, txns, v, old):
+            return self.inner.resolve(txns, v, old)
+
+    async def run_window(eng):
+        svc = PipelinedResolverService(
+            PipelineConfig(depth=3, device_ms_per_batch=5.0), eng)
+        peaks = []
+
+        async def one(txns, v, old):
+            await svc.acquire()
+            peaks.append(svc.in_flight)
+            await svc.resolve(txns, v, old)
+
+        tasks = [sim.sched.spawn(one(t, v, o))
+                 for t, v, o in batch_stream(10, 8, writes=False)]
+        for t in tasks:
+            await t
+        return max(peaks)
+
+    healthy_peak = drive(sim, run_window(Eng()))
+    sick = Eng()
+    sick.degraded = True
+    degraded_peak = drive(sim, run_window(sick))
+    assert healthy_peak == 3
+    assert degraded_peak == 1
+
+
+# -- serial resolver path (satellite: typed engine exceptions) --------------
+
+def test_serial_engine_exception_is_typed_and_recoverable(sim):
+    """server/resolver.py serial path: an untyped engine exception reaches
+    the requester as a typed FDBError (please_reboot -> the proxy's
+    commit_unknown_result path), the actor survives, the stats counter
+    bumps, and a retry of the same version then resolves."""
+    from foundationdb_tpu.server.messages import ResolveTransactionBatchRequest
+    from foundationdb_tpu.server.resolver import Resolver
+    from foundationdb_tpu.sim.loop import TaskPriority
+    from foundationdb_tpu.sim.network import Endpoint
+
+    class FlakyEngine:
+        def __init__(self):
+            self.inner = OracleConflictEngine()
+            self.fail_next = 1
+
+        def resolve(self, txns, v, old):
+            if self.fail_next:
+                self.fail_next -= 1
+                raise ValueError("XLA runtime error")   # deliberately untyped
+            return self.inner.resolve(txns, v, old)
+
+    proc = sim.new_process("resolver")
+    client = sim.new_process("proxy")
+    res = Resolver(proc, FlakyEngine(), start_version=0)
+    req = ResolveTransactionBatchRequest(
+        prev_version=0, version=10, last_received_version=0,
+        transactions=[CommitTransaction(read_snapshot=5)])
+
+    async def go():
+        try:
+            await sim.net.request(client.address,
+                                  Endpoint(proc.address, res.token), req,
+                                  TaskPriority.PROXY_RESOLVER_REPLY, timeout=5.0)
+        except error.FDBError as e:
+            first = e
+        else:
+            raise AssertionError("engine exception did not surface")
+        assert first.code == error.please_reboot("").code
+        assert proc.alive
+        # same version again: the chain never advanced, the retry resolves
+        reply = await sim.net.request(client.address,
+                                      Endpoint(proc.address, res.token), req,
+                                      TaskPriority.PROXY_RESOLVER_REPLY, timeout=5.0)
+        assert reply.committed == [int(TransactionCommitResult.COMMITTED)]
+    drive(sim, go())
+    assert res.stats.counter("resolve_errors").value == 1
+
+
+def test_serial_duplicate_waits_on_inflight_dispatch(sim):
+    """Once the engine awaits (supervised dispatch), a duplicate delivery
+    of the in-flight version must wait for the first outcome instead of
+    double-dispatching the batch."""
+    from foundationdb_tpu.server.messages import ResolveTransactionBatchRequest
+    from foundationdb_tpu.server.resolver import Resolver
+    from foundationdb_tpu.sim.loop import TaskPriority
+    from foundationdb_tpu.sim.network import Endpoint
+
+    class SlowEngine:
+        def __init__(self):
+            self.inner = OracleConflictEngine()
+            self.dispatches = 0
+
+        async def _run(self, txns, v, old):
+            self.dispatches += 1
+            await delay(0.5)
+            return self.inner.resolve(txns, v, old)
+
+        def resolve(self, txns, v, old):
+            return self._run(txns, v, old)
+
+        def health_stats(self):
+            return {"state": "healthy", "degraded": False}
+
+    proc = sim.new_process("resolver")
+    client = sim.new_process("proxy")
+    eng = SlowEngine()
+    res = Resolver(proc, eng, start_version=0)
+    req = ResolveTransactionBatchRequest(
+        prev_version=0, version=10, last_received_version=0,
+        transactions=[CommitTransaction(read_snapshot=5)])
+
+    async def one():
+        return await sim.net.request(client.address,
+                                     Endpoint(proc.address, res.token), req,
+                                     TaskPriority.PROXY_RESOLVER_REPLY, timeout=5.0)
+
+    async def go():
+        a = sim.sched.spawn(one())
+        await delay(0.1)
+        b = sim.sched.spawn(one())   # duplicate while the first is in flight
+        ra, rb = await a, await b
+        assert ra.committed == rb.committed
+    drive(sim, go())
+    assert eng.dispatches == 1
+
+
+# -- ratekeeper signal ------------------------------------------------------
+
+def test_ratekeeper_throttles_on_degraded_resolver():
+    """A degraded conflict engine caps admission at the knob fraction."""
+    from foundationdb_tpu.core.knobs import SERVER_KNOBS
+    from foundationdb_tpu.server.ratekeeper import Ratekeeper, StorageQueueInfo
+
+    rk = Ratekeeper(None, "rk", [], lambda: 0)
+    infos = [StorageQueueInfo(tag=0, version=100, durable_version=100)]
+    full = rk._update_rate(infos, [], [{"state": "healthy", "degraded": False}])
+    assert full == float(SERVER_KNOBS.max_transactions_per_second)
+    capped = rk._update_rate(infos, [], [{"state": "failed", "degraded": True}])
+    assert rk.resolver_degraded
+    assert capped == pytest.approx(
+        full * SERVER_KNOBS.resolver_degraded_tps_fraction)

@@ -9,7 +9,9 @@ from foundationdb_tpu.testing.specs import SPECS
 from foundationdb_tpu.testing.workload import run_spec
 
 KERNEL_SPECS = {"CycleTestTPU", "CycleTestTPU8", "RandomReadWriteTPU8"}
-FAST_SPECS = [n for n in sorted(SPECS) if n not in KERNEL_SPECS]
+# DeviceNemesis has its own smoke + slow campaign (tests/test_device_nemesis.py)
+FAST_SPECS = [n for n in sorted(SPECS)
+              if n not in KERNEL_SPECS and n != "DeviceNemesis"]
 
 
 @pytest.mark.parametrize("name", FAST_SPECS)
