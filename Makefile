@@ -6,6 +6,13 @@ check:
 bench:
 	python bench.py
 
+# CPU-backend perf-path smoke (seconds): bucket-ladder serving drive with
+# oracle parity + zero-steady-state-compile assertion, and a mini
+# latency-under-load curve through the e2e sim cluster with injected
+# device times (docs/perf.md). Breaks loudly in CI when perf wiring rots.
+bench-smoke:
+	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.bench_smoke
+
 # Device-fault chaos: the full multi-seed nemesis campaign (slow tier; the
 # 3-seed smoke rides `check`) + the buggify coverage report over the
 # grinder battery (docs/fault_tolerance.md).
@@ -13,4 +20,4 @@ chaos:
 	python -m pytest tests/test_device_nemesis.py -q -m slow
 	python -m foundationdb_tpu.tools.buggify_coverage --seeds 4 --min-frac 0.5
 
-.PHONY: check bench chaos
+.PHONY: check bench bench-smoke chaos

@@ -234,9 +234,13 @@ def main():
     p99_ms = float(np.percentile(np.asarray(lat) * 1e3, 99))
     device_ms_per_batch = dt / (THROUGHPUT_SCANS * SCAN_STEPS) * 1e3
 
-    host_pack_ms = host_packing_ms_per_batch()
+    # alloc = per-chunk np.zeros (the pre-arena cost); the arena figure is
+    # what the serving path pays now and feeds every downstream estimate
+    host_pack_alloc_ms = host_packing_ms_per_batch()
+    host_pack_ms = host_packing_ms_per_batch(arena=True)
     parity_ok = parity_measurement_set()
     weak8 = sharded_tpu_weak_scale()
+    ladder = bucket_ladder_section()
     curve = latency_curve(host_pack_ms)
     under_load = latency_under_load(host_pack_ms, curve)
     # Sequential estimate (host pack, then device) and the pipelined rate: a
@@ -256,6 +260,9 @@ def main():
         "vs_baseline": round(txns_per_sec / BASELINE_TXNS_PER_SEC_PER_CHIP, 4),
         "device_ms_per_batch": round(device_ms_per_batch, 3),
         "host_pack_ms_per_batch": round(host_pack_ms, 3),
+        "host_pack_ms_per_batch_alloc": round(host_pack_alloc_ms, 3),
+        "host_pack_arena_speedup": round(host_pack_alloc_ms / host_pack_ms, 3)
+            if host_pack_ms > 0 else None,
         "e2e_txns_per_sec_est": round(e2e, 1),
         "e2e_pipelined_txns_per_sec": round(e2e_pipelined, 1),
         "parity_configs_ok": parity_ok,
@@ -265,6 +272,7 @@ def main():
         "vs_native_cpu": round(txns_per_sec / native_cpu, 2) if native_cpu else None,
         "sharded_cpu_mesh": sharded,
         "sharded_tpu_weak_scale": weak8,
+        "bucket_ladder": ladder,
         "latency_curve": curve,
         "latency_under_load": under_load,
         "device": str(dev),
@@ -344,15 +352,14 @@ def latency_curve(host_pack_ms_at_headline: float):
     return {"points": out, "production_point": chosen}
 
 
-#: client-observed p99 commit budget for the production point: the
-#: resolver-inclusive share of the reference's < 3ms end-to-end commit
-#: target (performance.rst:36,49), matching BASELINE.md's 1.5-2.5ms window.
-LATENCY_BUDGET_P99_MS = 2.5
 #: batch shapes the pipelined service is scanned over. 512 is the serial
 #: latency_curve production point (the comparison baseline); the
 #: intermediate shapes are where depth>=2 converts device speed into
-#: sustained in-budget throughput.
-HARNESS_SHAPES = (512, 768, 832, 896, 1024)
+#: sustained in-budget throughput; the >=1280 shapes are reachable only
+#: with the bucket ladder (each pays its own bucket's device time, and the
+#: BudgetBatcher rejects them adaptively if the budget says no). The p99
+#: budget itself is the resolver_p99_budget_ms knob (docs/perf.md).
+HARNESS_SHAPES = (512, 768, 832, 896, 1024, 1280, 1536, 2048)
 HARNESS_SCAN_STEPS = 4096   # tunnel RTT amortized to < 0.04 ms/batch
 
 
@@ -371,8 +378,11 @@ def latency_under_load(host_pack_ms_at_headline: float, curve: dict):
     pipelined (depth >= 2) resolver configurations, offered loads at 90%
     and 96% of each shape's device-paced capacity T / interval. The
     production point is the highest sustained-throughput depth >= 2 point
-    whose p99 stays inside LATENCY_BUDGET_P99_MS."""
-    from foundationdb_tpu.pipeline.latency_harness import run_latency_under_load
+    whose p99 stays inside the resolver_p99_budget_ms knob."""
+    from foundationdb_tpu.pipeline.latency_harness import (
+        p99_budget_ms, run_latency_under_load)
+
+    budget = p99_budget_ms()
 
     pack_per_txn = host_pack_ms_at_headline / CFG.max_txns
     device_ms_by_shape = {}
@@ -396,6 +406,7 @@ def latency_under_load(host_pack_ms_at_headline: float, curve: dict):
             depth=depth, batch_txns=T, device_ms=device_ms_by_shape[T],
             pack_ms_per_txn=pack_per_txn,
             offered_txns_per_sec=offered, n_txns=12_000,
+            device_ms_by_bucket=device_ms_by_shape, budget_ms=budget,
         )
         d = r.as_dict()
         d["utilization"] = util
@@ -419,7 +430,7 @@ def latency_under_load(host_pack_ms_at_headline: float, curve: dict):
                 run_point(2, T, util * capacity, util)
 
     def in_budget(p):
-        return p["errors"] == 0 and p["p99_ms"] <= LATENCY_BUDGET_P99_MS
+        return p["errors"] == 0 and p["p99_ms"] <= budget
 
     candidates = [p for p in points if p["depth"] >= 2 and in_budget(p)]
     production = max(candidates, key=lambda p: p["sustained_txns_per_sec"]) \
@@ -437,7 +448,8 @@ def latency_under_load(host_pack_ms_at_headline: float, curve: dict):
         if serial_points else None
 
     out = {
-        "budget_p99_ms": LATENCY_BUDGET_P99_MS,
+        "budget_p99_ms": budget,
+        "budget_knob": "resolver_p99_budget_ms",
         "scan_steps": HARNESS_SCAN_STEPS,
         "device_ms_by_shape": {str(t): round(v, 4)
                                for t, v in sorted(device_ms_by_shape.items())},
@@ -461,6 +473,41 @@ def latency_under_load(host_pack_ms_at_headline: float, curve: dict):
             production["sustained_txns_per_sec"]
             / serial_best["sustained_txns_per_sec"], 3)
     return out
+
+
+#: sub-capacity bucket sizes compiled alongside the top CFG shape for the
+#: bucket_ladder section (the resolver_bucket_ladder knob's production
+#: default candidate) — chosen so the latency-budget production point can
+#: pick a shape that pays its own device time instead of the 4096 pad's.
+LADDER_BUCKETS = (512, 1024, 2048)
+
+
+def bucket_ladder_section(smoke: bool = False):
+    """The bucket-ladder proof (docs/perf.md): per-bucket device ms with
+    the scan methodology, plus a warmed JaxConflictEngine driven with
+    mixed-size batches straddling every bucket boundary — reporting the
+    bucket-hit histogram, the fused-scan dispatch histogram, warmup cost,
+    and the compile counter split that shows ZERO steady-state compiles
+    in the serving path."""
+    from foundationdb_tpu.tools.ladder_bench import drive_bucket_ladder
+
+    try:
+        sec = drive_bucket_ladder(CFG, list(LADDER_BUCKETS), pool=POOL,
+                                  steady_rounds=1 if smoke else 2)
+    except Exception:
+        return None
+    dev_ms = {}
+    for b in sec["ladder"]:
+        try:
+            dev_ms[b] = measure_scan(CFG.bucket(b),
+                                     scan_steps=64 if smoke else 256)
+        except Exception:
+            continue
+    sec["device_ms_by_bucket"] = {str(t): round(v, 4)
+                                  for t, v in sorted(dev_ms.items())}
+    sec["device_txns_per_sec_by_bucket"] = {
+        str(t): round(t / (v / 1e3), 1) for t, v in sorted(dev_ms.items())}
+    return sec
 
 
 def sharded_cpu_numbers():
@@ -518,7 +565,7 @@ def native_baseline_txns_per_sec():
     return round((len(encoded) - 1) * 1000 / (time.perf_counter() - t0))
 
 
-def host_packing_ms_per_batch() -> float:
+def host_packing_ms_per_batch(arena: bool = False) -> float:
     """End-to-end cost of the host side of a resolve: transactions off the
     wire -> fixed-shape device arrays. Transactions arrive as columnar
     conflict-wire blocks (core/wire.py; the client serializes its commit
@@ -550,10 +597,17 @@ def host_packing_ms_per_batch() -> float:
     ]
     snaps = np.full((T,), 100, np.int64)
     window = 4 * CFG.key_words
+    pool_arena = he.HostPackArena() if arena else None
     REPS = 10
     best = float("inf")
     for _ in range(REPS):
         t0 = time.perf_counter()
+        bufs = lease = None
+        if pool_arena is not None:
+            # serving path: lease pooled buffers instead of np.zeros-ing
+            # ~10 padded arrays per chunk (first rep allocates — a pool
+            # miss; min over reps is the steady-state reuse cost)
+            bufs, lease = pool_arena.lease(CFG)
         p1 = he.wire_pass1(window, blocks)
         assert p1 is not None, "native wire parser unavailable"
         blob, offs, rp_cnt, wp_cnt = p1
@@ -562,7 +616,9 @@ def host_packing_ms_per_batch() -> float:
         skip = too_old.astype(np.uint8)
         eff_r = np.where(too_old, 0, rp_cnt).astype(np.int32)
         he.wire_chunk_arrays(
-            CFG, blob, offs, 0, T, skip, snap_rel, eff_r, 1000, 0)
+            CFG, blob, offs, 0, T, skip, snap_rel, eff_r, 1000, 0, bufs=bufs)
+        if lease is not None:
+            lease.release()
         # min over reps: the host share is a fixed amount of C + numpy
         # work; anything above the minimum is scheduler noise on this
         # single-core box, not cost the resolver would pay

@@ -123,6 +123,21 @@ def _make_server_knobs() -> Knobs:
     k.init("conflict_key_words", 4)
     k.init("conflict_max_batch_txns", 1 << 12)
     k.init("conflict_max_batch_ranges", 1 << 13)
+    # Bucketed kernel ladder + budget-driven batching (docs/perf.md).
+    #: comma-separated sub-capacity batch sizes compiled alongside the top
+    #: shape ("512,1024"); empty = single bucket. Each must be a multiple
+    #: of 32; an engine keeps only sizes below its own top shape (the
+    #: global knob serves engines of every size), so oversized entries are
+    #: ignored, not errors.
+    k.init("resolver_bucket_ladder", "")
+    #: client-observed p99 commit budget the adaptive batcher fits batches
+    #: into — the resolver-inclusive share of the reference's < 3 ms
+    #: end-to-end commit target (performance.rst:36,49; BASELINE.md's
+    #: 1.5-2.5 ms window). bench.py's latency_under_load production-point
+    #: filter reads the same knob.
+    k.init("resolver_p99_budget_ms", 2.5)
+    #: EWMA smoothing for observed per-bucket device latency (0 < a <= 1)
+    k.init("resolver_latency_ewma_alpha", 0.25)
     return k
 
 

@@ -144,6 +144,22 @@ class ResilientEngine:
             self._failover.clear(version)
         self._shadow.clear()
 
+    def warmup(self, **kw) -> "ResilientEngine":
+        """Pass-through to a bucketed device engine's ladder warmup
+        (ops/host_engine.py) so supervised serving is compile-stall-proof
+        too; a no-op for engines without a ladder (the oracle)."""
+        fn = getattr(self._rewarm_engine(), "warmup", None)
+        if fn is not None:
+            fn(**kw)
+        return self
+
+    def _rewarm_engine(self):
+        """The engine whose device state/programs a re-warm rebuilds (the
+        fault injector's rewarm_target bypasses the flaky dispatch path)."""
+        target = self.device
+        fn = getattr(target, "rewarm_target", None)
+        return fn() if fn is not None else target
+
     async def resolve(self, transactions, now_v, new_oldest):
         """One batch through the supervisor; callers (server/resolver.py,
         pipeline/service.py) enter strictly in commit-version order."""
@@ -353,12 +369,17 @@ class ResilientEngine:
         if buggify.buggify():
             # re-warm itself can fail (the device is, after all, sick)
             raise error.device_fault("buggify: device re-warm failed")
-        target = self.device
-        fn = getattr(target, "rewarm_target", None)
-        if fn is not None:
-            target = fn()
+        target = self._rewarm_engine()
         try:
             self._replay_shadow(target)
+            # Bucketed engines: the shadow replay rebuilds device STATE;
+            # program coverage persists across clear(), so only ladder
+            # buckets that actually served traffic get (re-)warmed — a
+            # rebuild never front-loads compiles for shapes this stream
+            # has not used.
+            fn = getattr(target, "ensure_warm", None)
+            if fn is not None:
+                fn(used_only=True)
         except error.FDBError:
             raise
         except Exception as e:

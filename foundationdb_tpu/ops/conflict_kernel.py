@@ -104,6 +104,39 @@ class KernelConfig:
     def levels(self) -> int:    # sparse-table levels
         return int(math.ceil(math.log2(self.capacity))) + 1
 
+    def bucket(self, t: int) -> "KernelConfig":
+        """Sub-capacity clone for a bucketed kernel ladder: batch-side
+        shapes (txns + read/write row caps) scale down to `t` transactions
+        while the `capacity`-sized interval-table state stays SHAPE-
+        INVARIANT — every bucket's program runs against the same device
+        state, so a ladder of compiled programs shares one history.
+
+        Row caps scale pro-rata, rounded up to a multiple of 32 (keeps the
+        bit-word packing and the Pallas fixpoint's T%32 layout happy).
+        t == max_txns returns self (the top bucket IS the base config)."""
+        if t == self.max_txns:
+            return self
+        if not (0 < t < self.max_txns):
+            raise ValueError(f"bucket size {t} outside (0, {self.max_txns}]")
+        if t % 32:
+            raise ValueError(f"bucket size {t} must be a multiple of 32")
+
+        def scale(rows: int) -> int:
+            if rows <= 0:
+                return rows
+            return min(rows, max(32, -(-rows * t // self.max_txns) + 31 & ~31))
+
+        return KernelConfig(
+            key_words=self.key_words,
+            capacity=self.capacity,
+            max_reads=scale(self.max_reads),
+            max_writes=scale(self.max_writes),
+            max_txns=t,
+            max_point_reads=scale(self.rp),
+            max_point_writes=scale(self.wp),
+            fixpoint=self.fixpoint,
+        )
+
 
 def _key_less(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Lexicographic a < b over trailing lane axis (uint32 words + length)."""
@@ -666,7 +699,10 @@ def apply_writes_and_gc(
         return outc[:, :K], fin_v, n1
 
     hk, hv, n2 = lax.cond(gc > 0, compact, no_gc, None)
-    new_state = {"hkeys": hk, "hvers": hv, "n": n2}
+    # n stays int32 under any jax_enable_x64 default: a drifting state
+    # dtype would silently retrace/recompile the serving program on the
+    # SECOND batch (the bucket ladder's AOT executables reject it loudly).
+    new_state = {"hkeys": hk, "hvers": hv, "n": n2.astype(jnp.int32)}
     return new_state, overflow
 
 
@@ -812,6 +848,85 @@ def apply_step_stacked(cfg: KernelConfig, state, batch, committed, wpos):
         lambda st, b, w: apply_writes_and_gc(cfg, st, b, committed, w)
     )(state, batch, wpos)
     return new_state, jnp.any(overflow)
+
+
+def resolve_step_scan(
+    cfg: KernelConfig,
+    state: Dict[str, jnp.ndarray],
+    batches: Dict[str, jnp.ndarray],   # leaves [C, ...]
+) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+    """C same-shape resolver batches as ONE device program: a lax.scan of
+    resolve_step threading the interval-table state across chunks, so a
+    multi-chunk batch costs one dispatch instead of C. Scan order equals
+    the per-chunk dispatch order on the single device queue, so the
+    status/overflow stacks are bit-identical to C serial resolve_steps."""
+
+    def body(st, b):
+        st, out = resolve_step(cfg, st, b)
+        return st, (out["status"], out["overflow"])
+
+    state, (status, overflow) = lax.scan(body, state, batches)
+    return state, {"status": status, "overflow": overflow}
+
+
+def resolve_step_stacked_scan(
+    cfg: KernelConfig,
+    state: Dict[str, jnp.ndarray],     # leaves [S, ...]
+    batches: Dict[str, jnp.ndarray],   # leaves [C, S, ...]
+) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+    """Fused chunk scan over the S-sub-shard stacked step (one device)."""
+
+    def body(st, b):
+        st, out = resolve_step_stacked(cfg, st, b)
+        return st, (out["status"], out["overflow"])
+
+    state, (status, overflow) = lax.scan(body, state, batches)
+    return state, {"status": status, "overflow": overflow}
+
+
+def state_struct(cfg: KernelConfig, stack: Tuple[int, ...] = ()) -> Dict[str, jax.ShapeDtypeStruct]:
+    """Abstract shapes of the device interval-table state (initial_state),
+    optionally stacked under leading axes — what an AOT .lower() needs."""
+    s = jax.ShapeDtypeStruct
+    return {
+        "hkeys": s(stack + (cfg.capacity, cfg.lanes), jnp.uint32),
+        "hvers": s(stack + (cfg.capacity,), jnp.int32),
+        "n": s(stack + (), jnp.int32),
+    }
+
+
+def batch_struct(cfg: KernelConfig, stack: Tuple[int, ...] = ()) -> Dict[str, jax.ShapeDtypeStruct]:
+    """Abstract shapes/dtypes of one packed batch (build_batch_arrays /
+    host_engine.wire_chunk_arrays emit exactly these), optionally stacked
+    under leading axes ((S,) sub-shards, (C,) scan chunks, or (C, S))."""
+    K = cfg.lanes
+    s = jax.ShapeDtypeStruct
+
+    def f(shape, dt):
+        return s(stack + shape, dt)
+
+    return {
+        "rpb": f((cfg.rp, K), jnp.uint32),
+        "rp_snap": f((cfg.rp,), jnp.int32),
+        "rp_txn": f((cfg.rp,), jnp.int32),
+        "rp_valid": f((cfg.rp,), jnp.bool_),
+        "rb": f((cfg.max_reads, K), jnp.uint32),
+        "re": f((cfg.max_reads, K), jnp.uint32),
+        "r_snap": f((cfg.max_reads,), jnp.int32),
+        "r_txn": f((cfg.max_reads,), jnp.int32),
+        "r_valid": f((cfg.max_reads,), jnp.bool_),
+        "wpb": f((cfg.wp, K), jnp.uint32),
+        "wp_txn": f((cfg.wp,), jnp.int32),
+        "wp_valid": f((cfg.wp,), jnp.bool_),
+        "wb": f((cfg.max_writes, K), jnp.uint32),
+        "we": f((cfg.max_writes, K), jnp.uint32),
+        "w_txn": f((cfg.max_writes,), jnp.int32),
+        "w_valid": f((cfg.max_writes,), jnp.bool_),
+        "t_ok": f((cfg.max_txns,), jnp.bool_),
+        "t_too_old": f((cfg.max_txns,), jnp.bool_),
+        "now": f((), jnp.int32),
+        "gc": f((), jnp.int32),
+    }
 
 
 def initial_state(cfg: KernelConfig, version_rel: int = 0, first_key: bytes = b"") -> Dict[str, jnp.ndarray]:

@@ -14,8 +14,10 @@ history-vs-intra-batch classification cannot change any verdict.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence, Tuple
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import functools
 
@@ -142,6 +144,123 @@ def wire_pass1_sharded(window: int, blocks: List[bytes],
     return blob, offs, rp_cnt, wp_cnt
 
 
+class ArenaLease:
+    """Checkout handle for one chunk's mutable pack buffers. The arrays may
+    be read ZERO-COPY by an async-dispatched device program (see
+    _dispatch_unit's keepalive contract), so the buffers return to the
+    pool only when release() is called — columnar_dispatch's force() does
+    it after blocking on the program's outputs. An unreleased lease is
+    merely unpooled: the buffers fall to the GC, never to reuse-while-read."""
+
+    __slots__ = ("_arena", "_key", "_bufs")
+
+    def __init__(self, arena: "HostPackArena", key, bufs: Dict[str, np.ndarray]):
+        self._arena = arena
+        self._key = key
+        self._bufs = bufs
+
+    def release(self) -> None:
+        if self._bufs is not None:
+            self._arena._give_back(self._key, self._bufs)
+            self._bufs = None
+
+
+class HostPackArena:
+    """Reusable host-pack buffers: wire_chunk_arrays[_sharded] used to
+    allocate ~10 fresh padded numpy arrays per chunk (the rp/wp key planes
+    dominate — MBs per chunk at production shapes); the arena hands out
+    pooled buffer sets keyed by the bucket shape instead.
+
+    Reuse is bit-safe WITHOUT zeroing the big planes: every kernel input
+    row beyond a group's valid prefix is dead — invalid rows sort under an
+    all-ones key override, their hits are masked by the *_valid lanes, and
+    the segment reduces only cover valid prefixes — so stale content from
+    a previous chunk can never reach a verdict. Only the [T] t_ok /
+    t_too_old lanes (whole-array semantics) are cleared per checkout.
+
+    The range-row group is all-zero forever on the columnar path (points
+    only), so one immutable zero set per shape is SHARED by every chunk in
+    flight. Thread-safe: the pipeline packs on an executor thread while
+    the main thread dispatches."""
+
+    #: pooled buffer sets kept per shape (in-flight count is bounded by the
+    #: pipeline depth + chunks per plan; beyond this, release just drops)
+    MAX_POOLED = 8
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._pools: Dict[tuple, List[Dict[str, np.ndarray]]] = {}
+        self._shared: Dict[tuple, Dict[str, np.ndarray]] = {}
+        #: buffer sets created fresh because the pool was empty (the bench
+        #: reports steady-state == 0 alongside host_pack_ms)
+        self.misses = 0
+
+    @staticmethod
+    def _key(cfg: KernelConfig, S: int) -> tuple:
+        return (S, cfg.max_txns, cfg.rp, cfg.wp, cfg.max_reads,
+                cfg.max_writes, cfg.lanes)
+
+    def lease(self, cfg: KernelConfig, S: int = 1) -> Tuple[Dict[str, np.ndarray], ArenaLease]:
+        """Buffers for one chunk at `cfg`'s shapes ((S, ...) when sharded).
+        Returns (bufs, lease); bufs also exposes the shared zero range-row
+        arrays and cached aranges under the same dict."""
+        key = self._key(cfg, S)
+        with self._lock:
+            pool = self._pools.get(key)
+            bufs = pool.pop() if pool else None
+            shared = self._shared.get(key)
+            if shared is None:
+                shared = self._make_shared(cfg, S)
+                self._shared[key] = shared
+        if bufs is None:
+            self.misses += 1
+            bufs = self._make_bufs(cfg, S)
+        out = dict(shared)
+        out.update(bufs)
+        return out, ArenaLease(self, key, bufs)
+
+    def _give_back(self, key, bufs: Dict[str, np.ndarray]) -> None:
+        with self._lock:
+            pool = self._pools.setdefault(key, [])
+            if len(pool) < self.MAX_POOLED:
+                pool.append(bufs)
+
+    @staticmethod
+    def _make_bufs(cfg: KernelConfig, S: int) -> Dict[str, np.ndarray]:
+        K = cfg.lanes
+        sh = (lambda *s: s) if S == 1 else (lambda *s: (S,) + s)
+        return {
+            "rpb": np.zeros(sh(cfg.rp, K), np.uint32),
+            "rp_txn": np.zeros(sh(cfg.rp), np.int32),
+            "rp_snap": np.zeros(sh(cfg.rp), np.int32),
+            "rp_valid": np.zeros(sh(cfg.rp), bool),
+            "wpb": np.zeros(sh(cfg.wp, K), np.uint32),
+            "wp_txn": np.zeros(sh(cfg.wp), np.int32),
+            "wp_valid": np.zeros(sh(cfg.wp), bool),
+            "t_ok": np.zeros((cfg.max_txns,), bool),
+            "t_too_old": np.zeros((cfg.max_txns,), bool),
+        }
+
+    @staticmethod
+    def _make_shared(cfg: KernelConfig, S: int) -> Dict[str, np.ndarray]:
+        K = cfg.lanes
+        Rr, Wr = cfg.max_reads, cfg.max_writes
+        sh = (lambda *s: s) if S == 1 else (lambda *s: (S,) + s)
+        return {
+            "rb": np.zeros(sh(Rr, K), np.uint32),
+            "re": np.zeros(sh(Rr, K), np.uint32),
+            "r_snap": np.zeros(sh(Rr), np.int32),
+            "r_txn": np.zeros(sh(Rr), np.int32),
+            "r_valid": np.zeros(sh(Rr), bool),
+            "wb": np.zeros(sh(Wr, K), np.uint32),
+            "we": np.zeros(sh(Wr, K), np.uint32),
+            "w_txn": np.zeros(sh(Wr), np.int32),
+            "w_valid": np.zeros(sh(Wr), bool),
+            "_arange_rp": np.arange(cfg.rp),
+            "_arange_wp": np.arange(cfg.wp),
+        }
+
+
 def wire_chunk_arrays_sharded(
     cfg: KernelConfig,
     blob: bytes,
@@ -156,21 +275,27 @@ def wire_chunk_arrays_sharded(
     splits_blob: bytes,
     splits_offs: np.ndarray,
     S: int,
+    bufs: Optional[Dict[str, np.ndarray]] = None,
 ) -> List[Dict[str, np.ndarray]]:
     """Native pass 2, sharded: per-shard kernel batch dicts for txns
     [t0, t1) straight from wire bytes. One C call routes + packs every
     point row into its shard's padded region; the int lanes are vectorized
     numpy. Point keys route whole (a point range never straddles a shard
-    split), so no clipping happens here."""
+    split), so no clipping happens here. `bufs` (HostPackArena.lease)
+    supplies reusable buffers; rows beyond each valid prefix stay stale —
+    masked by the *_valid lanes (see HostPackArena)."""
     import ctypes
 
     lib = keypack._fastpack()
     K = cfg.lanes
     n = t1 - t0
-    rpb = np.zeros((S, cfg.rp, K), np.uint32)
-    rp_txn = np.zeros((S, cfg.rp), np.int32)
-    wpb = np.zeros((S, cfg.wp, K), np.uint32)
-    wp_txn = np.zeros((S, cfg.wp), np.int32)
+    if bufs is None:
+        bufs = dict(HostPackArena._make_shared(cfg, S))
+        bufs.update(HostPackArena._make_bufs(cfg, S))
+    rpb = bufs["rpb"]
+    rp_txn = bufs["rp_txn"]
+    wpb = bufs["wpb"]
+    wp_txn = bufs["wp_txn"]
     out_n = np.zeros((2 * S,), np.int64)
     lib.build_point_rows_sharded(
         blob,
@@ -187,35 +312,42 @@ def wire_chunk_arrays_sharded(
         wp_txn.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         out_n.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
     )
-    t_ok = np.zeros((cfg.max_txns,), bool)
-    t_too_old = np.zeros((cfg.max_txns,), bool)
+    t_ok = bufs["t_ok"]
+    t_too_old = bufs["t_too_old"]
+    t_ok.fill(False)
+    t_too_old.fill(False)
     t_too_old[:n] = skip[t0:t1] != 0
     t_ok[:n] = ~t_too_old[:n]
-    Rr, Wr = cfg.max_reads, cfg.max_writes
+    rp_valid = bufs["rp_valid"]
+    wp_valid = bufs["wp_valid"]
+    rp_snap = bufs["rp_snap"]
+    arange_rp = bufs["_arange_rp"]
+    arange_wp = bufs["_arange_wp"]
     now_a = np.asarray(now_rel, np.int32)
     gc_a = np.asarray(gc_rel, np.int32)
     per = []
     for s in range(S):
         n_rp, n_wp = int(out_n[2 * s]), int(out_n[2 * s + 1])
-        rp_snap = np.zeros((cfg.rp,), np.int32)
-        rp_snap[:n_rp] = np.repeat(snap_rel[t0:t1], eff_r[t0:t1, s])
+        rp_snap[s, :n_rp] = np.repeat(snap_rel[t0:t1], eff_r[t0:t1, s])
+        np.less(arange_rp, n_rp, out=rp_valid[s])
+        np.less(arange_wp, n_wp, out=wp_valid[s])
         per.append({
             "rpb": rpb[s],
-            "rp_snap": rp_snap,
+            "rp_snap": rp_snap[s],
             "rp_txn": rp_txn[s],
-            "rp_valid": np.arange(cfg.rp) < n_rp,
-            "rb": np.zeros((Rr, K), np.uint32),
-            "re": np.zeros((Rr, K), np.uint32),
-            "r_snap": np.zeros((Rr,), np.int32),
-            "r_txn": np.zeros((Rr,), np.int32),
-            "r_valid": np.zeros((Rr,), bool),
+            "rp_valid": rp_valid[s],
+            "rb": bufs["rb"][s],
+            "re": bufs["re"][s],
+            "r_snap": bufs["r_snap"][s],
+            "r_txn": bufs["r_txn"][s],
+            "r_valid": bufs["r_valid"][s],
             "wpb": wpb[s],
             "wp_txn": wp_txn[s],
-            "wp_valid": np.arange(cfg.wp) < n_wp,
-            "wb": np.zeros((Wr, K), np.uint32),
-            "we": np.zeros((Wr, K), np.uint32),
-            "w_txn": np.zeros((Wr,), np.int32),
-            "w_valid": np.zeros((Wr,), bool),
+            "wp_valid": wp_valid[s],
+            "wb": bufs["wb"][s],
+            "we": bufs["we"][s],
+            "w_txn": bufs["w_txn"][s],
+            "w_valid": bufs["w_valid"][s],
             "t_ok": t_ok,
             "t_too_old": t_too_old,
             "now": now_a,
@@ -235,20 +367,26 @@ def wire_chunk_arrays(
     eff_r: np.ndarray,         # int32 [ntx] read counts with skipped txns zeroed
     now_rel: int,
     gc_rel: int,
+    bufs: Optional[Dict[str, np.ndarray]] = None,
 ) -> Dict[str, np.ndarray]:
     """Native pass 2: kernel batch dict for txns [t0, t1) straight from wire
     bytes — the row groups are written into their padded arrays by C, the
     int lanes by vectorized numpy. The per-range Python of build_batch_arrays
-    never runs on this path."""
+    never runs on this path. `bufs` (HostPackArena.lease) supplies reusable
+    buffers; rows beyond each valid prefix stay stale — masked by the
+    *_valid lanes (see HostPackArena)."""
     import ctypes
 
     lib = keypack._fastpack()
     K = cfg.lanes
     n = t1 - t0
-    rpb = np.zeros((cfg.rp, K), np.uint32)
-    rp_txn = np.zeros((cfg.rp,), np.int32)
-    wpb = np.zeros((cfg.wp, K), np.uint32)
-    wp_txn = np.zeros((cfg.wp,), np.int32)
+    if bufs is None:
+        bufs = dict(HostPackArena._make_shared(cfg, 1))
+        bufs.update(HostPackArena._make_bufs(cfg, 1))
+    rpb = bufs["rpb"]
+    rp_txn = bufs["rp_txn"]
+    wpb = bufs["wpb"]
+    wp_txn = bufs["wp_txn"]
     out_n = np.zeros((2,), np.int64)
     lib.build_point_rows(
         blob,
@@ -262,30 +400,35 @@ def wire_chunk_arrays(
         out_n.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
     )
     n_rp, n_wp = int(out_n[0]), int(out_n[1])
-    rp_snap = np.zeros((cfg.rp,), np.int32)
+    rp_snap = bufs["rp_snap"]
     rp_snap[:n_rp] = np.repeat(snap_rel[t0:t1], eff_r[t0:t1])
-    t_ok = np.zeros((cfg.max_txns,), bool)
-    t_too_old = np.zeros((cfg.max_txns,), bool)
+    t_ok = bufs["t_ok"]
+    t_too_old = bufs["t_too_old"]
+    t_ok.fill(False)
+    t_too_old.fill(False)
     t_too_old[:n] = skip[t0:t1] != 0
     t_ok[:n] = ~t_too_old[:n]
-    Rr, Wr = cfg.max_reads, cfg.max_writes
+    rp_valid = bufs["rp_valid"]
+    wp_valid = bufs["wp_valid"]
+    np.less(bufs["_arange_rp"], n_rp, out=rp_valid)
+    np.less(bufs["_arange_wp"], n_wp, out=wp_valid)
     return {
         "rpb": rpb,
         "rp_snap": rp_snap,
         "rp_txn": rp_txn,
-        "rp_valid": np.arange(cfg.rp) < n_rp,
-        "rb": np.zeros((Rr, K), np.uint32),
-        "re": np.zeros((Rr, K), np.uint32),
-        "r_snap": np.zeros((Rr,), np.int32),
-        "r_txn": np.zeros((Rr,), np.int32),
-        "r_valid": np.zeros((Rr,), bool),
+        "rp_valid": rp_valid,
+        "rb": bufs["rb"],
+        "re": bufs["re"],
+        "r_snap": bufs["r_snap"],
+        "r_txn": bufs["r_txn"],
+        "r_valid": bufs["r_valid"],
         "wpb": wpb,
         "wp_txn": wp_txn,
-        "wp_valid": np.arange(cfg.wp) < n_wp,
-        "wb": np.zeros((Wr, K), np.uint32),
-        "we": np.zeros((Wr, K), np.uint32),
-        "w_txn": np.zeros((Wr,), np.int32),
-        "w_valid": np.zeros((Wr,), bool),
+        "wp_valid": wp_valid,
+        "wb": bufs["wb"],
+        "we": bufs["we"],
+        "w_txn": bufs["w_txn"],
+        "w_valid": bufs["w_valid"],
         "t_ok": t_ok,
         "t_too_old": t_too_old,
         "now": np.asarray(now_rel, np.int32),
@@ -293,14 +436,67 @@ def wire_chunk_arrays(
     }
 
 
+@dataclass
+class EnginePerf:
+    """Serving-path performance counters of a bucketed engine, read by
+    bench.py's `bucket_ladder` section and the compile regression guard."""
+
+    #: programs built (one per (bucket, chunk-count) shape ever dispatched);
+    #: after warmup() this must NOT grow in steady state
+    compiles: int = 0
+    #: chunks dispatched per bucket T
+    bucket_hits: Dict[int, int] = field(default_factory=dict)
+    #: dispatches per fused-scan length (1 = single-chunk program)
+    scan_dispatches: Dict[int, int] = field(default_factory=dict)
+    warmup_ms: float = 0.0
+    warmed: bool = False
+
+    def as_dict(self) -> dict:
+        return {
+            "compiles": self.compiles,
+            "bucket_hits": {str(k): v for k, v in sorted(self.bucket_hits.items())},
+            "scan_dispatches": {str(k): v
+                                for k, v in sorted(self.scan_dispatches.items())},
+            "warmup_ms": round(self.warmup_ms, 1),
+            "warmed": self.warmed,
+        }
+
+
+def ladder_from_knob() -> Optional[List[int]]:
+    """Parse the `resolver_bucket_ladder` knob ("512,1024,2048") into bucket
+    sizes; empty/unset means single-bucket (today's behavior). Entries are
+    NOT validated here: an engine keeps only the sizes below its own top
+    shape (the global knob serves engines of every size — a 128-txn test
+    engine under a "512,1024" production knob runs single-bucket), while a
+    size that fits but breaks the %32 layout fails loudly in bucket()."""
+    from ..core.knobs import SERVER_KNOBS
+
+    raw = str(getattr(SERVER_KNOBS, "resolver_bucket_ladder", "") or "").strip()
+    if not raw:
+        return None
+    return [int(tok) for tok in raw.replace(" ", "").split(",") if tok]
+
+
 class RoutedConflictEngineBase:
     """Host side of a device-backed ConflictSet engine. Subclasses implement
     `_run_step(per_shard_batches) -> (status[T] np.ndarray, overflow bool)`
-    and `_reset_device_state(version_rel)`."""
+    and `_reset_device_state(version_rel)`.
+
+    Bucketed kernel ladder: `ladder` lists sub-capacity batch sizes (each a
+    divisor-ish T < cfg.max_txns; cfg itself is always the top bucket).
+    Every bucket's program shares the one `capacity`-sized interval-table
+    state, so the host may dispatch any chunk on the smallest bucket whose
+    batch-side shapes fit — a light batch no longer pays the heavy batch's
+    device time. warmup() compiles the whole ladder eagerly so the serving
+    path never hits a JIT stall; consecutive same-bucket chunks fuse into
+    one lax.scan dispatch (`scan_sizes`)."""
 
     name = "routed"
 
-    def __init__(self, cfg: KernelConfig, shards: KeyShardMap):
+    def __init__(self, cfg: KernelConfig, shards: KeyShardMap,
+                 ladder: Optional[Sequence[int]] = None,
+                 scan_sizes: Sequence[int] = (2, 4, 8),
+                 arena: bool = True):
         # Subclasses seed their device state (incl. any initial version, as a
         # base-relative offset) via _reset_device_state.
         self.cfg = cfg
@@ -321,26 +517,108 @@ class RoutedConflictEngineBase:
             np.fromiter((len(s) for s in splits), np.int64, count=len(splits)),
             out=self._splits_offs[1:],
         )
+        # -- bucket ladder ------------------------------------------------
+        if ladder is None:
+            ladder = ladder_from_knob() or []
+        # only sizes below this engine's top shape apply (ladder_from_knob)
+        sizes = sorted({t for t in ladder if t < cfg.max_txns})
+        self.buckets: List[KernelConfig] = [cfg.bucket(t) for t in sizes] + [cfg]
+        self._scan_sizes = tuple(sorted({int(c) for c in scan_sizes if c > 1}))
+        #: (bucket_T, n_chunks) -> device program (engine-specific handle)
+        self._programs: Dict[Tuple[int, int], Any] = {}
+        self.perf = EnginePerf(
+            bucket_hits={b.max_txns: 0 for b in self.buckets})
+        self.arena: Optional[HostPackArena] = HostPackArena() if arena else None
+
+    # -- bucket ladder / program cache --------------------------------------
+    def bucket_for(self, n_txns: int, n_reads: int, n_writes: int) -> KernelConfig:
+        """Smallest bucket that fits a chunk's txn count and point-row
+        counts (per-shard maxima for S > 1); the top bucket always fits by
+        chunk construction."""
+        for b in self.buckets:
+            if n_txns <= b.max_txns and n_reads <= b.rp and n_writes <= b.wp:
+                return b
+        return self.buckets[-1]
+
+    def _program(self, bucket: KernelConfig, n_chunks: int):
+        key = (bucket.max_txns, n_chunks)
+        prog = self._programs.get(key)
+        if prog is None:
+            prog = self._make_program(bucket, n_chunks)
+            self._programs[key] = prog
+            self.perf.compiles += 1
+        return prog
+
+    def _make_program(self, bucket: KernelConfig, n_chunks: int):
+        """Build (and compile) the device program for `n_chunks` stacked
+        chunks at `bucket` shapes (1 = plain step, >1 = fused lax.scan)."""
+        raise NotImplementedError
+
+    def _warm_program(self, bucket: KernelConfig, n_chunks: int, prog) -> None:
+        """Post-build warm hook: AOT-compiled engines need nothing (the
+        build IS the compile); jit-based engines execute a no-op batch."""
+
+    def warmup(self, buckets: Optional[Sequence[KernelConfig]] = None,
+               scan_sizes: Optional[Sequence[int]] = None) -> "RoutedConflictEngineBase":
+        """Eagerly compile every (bucket, scan-size) program the serving
+        path can dispatch, so steady state never hits a compile stall.
+        Idempotent; returns self for chaining."""
+        t0 = time.perf_counter()
+        for b in (buckets if buckets is not None else self.buckets):
+            for c in (1,) + tuple(scan_sizes if scan_sizes is not None
+                                  else self._scan_sizes):
+                self._warm_program(b, c, self._program(b, c))
+        self.perf.warmup_ms += (time.perf_counter() - t0) * 1e3
+        self.perf.warmed = True
+        return self
+
+    def ensure_warm(self, used_only: bool = True) -> None:
+        """(Re-)warm program coverage — after a fault-path rebuild, only
+        the buckets actually serving traffic (fault/resilient.py re-warm);
+        a stream that used no bucket yet warms nothing (its first dispatch
+        compiles lazily, and the next rebuild sees the hit counts)."""
+        if not used_only:
+            self.warmup()
+            return
+        used = [b for b in self.buckets
+                if self.perf.bucket_hits.get(b.max_txns, 0) > 0]
+        if used:
+            self.warmup(buckets=used)
+
+    def _split_run(self, n: int) -> List[int]:
+        """Decompose a run of n same-bucket chunks into dispatchable scan
+        lengths (largest precompiled size first, singles as remainder)."""
+        out: List[int] = []
+        for c in sorted(self._scan_sizes, reverse=True):
+            while n >= c:
+                out.append(c)
+                n -= c
+        out.extend([1] * n)
+        return out
 
     # -- subclass interface -------------------------------------------------
     def _run_step(self, per_shard: List[Dict[str, np.ndarray]]) -> Tuple[np.ndarray, bool]:
         """Fused detect+fix+apply (the fast path; no host tier involved)."""
         raise NotImplementedError
 
-    def _run_step_async(self, per_shard: List[Dict[str, np.ndarray]]):
-        """Fused step, dispatch-only: returns (status, overflow, keepalive)
-        WITHOUT forcing device values to the host. The default runs the
-        synchronous step (already-forced numpy arrays force trivially);
-        device engines override to return unmaterialized device arrays so
-        the host is free to pack the next batch while this one runs.
+    def _dispatch_unit(self, bucket: KernelConfig,
+                       per_chunks: List[List[Dict[str, np.ndarray]]]):
+        """Dispatch C = len(per_chunks) same-bucket chunks as ONE device
+        program (C > 1: the fused lax.scan) via JAX ASYNC dispatch —
+        nothing is forced to the host. Returns force() -> (status [C, T]
+        np.ndarray, overflow bool), which blocks on the device values.
 
-        `keepalive` is whatever host memory the dispatched program may
-        still be reading — CPU-backend jax aliases well-aligned numpy
-        inputs ZERO-COPY, so the batch arrays handed to the jit must stay
-        referenced until the program's outputs are forced, or the async
-        program races a freed buffer (flaky verdicts / segfaults)."""
-        status, overflow = self._run_step(per_shard)
-        return status, np.asarray(overflow), None
+        The dispatched program may still be reading the chunks' host
+        arrays — CPU-backend jax aliases well-aligned numpy inputs
+        ZERO-COPY — so implementations must keep whatever the program
+        reads referenced until force() ran (closure capture), and callers
+        must not recycle arena buffers earlier (columnar_dispatch releases
+        leases inside force()). The default runs the synchronous per-chunk
+        step (no overlap) — device engines override."""
+        results = [self._run_step(per) for per in per_chunks]
+        status = np.stack([np.asarray(s) for s, _ in results])
+        overflow = any(bool(o) for _, o in results)
+        return lambda: (status, overflow)
 
     def _run_detect(self, per_shard: List[Dict[str, np.ndarray]]):
         """Phases 1-2; returns an opaque device context for _run_fix/_run_apply."""
@@ -595,7 +873,8 @@ class RoutedConflictEngineBase:
         cw = np.cumsum(eff_w, axis=0)
 
         now_rel = self._rel(now)
-        chunks: List[Tuple[List[Dict[str, np.ndarray]], int]] = []
+        #: (per_shard_arrays, n_txns, bucket_cfg, arena_lease) per chunk
+        chunks: List[Tuple[List[Dict[str, np.ndarray]], int, KernelConfig, Optional[ArenaLease]]] = []
         i = 0
         while i < ntx:
             r0 = cr[i - 1] if i else np.zeros_like(cr[0])
@@ -621,41 +900,70 @@ class RoutedConflictEngineBase:
                 if last and new_oldest > self.oldest_version
                 else 0
             )
+            # Smallest ladder bucket the chunk fits (per-shard row maxima).
+            if S > 1:
+                nr = int((cr[j - 1] - r0).max())
+                nw = int((cw[j - 1] - w0).max())
+            else:
+                nr = int(cr[j - 1] - r0)
+                nw = int(cw[j - 1] - w0)
+            bucket = self.bucket_for(j - i, nr, nw)
+            bufs = lease = None
+            if self.arena is not None:
+                bufs, lease = self.arena.lease(bucket, 1 if S == 1 else S)
             if S == 1:
                 per = [wire_chunk_arrays(
-                    cfg, blob, offs, i, j, skip, snap_rel, eff_r, now_rel, gc_rel,
+                    bucket, blob, offs, i, j, skip, snap_rel, eff_r, now_rel,
+                    gc_rel, bufs=bufs,
                 )]
             else:
                 per = wire_chunk_arrays_sharded(
-                    cfg, blob, offs, i, j, skip, snap_rel, eff_r, now_rel,
-                    gc_rel, self._splits_blob, self._splits_offs, S,
+                    bucket, blob, offs, i, j, skip, snap_rel, eff_r, now_rel,
+                    gc_rel, self._splits_blob, self._splits_offs, S, bufs=bufs,
                 )
-            chunks.append((per, j - i))
+            chunks.append((per, j - i, bucket, lease))
             i = j
-        return {"chunks": chunks, "new_oldest": new_oldest}
+        return {"chunks": chunks, "new_oldest": new_oldest,
+                "chunk_buckets": [c[2].max_txns for c in chunks]}
 
     def columnar_dispatch(self, plan: dict):
-        """Device half of the columnar fast path: dispatch every chunk's
-        program via JAX ASYNC dispatch (nothing is forced to the host) and
-        advance the host version bookkeeping. Returns force() ->
-        List[TransactionCommitResult], which blocks on the device values.
+        """Device half of the columnar fast path: group consecutive
+        same-bucket chunks into fused lax.scan dispatch units (one device
+        program threading the interval-table state across chunks instead of
+        one program per chunk), dispatch every unit via JAX ASYNC dispatch
+        (nothing is forced to the host) and advance the host version
+        bookkeeping. Returns force() -> List[TransactionCommitResult],
+        which blocks on the device values.
 
         The ResolverPipeline keeps several dispatched batches in flight —
         the host packs batch i+1 while the device still runs batch i — and
         forces them in commit-version order, so abort sets are bit-identical
-        to the serial resolve() path (the device programs run in dispatch
-        order on one device queue either way). One observable difference:
-        a boundary-table overflow raises at force() time, after any later
-        chunks of the SAME batch were already dispatched (the serial path
-        stops at the overflowing chunk); overflow is a fatal capacity error
-        in both cases."""
-        outs = []
-        for per, n in plan["chunks"]:
-            status_dev, overflow_dev, keepalive = self._run_step_async(per)
-            # keepalive pins the host arrays the async program may be
-            # reading zero-copy; it rides in `outs` until force() has
-            # blocked on the program's outputs (see _run_step_async).
-            outs.append((status_dev, overflow_dev, n, keepalive))
+        to the serial resolve() path (scan order == the per-chunk dispatch
+        order on the one device queue either way). One observable
+        difference: a boundary-table overflow raises at force() time, after
+        any later chunks of the SAME batch were already dispatched (the
+        serial path stops at the overflowing chunk); overflow is a fatal
+        capacity error in both cases."""
+        chunks = plan["chunks"]
+        #: (unit_force, [n_txns per chunk], [leases per chunk])
+        outs: List[Tuple[Callable, List[int], List[Optional[ArenaLease]]]] = []
+        i = 0
+        while i < len(chunks):
+            bucket = chunks[i][2]
+            j = i
+            while j < len(chunks) and chunks[j][2] is bucket:
+                j += 1
+            run = chunks[i:j]
+            self.perf.bucket_hits[bucket.max_txns] = (
+                self.perf.bucket_hits.get(bucket.max_txns, 0) + len(run))
+            for c in self._split_run(len(run)):
+                sub, run = run[:c], run[c:]
+                unit = self._dispatch_unit(bucket, [ch[0] for ch in sub])
+                self.perf.scan_dispatches[c] = (
+                    self.perf.scan_dispatches.get(c, 0) + 1)
+                outs.append((unit, [ch[1] for ch in sub],
+                             [ch[3] for ch in sub]))
+            i = j
         new_oldest = plan["new_oldest"]
         if new_oldest > self.oldest_version:
             self.tier_map.gc(new_oldest)
@@ -665,13 +973,20 @@ class RoutedConflictEngineBase:
 
         def force() -> List[TransactionCommitResult]:
             results: List[TransactionCommitResult] = []
-            for status_dev, overflow_dev, n, _keepalive in outs:
-                status = np.asarray(status_dev)
-                if bool(np.asarray(overflow_dev)):
+            for unit, ns, leases in outs:
+                status, overflow = unit()
+                if overflow:
                     raise error.conflict_capacity_exceeded(
                         f"a shard's boundary table needs > {capacity} rows"
                     )
-                results.extend(TransactionCommitResult(int(v)) for v in status[:n])
+                for c, n in enumerate(ns):
+                    results.extend(
+                        TransactionCommitResult(int(v)) for v in status[c, :n])
+                # the unit's outputs are forced: its programs can no longer
+                # be reading the chunks' host buffers — recycle them
+                for lease in leases:
+                    if lease is not None:
+                        lease.release()
             return results
 
         return force
@@ -869,14 +1184,14 @@ class SubshardedConflictEngine(RoutedConflictEngineBase):
     name = "subsharded"
 
     def __init__(self, cfg: KernelConfig, shards: KeyShardMap,
-                 initial_version: Version = 0):
-        super().__init__(cfg, shards)
+                 initial_version: Version = 0,
+                 ladder: Optional[Sequence[int]] = None,
+                 scan_sizes: Sequence[int] = (2, 4, 8),
+                 arena: bool = True):
+        super().__init__(cfg, shards, ladder=ladder, scan_sizes=scan_sizes,
+                         arena=arena)
         self._reset_device_state(initial_version)
         self.tier_map = VersionIntervalMap(initial_version)
-        self._step = jax.jit(
-            functools.partial(ck.resolve_step_stacked, cfg),
-            **donate_state_kwargs(),
-        )
         self._detect = jax.jit(functools.partial(ck.detect_step_stacked, cfg))
         self._fix = jax.jit(functools.partial(ck.fix_step_stacked, cfg))
         self._apply = jax.jit(
@@ -895,15 +1210,43 @@ class SubshardedConflictEngine(RoutedConflictEngineBase):
             lambda *xs: jnp.asarray(np.stack([np.asarray(x) for x in xs])),
             *per_shard)
 
-    def _run_step(self, per_shard: List[Dict[str, np.ndarray]]) -> Tuple[np.ndarray, bool]:
-        batch = self._stack(per_shard)
-        self.state, out = self._step(self.state, batch)
-        return np.asarray(out["status"]), bool(out["overflow"])
+    def _make_program(self, bucket: KernelConfig, n_chunks: int):
+        S = self.n_shards
+        st = ck.state_struct(self.cfg, stack=(S,))
+        if n_chunks == 1:
+            fn = functools.partial(ck.resolve_step_stacked, bucket)
+            bt = ck.batch_struct(bucket, stack=(S,))
+        else:
+            fn = functools.partial(ck.resolve_step_stacked_scan, bucket)
+            bt = ck.batch_struct(bucket, stack=(n_chunks, S))
+        return jax.jit(fn, **donate_state_kwargs()).lower(st, bt).compile()
 
-    def _run_step_async(self, per_shard: List[Dict[str, np.ndarray]]):
-        batch = self._stack(per_shard)
-        self.state, out = self._step(self.state, batch)
-        return out["status"], out["overflow"], batch
+    def _dispatch_unit(self, bucket: KernelConfig,
+                       per_chunks: List[List[Dict[str, np.ndarray]]]):
+        C = len(per_chunks)
+        prog = self._program(bucket, C)
+        if C == 1:
+            batch = {k: np.stack([np.asarray(sh[k]) for sh in per_chunks[0]])
+                     for k in per_chunks[0][0]}
+        else:
+            batch = {k: np.stack([np.stack([np.asarray(sh[k]) for sh in pc])
+                                  for pc in per_chunks])
+                     for k in per_chunks[0][0]}
+        self.state, out = prog(self.state, batch)
+        status_dev, overflow_dev = out["status"], out["overflow"]
+        keep = batch   # zero-copy keepalive (see _dispatch_unit contract)
+
+        def force() -> Tuple[np.ndarray, bool]:
+            status = np.asarray(status_dev)
+            overflow = bool(np.any(np.asarray(overflow_dev)))
+            _ = keep   # pinned until the outputs above were forced
+            return (status[None] if C == 1 else status), overflow
+
+        return force
+
+    def _run_step(self, per_shard: List[Dict[str, np.ndarray]]) -> Tuple[np.ndarray, bool]:
+        status, overflow = self._dispatch_unit(self.cfg, [per_shard])()
+        return status[0], overflow
 
     def _run_detect(self, per_shard):
         batch = self._stack(per_shard)
@@ -929,14 +1272,14 @@ class JaxConflictEngine(RoutedConflictEngineBase):
 
     name = "jax"
 
-    def __init__(self, cfg: KernelConfig = KernelConfig(), initial_version: Version = 0):
-        super().__init__(cfg, KeyShardMap([]))
+    def __init__(self, cfg: KernelConfig = KernelConfig(), initial_version: Version = 0,
+                 ladder: Optional[Sequence[int]] = None,
+                 scan_sizes: Sequence[int] = (2, 4, 8),
+                 arena: bool = True):
+        super().__init__(cfg, KeyShardMap([]), ladder=ladder,
+                         scan_sizes=scan_sizes, arena=arena)
         self.state = ck.initial_state(cfg, version_rel=initial_version)
         self.tier_map = VersionIntervalMap(initial_version)
-        self._step = jax.jit(
-            functools.partial(ck.resolve_step, cfg),
-            **donate_state_kwargs(),
-        )
         # Split-step programs for the long-key tier path, compiled lazily
         # (short-key-only workloads never pay for them).
         self._detect = jax.jit(functools.partial(ck.detect_step, cfg))
@@ -946,17 +1289,43 @@ class JaxConflictEngine(RoutedConflictEngineBase):
     def _reset_device_state(self, version_rel: int) -> None:
         self.state = ck.initial_state(self.cfg, version_rel=version_rel)
 
-    def _run_step(self, per_shard: List[Dict[str, np.ndarray]]) -> Tuple[np.ndarray, bool]:
-        (arrays,) = per_shard
-        batch = {k: jnp.asarray(v) for k, v in arrays.items()}
-        self.state, out = self._step(self.state, batch)
-        return np.asarray(out["status"]), bool(out["overflow"])
+    def _make_program(self, bucket: KernelConfig, n_chunks: int):
+        st = ck.state_struct(bucket)
+        if n_chunks == 1:
+            fn = functools.partial(ck.resolve_step, bucket)
+            bt = ck.batch_struct(bucket)
+        else:
+            fn = functools.partial(ck.resolve_step_scan, bucket)
+            bt = ck.batch_struct(bucket, stack=(n_chunks,))
+        # AOT: .lower().compile() eagerly; the stored executable can never
+        # re-trace or re-compile, so a warmed ladder is compile-stall-proof
+        # by construction.
+        return jax.jit(fn, **donate_state_kwargs()).lower(st, bt).compile()
 
-    def _run_step_async(self, per_shard: List[Dict[str, np.ndarray]]):
-        (arrays,) = per_shard
-        batch = {k: jnp.asarray(v) for k, v in arrays.items()}
-        self.state, out = self._step(self.state, batch)
-        return out["status"], out["overflow"], (arrays, batch)
+    def _dispatch_unit(self, bucket: KernelConfig,
+                       per_chunks: List[List[Dict[str, np.ndarray]]]):
+        C = len(per_chunks)
+        prog = self._program(bucket, C)
+        if C == 1:
+            (batch,) = per_chunks[0]
+        else:
+            batch = {k: np.stack([pc[0][k] for pc in per_chunks])
+                     for k in per_chunks[0][0]}
+        self.state, out = prog(self.state, batch)
+        status_dev, overflow_dev = out["status"], out["overflow"]
+        keep = batch   # zero-copy keepalive (see _dispatch_unit contract)
+
+        def force() -> Tuple[np.ndarray, bool]:
+            status = np.asarray(status_dev)
+            overflow = bool(np.any(np.asarray(overflow_dev)))
+            _ = keep   # pinned until the outputs above were forced
+            return (status[None] if C == 1 else status), overflow
+
+        return force
+
+    def _run_step(self, per_shard: List[Dict[str, np.ndarray]]) -> Tuple[np.ndarray, bool]:
+        status, overflow = self._dispatch_unit(self.cfg, [per_shard])()
+        return status[0], overflow
 
     def _run_detect(self, per_shard):
         (arrays,) = per_shard

@@ -14,10 +14,11 @@ Three pieces (see docs/pipeline.md):
     client-observed commit-latency percentiles + sustained throughput for
     bench.py's `latency_under_load` section.
 """
-from .resolver_pipeline import PendingResolve, ResolverPipeline
+from .resolver_pipeline import BudgetBatcher, PendingResolve, ResolverPipeline
 from .service import PipelineConfig, PipelinedResolverService
 
 __all__ = [
+    "BudgetBatcher",
     "PendingResolve",
     "ResolverPipeline",
     "PipelineConfig",

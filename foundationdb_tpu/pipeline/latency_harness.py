@@ -26,9 +26,20 @@ matches what the device path would produce.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
+from typing import Dict, Optional
 
 import math
+
+
+def p99_budget_ms() -> float:
+    """The client-observed p99 commit budget (the resolver-inclusive share
+    of the reference's < 3 ms end-to-end commit target). Was a hard-coded
+    2.5 in bench.py; now the `resolver_p99_budget_ms` knob, shared with
+    the BudgetBatcher's adaptive batch sizing (docs/perf.md) so the bench
+    filter and the serving-path batcher can never disagree."""
+    from ..core.knobs import SERVER_KNOBS
+
+    return float(SERVER_KNOBS.resolver_p99_budget_ms)
 
 
 @dataclass
@@ -87,6 +98,8 @@ def run_latency_under_load(
     sim_timeout_s: float = 120.0,
     proxy_window: Optional[int] = None,
     batch_interval_ms: Optional[float] = None,
+    device_ms_by_bucket: Optional[Dict[int, float]] = None,
+    budget_ms: Optional[float] = None,
 ) -> HarnessResult:
     """One harness point: an e2e sim cluster whose resolver runs the
     pipelined service at `depth` with the given measured service times,
@@ -143,6 +156,12 @@ def run_latency_under_load(
             pack_ms_per_txn=pack_ms_per_txn,
             device_ms_per_batch=device_ms,
             max_batch_txns=batch_txns,
+            # bucket ladder (docs/perf.md): a batch pays its own bucket's
+            # measured device time, and the service's BudgetBatcher reports
+            # the adaptive target that — via ratekeeper — caps the proxy's
+            # commit batches to the largest in-budget bucket
+            device_ms_by_bucket=device_ms_by_bucket,
+            p99_budget_ms=budget_ms,
         ),
         max_commit_batch=batch_txns,
         # One slot beyond the service depth: `depth` batches in service at

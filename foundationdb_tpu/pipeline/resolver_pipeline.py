@@ -26,8 +26,9 @@ batch — the pipeline still preserves ordering, it just cannot overlap.
 """
 from __future__ import annotations
 
+import time
 from collections import deque
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 from ..core.types import CommitTransaction, TransactionCommitResult, Version
 
@@ -35,12 +36,91 @@ from ..core.types import CommitTransaction, TransactionCommitResult, Version
 _PACKING, _DISPATCHED, _DONE = 0, 1, 2
 
 
+class BudgetBatcher:
+    """Budget-driven batch sizing over a bucketed kernel ladder.
+
+    Replaces the static production-point choice (a batch size picked once
+    from an offline latency curve) with an adaptive target: an EWMA of the
+    OBSERVED per-bucket service latency predicts what a client would see
+    with `depth` batches in flight — pack(T) + depth * device(T) — and the
+    batcher targets the largest ladder bucket whose prediction fits the
+    `resolver_p99_budget_ms` knob. Under the fault path's depth collapse
+    (pipeline/service.py: a degraded engine serves at depth 1 through
+    watchdog retries or the CPU failover oracle) the EWMA balloons and the
+    target degrades toward the smallest bucket; a degraded engine is
+    additionally clamped there outright.
+
+    Shared by the wall-clock ResolverPipeline (observing force() wall
+    times) and the sim PipelinedResolverService (observing virtual-time
+    service delays); seed_ms pre-loads bench-measured device times so the
+    first batches are not sized blind."""
+
+    def __init__(self, ladder: Sequence[int], budget_ms: Optional[float] = None,
+                 pack_ms_per_txn: float = 0.0, alpha: Optional[float] = None,
+                 seed_ms: Optional[Dict[int, float]] = None):
+        from ..core.knobs import SERVER_KNOBS
+
+        self.ladder = sorted(set(int(t) for t in ladder))
+        if not self.ladder:
+            raise ValueError("BudgetBatcher needs a non-empty bucket ladder")
+        self.budget_ms = (float(SERVER_KNOBS.resolver_p99_budget_ms)
+                          if budget_ms is None else float(budget_ms))
+        self.pack_ms_per_txn = pack_ms_per_txn
+        self.alpha = (float(SERVER_KNOBS.resolver_latency_ewma_alpha)
+                      if alpha is None else float(alpha))
+        self.ewma_ms: Dict[int, float] = dict(seed_ms or {})
+
+    def bucket_of(self, n_txns: int) -> int:
+        """Smallest ladder bucket holding an n_txns batch (top if none)."""
+        for t in self.ladder:
+            if n_txns <= t:
+                return t
+        return self.ladder[-1]
+
+    def observe(self, bucket: int, service_ms: float) -> None:
+        cur = self.ewma_ms.get(bucket)
+        self.ewma_ms[bucket] = (service_ms if cur is None
+                                else cur + self.alpha * (service_ms - cur))
+
+    def predicted_ms(self, bucket: int, depth: int) -> Optional[float]:
+        """Client-visible latency estimate at `depth` in flight: own pack +
+        up to `depth` device services ahead of the verdict (the in-order
+        device chain). None until the bucket has an observation."""
+        dev = self.ewma_ms.get(bucket)
+        if dev is None:
+            return None
+        return self.pack_ms_per_txn * bucket + max(1, depth) * dev
+
+    def target_batch_txns(self, depth: int, degraded: bool = False) -> int:
+        """The adaptive production point: largest bucket predicted to fit
+        the budget. Unobserved buckets don't qualify (never size batches on
+        guesses); if nothing fits — or the engine is degraded — the
+        smallest bucket wins (minimum service quantum, fastest drain)."""
+        if degraded:
+            return self.ladder[0]
+        best = None
+        for t in self.ladder:
+            p = self.predicted_ms(t, depth)
+            if p is not None and p <= self.budget_ms:
+                best = t
+        return best if best is not None else self.ladder[0]
+
+    def as_dict(self) -> dict:
+        return {
+            "ladder": list(self.ladder),
+            "budget_ms": self.budget_ms,
+            "pack_ms_per_txn": round(self.pack_ms_per_txn, 6),
+            "ewma_ms": {str(t): round(v, 4)
+                        for t, v in sorted(self.ewma_ms.items())},
+        }
+
+
 class PendingResolve:
     """Handle for one submitted batch; result() forces it (and every
     earlier in-flight batch first — commit-version order)."""
 
     __slots__ = ("pipeline", "version", "n_txns", "_state", "_pack",
-                 "_force", "_result", "_error", "_txns")
+                 "_force", "_result", "_error", "_txns", "_buckets")
 
     def __init__(self, pipeline: "ResolverPipeline", version: Version, n_txns: int):
         self.pipeline = pipeline
@@ -52,6 +132,7 @@ class PendingResolve:
         self._result: Optional[List[TransactionCommitResult]] = None
         self._error: Optional[BaseException] = None
         self._txns = None
+        self._buckets = None       # plan chunk buckets (BudgetBatcher feed)
 
     @property
     def is_done(self) -> bool:
@@ -92,7 +173,8 @@ class ResolverPipeline:
                  returns from submit() and the device runs batch i.
     """
 
-    def __init__(self, engine, depth: int = 2, executor=None):
+    def __init__(self, engine, depth: int = 2, executor=None,
+                 batcher: Optional[BudgetBatcher] = None):
         assert depth >= 1
         self.engine = engine
         self.depth = depth
@@ -101,6 +183,16 @@ class ResolverPipeline:
         #: popped from the left as the window advances
         self._queue: deque = deque()
         self._can_overlap = hasattr(engine, "columnar_pack")
+        #: budget-driven batch sizing: when set, force() wall times feed the
+        #: per-bucket EWMA and suggested_batch_txns() tracks the largest
+        #: in-budget bucket (callers size their submissions to it)
+        self.batcher = batcher
+
+    def suggested_batch_txns(self) -> Optional[int]:
+        if self.batcher is None:
+            return None
+        return self.batcher.target_batch_txns(
+            self.depth, degraded=getattr(self.engine, "degraded", False))
 
     @property
     def in_flight(self) -> int:
@@ -174,16 +266,29 @@ class ResolverPipeline:
             pb._state = _DONE
             return
         pb._force = self.engine.columnar_dispatch(plan)
+        pb._buckets = plan.get("chunk_buckets")
         pb._state = _DISPATCHED
 
     def _force(self, pb: PendingResolve) -> None:
         if pb._state == _PACKING:
             self._dispatch(pb)
         if pb._state == _DISPATCHED:
+            t0 = time.perf_counter() if self.batcher is not None else 0.0
             try:
                 pb._result = pb._force()
             except BaseException as e:
                 pb._error = e
+            else:
+                if self.batcher is not None and pb._buckets:
+                    # observed service time split across the batch's chunks
+                    # pro-rata by bucket size (device time scales with T):
+                    # a flat mean would charge a small-bucket tail chunk a
+                    # big chunk's cost and vice versa, skewing the EWMA the
+                    # budget target is computed from
+                    wall = (time.perf_counter() - t0) * 1e3
+                    total = sum(pb._buckets)
+                    for t in pb._buckets:
+                        self.batcher.observe(t, wall * t / total)
             pb._force = None
             pb._state = _DONE
 

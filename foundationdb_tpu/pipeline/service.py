@@ -26,15 +26,18 @@ from __future__ import annotations
 
 from collections import deque
 from dataclasses import dataclass
+from typing import Dict, Optional
 
 from ..core import buggify
 from ..sim.actors import NotifiedVersion
 from ..sim.loop import Promise, TaskPriority, delay
+from .resolver_pipeline import BudgetBatcher
 
 
 @dataclass
 class PipelineConfig:
-    """Knobs of the pipelined resolver service (docs/pipeline.md).
+    """Knobs of the pipelined resolver service (docs/pipeline.md,
+    docs/perf.md).
 
     depth               — in-flight window: 1 = serial, 2 = double
                           buffering (pack overlaps device), 3 = triple.
@@ -42,21 +45,35 @@ class PipelineConfig:
                           (bench.py: host_pack_ms_per_batch / batch_txns).
     device_ms_per_batch — device program time for the compiled batch shape
                           (constant per dispatch; bench.py measure_scan).
-    max_batch_txns      — the compiled kernel's T: proxies must not send
-                          larger batches (server/proxy.py max_commit_batch
-                          is sized to it).
+    max_batch_txns      — the compiled kernel's top-bucket T: proxies must
+                          not send larger batches (server/proxy.py
+                          max_commit_batch is sized to it).
+    device_ms_by_bucket — bucketed kernel ladder: measured device ms per
+                          compiled bucket shape {T: ms} (bench.py
+                          bucket_ladder section). When set, a batch pays
+                          its own bucket's device time — not the top
+                          shape's — and the service's BudgetBatcher
+                          adaptively targets the largest bucket whose
+                          predicted latency fits p99_budget_ms.
+    p99_budget_ms       — commit-latency budget the adaptive target fits
+                          (None = the resolver_p99_budget_ms knob).
     """
 
     depth: int = 2
     pack_ms_per_txn: float = 0.0
     device_ms_per_batch: float = 0.0
     max_batch_txns: int = 4096
+    device_ms_by_bucket: Optional[Dict[int, float]] = None
+    p99_budget_ms: Optional[float] = None
 
     def as_dict(self) -> dict:
         return {"depth": self.depth,
                 "pack_ms_per_txn": self.pack_ms_per_txn,
                 "device_ms_per_batch": self.device_ms_per_batch,
-                "max_batch_txns": self.max_batch_txns}
+                "max_batch_txns": self.max_batch_txns,
+                "device_ms_by_bucket": (dict(self.device_ms_by_bucket)
+                                        if self.device_ms_by_bucket else None),
+                "p99_budget_ms": self.p99_budget_ms}
 
 
 class PipelinedResolverService:
@@ -70,10 +87,42 @@ class PipelinedResolverService:
         self._seq = 0
         #: sequence number of the newest batch whose device stage finished
         self._device_done = NotifiedVersion(0)
+        #: budget-driven batch sizing over the bucket ladder (None without
+        #: a per-bucket device-time table): virtual-time service delays
+        #: feed the EWMA; target_batch_txns() is the adaptive production
+        #: point the proxy's commit batcher is capped to (via ratekeeper)
+        self.batcher: Optional[BudgetBatcher] = None
+        if cfg.device_ms_by_bucket:
+            self.batcher = BudgetBatcher(
+                ladder=list(cfg.device_ms_by_bucket),
+                budget_ms=cfg.p99_budget_ms,
+                pack_ms_per_txn=cfg.pack_ms_per_txn,
+                seed_ms={int(t): float(v)
+                         for t, v in cfg.device_ms_by_bucket.items()},
+            )
 
     @property
     def in_flight(self) -> int:
         return self._in_use
+
+    def target_batch_txns(self) -> int:
+        """Adaptive batch-size target (falls back to the static top shape
+        without a ladder). Degradation (fault/resilient.py) clamps to the
+        smallest bucket on top of the depth-1 window collapse."""
+        if self.batcher is None:
+            return self.cfg.max_batch_txns
+        return self.batcher.target_batch_txns(
+            self.cfg.depth, degraded=getattr(self.engine, "degraded", False))
+
+    def _device_ms(self, n_txns: int) -> float:
+        """Injected device time for one batch: its own bucket's measured
+        program time under a ladder (a light batch no longer pays the top
+        shape's device time), else the flat per-batch figure."""
+        if self.batcher is None:
+            return self.cfg.device_ms_per_batch
+        bucket = self.batcher.bucket_of(n_txns)
+        ms = (self.cfg.device_ms_by_bucket or {}).get(bucket)
+        return self.cfg.device_ms_per_batch if ms is None else ms
 
     def _capacity(self) -> int:
         """Effective window: a degraded engine (fault/resilient.py —
@@ -126,14 +175,24 @@ class PipelinedResolverService:
             if pack_ms > 0:
                 await delay(pack_ms / 1e3, TaskPriority.PROXY_RESOLVER_REPLY)
             await self._device_done.when_at_least(seq - 1)
+            from ..sim.loop import now as _now
+
+            t_dev = _now()
             verdicts = self.engine.resolve(transactions, version, new_oldest)
             if hasattr(verdicts, "__await__"):
                 # supervised engine (fault/resilient.py): the dispatch may
                 # retry/fail over under its watchdog before verdicts land
                 verdicts = await verdicts
-            if self.cfg.device_ms_per_batch > 0:
-                await delay(self.cfg.device_ms_per_batch / 1e3,
-                            TaskPriority.PROXY_RESOLVER_REPLY)
+            device_ms = self._device_ms(len(transactions))
+            if device_ms > 0:
+                await delay(device_ms / 1e3, TaskPriority.PROXY_RESOLVER_REPLY)
+            if self.batcher is not None:
+                # observed device-stage time: injected program time plus any
+                # real engine/supervisor stalls (watchdog retries, failover)
+                # — exactly what balloons the EWMA and degrades the target
+                self.batcher.observe(
+                    self.batcher.bucket_of(len(transactions)),
+                    (_now() - t_dev) * 1e3)
             return verdicts
         finally:
             # On any exit (including cancellation mid-wait) unblock the

@@ -254,6 +254,10 @@ class Proxy:
         #: ratekeeper admission (transactionStarter:947): GRVs are released
         #: from a budget replenished at tps_limit per second
         self._tps_limit: float = float("inf")
+        #: adaptive commit-batch cap relayed from the resolvers' budget
+        #: batchers through the ratekeeper (GetRateInfoReply); None =
+        #: static cfg.max_commit_batch sizing only
+        self._commit_batch_target: Optional[int] = None
         self._grv_budget: float = 0.0
         self._grv_budget_t: float = 0.0
         self._dead = False
@@ -287,6 +291,8 @@ class Proxy:
                     TaskPriority.RATEKEEPER, timeout=1.0,
                 )
                 self._tps_limit = reply.tps_limit
+                self._commit_batch_target = getattr(
+                    reply, "commit_batch_target", None)
             except error.FDBError:
                 pass
             await delay(SERVER_KNOBS.ratekeeper_update_interval, TaskPriority.RATEKEEPER)
@@ -474,6 +480,11 @@ class Proxy:
                              TaskPriority.PROXY_COMMIT_BATCHER)
             cap = min(self.cfg.max_commit_batch or MAX_COMMIT_BATCH,
                       SERVER_KNOBS.commit_transaction_batch_count_max)
+            if self._commit_batch_target is not None:
+                # budget-driven sizing (pipeline/resolver_pipeline.py
+                # BudgetBatcher via ratekeeper): batches beyond the largest
+                # in-budget resolver bucket would blow the p99 commit budget
+                cap = max(1, min(cap, self._commit_batch_target))
             if buggify.buggify():
                 cap = 1  # force single-transaction batches: deep pipelines
             while len(batch) < cap:

@@ -63,6 +63,11 @@ class GetRateInfoRequest:
 @dataclass
 class GetRateInfoReply:
     tps_limit: float
+    #: adaptive commit-batch cap from the resolvers' budget batchers
+    #: (min across resolvers; None = no resolver reported a target) — the
+    #: proxy's commit batcher clamps its batch size to it, closing the
+    #: resolver -> ratekeeper -> proxy sizing loop
+    commit_batch_target: Optional[int] = None
 
 
 class Ratekeeper:
@@ -93,6 +98,9 @@ class Ratekeeper:
         self.resolver_degraded: bool = False
         #: resolver address -> last reported engine health state
         self.resolver_health: Dict[str, str] = {}
+        #: min adaptive batch target across budget-batching resolvers
+        #: (pipeline/service.py target_batch_txns); None = none reported
+        self.commit_batch_target: Optional[int] = None
 
     async def run(self) -> None:
         from ..core import buggify
@@ -155,6 +163,11 @@ class Ratekeeper:
                     continue
                 self.resolver_health[ep.address] = h.get("state", "healthy")
                 resolver_infos.append(h)
+            targets = [h["target_batch_txns"] for h in resolver_infos
+                       if h.get("target_batch_txns") is not None]
+            # min wins: a commit batch crosses every resolver, so it must
+            # fit the slowest engine's in-budget bucket
+            self.commit_batch_target = min(targets) if targets else None
             self.tps_limit = self._update_rate(infos, tlog_infos, resolver_infos)
 
     def _update_rate(self, infos: List[StorageQueueInfo],
@@ -230,4 +243,5 @@ class Ratekeeper:
             # brief artificial squeeze: the GRV back-pressure path (queued
             # starts, latency instead of errors) runs even on idle clusters
             limit = max(1.0, limit / 100)
-        return GetRateInfoReply(tps_limit=limit)
+        return GetRateInfoReply(tps_limit=limit,
+                                commit_batch_target=self.commit_batch_target)

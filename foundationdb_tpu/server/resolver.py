@@ -125,12 +125,17 @@ class Resolver:
     async def engine_health(self, _req) -> dict:
         """Engine-health fragment (the device-fault analog of
         ResolutionMetricsRequest): the ratekeeper polls it as a throttle
-        signal and the status document surfaces it (tools/cli.py)."""
+        signal and the status document surfaces it (tools/cli.py). A
+        budget-batching pipeline additionally reports its adaptive batch
+        target, which the ratekeeper relays to proxies as the commit-batch
+        cap (the resolver -> ratekeeper -> proxy sizing loop)."""
         out = {"state": "healthy", "degraded": False}
         fn = getattr(self.engine, "health_stats", None)
         if fn is not None:
             out.update(fn())
         out["resolve_errors"] = self.stats.counter("resolve_errors").value
+        if self._service is not None and self._service.batcher is not None:
+            out["target_batch_txns"] = self._service.target_batch_txns()
         return out
 
     def _sample_rows(self, transactions) -> None:

@@ -1,0 +1,84 @@
+"""`make bench-smoke`: CPU-backend mini perf-path check, seconds not
+minutes, so perf wiring breaks loudly in CI rather than only on TPU.
+
+Runs the same code paths as bench.py's perf sections at toy sizes:
+
+  * bucket_ladder — a warmed 3-bucket JaxConflictEngine driven with
+    batch sizes straddling every bucket boundary (tools/ladder_bench.py),
+    abort sets replayed through the CPU oracle, and the compile counter
+    asserted flat in steady state;
+  * latency_under_load — a mini latency curve through the e2e sim
+    cluster (pipeline/latency_harness.py) with INJECTED device times and
+    a per-bucket ladder table, production point filtered by the
+    resolver_p99_budget_ms knob.
+
+Prints one JSON line; any failed check exits non-zero. Device timings on
+the CPU backend are meaningless and deliberately not asserted — this
+checks wiring, parity, and the zero-recompile claim, not speed.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main() -> int:
+    from foundationdb_tpu.ops import conflict_kernel as ck
+    from foundationdb_tpu.pipeline.latency_harness import (
+        p99_budget_ms, run_latency_under_load)
+    from foundationdb_tpu.tools.ladder_bench import drive_bucket_ladder
+
+    failures = []
+
+    cfg = ck.KernelConfig(key_words=4, capacity=2048, max_txns=128,
+                          max_point_reads=256, max_point_writes=256,
+                          max_reads=32, max_writes=32)
+    # scan_sizes (2,): one fused size keeps the smoke's warmup to 6
+    # compiles (~half the default ladder) while still proving the fused
+    # dispatch path end to end
+    ladder = drive_bucket_ladder(cfg, [32, 64], pool=512, steady_rounds=2,
+                                 scan_sizes=(2,), oracle_check=True)
+    if ladder["steady_state_compiles"] != 0:
+        failures.append(
+            f"steady_state_compiles={ladder['steady_state_compiles']} != 0")
+    if not ladder["oracle_parity_ok"]:
+        failures.append("abort-set parity vs CPU oracle failed")
+    if not ladder["scan_dispatches"].get("2"):
+        failures.append("multi-chunk batch never took a fused-scan dispatch")
+
+    # Mini latency curve: injected service times (the harness's time model
+    # is virtual), bucket table + budget knob exactly as bench.py wires
+    # them. Offered load near each shape's device-paced capacity.
+    budget = p99_budget_ms()
+    dev_by_bucket = {64: 0.45, 128: 0.8}
+    points = []
+    for T, depth in ((64, 1), (64, 2), (128, 2)):
+        r = run_latency_under_load(
+            depth=depth, batch_txns=T, device_ms=dev_by_bucket[T],
+            pack_ms_per_txn=0.0006,
+            offered_txns_per_sec=0.9 * T / (dev_by_bucket[T] / 1e3),
+            n_txns=1_200,
+            device_ms_by_bucket=dev_by_bucket, budget_ms=budget,
+        )
+        d = r.as_dict()
+        points.append(d)
+        if d["errors"]:
+            failures.append(f"harness point depth={depth} T={T}: "
+                            f"{d['errors']} transport/cluster errors")
+    fitting = [p for p in points if p["depth"] >= 2 and p["p99_ms"] <= budget]
+    production = (max(fitting, key=lambda p: p["sustained_txns_per_sec"])
+                  if fitting else None)
+    under_load = {"budget_p99_ms": budget,
+                  "budget_knob": "resolver_p99_budget_ms",
+                  "points": points,
+                  "production_point": production}
+
+    out = {"metric": "bench_smoke", "ok": not failures,
+           "failures": failures,
+           "bucket_ladder": ladder, "latency_under_load": under_load}
+    print(json.dumps(out))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

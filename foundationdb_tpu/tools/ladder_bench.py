@@ -1,0 +1,122 @@
+"""Bucket-ladder serving-path driver shared by bench.py's `bucket_ladder`
+section and `make bench-smoke` (tools/bench_smoke.py).
+
+Drives a warmed bucketed engine (ops/host_engine.py) with mixed-size
+batches of point-conflict transactions — sizes straddling every bucket
+boundary plus multi-chunk batches that exercise the fused lax.scan
+dispatch — and reports the engine's EnginePerf counters: per-bucket chunk
+hits, fused-scan dispatch histogram, warmup cost, and the compile count
+split into warmup vs steady state. A non-zero steady-state compile count
+means the serving path hit a JIT stall the ladder was supposed to make
+impossible; bench-smoke and the tier-1 regression guard
+(tests/test_bucket_ladder.py) both fail on it.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def drive_batch_sizes(buckets: Sequence[int], top_chunks: int = 2) -> List[int]:
+    """Mixed serving sizes: every bucket boundary straddled (k-1, k, k+1 —
+    the k+1 batch selects the next bucket up, or for the top bucket splits
+    into a second chunk) plus one multi-chunk batch (top_chunks full
+    top-bucket chunks + a tail) that the engine must fuse into a lax.scan
+    dispatch."""
+    sizes: List[int] = []
+    for k in buckets:
+        sizes.extend([k - 1, k, k + 1])
+    top = max(buckets)
+    sizes.append(top_chunks * top + max(1, top // 8))
+    return sizes
+
+
+def make_point_txns(n: int, pool: int, rng: np.random.Generator,
+                    version: int, reads: int = 2, writes: int = 2):
+    """n point-conflict transactions over a `pool`-key hot pool (the bench
+    workload shape); all-point so the engine's columnar fast path packs
+    them without per-range Python."""
+    from ..core.types import CommitTransaction, KeyRange
+
+    txns = []
+    ks = rng.integers(0, pool, size=(n, reads + writes))
+    for t in range(n):
+        tr = CommitTransaction(read_snapshot=max(0, version - 50))
+        for i in range(reads):
+            k = b"lad/%08d" % ks[t, i]
+            tr.read_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+        for i in range(writes):
+            k = b"lad/%08d" % ks[t, reads + i]
+            tr.write_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+        txns.append(tr)
+    return txns
+
+
+def drive_bucket_ladder(
+    cfg,
+    ladder: Sequence[int],
+    *,
+    pool: int = 4096,
+    steady_rounds: int = 2,
+    seed: int = 2026,
+    scan_sizes: Sequence[int] = (2, 4, 8),
+    oracle_check: bool = False,
+    engine: Optional[object] = None,
+) -> Dict:
+    """Warm a bucketed JaxConflictEngine at `cfg` + `ladder`, drive mixed
+    batch sizes through the columnar serving path for `steady_rounds`, and
+    return the `bucket_ladder` bench section. `oracle_check` additionally
+    replays every batch through the CPU oracle and reports abort-set
+    parity (bench-smoke turns it on; the TPU bench leans on the tier-1
+    parity suite instead)."""
+    from ..ops.host_engine import JaxConflictEngine
+    from ..ops.oracle import OracleConflictEngine
+
+    if engine is None:
+        engine = JaxConflictEngine(cfg, ladder=ladder, scan_sizes=scan_sizes)
+    engine.warmup()
+    compiles_warmup = engine.perf.compiles
+
+    oracle = OracleConflictEngine() if oracle_check else None
+    parity_ok = True
+    rng = np.random.default_rng(seed)
+    sizes = drive_batch_sizes([b.max_txns for b in engine.buckets])
+    version = 1_000
+    host_ms = 0.0
+    n_batches = 0
+    for _ in range(steady_rounds):
+        for n in sizes:
+            txns = make_point_txns(n, pool, rng, version)
+            version += max(64, n)
+            new_oldest = max(0, version - 100_000)
+            t0 = time.perf_counter()
+            got = engine.resolve(txns, version, new_oldest)
+            host_ms += (time.perf_counter() - t0) * 1e3
+            n_batches += 1
+            if oracle is not None:
+                want = oracle.resolve(txns, version, new_oldest)
+                if [int(x) for x in got] != [int(x) for x in want]:
+                    parity_ok = False
+    steady_compiles = engine.perf.compiles - compiles_warmup
+
+    out = {
+        "ladder": [b.max_txns for b in engine.buckets],
+        "scan_sizes": list(engine._scan_sizes),
+        "warmup_ms": round(engine.perf.warmup_ms, 1),
+        "compiles_warmup": compiles_warmup,
+        #: the zero-steady-state-compiles claim, measured on the driven mix
+        "steady_state_compiles": steady_compiles,
+        "bucket_hits": {str(k): v
+                        for k, v in sorted(engine.perf.bucket_hits.items())},
+        "scan_dispatches": {str(k): v
+                            for k, v in sorted(engine.perf.scan_dispatches.items())},
+        "driven_batch_sizes": sizes,
+        "rounds": steady_rounds,
+        "resolve_ms_per_batch": round(host_ms / max(1, n_batches), 3),
+        "arena_misses": engine.arena.misses if engine.arena is not None else None,
+    }
+    if oracle is not None:
+        out["oracle_parity_ok"] = parity_ok
+    return out

@@ -1,0 +1,309 @@
+"""Bucketed kernel ladder + fused chunk scan (ISSUE 3 tentpole).
+
+Parity: abort sets must be bit-identical to the reference-exact CPU
+oracle for every bucket in the ladder — batch sizes straddling every
+bucket boundary (k-1, k, k+1), randomized sizes, multi-chunk batches that
+take the fused lax.scan dispatch — for S=1, the device-mesh sharded
+engine, the sub-shard stacked engine, and a resilient-wrapped engine that
+faults (and re-warms) mid-stream. Fused-scan dispatch must equal
+per-chunk dispatch through the ResolverPipeline at depths {1,2,3}.
+
+Regression guard: after warmup() a bucketed engine serving steady-state
+mixed-size traffic must never compile again — asserted on the REAL JAX
+compile counter (monitoring events), not just the engine's own counter,
+so a silent retrace in any engine class fails tier-1 loudly.
+"""
+import random
+
+import numpy as np
+import pytest
+
+import jax
+
+from foundationdb_tpu.core import buggify, error
+from foundationdb_tpu.core.types import CommitTransaction, KeyRange
+from foundationdb_tpu.fault import ResilienceConfig, ResilientEngine
+from foundationdb_tpu.ops.conflict_kernel import KernelConfig
+from foundationdb_tpu.ops.host_engine import JaxConflictEngine, SubshardedConflictEngine
+from foundationdb_tpu.ops.oracle import OracleConflictEngine
+from foundationdb_tpu.parallel.sharding import KeyShardMap, ShardedConflictEngine
+from foundationdb_tpu.pipeline import ResolverPipeline
+from foundationdb_tpu.sim.loop import set_scheduler
+from foundationdb_tpu.sim.simulator import Simulator
+
+#: max_txns 128 with ladder [32, 64]: three buckets, every boundary a
+#: multiple of 32 (the Pallas layout constraint bucket() enforces)
+CFG = KernelConfig(key_words=2, capacity=2048, max_txns=128,
+                   max_reads=32, max_writes=32,
+                   max_point_reads=256, max_point_writes=256)
+LADDER = [32, 64]
+#: one fused size keeps per-engine warmup to 6 programs (tier-1 budget);
+#: _split_run covers any chunk count with scan-2 units + singles
+SCAN_SIZES = (2,)
+
+#: every bucket boundary straddled, plus multi-chunk sizes: 300 splits
+#: into chunks [128, 128, 44] — two top-bucket chunks fused into one
+#: scan-2 dispatch + a 64-bucket tail — and 129 into [128, 1]
+BOUNDARY_SIZES = [31, 32, 33, 63, 64, 65, 127, 128, 129, 300]
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    buggify.disable()
+    set_scheduler(None)
+
+
+def point_txns(rng, n, v, pool=160):
+    """n point-only conflicting transactions (columnar fast path): lagging
+    snapshots over a hot pool make real aborts common."""
+    txns = []
+    for _ in range(n):
+        t = CommitTransaction(read_snapshot=max(0, v - rng.randrange(1, 260)))
+        for _ in range(rng.randrange(1, 3)):
+            k = b"bl/%04d" % rng.randrange(pool)
+            t.read_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+        for _ in range(rng.randrange(1, 3)):
+            k = b"bl/%04d" % rng.randrange(pool)
+            t.write_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+        txns.append(t)
+    return txns
+
+
+def boundary_stream(seed, extra_random=6):
+    """(txns, version, new_oldest) batches at every boundary size plus
+    randomized sizes in [1, 300]."""
+    rng = random.Random(seed)
+    sizes = list(BOUNDARY_SIZES)
+    sizes += [rng.randrange(1, 301) for _ in range(extra_random)]
+    v = 0
+    out = []
+    for n in sizes:
+        v += rng.randrange(60, 240)
+        out.append((point_txns(rng, n, v), v, max(0, v - 1200)))
+    return out
+
+
+def assert_oracle_parity(engine, batches):
+    oracle = OracleConflictEngine()
+    for i, (txns, v, old) in enumerate(batches):
+        got = engine.resolve(txns, v, old)
+        want = oracle.resolve(txns, v, old)
+        assert [int(x) for x in got] == [int(x) for x in want], \
+            f"batch {i} (n={len(txns)}, v={v})"
+
+
+# -- bucket config ----------------------------------------------------------
+
+def test_bucket_config_shapes():
+    b = CFG.bucket(32)
+    # batch-side shapes shrink; the interval-table state stays invariant
+    assert b.max_txns == 32 and b.capacity == CFG.capacity
+    assert b.key_words == CFG.key_words and b.fixpoint == CFG.fixpoint
+    assert b.rp == 64 and b.rp % 32 == 0      # pro-rata 256*32/128, 32-aligned
+    assert b.wp == 64
+    assert CFG.bucket(CFG.max_txns) is CFG    # top bucket IS the base config
+    with pytest.raises(ValueError):
+        CFG.bucket(48 + 1)                    # not a multiple of 32
+    with pytest.raises(ValueError):
+        CFG.bucket(CFG.max_txns + 32)         # beyond capacity
+
+
+def test_ladder_and_warmup_program_coverage():
+    eng = JaxConflictEngine(CFG, ladder=LADDER, scan_sizes=SCAN_SIZES)
+    assert [b.max_txns for b in eng.buckets] == [32, 64, 128]
+    eng.warmup()
+    assert eng.perf.warmed and eng.perf.warmup_ms > 0
+    want_keys = {(t, c) for t in (32, 64, 128) for c in (1, 2)}
+    assert set(eng._programs) == want_keys
+    assert eng.perf.compiles == len(want_keys)
+
+
+# -- abort-set parity vs the CPU oracle -------------------------------------
+
+def test_parity_s1_bucket_boundaries():
+    eng = JaxConflictEngine(CFG, ladder=LADDER, scan_sizes=SCAN_SIZES).warmup()
+    assert_oracle_parity(eng, boundary_stream(1801))
+    # the stream genuinely exercised the whole ladder and the fused scan
+    assert all(eng.perf.bucket_hits[t] > 0 for t in (32, 64, 128))
+    assert eng.perf.scan_dispatches.get(2, 0) > 0
+
+
+@pytest.mark.parametrize("seed", [2901, 2902])
+def test_parity_s1_randomized(seed):
+    rng = random.Random(seed)
+    eng = JaxConflictEngine(CFG, ladder=LADDER, scan_sizes=SCAN_SIZES)
+    batches = []
+    v = 0
+    for _ in range(12):
+        v += rng.randrange(60, 240)
+        batches.append((point_txns(rng, rng.randrange(1, 290), v), v,
+                        max(0, v - 1500)))
+    assert_oracle_parity(eng, batches)
+
+
+def test_parity_sharded_bucket_boundaries():
+    shard_map = KeyShardMap([b"bl/0080"])    # split inside the hot pool
+    mesh = jax.make_mesh((2,), ("shard",), devices=jax.devices()[:2])
+    eng = ShardedConflictEngine(CFG, shard_map, mesh, ladder=LADDER,
+                                scan_sizes=SCAN_SIZES).warmup()
+    assert_oracle_parity(eng, boundary_stream(1802))
+    assert eng.perf.scan_dispatches.get(2, 0) > 0
+
+
+def test_parity_subsharded_bucket_boundaries():
+    eng = SubshardedConflictEngine(CFG, KeyShardMap([b"bl/0080"]),
+                                   ladder=[64], scan_sizes=SCAN_SIZES).warmup()
+    assert_oracle_parity(eng, boundary_stream(1803))
+    assert eng.perf.scan_dispatches.get(2, 0) > 0
+
+
+def test_parity_arena_disabled_identical():
+    """Buffer-arena reuse is a pure host optimization: verdicts with the
+    arena must equal verdicts with per-chunk fresh allocations."""
+    batches = boundary_stream(1804)
+    with_arena = JaxConflictEngine(CFG, ladder=LADDER, scan_sizes=SCAN_SIZES)
+    without = JaxConflictEngine(CFG, ladder=LADDER, scan_sizes=SCAN_SIZES,
+                                arena=False)
+    for txns, v, old in batches:
+        got_a = [int(x) for x in with_arena.resolve(txns, v, old)]
+        got_b = [int(x) for x in without.resolve(txns, v, old)]
+        assert got_a == got_b
+    assert with_arena.arena is not None and with_arena.arena.misses > 0
+    assert without.arena is None
+
+
+# -- fused scan vs per-chunk dispatch through the pipeline ------------------
+
+@pytest.mark.parametrize("depth", [1, 2, 3])
+def test_pipeline_scan_vs_per_chunk_parity(depth):
+    """The fused lax.scan dispatch must be invisible: a ladder engine with
+    scan fusion, driven through the ResolverPipeline at every depth,
+    produces bit-identical verdicts to a plain single-bucket engine that
+    dispatches one program per chunk."""
+    batches = boundary_stream(3000 + depth)
+    plain = JaxConflictEngine(CFG)           # no ladder, per-chunk dispatch
+    want = [[int(x) for x in plain.resolve(txns, v, old)]
+            for txns, v, old in batches]
+
+    pipe = ResolverPipeline(
+        JaxConflictEngine(CFG, ladder=LADDER, scan_sizes=SCAN_SIZES).warmup(),
+        depth=depth)
+    handles = [pipe.submit(txns, v, old) for txns, v, old in batches]
+    got = [[int(x) for x in h.result()] for h in handles]
+    assert got == want
+    assert pipe.in_flight == 0
+
+
+def test_wallclock_pipeline_budget_batcher_observes():
+    """The wall-clock pipeline's adaptive sizing loop: force() wall times
+    must feed the per-bucket EWMA pro-rata by bucket size, and
+    suggested_batch_txns() must return a ladder bucket."""
+    from foundationdb_tpu.pipeline import BudgetBatcher
+
+    batcher = BudgetBatcher([32, 64, 128], budget_ms=1e6)  # everything fits
+    pipe = ResolverPipeline(
+        JaxConflictEngine(CFG, ladder=LADDER, scan_sizes=SCAN_SIZES).warmup(),
+        depth=2, batcher=batcher)
+    for txns, v, old in boundary_stream(5200):
+        pipe.submit(txns, v, old).result()
+    # every bucket the stream hit has an observation, so the target is the
+    # largest (in-budget) bucket, not the never-observed fallback
+    assert set(map(int, batcher.ewma_ms)) == {32, 64, 128}
+    assert all(ms > 0 for ms in batcher.ewma_ms.values())
+    assert pipe.suggested_batch_txns() == 128
+    batcher.budget_ms = 0.0                   # nothing fits -> smallest
+    assert pipe.suggested_batch_txns() == 32
+
+
+# -- resilient wrap: fault + shadow rebuild + ladder re-warm ----------------
+
+class _FlakyDevice:
+    """A real ladder engine behind a dispatch that faults once mid-stream
+    (the supervisor must retry through a shadow rebuild + ladder re-warm
+    and keep serving bit-identical verdicts)."""
+
+    name = "flaky-ladder"
+
+    def __init__(self, inner, fail_at_call):
+        self.inner = inner
+        self.fail_at_call = fail_at_call
+        self.calls = 0
+
+    def clear(self, version):
+        self.inner.clear(version)
+
+    def rewarm_target(self):
+        return self.inner
+
+    def resolve(self, transactions, now_v, new_oldest):
+        self.calls += 1
+        if self.calls == self.fail_at_call:
+            raise error.device_fault("injected ladder dispatch fault")
+        return self.inner.resolve(transactions, now_v, new_oldest)
+
+
+def test_resilient_wrapped_ladder_parity():
+    sim = Simulator(17)
+    buggify.disable()
+    inner = JaxConflictEngine(CFG, ladder=LADDER, scan_sizes=SCAN_SIZES)
+    eng = ResilientEngine(
+        _FlakyDevice(inner, fail_at_call=5),
+        ResilienceConfig(dispatch_timeout=0.5, retry_budget=2,
+                         retry_backoff=0.01, probe_rate=0.0,
+                         probation_batches=2, failover_min_batches=2))
+    eng.warmup()                             # pass-through to the ladder
+    assert inner.perf.warmed
+    batches = boundary_stream(4100)
+    oracle = OracleConflictEngine()
+
+    async def go():
+        for txns, v, old in batches:
+            got = await eng.resolve(txns, v, old)
+            want = oracle.resolve(txns, v, old)
+            assert [int(x) for x in got] == [int(x) for x in want], (v, len(txns))
+
+    sim.sched.run_until(sim.sched.spawn(go()), until=10000)
+    assert eng.stats["dispatch_faults"] == 1 and eng.stats["retries"] == 1
+    assert eng.health_stats()["state"] == "healthy"
+
+
+# -- the tier-1 compile regression guard ------------------------------------
+
+def test_no_steady_state_recompiles():
+    """A warmed engine serving steady-state mixed-size batches must never
+    hit the JAX compiler again: counted via jax monitoring events (every
+    backend compile request fires one), so ANY retrace — engine counter
+    bumped or not — fails here."""
+    from jax._src import monitoring
+
+    eng = JaxConflictEngine(CFG, ladder=LADDER, scan_sizes=SCAN_SIZES).warmup()
+    rng = random.Random(5001)
+    v = 0
+
+    def drive_round(seed_round):
+        nonlocal v
+        for n in BOUNDARY_SIZES:
+            v += rng.randrange(60, 240)
+            eng.resolve(point_txns(rng, n, v), v, max(0, v - 1200))
+
+    # round 1 absorbs every one-time lazy cost outside the device programs
+    # (arena pool fill, numpy scratch); steady state starts after it
+    drive_round(0)
+    compiles_warm = eng.perf.compiles
+
+    events = []
+
+    def listen(name, **kw):
+        if "compil" in name:
+            events.append(name)
+
+    monitoring.register_event_listener(listen)
+    try:
+        for r in range(1, 3):
+            drive_round(r)
+    finally:
+        monitoring._unregister_event_listener_by_callback(listen)
+
+    assert events == [], f"steady-state JAX compiles: {events}"
+    assert eng.perf.compiles == compiles_warm
