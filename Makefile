@@ -13,6 +13,13 @@ bench:
 bench-smoke:
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.bench_smoke
 
+# Observability-path smoke (docs/observability.md): commit-path spans
+# attribute client latency within tolerance, unified telemetry drains to
+# \xff/metrics/, the flight recorder populates, and disabled tracing stays
+# near-zero-cost.
+telemetry-smoke:
+	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.telemetry_smoke
+
 # Device-fault chaos: the full multi-seed nemesis campaign (slow tier; the
 # 3-seed smoke rides `check`) + the buggify coverage report over the
 # grinder battery (docs/fault_tolerance.md).
@@ -20,4 +27,4 @@ chaos:
 	python -m pytest tests/test_device_nemesis.py -q -m slow
 	python -m foundationdb_tpu.tools.buggify_coverage --seeds 4 --min-frac 0.5
 
-.PHONY: check bench bench-smoke chaos
+.PHONY: check bench bench-smoke telemetry-smoke chaos

@@ -243,6 +243,7 @@ def main():
     ladder = bucket_ladder_section()
     curve = latency_curve(host_pack_ms)
     under_load = latency_under_load(host_pack_ms, curve)
+    attribution = latency_attribution(host_pack_ms, under_load)
     # Sequential estimate (host pack, then device) and the pipelined rate: a
     # production resolver packs batch i+1 on the host while the device runs
     # batch i (JAX async dispatch gives the overlap for free — the host-side
@@ -275,6 +276,7 @@ def main():
         "bucket_ladder": ladder,
         "latency_curve": curve,
         "latency_under_load": under_load,
+        "latency_attribution": attribution,
         "device": str(dev),
     }))
 
@@ -472,6 +474,51 @@ def latency_under_load(host_pack_ms_at_headline: float, curve: dict):
         out["vs_serial_harness"] = round(
             production["sustained_txns_per_sec"]
             / serial_best["sustained_txns_per_sec"], 3)
+    return out
+
+
+def latency_attribution(host_pack_ms_at_headline: float, under_load):
+    """Span-based decomposition of the client-observed commit latency at
+    the production point (docs/observability.md): re-runs the e2e harness
+    with commit-path span collection enabled (core/trace.py) so the p50/p99
+    latency splits into named phase segments — batch wait, version fetch,
+    resolver queue wait, host pack, pipeline wait, device dispatch, force,
+    log push, network residuals — that sum to the client-observed figure
+    (the sum identity is by construction; every segment is measured from
+    real span timestamps along the commit path)."""
+    from foundationdb_tpu.pipeline.latency_harness import (
+        p99_budget_ms, run_latency_under_load)
+
+    production = (under_load or {}).get("production_point")
+    if production is not None:
+        depth = production["depth"]
+        T = production["batch_txns"]
+        offered = production["offered_txns_per_sec"]
+    else:
+        depth, T = 2, 512
+        offered = None
+    dev_by_shape = {int(t): v for t, v in
+                    ((under_load or {}).get("device_ms_by_shape") or {}).items()}
+    if T not in dev_by_shape:
+        return None
+    if offered is None:
+        offered = 0.9 * T / (max(0.2, dev_by_shape[T]) / 1e3)
+    try:
+        r = run_latency_under_load(
+            depth=depth, batch_txns=T, device_ms=dev_by_shape[T],
+            pack_ms_per_txn=host_pack_ms_at_headline / CFG.max_txns,
+            offered_txns_per_sec=offered, n_txns=8_000,
+            device_ms_by_bucket=dev_by_shape, budget_ms=p99_budget_ms(),
+            collect_spans=True,
+        )
+    except Exception:
+        return None
+    if r.attribution is None:
+        return None
+    out = dict(r.attribution)
+    out.update({"depth": depth, "batch_txns": T,
+                "offered_txns_per_sec": round(offered, 1),
+                "p50_ms": round(r.p50_ms, 3), "p99_ms": round(r.p99_ms, 3)})
     return out
 
 

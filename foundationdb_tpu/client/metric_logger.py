@@ -32,11 +32,24 @@ def _block_key(process: str, metric: str, t: float, seq: int = 0) -> bytes:
 
 
 async def run_metric_logger(db, collection: TDMetricCollection,
-                            process: str, interval: float = 2.0) -> None:
-    """Drain `collection` into the database forever (spawn as an actor)."""
+                            process: str, interval: float = 2.0,
+                            sync=None) -> None:
+    """Drain `collection` into the database forever (spawn as an actor).
+    `sync` is an optional pre-drain hook — pass
+    `core.telemetry.hub().sync` so the unified registry pulls engine perf /
+    batcher / health values into the collection right before each drain."""
+    from ..core import buggify
+
     seq = 0
     while True:
         await delay(interval)
+        if buggify.buggify():
+            # laggy telemetry drain: metrics recorded meanwhile must buffer
+            # (never drop) and land in a later block — the drain-vs-record
+            # interleaving the tdmetric tests pin
+            await delay(interval * 4)
+        if sync is not None:
+            sync()
         drained = collection.drain_all()
         if not drained:
             continue

@@ -17,4 +17,5 @@ from .types import (
 from .error import FDBError
 from .rng import DeterministicRandom, g_random, g_nondeterministic_random
 from .knobs import SERVER_KNOBS, CLIENT_KNOBS, FLOW_KNOBS
-from .trace import TraceEvent, TraceBatch, g_trace, g_trace_batch, Severity
+from .trace import (TraceEvent, TraceBatch, g_trace, g_trace_batch, Severity,
+                    Span, g_spans, span, span_event, span_now)

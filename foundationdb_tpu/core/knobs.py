@@ -138,6 +138,19 @@ def _make_server_knobs() -> Knobs:
     k.init("resolver_p99_budget_ms", 2.5)
     #: EWMA smoothing for observed per-bucket device latency (0 < a <= 1)
     k.init("resolver_latency_ewma_alpha", 0.25)
+    # Observability (docs/observability.md).
+    #: commit-path span collection (core/trace.py): 0 disables span
+    #: recording entirely — instrumented sites pay one attribute check and
+    #: allocate nothing (the near-zero-cost guarantee the regression test
+    #: pins); > 0 enables collection (the value is reserved for per-batch
+    #: sampling). Deliberately no BUGGIFY randomizer: span recording draws
+    #: no rng, but enabling it mid-battery would grow the span buffer for
+    #: nothing.
+    k.init("trace_span_sample_rate", 0.0)
+    #: dispatch records the ResilientEngine's flight recorder retains — the
+    #: bounded ring dumped into quarantine/failover trace events for
+    #: post-mortem replay (fault/resilient.py)
+    k.init("resolver_flight_recorder_size", 64)
     return k
 
 

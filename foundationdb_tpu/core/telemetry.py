@@ -1,0 +1,169 @@
+"""Unified telemetry registry: one bridge from the serving path's disjoint
+counter islands into the TDMetric time-series machinery.
+
+Before this module, four telemetry sources lived apart with no common
+drain: `EnginePerf` (ops/host_engine.py compile/bucket/scan counters),
+per-bucket `BudgetBatcher` EWMAs (pipeline/resolver_pipeline.py),
+`ResilientEngine` health-state transitions (fault/resilient.py) and the
+role `CounterCollection`s. The hub gives every source one registration
+call and one `TDMetricCollection` (core/tdmetric.py), so:
+
+  * `client/metric_logger.run_metric_logger(db, hub().tdmetrics, ...)`
+    persists all of it into the `\\xff/metrics/` keyspace, queryable by
+    (metric, time range) like any other TDMetric series;
+  * `snapshot()` is the live status fragment the resolver's engine-health
+    endpoint attaches, flowing resolver -> ratekeeper -> master status ->
+    CC status doc -> `tools/cli.py telemetry`;
+  * `prometheus_text()` renders the current values as a Prometheus-style
+    text exposition (real/demo_server.py serves it).
+
+Sim hygiene: `Simulator.__init__` calls `reset()` (like the fault-engine
+registry and sim/validation), so one simulation's engines never leak into
+the next run's telemetry. Registration is append-only and draws no rng —
+registering a source can never perturb a deterministic simulation.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+from .tdmetric import TDMetricCollection
+from .trace import span_now
+
+#: health states in transition-metric encoding (fault/resilient.py's
+#: state machine; the Int64 series records the index at each transition)
+HEALTH_STATE_INDEX = {"healthy": 0, "suspect": 1, "failed": 2,
+                      "probation": 3, "quarantined": 4}
+
+
+class TelemetryHub:
+    """Per-process registry of serving-path telemetry sources.
+
+    Registries hold WEAK references: engines/batchers register at
+    construction with no unregister path, and a long-lived wall-clock
+    process (real demo server, bench drivers, repeated pipeline
+    construction) must not pin every discarded engine — and its device
+    state — forever, nor pay sync() cost scaling with process lifetime.
+    A collected source simply stops updating; its last synced values
+    remain in the TDMetric series. (In simulation the cluster and the
+    fault registry keep live sources strongly reachable anyway.)"""
+
+    def __init__(self) -> None:
+        self.tdmetrics = TDMetricCollection(now=span_now)
+        #: label -> weakref to EnginePerf
+        self._engine_perf: Dict[str, "weakref.ref"] = {}
+        #: label -> weakref to BudgetBatcher
+        self._batchers: Dict[str, "weakref.ref"] = {}
+        #: label -> weakref to ResilientEngine
+        self._health: Dict[str, "weakref.ref"] = {}
+        self._seq = 0
+
+    # -- registration --------------------------------------------------------
+    def _label(self, kind: str, name: str) -> str:
+        self._seq += 1
+        return f"{name or kind}.{self._seq}"
+
+    def register_engine_perf(self, perf, name: str = "engine") -> str:
+        label = self._label("engine", name)
+        self._engine_perf[label] = weakref.ref(perf)
+        return label
+
+    def register_batcher(self, batcher, name: str = "batcher") -> str:
+        label = self._label("batcher", name)
+        self._batchers[label] = weakref.ref(batcher)
+        return label
+
+    def register_health(self, engine, name: str = "resilient") -> str:
+        label = self._label("resilient", name)
+        self._health[label] = weakref.ref(engine)
+        return label
+
+    @staticmethod
+    def _live(registry: Dict[str, "weakref.ref"]):
+        """(label, source) for live sources; dead entries are pruned."""
+        dead = [label for label, ref in registry.items() if ref() is None]
+        for label in dead:
+            del registry[label]
+        return [(label, ref()) for label, ref in registry.items()
+                if ref() is not None]
+
+    def record_health_transition(self, label: str, state: str) -> None:
+        """Called by ResilientEngine._set_state on every transition: the
+        change history IS the incident timeline (TDMetric read model).
+        Recorded unconditionally — the construction-time entry indexes 0
+        (healthy), which a level metric's change-only set() would swallow,
+        and an engine's very existence belongs in the timeline."""
+        m = self.tdmetrics.int64(f"resolver.{label}.state")
+        m.value = HEALTH_STATE_INDEX.get(state, -1)
+        m._record(m.value)
+
+    # -- bridging ------------------------------------------------------------
+    def sync(self) -> None:
+        """Pull every registered source's current values into the TDMetric
+        collection (level metrics record only on change, so a quiet sync is
+        free). Run before each MetricLogger drain or status snapshot."""
+        from . import buggify
+
+        if buggify.buggify():
+            # stale telemetry: one sync silently skipped — the change-history
+            # metric model must tolerate a lagging bridge (values catch up on
+            # the next sync; level metrics record no spurious entries)
+            return
+        td = self.tdmetrics
+        for label, perf in self._live(self._engine_perf):
+            td.int64(f"engine.{label}.compiles").set(perf.compiles)
+            for bucket, hits in perf.bucket_hits.items():
+                td.int64(f"engine.{label}.bucket_hits.{bucket}").set(hits)
+            for scan, n in perf.scan_dispatches.items():
+                td.int64(f"engine.{label}.scan_dispatches.{scan}").set(n)
+        for label, b in self._live(self._batchers):
+            # EWMAs are floats; the Int64 series stores microseconds so the
+            # persisted change history stays integral
+            for bucket, ms in b.ewma_ms.items():
+                td.int64(f"batcher.{label}.ewma_us.{bucket}").set(
+                    int(ms * 1000))
+        for label, eng in self._live(self._health):
+            st = eng.stats
+            for key in ("batches", "dispatch_faults", "retries", "failovers",
+                        "swap_backs", "probes", "probe_mismatches",
+                        "oracle_batches"):
+                td.int64(f"resolver.{label}.{key}").set(st.get(key, 0))
+
+    def snapshot(self) -> dict:
+        """Live values for status documents (no TDMetric round trip)."""
+        return {
+            "engines": {label: perf.as_dict()
+                        for label, perf in self._live(self._engine_perf)},
+            "batchers": {label: b.as_dict()
+                         for label, b in self._live(self._batchers)},
+            "health": {label: eng.health_stats()
+                       for label, eng in self._live(self._health)},
+        }
+
+    def prometheus_text(self) -> str:
+        """Current value of every registered metric, Prometheus text
+        exposition style (one `fdbtpu_<name> <value>` line per series)."""
+        self.sync()
+        lines: List[str] = ["# fdbtpu telemetry exposition"]
+        for name in sorted(self.tdmetrics.metrics):
+            m = self.tdmetrics.metrics[name]
+            value = getattr(m, "value", None)
+            if value is None:   # ContinuousMetric: expose the event count
+                value = len(m.buffer)
+            safe = (name.replace(".", "_").replace("-", "_")
+                    .replace("/", "_").replace(":", "_"))
+            lines.append(f"fdbtpu_{safe} {value}")
+        return "\n".join(lines) + "\n"
+
+
+_hub = TelemetryHub()
+
+
+def hub() -> TelemetryHub:
+    return _hub
+
+
+def reset() -> None:
+    """Fresh hub (Simulator.__init__, like fault.reset_registry)."""
+    global _hub
+    _hub = TelemetryHub()

@@ -32,6 +32,9 @@ class TraceCollector:
         self.min_severity = Severity.INFO
         self.file = None
         self.buffer_limit = 100_000
+        #: observer callbacks that raised (isolated, never re-raised into
+        #: the emitting role — telemetry must not take down the commit path)
+        self.observer_errors = 0
         self._lock = threading.Lock()
 
     def emit(self, event: Dict[str, Any]) -> None:
@@ -40,13 +43,37 @@ class TraceCollector:
             if len(self.events) > self.buffer_limit:
                 del self.events[: self.buffer_limit // 2]
             if self.file is not None:
-                self.file.write(json.dumps(event, default=str) + "\n")
+                try:
+                    self.file.write(json.dumps(event, default=str) + "\n")
+                    if event.get("Severity", 0) >= Severity.ERROR:
+                        # a SevError may be the last thing this process logs:
+                        # make sure it reaches the sink before anything dies
+                        self.file.flush()
+                except (OSError, ValueError):
+                    pass
         for obs in list(self.observers):
-            obs(event)
+            # One raising observer must neither break event emission nor
+            # starve observers registered after it (the harness's SevError
+            # watchdog must see the event even if a metrics bridge raised).
+            try:
+                obs(event)
+            except Exception:
+                self.observer_errors += 1
 
     def clear(self) -> None:
         with self._lock:
             self.events.clear()
+
+    def close(self) -> None:
+        """Flush and detach the JSON-lines file sink (events keep
+        accumulating in memory)."""
+        with self._lock:
+            if self.file is not None:
+                try:
+                    self.file.flush()
+                except (OSError, ValueError):
+                    pass
+                self.file = None
 
     def find(self, event_type: str) -> List[Dict[str, Any]]:
         with self._lock:
@@ -106,6 +133,171 @@ class TraceEvent:
 
     def __exit__(self, *exc) -> None:
         self.log()
+
+
+# -- spans -------------------------------------------------------------------
+#
+# Lightweight latency spans for the commit path (docs/observability.md): a
+# span is a named [t0, t1) segment tied to a trace id (the commit version of
+# the batch it belongs to), emitted by the proxy's commit phases, the
+# resolver's queue/service stages and the engine's pack/force halves, so a
+# client-observed commit latency decomposes into named phase segments
+# (bench.py `latency_attribution`). Sim-time and wall-time aware: span_now()
+# reads the active deterministic scheduler's virtual clock when one is
+# installed and the wall clock otherwise, so the same instrumentation serves
+# the sim harness and the wall-clock ResolverPipeline.
+#
+# Cost discipline: collection is OFF unless the `trace_span_sample_rate`
+# knob (core/knobs.py) or a harness enables it; disabled call sites pay one
+# attribute check and allocate nothing (span() returns a shared null object
+# — tests/test_trace_spans.py pins this).
+
+_loop_mod = None
+
+
+def span_now() -> float:
+    """Virtual time under an active sim scheduler, wall time otherwise."""
+    global _loop_mod
+    if _loop_mod is None:
+        from ..sim import loop as _loop
+        _loop_mod = _loop
+    s = _loop_mod._current
+    return s.time if s is not None else time.perf_counter()
+
+
+class SpanCollector:
+    """Finished spans, bounded like the event buffer. `enabled` is the one
+    fast-path gate every instrumented site checks."""
+
+    def __init__(self) -> None:
+        self.enabled = False
+        self.spans: List[Dict[str, Any]] = []
+        self.buffer_limit = 500_000
+
+    def add(self, span: Dict[str, Any]) -> None:
+        self.spans.append(span)
+        if len(self.spans) > self.buffer_limit:
+            del self.spans[: self.buffer_limit // 2]
+
+    def clear(self) -> None:
+        self.spans.clear()
+
+    def by_name(self, name: str) -> List[Dict[str, Any]]:
+        return [s for s in self.spans if s["Name"] == name]
+
+    def for_trace(self, trace_id: Any) -> List[Dict[str, Any]]:
+        return [s for s in self.spans if s.get("Trace") == trace_id]
+
+    def durations_by_trace(self) -> Dict[Any, Dict[str, float]]:
+        """trace id -> {span name: summed duration seconds} (+ `<name>.t0`:
+        earliest start), the shape the latency-attribution math consumes."""
+        out: Dict[Any, Dict[str, float]] = {}
+        for s in self.spans:
+            d = out.setdefault(s.get("Trace"), {})
+            name = s["Name"]
+            d[name] = d.get(name, 0.0) + (s["End"] - s["Begin"])
+            k0 = name + ".t0"
+            if k0 not in d or s["Begin"] < d[k0]:
+                d[k0] = s["Begin"]
+        return out
+
+
+g_spans = SpanCollector()
+
+#: spans allocated since process start — the tracing-disabled regression
+#: guard asserts this stays flat across an instrumented run with sampling off
+span_allocations = [0]
+
+
+class Span:
+    """One named phase segment. Created at its start; finish() records it.
+    Only ever constructed when collection is enabled — disabled sites get
+    NULL_SPAN from span() and allocate nothing."""
+
+    __slots__ = ("name", "trace_id", "parent", "t0", "details")
+
+    def __init__(self, name: str, trace_id: Any = None,
+                 parent: Optional[str] = None, **details: Any):
+        span_allocations[0] += 1
+        self.name = name
+        self.trace_id = trace_id
+        self.parent = parent
+        self.t0 = span_now()
+        self.details = details or None
+
+    def child(self, name: str, **details: Any) -> "Span":
+        return Span(name, trace_id=self.trace_id, parent=self.name, **details)
+
+    def finish(self, **details: Any) -> None:
+        rec: Dict[str, Any] = {"Name": self.name, "Trace": self.trace_id,
+                               "Begin": self.t0, "End": span_now()}
+        if self.parent is not None:
+            rec["Parent"] = self.parent
+        if self.details:
+            rec.update(self.details)
+        if details:
+            rec.update(details)
+        g_spans.add(rec)
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.finish()
+
+
+class _NullSpan:
+    """Shared no-op span for disabled collection: no allocation, no clock
+    reads, no record."""
+
+    __slots__ = ()
+
+    def child(self, name: str, **details: Any) -> "_NullSpan":
+        return self
+
+    def finish(self, **details: Any) -> None:
+        pass
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+NULL_SPAN = _NullSpan()
+
+
+def span(name: str, trace_id: Any = None, parent: Optional[str] = None,
+         **details: Any):
+    """Open a span if collection is enabled, else the shared null span."""
+    if not g_spans.enabled:
+        return NULL_SPAN
+    return Span(name, trace_id=trace_id, parent=parent, **details)
+
+
+def span_event(name: str, trace_id: Any, t0: float, t1: float,
+               parent: Optional[str] = None, **details: Any) -> None:
+    """Record a completed span retroactively from explicit timestamps
+    (callers that only learn the trace id — e.g. the commit version — after
+    the phase ran)."""
+    if not g_spans.enabled:
+        return
+    rec: Dict[str, Any] = {"Name": name, "Trace": trace_id,
+                           "Begin": t0, "End": t1}
+    if parent is not None:
+        rec["Parent"] = parent
+    if details:
+        rec.update(details)
+    g_spans.add(rec)
+
+
+def spans_enabled() -> bool:
+    return g_spans.enabled
+
+
+def set_span_collection(enabled: bool) -> None:
+    g_spans.enabled = bool(enabled)
 
 
 class TraceBatch:

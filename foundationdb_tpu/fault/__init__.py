@@ -37,8 +37,10 @@ from .resilient import (
     FAILED,
     PROBATION,
     QUARANTINED,
+    FlightRecorder,
     ResilienceConfig,
     ResilientEngine,
+    abort_set_digest,
 )
 
 #: every ResilientEngine constructed since the last reset (sim-wide; the

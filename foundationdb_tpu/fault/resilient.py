@@ -43,14 +43,15 @@ and flip verdicts.
 """
 from __future__ import annotations
 
+import zlib
 from collections import deque
 from dataclasses import dataclass
 from typing import Deque, List, Optional, Tuple
 
-from ..core import buggify, error
+from ..core import buggify, error, telemetry
 from ..core.knobs import SERVER_KNOBS
 from ..core.rng import DeterministicRandom
-from ..core.trace import Severity, TraceEvent
+from ..core.trace import Severity, TraceEvent, g_spans, span_event, span_now
 from ..core.types import CommitTransaction, KeyRange, TransactionCommitResult
 from ..ops.oracle import OracleConflictEngine
 from ..sim.actors import any_of
@@ -90,6 +91,41 @@ class ResilienceConfig:
         )
 
 
+def abort_set_digest(verdicts) -> str:
+    """Stable 32-bit digest of a batch's verdict vector — the flight
+    recorder's compact abort-set fingerprint. Replaying the batch through a
+    clean oracle and digesting its verdicts must reproduce this exactly
+    (DeviceFaultValidationWorkload's post-mortem parity check)."""
+    return format(zlib.crc32(bytes(int(v) & 0xFF for v in verdicts)), "08x")
+
+
+class FlightRecorder:
+    """Bounded ring of recent device dispatches (docs/observability.md).
+
+    A quarantine SevError used to say only "the device corrupted verdicts"
+    with no record of the dispatches that led up to it; this ring keeps the
+    last N dispatch records — version, txn/conflict-row counts, health
+    state at dispatch, service latency, retries consumed, which path served
+    (device/oracle), and the abort-set digest — and is dumped whole into
+    the quarantine/failover trace events for post-mortem replay."""
+
+    __slots__ = ("ring",)
+
+    def __init__(self, size: Optional[int] = None):
+        if size is None:
+            size = int(SERVER_KNOBS.resolver_flight_recorder_size)
+        self.ring: Deque[dict] = deque(maxlen=max(1, size))
+
+    def record(self, **rec) -> None:
+        self.ring.append(rec)
+
+    def dump(self) -> List[dict]:
+        return list(self.ring)
+
+    def __len__(self) -> int:
+        return len(self.ring)
+
+
 class ResilientEngine:
     """Fault-tolerant supervisor over a device conflict engine."""
 
@@ -122,9 +158,17 @@ class ResilientEngine:
         #: engine's. Off by default: the journal is unbounded by design
         #: (test-harness memory), so only sim campaigns opt in.
         self.journal: Optional[List[Tuple]] = [] if record_journal else None
+        #: bounded ring of recent dispatches, dumped into quarantine/
+        #: failover trace events (docs/observability.md)
+        self.flight = FlightRecorder()
+        #: per-batch retry bookkeeping for the flight record
+        self._batch_retries = 0
         from . import register_engine
 
         register_engine(self)
+        self._telemetry_label = telemetry.hub().register_health(self)
+        telemetry.hub().record_health_transition(self._telemetry_label,
+                                                 self.state)
 
     # -- public surface ------------------------------------------------------
     @property
@@ -164,6 +208,9 @@ class ResilientEngine:
         """One batch through the supervisor; callers (server/resolver.py,
         pipeline/service.py) enter strictly in commit-version order."""
         self.stats["batches"] += 1
+        self._batch_retries = 0
+        t_dispatch = span_now()
+        state_at_dispatch = self.state
         if self.state == FAILED:
             # re-warm BEFORE resolving this batch: the shadow and the
             # failover oracle are both exactly one-batch-behind states, so
@@ -177,6 +224,19 @@ class ResilientEngine:
         else:
             verdicts = await self._healthy_batch(transactions, now_v, new_oldest)
         self._record(now_v, transactions, new_oldest, verdicts)
+        self.flight.record(
+            version=now_v,
+            new_oldest=new_oldest,
+            txns=len(transactions),
+            reads=sum(len(t.read_conflict_ranges) for t in transactions),
+            writes=sum(len(t.write_conflict_ranges) for t in transactions),
+            state=state_at_dispatch,
+            served_by=("device" if state_at_dispatch in (HEALTHY, SUSPECT)
+                       else "oracle"),
+            retries=self._batch_retries,
+            ms=round((span_now() - t_dispatch) * 1e3, 4),
+            digest=abort_set_digest(verdicts),
+        )
         return verdicts
 
     # -- state machine -------------------------------------------------------
@@ -187,6 +247,10 @@ class ResilientEngine:
                                  else Severity.INFO)) \
                 .detail("From", self.state).detail("To", state).log()
             self.state = state
+            # transition into the unified TDMetric registry: the change
+            # history of this Int64 series IS the incident timeline
+            telemetry.hub().record_health_transition(
+                self._telemetry_label, state)
 
     async def _healthy_batch(self, transactions, now_v, new_oldest):
         try:
@@ -236,24 +300,34 @@ class ResilientEngine:
         before every retry (the failed attempt may have applied)."""
         last: Optional[error.FDBError] = None
         for i in range(attempts):
-            if i:
-                self.stats["retries"] += 1
-                backoff = (self.cfg.retry_backoff * (2 ** (i - 1))
-                           * (0.5 + self.rng.random01()))
-                await delay(backoff, TaskPriority.PROXY_RESOLVER_REPLY)
-                try:
-                    self._rewarm_device()
-                except error.FDBError as e:
-                    self.stats["rewarm_failures"] += 1
-                    last = e
-                    continue
+            # retry time (backoff + re-warm + the re-dispatch itself) gets
+            # its own span segment so latency attribution charges it to the
+            # fault path, not to the healthy device-dispatch figure
+            t_retry = span_now() if (i and g_spans.enabled) else None
             try:
-                return await self._dispatch_once(transactions, now_v, new_oldest)
-            except error.FDBError as e:
-                self.stats["dispatch_faults"] += 1
-                if self.state == HEALTHY:
-                    self._set_state(SUSPECT)
-                last = e
+                if i:
+                    self.stats["retries"] += 1
+                    self._batch_retries += 1
+                    backoff = (self.cfg.retry_backoff * (2 ** (i - 1))
+                               * (0.5 + self.rng.random01()))
+                    await delay(backoff, TaskPriority.PROXY_RESOLVER_REPLY)
+                    try:
+                        self._rewarm_device()
+                    except error.FDBError as e:
+                        self.stats["rewarm_failures"] += 1
+                        last = e
+                        continue
+                try:
+                    return await self._dispatch_once(transactions, now_v, new_oldest)
+                except error.FDBError as e:
+                    self.stats["dispatch_faults"] += 1
+                    if self.state == HEALTHY:
+                        self._set_state(SUSPECT)
+                    last = e
+            finally:
+                if t_retry is not None and g_spans.enabled:
+                    span_event("resolver.retry", now_v, t_retry, span_now(),
+                               attempt=i)
         raise last if last is not None else error.device_fault("no attempts")
 
     async def _dispatch_once(self, transactions, now_v, new_oldest):
@@ -308,6 +382,7 @@ class ResilientEngine:
         self._set_state(FAILED)
         TraceEvent("ResolverEngineFailover", severity=Severity.WARN) \
             .detail("Version", now_v).detail("ShadowEntries", len(self._shadow)) \
+            .detail("FlightRecorder", self.flight.dump()) \
             .error(err).log()
 
     def _maybe_rewarm(self) -> None:
@@ -332,10 +407,14 @@ class ResilientEngine:
         device is never trusted again this incarnation."""
         self.stats["probe_mismatches"] += 1
         self._set_state(QUARANTINED)
+        # the flight recorder's last N dispatch records ride the SevError:
+        # a post-mortem replays them (digests + journal) without having to
+        # reconstruct the dispatch history from scattered logs
         TraceEvent("ResolverEngineQuarantine", severity=Severity.ERROR) \
             .detail("Version", now_v) \
             .detail("Got", [int(x) for x in got]) \
-            .detail("Want", [int(x) for x in want]).log()
+            .detail("Want", [int(x) for x in want]) \
+            .detail("FlightRecorder", self.flight.dump()).log()
 
     # -- shadow history ------------------------------------------------------
     def _oracle_resolve(self, transactions, now_v, new_oldest):

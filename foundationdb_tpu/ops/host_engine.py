@@ -436,6 +436,17 @@ def wire_chunk_arrays(
     }
 
 
+#: dispatch-ring size of EnginePerf.recent (module-level so the dataclass
+#: default factory stays picklable/simple)
+DISPATCH_RING_SIZE = 64
+
+
+def _dispatch_ring():
+    from collections import deque
+
+    return deque(maxlen=DISPATCH_RING_SIZE)
+
+
 @dataclass
 class EnginePerf:
     """Serving-path performance counters of a bucketed engine, read by
@@ -450,6 +461,18 @@ class EnginePerf:
     scan_dispatches: Dict[int, int] = field(default_factory=dict)
     warmup_ms: float = 0.0
     warmed: bool = False
+    #: flight recorder (docs/observability.md): a bounded ring of recent
+    #: dispatch units — bucket, fused-scan length, txns covered, and the
+    #: force/readback wall ms once the unit was forced. Always on: records
+    #: are tiny dicts in a fixed-size deque, and a device incident report
+    #: needs the dispatches that LED UP to it, which can never be sampled
+    #: after the fact.
+    recent: "deque" = field(default_factory=lambda: _dispatch_ring())
+
+    def record_dispatch(self, bucket: int, scan: int, txns: int) -> dict:
+        rec = {"bucket": bucket, "scan": scan, "txns": txns, "force_ms": None}
+        self.recent.append(rec)
+        return rec
 
     def as_dict(self) -> dict:
         return {
@@ -459,6 +482,7 @@ class EnginePerf:
                                 for k, v in sorted(self.scan_dispatches.items())},
             "warmup_ms": round(self.warmup_ms, 1),
             "warmed": self.warmed,
+            "recent_dispatches": len(self.recent),
         }
 
 
@@ -529,6 +553,12 @@ class RoutedConflictEngineBase:
         self.perf = EnginePerf(
             bucket_hits={b.max_txns: 0 for b in self.buckets})
         self.arena: Optional[HostPackArena] = HostPackArena() if arena else None
+        # unified telemetry (core/telemetry.py): perf counters become
+        # TDMetric series a MetricLogger can persist; registration draws no
+        # rng and costs one list append
+        from ..core import telemetry
+
+        telemetry.hub().register_engine_perf(self.perf, name=self.name)
 
     # -- bucket ladder / program cache --------------------------------------
     def bucket_for(self, n_txns: int, n_reads: int, n_writes: int) -> KernelConfig:
@@ -828,11 +858,14 @@ class RoutedConflictEngineBase:
         Mutates NO engine state, but the packed arrays embed base-relative
         versions: the matching columnar_dispatch must run before any LATER
         batch packs (the ResolverPipeline keeps this ordering)."""
+        from ..core.trace import g_spans, span_event, span_now
+
         cfg = self.cfg
         S = self.n_shards
         ntx = len(transactions)
         if ntx == 0:
             return None
+        t_pack = span_now() if g_spans.enabled else 0.0
         blocks = []
         for tr in transactions:
             blk, all_point, max_len = tr.conflict_wire_info()
@@ -923,7 +956,12 @@ class RoutedConflictEngineBase:
                 )
             chunks.append((per, j - i, bucket, lease))
             i = j
-        return {"chunks": chunks, "new_oldest": new_oldest,
+        if g_spans.enabled:
+            # wall-clock host-pack segment of the engine's columnar fast
+            # path, keyed by the batch's commit version like every other
+            # commit-path span
+            span_event("engine.host_pack", now, t_pack, span_now(), txns=ntx)
+        return {"chunks": chunks, "new_oldest": new_oldest, "now": now,
                 "chunk_buckets": [c[2].max_txns for c in chunks]}
 
     def columnar_dispatch(self, plan: dict):
@@ -945,8 +983,8 @@ class RoutedConflictEngineBase:
         serial path stops at the overflowing chunk); overflow is a fatal
         capacity error in both cases."""
         chunks = plan["chunks"]
-        #: (unit_force, [n_txns per chunk], [leases per chunk])
-        outs: List[Tuple[Callable, List[int], List[Optional[ArenaLease]]]] = []
+        #: (unit_force, [n_txns per chunk], [leases per chunk], flight rec)
+        outs: List[Tuple[Callable, List[int], List[Optional[ArenaLease]], dict]] = []
         i = 0
         while i < len(chunks):
             bucket = chunks[i][2]
@@ -961,8 +999,10 @@ class RoutedConflictEngineBase:
                 unit = self._dispatch_unit(bucket, [ch[0] for ch in sub])
                 self.perf.scan_dispatches[c] = (
                     self.perf.scan_dispatches.get(c, 0) + 1)
+                rec = self.perf.record_dispatch(
+                    bucket.max_txns, c, sum(ch[1] for ch in sub))
                 outs.append((unit, [ch[1] for ch in sub],
-                             [ch[3] for ch in sub]))
+                             [ch[3] for ch in sub], rec))
             i = j
         new_oldest = plan["new_oldest"]
         if new_oldest > self.oldest_version:
@@ -971,10 +1011,19 @@ class RoutedConflictEngineBase:
             self.base += max(0, new_oldest - self.base)
         capacity = self.cfg.capacity
 
+        version = plan.get("now")
+
         def force() -> List[TransactionCommitResult]:
+            from ..core.trace import g_spans, span_event, span_now
+
+            t_force = span_now() if g_spans.enabled else 0.0
             results: List[TransactionCommitResult] = []
-            for unit, ns, leases in outs:
+            for unit, ns, leases, rec in outs:
+                t_unit = time.perf_counter()
                 status, overflow = unit()
+                # flight record completes when the unit's device values land
+                rec["force_ms"] = round(
+                    (time.perf_counter() - t_unit) * 1e3, 4)
                 if overflow:
                     raise error.conflict_capacity_exceeded(
                         f"a shard's boundary table needs > {capacity} rows"
@@ -987,6 +1036,10 @@ class RoutedConflictEngineBase:
                 for lease in leases:
                     if lease is not None:
                         lease.release()
+            if g_spans.enabled:
+                # readback/force segment of the wall-clock engine path
+                span_event("engine.force", version, t_force, span_now(),
+                           units=len(outs))
             return results
 
         return force

@@ -61,9 +61,12 @@ class HarnessResult:
     conflicted: int
     errors: int
     mean_batch_fill: float
+    #: span-based phase decomposition of client-observed latency
+    #: (collect_spans=True; docs/observability.md)
+    attribution: Optional[dict] = None
 
     def as_dict(self) -> dict:
-        return {
+        out = {
             "depth": self.depth,
             "batch_txns": self.batch_txns,
             "device_ms": round(self.device_ms, 4),
@@ -77,6 +80,104 @@ class HarnessResult:
             "errors": self.errors,
             "mean_batch_fill": round(self.mean_batch_fill, 1),
         }
+        if self.attribution is not None:
+            out["attribution"] = self.attribution
+        return out
+
+
+#: the named phase segments a client-observed commit latency decomposes
+#: into (docs/observability.md). Together they PARTITION the submit->reply
+#: interval: batch_wait and the two residuals (resolve_overhead: resolver
+#: RPC time outside the resolver's own spans; reply_net: phase-5 reply
+#: delivery) absorb network/marshalling, so the segment sum equals the
+#: client-observed latency by construction — what the acceptance check
+#: verifies end to end through real span timestamps.
+ATTRIBUTION_SEGMENTS = (
+    "batch_wait",        # client submit -> proxy commit batch dispatched
+    "get_version",       # proxy phase 1: master version fetch (+ batch order)
+    "queue_wait",        # resolver: version chain + service window slot
+    "host_pack",         # resolver service: host pack stage
+    "pipeline_wait",     # resolver service: in-order device chain wait
+    "device_dispatch",   # resolver service: device program (retry share removed)
+    "retry",             # supervisor watchdog retries (fault/resilient.py)
+    "force",             # verdict materialization / readback tail
+    "resolve_overhead",  # resolver RPC residual: network + marshalling
+    "meta_drain",        # proxy phase 3.5: metadata stream drain
+    "log_push",          # proxy phase 4: tlog push (+ logging order wait)
+    "reply_net",         # phase 5 reply delivery back to the client
+)
+
+
+def _attribute(records, by_trace) -> Optional[dict]:
+    """Per-txn phase decomposition from the span record (core/trace.py).
+
+    `records` are steady-window (submit_t, latency_s, committed?, version)
+    acks; `by_trace` maps a commit version to its summed span durations.
+    Only committed acks with a complete span set attribute (a conflict
+    verdict has no CommitReply version to join on)."""
+    rows = []
+    for t0, lat, ok, v in records:
+        if not ok or v is None:
+            continue
+        tr = by_trace.get(v)
+        if tr is None:
+            continue
+        if any(k not in tr for k in ("proxy.commit_batch.t0",
+                                     "proxy.get_version", "proxy.resolve_rpc",
+                                     "proxy.meta_drain", "proxy.log_push")):
+            continue
+        qw = tr.get("resolver.queue_wait", 0.0)
+        hp = tr.get("resolver.host_pack", 0.0)
+        pw = tr.get("resolver.pipeline_wait", 0.0)
+        dd = tr.get("resolver.device_dispatch", 0.0)
+        fc = tr.get("resolver.force", 0.0)
+        rt = tr.get("resolver.retry", 0.0)
+        seg = {
+            "batch_wait": tr["proxy.commit_batch.t0"] - t0,
+            "get_version": tr["proxy.get_version"],
+            "queue_wait": qw,
+            "host_pack": hp,
+            "pipeline_wait": pw,
+            "device_dispatch": dd - rt,
+            "retry": rt,
+            "force": fc,
+            "resolve_overhead": tr["proxy.resolve_rpc"] - (qw + hp + pw + dd + fc),
+            "meta_drain": tr["proxy.meta_drain"],
+            "log_push": tr["proxy.log_push"],
+        }
+        seg["reply_net"] = lat - sum(seg.values())
+        rows.append((lat, seg))
+    if not rows:
+        return None
+    rows.sort(key=lambda r: r[0])
+
+    def at(p: float) -> dict:
+        idx = min(len(rows) - 1, int(p * len(rows)))
+        w = max(1, int(0.02 * len(rows)))
+        sel = rows[max(0, idx - w): idx + w + 1]
+        segs = {k: sum(s[k] for _, s in sel) / len(sel) * 1e3
+                for k in ATTRIBUTION_SEGMENTS}
+        client = sum(l for l, _ in sel) / len(sel) * 1e3
+        total = sum(segs.values())
+        return {
+            "client_ms": round(client, 4),
+            "segments_ms": {k: round(v, 4) for k, v in segs.items()},
+            "sum_ms": round(total, 4),
+            "sum_over_client": round(total / client, 4) if client else None,
+        }
+
+    return {
+        "n_attributed": len(rows),
+        "segments": list(ATTRIBUTION_SEGMENTS),
+        "p50": at(0.50),
+        "p99": at(0.99),
+        "mean": at(0.50) if len(rows) < 3 else {
+            "client_ms": round(sum(l for l, _ in rows) / len(rows) * 1e3, 4),
+            "segments_ms": {
+                k: round(sum(s[k] for _, s in rows) / len(rows) * 1e3, 4)
+                for k in ATTRIBUTION_SEGMENTS},
+        },
+    }
 
 
 def run_latency_under_load(
@@ -100,6 +201,9 @@ def run_latency_under_load(
     batch_interval_ms: Optional[float] = None,
     device_ms_by_bucket: Optional[Dict[int, float]] = None,
     budget_ms: Optional[float] = None,
+    collect_spans: bool = False,
+    engine_factory=None,
+    resilient: bool = False,
 ) -> HarnessResult:
     """One harness point: an e2e sim cluster whose resolver runs the
     pipelined service at `depth` with the given measured service times,
@@ -112,7 +216,15 @@ def run_latency_under_load(
     `writes_per_txn` point writes over a `pool`-key hot pool, snapshots
     from a client-side cached read version refreshed every
     `snapshot_refresh_ms` (a GRV cache, so commit latency is measured
-    from commit submission like the reference's commit budget)."""
+    from commit submission like the reference's commit budget).
+
+    `collect_spans=True` turns on commit-path span collection
+    (core/trace.py) for the run and attaches a `latency_attribution`
+    decomposition to the result: named phase segments that sum to the
+    client-observed latency (docs/observability.md). `engine_factory` /
+    `resilient` override the resolver's conflict engine (e.g. a
+    FaultInjectingEngine under the ResilientEngine supervisor, to measure
+    what watchdog retries do to the decomposition)."""
     # Imported here: the harness pulls in the whole sim cluster, and
     # bench.py imports this module lazily.
     from ..core import buggify
@@ -126,7 +238,11 @@ def run_latency_under_load(
     from ..server.proxy import COMMIT_TOKEN, COMMITTED_VERSION_TOKEN
     from .service import PipelineConfig
 
+    from ..core.trace import g_spans
+    from ..ops.oracle import OracleConflictEngine
+
     sim = Simulator(seed)
+    spans_were_enabled = g_spans.enabled
     # Benchmark profile: no fault injection, fixed datacenter-scale hops
     # (in-rack RTT), NVMe-class tlog fsync, and a device-paced batch
     # deadline. The reference's dynamic batcher tunes its interval to track
@@ -151,6 +267,8 @@ def run_latency_under_load(
         n_resolvers=1,
         n_proxies=1,
         n_storage=2,
+        engine_factory=engine_factory or OracleConflictEngine,
+        resilient_resolver=resilient,
         resolver_pipeline=PipelineConfig(
             depth=depth,
             pack_ms_per_txn=pack_ms_per_txn,
@@ -177,7 +295,8 @@ def run_latency_under_load(
 
     lam = offered_txns_per_sec
     cached_version = [cluster.cfg.start_version]
-    latencies: list = []          # (submit_time, latency_s, committed?)
+    #: (submit_time, latency_s, committed?, commit version | None)
+    latencies: list = []
     counts = {"committed": 0, "conflicted": 0, "errors": 0, "acked": 0}
     done = Promise()
 
@@ -208,11 +327,13 @@ def run_latency_under_load(
 
         t0 = now()
         ok = False
+        version = None
         try:
-            await net.request(client.address, commit_ep,
-                              CommitTransactionRequest(make_txn()),
-                              TaskPriority.PROXY_COMMIT, timeout=30.0)
+            reply = await net.request(client.address, commit_ep,
+                                      CommitTransactionRequest(make_txn()),
+                                      TaskPriority.PROXY_COMMIT, timeout=30.0)
             ok = True
+            version = getattr(reply, "version", None)
             counts["committed"] += 1
         except _error.FDBError as e:
             # a conflict verdict is a real reply (its latency is honest);
@@ -221,7 +342,7 @@ def run_latency_under_load(
                 counts["conflicted"] += 1
             else:
                 counts["errors"] += 1
-        latencies.append((t0, now() - t0, ok))
+        latencies.append((t0, now() - t0, ok, version))
         counts["acked"] += 1
         if counts["acked"] >= n_txns and not done.is_set:
             done.send(None)
@@ -234,6 +355,11 @@ def run_latency_under_load(
                         TaskPriority.DEFAULT_DELAY)
             sim.sched.spawn(one_txn(), TaskPriority.DEFAULT_DELAY)
 
+    if collect_spans:
+        # enabled just before the run (restored in the finally below):
+        # the instrumentation only matters while the sim executes
+        g_spans.enabled = True
+        g_spans.clear()
     try:
         from ..core import error as _error
 
@@ -247,6 +373,12 @@ def run_latency_under_load(
         for name, val in saved_knobs.items():
             SERVER_KNOBS._values[name] = val
         set_scheduler(None)
+        # restore here, not after attribution: an exception mid-run (sim
+        # timeout, cluster build failure) must not leak collection enabled
+        # into the rest of the process; the recorded spans survive for the
+        # attribution pass below
+        if collect_spans:
+            g_spans.enabled = spans_were_enabled
 
     # Steady-state window: drop the warmup head (pipeline fill, empty
     # tables, cold batcher) before computing percentiles and throughput.
@@ -255,13 +387,16 @@ def run_latency_under_load(
     window = latencies[skip:]
     if not window:
         window = latencies
+    attribution = None
+    if collect_spans:
+        attribution = _attribute(window, g_spans.durations_by_trace())
     # Percentiles over EVERY acked reply, committed or conflicted — the
     # same population the sustained rate counts (a conflict verdict rides
     # the full commit path and is an honest client-observed latency).
-    lat_ms = sorted(l * 1e3 for _, l, _ok in window)
+    lat_ms = sorted(l * 1e3 for _, l, _ok, _v in window)
     span = window[-1][0] - window[0][0] if len(window) > 1 else 1.0
     sustained = len(window) / max(span, 1e-9)
-    sustained_committed = sum(1 for _, _, ok in window if ok) / max(span, 1e-9)
+    sustained_committed = sum(1 for _, _, ok, _v in window if ok) / max(span, 1e-9)
 
     def pct(p: float) -> float:
         if not lat_ms:
@@ -284,4 +419,5 @@ def run_latency_under_load(
         conflicted=counts["conflicted"],
         errors=counts["errors"],
         mean_batch_fill=stats.get("txns_in", 0) / n_batches,
+        attribution=attribution,
     )

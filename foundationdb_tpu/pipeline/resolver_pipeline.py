@@ -30,6 +30,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Sequence
 
+from ..core.trace import g_spans, span_event, span_now
 from ..core.types import CommitTransaction, TransactionCommitResult, Version
 
 #: submit-side states of a PendingResolve
@@ -69,6 +70,11 @@ class BudgetBatcher:
         self.alpha = (float(SERVER_KNOBS.resolver_latency_ewma_alpha)
                       if alpha is None else float(alpha))
         self.ewma_ms: Dict[int, float] = dict(seed_ms or {})
+        # unified telemetry (core/telemetry.py): the per-bucket EWMAs the
+        # whole cluster steers by become persistable TDMetric series
+        from ..core import telemetry
+
+        telemetry.hub().register_batcher(self)
 
     def bucket_of(self, n_txns: int) -> int:
         """Smallest ladder bucket holding an n_txns batch (top if none)."""
@@ -274,6 +280,7 @@ class ResolverPipeline:
             self._dispatch(pb)
         if pb._state == _DISPATCHED:
             t0 = time.perf_counter() if self.batcher is not None else 0.0
+            t_span = span_now() if g_spans.enabled else 0.0
             try:
                 pb._result = pb._force()
             except BaseException as e:
@@ -289,6 +296,11 @@ class ResolverPipeline:
                     total = sum(pb._buckets)
                     for t in pb._buckets:
                         self.batcher.observe(t, wall * t / total)
+            if g_spans.enabled:
+                # the wall-clock analog of the sim service's force segment:
+                # host blocked on the dispatched batch's device values
+                span_event("pipeline.force", pb.version, t_span, span_now(),
+                           txns=pb.n_txns)
             pb._force = None
             pb._state = _DONE
 

@@ -29,6 +29,7 @@ from dataclasses import dataclass
 from typing import Dict, Optional
 
 from ..core import buggify
+from ..core.trace import g_spans, span_event, span_now
 from ..sim.actors import NotifiedVersion
 from ..sim.loop import Promise, TaskPriority, delay
 from .resolver_pipeline import BudgetBatcher
@@ -163,10 +164,16 @@ class PipelinedResolverService:
         """Run one accepted batch through pack -> device -> verdicts.
         Callers hold a window slot and enter in commit-version order (the
         resolver's version chain guarantees it); the slot is released here
-        when the batch completes."""
+        when the batch completes. With span collection on (core/trace.py)
+        each stage emits a segment keyed by the commit version: host pack,
+        pipeline wait (the in-order device chain), device dispatch, and the
+        force/verdict-materialization tail — the decomposition bench.py's
+        `latency_attribution` reassembles against client-observed latency."""
         self._seq += 1
         seq = self._seq
+        spans_on = g_spans.enabled
         try:
+            t0 = span_now() if spans_on else 0.0
             pack_ms = self.cfg.pack_ms_per_txn * len(transactions)
             if buggify.buggify():
                 # jittered host pack: batches arrive at the device stage
@@ -174,9 +181,16 @@ class PipelinedResolverService:
                 pack_ms = pack_ms * 5 + 0.05
             if pack_ms > 0:
                 await delay(pack_ms / 1e3, TaskPriority.PROXY_RESOLVER_REPLY)
+            if spans_on:
+                t1 = span_now()
+                span_event("resolver.host_pack", version, t0, t1,
+                           txns=len(transactions))
             await self._device_done.when_at_least(seq - 1)
             from ..sim.loop import now as _now
 
+            if spans_on:
+                t2 = span_now()
+                span_event("resolver.pipeline_wait", version, t1, t2)
             t_dev = _now()
             verdicts = self.engine.resolve(transactions, version, new_oldest)
             if hasattr(verdicts, "__await__"):
@@ -186,6 +200,14 @@ class PipelinedResolverService:
             device_ms = self._device_ms(len(transactions))
             if device_ms > 0:
                 await delay(device_ms / 1e3, TaskPriority.PROXY_RESOLVER_REPLY)
+            if spans_on:
+                t3 = span_now()
+                # the device segment covers the engine dispatch (including
+                # any supervisor watchdog/retry time — the retry share is
+                # emitted separately as resolver.retry by fault/resilient.py)
+                # plus the injected program time for this batch's bucket
+                span_event("resolver.device_dispatch", version, t2, t3,
+                           txns=len(transactions))
             if self.batcher is not None:
                 # observed device-stage time: injected program time plus any
                 # real engine/supervisor stalls (watchdog retries, failover)
@@ -193,6 +215,12 @@ class PipelinedResolverService:
                 self.batcher.observe(
                     self.batcher.bucket_of(len(transactions)),
                     (_now() - t_dev) * 1e3)
+            if spans_on:
+                # verdict materialization / readback tail: zero virtual time
+                # in the sim model (readback rides the injected device
+                # figure); named so the wall-clock pipeline's real force
+                # segment and the sim's line up in attribution output
+                span_event("resolver.force", version, t3, span_now())
             return verdicts
         finally:
             # On any exit (including cancellation mid-wait) unblock the

@@ -26,22 +26,38 @@ SET_TOKEN = "demo.set"
 GET_TOKEN = "demo.get"
 RANGE_TOKEN = "demo.getRange"
 PING_TOKEN = "demo.ping"
+METRICS_TOKEN = "demo.metrics"
 
 
 class DemoKV:
     def __init__(self, proc: RealProcess):
+        from ..core import telemetry
+
         self.proc = proc
         self._d: Dict[bytes, bytes] = {}
+        #: per-op counters in the unified telemetry hub's TDMetric registry
+        #: — served back as a Prometheus-style text exposition on
+        #: METRICS_TOKEN (docs/observability.md), alongside whatever engine
+        #: perf / batcher series this process registered
+        self._td = telemetry.hub().tdmetrics
         proc.register(GET_TOKEN, self.get)
         proc.register(RANGE_TOKEN, self.get_range)
         proc.register(SET_TOKEN, self.set)
         proc.register(PING_TOKEN, self.ping)
+        proc.register(METRICS_TOKEN, self.metrics)
 
     async def ping(self, body):
         return body
 
+    async def metrics(self, _body) -> str:
+        """Prometheus-style text exposition of this process's telemetry."""
+        from ..core import telemetry
+
+        return telemetry.hub().prometheus_text()
+
     async def set(self, body) -> bool:
         k, v = body
+        self._td.int64("demo.sets").increment()
         if v is None:
             self._d.pop(k, None)
         else:
@@ -49,6 +65,7 @@ class DemoKV:
         return True
 
     async def get(self, req: GetValueRequest) -> GetValueReply:
+        self._td.int64("demo.gets").increment()
         return GetValueReply(value=self._d.get(req.key))
 
     async def get_range(self, req: GetKeyValuesRequest) -> GetKeyValuesReply:

@@ -171,6 +171,10 @@ class ClusterController:
                     # = some resolver is retrying/failed over/on probation
                     "resolver_degraded": frag.get("resolvers_degraded", False),
                     "resolver_health": frag.get("resolver_health", {}),
+                    # unified resolver telemetry (docs/observability.md):
+                    # engine perf counters + budget-batcher EWMAs per
+                    # resolver, consumed by `tools/cli.py telemetry`
+                    "resolver_telemetry": frag.get("resolver_telemetry", {}),
                 }
             except error.FDBError:
                 doc["cluster"]["version"] = None

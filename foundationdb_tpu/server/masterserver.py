@@ -781,6 +781,7 @@ class MasterServer:
                 "storage_lag_stale": ratekeeper.lag_stale,
                 "resolvers_degraded": ratekeeper.resolver_degraded,
                 "resolver_health": dict(ratekeeper.resolver_health),
+                "resolver_telemetry": dict(ratekeeper.resolver_telemetry),
                 "tlogs": list(tlog_addrs),
                 "resolvers": list(resolver_addrs),
                 "proxies": list(proxy_addrs),

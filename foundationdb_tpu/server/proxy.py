@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Tuple
 from ..core import buggify, error
 from ..core.knobs import SERVER_KNOBS
 from ..core.stats import CounterCollection
+from ..core.trace import g_spans, span_event, span_now
 from ..core.types import (
     CommitTransaction,
     Key,
@@ -674,6 +675,11 @@ class Proxy:
     async def _commit_batch_impl(self, bn: int, items: List[Tuple[CommitTransaction, Promise]]) -> None:
         cfg = self.cfg
         n_res = len(cfg.resolver_eps)
+        # span anchors (docs/observability.md): the batch's trace id is its
+        # commit version, known only after phase 1 — timestamps are taken
+        # along the way and the spans emitted retroactively
+        spans_on = g_spans.enabled
+        t_start = span_now() if spans_on else 0.0
 
         # ---- Phase 1: take a commit version, in batch order (:361) ----
         await self.batch_resolving.when_at_least(bn - 1)
@@ -689,6 +695,10 @@ class Proxy:
         self._pending_master_req.pop(bn, None)
         prev_v, v = vr.prev_version, vr.version
         self._batch_versions[bn] = (prev_v, v)
+        if spans_on:
+            t_version = span_now()
+            span_event("proxy.get_version", v, t_start, t_version,
+                       parent="proxy.commit_batch")
         rv = getattr(vr, "routing_version", 0)
         if rv and (not self._routing_flips or rv > self._routing_flips[-1][0]):
             self._routing_flips.append((rv, tuple(vr.routing_old_splits),
@@ -761,6 +771,10 @@ class Proxy:
         ]
         self.batch_resolving.advance(bn)
         replies: List[ResolveTransactionBatchReply] = await all_of(resolve_futures)
+        if spans_on:
+            t_resolved = span_now()
+            span_event("proxy.resolve_rpc", v, t_version, t_resolved,
+                       parent="proxy.commit_batch")
 
         # ---- Phase 3: combine votes with min (:489-500) ----
         verdicts: List[int] = []
@@ -779,6 +793,10 @@ class Proxy:
         # (phase 4 below), which this drain consumes — the txnState-tag /
         # ApplyMetadataMutation circuit of the reference.
         await self._drain_metadata(prev_v)
+        if spans_on:
+            t_drained = span_now()
+            span_event("proxy.meta_drain", v, t_resolved, t_drained,
+                       parent="proxy.commit_batch")
 
         # Database lock (lockDatabase / DR switchover): authoritative
         # through prev_v after the drain. User transactions are rejected;
@@ -836,6 +854,12 @@ class Proxy:
         await self.log.push(prev_v, v, messages, self.committed_version.get())
         self._batch_messages.pop(bn, None)
         self.batch_logging.advance(bn)
+        if spans_on:
+            t_logged = span_now()
+            span_event("proxy.log_push", v, t_drained, t_logged,
+                       parent="proxy.commit_batch")
+            span_event("proxy.commit_batch", v, t_start, t_logged,
+                       txns=len(items))
         # Apply our own committed metadata now (idempotent under the later
         # drain): this proxy's location replies must reflect a move it
         # itself just committed.

@@ -98,6 +98,11 @@ class Ratekeeper:
         self.resolver_degraded: bool = False
         #: resolver address -> last reported engine health state
         self.resolver_health: Dict[str, str] = {}
+        #: resolver address -> last reported telemetry fragment (engine
+        #: perf counters, batcher EWMAs — server/resolver.py engine_health):
+        #: rides the same health poll into the master status fragment and
+        #: the CC status document (docs/observability.md)
+        self.resolver_telemetry: Dict[str, dict] = {}
         #: min adaptive batch target across budget-batching resolvers
         #: (pipeline/service.py target_batch_txns); None = none reported
         self.commit_batch_target: Optional[int] = None
@@ -158,10 +163,15 @@ class Ratekeeper:
                 except error.FDBError:
                     # a dead resolver is recovery's problem, not a throttle
                     # signal — but its last health state must not linger in
-                    # the status map as if freshly measured
+                    # the status map as if freshly measured, and neither may
+                    # its telemetry fragment (stale perf counters rendered
+                    # as live would mislead exactly during the incident)
                     self.resolver_health[ep.address] = "unreachable"
+                    self.resolver_telemetry.pop(ep.address, None)
                     continue
                 self.resolver_health[ep.address] = h.get("state", "healthy")
+                if h.get("telemetry"):
+                    self.resolver_telemetry[ep.address] = h["telemetry"]
                 resolver_infos.append(h)
             targets = [h["target_batch_txns"] for h in resolver_infos
                        if h.get("target_batch_txns") is not None]

@@ -16,7 +16,9 @@ from __future__ import annotations
 from typing import Dict, Optional
 
 from ..core import buggify, error
+from ..core import telemetry
 from ..core.stats import CounterCollection
+from ..core.trace import g_spans, span_event, span_now
 from ..core.types import (
     CommitTransaction,
     KeyRange,
@@ -106,8 +108,12 @@ class Resolver:
         self._sample_rng = current_scheduler().rng
         #: reference: Resolver.actor.cpp's resolverCounters via traceCounters
         #: — the logger is a real scheduled task (cancelled on unregister),
-        #: not a dropped coroutine, so resolver counters actually trace
-        self.stats = CounterCollection("Resolver", proc.address)
+        #: not a dropped coroutine, so resolver counters actually trace.
+        #: Counters also feed the unified telemetry hub's TDMetric registry
+        #: (core/telemetry.py), so a MetricLogger persists them alongside
+        #: engine perf / batcher / health series.
+        self.stats = CounterCollection("Resolver", proc.address,
+                                       tdmetrics=telemetry.hub().tdmetrics)
         self._stats_task = spawn(self.stats.run_logger(),
                                  TaskPriority.RESOLUTION_METRICS,
                                  name="resolverStats")
@@ -136,6 +142,24 @@ class Resolver:
         out["resolve_errors"] = self.stats.counter("resolve_errors").value
         if self._service is not None and self._service.batcher is not None:
             out["target_batch_txns"] = self._service.target_batch_txns()
+        # Unified telemetry fragment (docs/observability.md): engine perf
+        # counters and the budget batcher's per-bucket EWMAs ride the same
+        # poll, so they reach the master status fragment -> CC status doc ->
+        # `tools/cli.py telemetry` without a second collection path.
+        tel: Dict[str, dict] = {}
+        perf = getattr(self.engine, "perf", None)
+        if perf is None:
+            # supervised engine: the device under the ResilientEngine
+            perf = getattr(getattr(self.engine, "device", None), "perf", None)
+        if perf is not None:
+            tel["engine_perf"] = perf.as_dict()
+        if self._service is not None and self._service.batcher is not None:
+            tel["batcher"] = self._service.batcher.as_dict()
+        flight = getattr(self.engine, "flight", None)
+        if flight is not None:
+            tel["flight_recorder_entries"] = len(flight)
+        if tel:
+            out["telemetry"] = tel
         return out
 
     def _sample_rows(self, transactions) -> None:
@@ -163,6 +187,9 @@ class Resolver:
 
     async def resolve_batch(self, req: ResolveTransactionBatchRequest) -> ResolveTransactionBatchReply:
         """reference: resolveBatch, Resolver.actor.cpp:71-260."""
+        # span anchor: queue wait = arrival -> the batch holds the version
+        # chain (serial) or a service window slot (pipelined)
+        t_enter = span_now() if g_spans.enabled else 0.0
         if req.version <= self.version.get():
             # Already resolved (proxy retry): replay the recorded verdicts.
             return await self._replay(req.version)
@@ -224,6 +251,9 @@ class Resolver:
             # (nothing awaits between it and the registration here).
             p = Promise()
             self._inflight[req.version] = p
+            if g_spans.enabled:
+                span_event("resolver.queue_wait", req.version,
+                           t_enter, span_now())
             try:
                 verdicts = await self._engine_resolve(
                     transactions, req.version, new_oldest)
@@ -268,6 +298,8 @@ class Resolver:
         p = Promise()
         self._inflight[req.version] = p
         self.version.set(req.version)
+        if g_spans.enabled:
+            span_event("resolver.queue_wait", req.version, t_enter, span_now())
         try:
             verdicts = await self._service.resolve(
                 transactions, req.version, new_oldest)
@@ -301,9 +333,15 @@ class Resolver:
         sites (every dynamic spec wraps engines by default) — not here,
         where a raw-engine fault would need the proxy's retry machinery to
         absorb (direct resolver harnesses have none)."""
+        t0 = span_now() if g_spans.enabled else 0.0
         r = self.engine.resolve(transactions, version, new_oldest)
         if hasattr(r, "__await__"):
             r = await r
+        if g_spans.enabled:
+            # serial path: no service stages, so the whole engine dispatch
+            # is the device segment (pack rides inside it in zero vtime)
+            span_event("resolver.device_dispatch", version, t0, span_now(),
+                       txns=len(transactions))
         return r
 
     def _finish(self, version: Version, verdicts, prepended: bool,

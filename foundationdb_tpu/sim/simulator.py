@@ -43,9 +43,19 @@ class Simulator:
         from .. import fault
 
         fault.reset_registry()
+        from ..core import telemetry
+
+        telemetry.reset()
         if randomize_knobs:
             from ..core import knobs
             knobs.randomize_all(self.sched.rng)
+        # span collection follows the knob (never force-disabled here: a
+        # harness may have enabled collection before building its sim)
+        from ..core.knobs import SERVER_KNOBS
+        from ..core.trace import g_spans
+
+        if float(getattr(SERVER_KNOBS, "trace_span_sample_rate", 0.0)) > 0:
+            g_spans.enabled = True
         self.machines: Dict[str, List[SimProcess]] = {}
         #: address -> its disk; survives kills and reboots (the platters)
         self.disks: Dict[str, SimDisk] = {}

@@ -1319,7 +1319,7 @@ class DeviceFaultValidationWorkload(TestWorkload):
 
     async def check(self, db: Database) -> bool:
         from ..core.trace import Severity, TraceEvent
-        from ..fault import registered_engines
+        from ..fault import abort_set_digest, registered_engines
         from ..ops.oracle import OracleConflictEngine
 
         ok = True
@@ -1337,6 +1337,13 @@ class DeviceFaultValidationWorkload(TestWorkload):
                 ok = False
             if eng.journal is None:
                 continue
+            # flight recorder (docs/observability.md): the incident ring's
+            # abort-set digests must replay — each recorded dispatch's
+            # digest equals the digest of a clean oracle's verdicts for the
+            # same batch (post-mortem parity without the full journal)
+            flight_by_version = {rec["version"]: rec
+                                 for rec in eng.flight.dump()}
+            self.ctx.count("flight_records", len(flight_by_version))
             clean = OracleConflictEngine()
             for version, txns, new_oldest, verdicts in eng.journal:
                 want = clean.resolve(list(txns), version, new_oldest)
@@ -1347,6 +1354,16 @@ class DeviceFaultValidationWorkload(TestWorkload):
                         .detail("Got", list(verdicts)) \
                         .detail("Want", [int(v) for v in want]).log()
                     self.ctx.count("parity_mismatches")
+                    ok = False
+                    break
+                rec = flight_by_version.get(version)
+                if rec is not None and rec["digest"] != abort_set_digest(want):
+                    TraceEvent("FlightRecorderDigestMismatch",
+                               severity=Severity.ERROR) \
+                        .detail("Version", version) \
+                        .detail("Recorded", rec["digest"]) \
+                        .detail("Replayed", abort_set_digest(want)).log()
+                    self.ctx.count("flight_digest_mismatches")
                     ok = False
                     break
         return ok

@@ -34,6 +34,9 @@ commands:
   configure [single|double|triple] [proxies=N] [resolvers=N] [logs=N]
                        change the database configuration (applies at the
                        next recovery; replication drives DD team growth)
+  telemetry [json]     resolver engine telemetry: health, perf counters,
+                       budget-batcher EWMAs (docs/observability.md)
+  telemetry read PROCESS METRIC   read a persisted \\xff/metrics/ series
   help                 this text
   exit                 quit
 Keys/values are text; prefix with 0x for hex bytes."""
@@ -111,6 +114,54 @@ class Cli:
             self._print(f"  recoveries         - {len(hist)} "
                         f"(latest generation {hist[-1][0]})")
         self._print(f"  workers            - {len(c.get('workers', {}))}")
+
+    def do_telemetry(self, args: List[str]) -> None:
+        """Unified resolver telemetry (docs/observability.md): the status
+        document's qos.resolver_telemetry fragment (engine perf counters,
+        budget-batcher EWMAs, health), or a persisted metric series."""
+        if args and args[0] == "read":
+            from ..client.metric_logger import read_metric
+
+            process, metric = args[1], args[2]
+            series = self._drive(read_metric(self.db, process, metric))
+            for t, v in series:
+                self._print(f"  {t:12.3f}  {v}")
+            self._print(f"{len(series)} entr{'y' if len(series) == 1 else 'ies'}")
+            return
+        doc = self._drive(self.db.get_status())
+        if doc is None:
+            self._print("status unavailable (no cluster controller reachable)")
+            return
+        qos = doc.get("qos") or {}
+        tel = qos.get("resolver_telemetry") or {}
+        if args and args[0] == "json":
+            self._print(json.dumps(
+                {"resolver_health": qos.get("resolver_health", {}),
+                 "resolver_telemetry": tel},
+                indent=2, sort_keys=True))
+            return
+        health = qos.get("resolver_health") or {}
+        if not health and not tel:
+            self._print("no resolver telemetry yet (cluster still seeding?)")
+            return
+        for addr in sorted(set(health) | set(tel)):
+            self._print(f"  resolver {addr}: {health.get(addr, '?')}")
+            frag = tel.get(addr) or {}
+            perf = frag.get("engine_perf")
+            if perf:
+                hits = ", ".join(f"{k}:{v}" for k, v in
+                                 sorted(perf.get("bucket_hits", {}).items()))
+                self._print(f"    engine   - compiles {perf.get('compiles')}, "
+                            f"warmed {perf.get('warmed')}, bucket hits {{{hits}}}")
+            b = frag.get("batcher")
+            if b:
+                ewma = ", ".join(f"{k}:{v}ms" for k, v in
+                                 sorted(b.get("ewma_ms", {}).items()))
+                self._print(f"    batcher  - budget {b.get('budget_ms')}ms, "
+                            f"ewma {{{ewma}}}")
+            if "flight_recorder_entries" in frag:
+                self._print(f"    flightrec- {frag['flight_recorder_entries']} "
+                            "recent dispatch records")
 
     def do_get(self, args: List[str]) -> None:
         (key,) = args

@@ -18,6 +18,8 @@ The 3-seed smoke rides tier-1; the full multi-seed campaign is marked
 aggregated into the spec metrics, that failover AND swap-back each
 occurred at least once across their seeds.
 """
+import random
+
 import pytest
 
 from foundationdb_tpu.testing.specs import SPECS
@@ -48,8 +50,96 @@ def _assert_coverage(per_seed):
 
 def test_device_nemesis_smoke():
     """3-seed tier-1 variant: spec passes, abort sets bit-identical, and
-    the failover/swap-back round trip happens at least once."""
-    _assert_coverage([_run(seed) for seed in SMOKE_SEEDS])
+    the failover/swap-back round trip happens at least once. The flight
+    recorder (docs/observability.md) populated on every supervised engine
+    and its digests replayed clean (folded into the spec check)."""
+    per_seed = [_run(seed) for seed in SMOKE_SEEDS]
+    _assert_coverage(per_seed)
+    assert sum(m.get("flight_records", 0) for m in per_seed) > 0, \
+        "flight recorder never populated across the smoke seeds"
+    assert not any(m.get("flight_digest_mismatches") for m in per_seed)
+
+
+def test_quarantine_sev_error_carries_flight_recorder():
+    """A corrupting device's quarantine SevError must carry the last N
+    flight-recorder dispatch records — the dispatches that LED UP to the
+    corruption — and each record's abort-set digest must replay through a
+    clean oracle (the post-mortem a SevError alone never allowed)."""
+    from foundationdb_tpu.core import buggify
+    from foundationdb_tpu.core.knobs import SERVER_KNOBS
+    from foundationdb_tpu.core.trace import g_trace
+    from foundationdb_tpu.core.types import CommitTransaction, KeyRange
+    from foundationdb_tpu.fault import (
+        FaultInjectingEngine, FaultRates, QUARANTINED, ResilienceConfig,
+        ResilientEngine, abort_set_digest)
+    from foundationdb_tpu.ops.oracle import OracleConflictEngine
+    from foundationdb_tpu.sim.loop import set_scheduler
+    from foundationdb_tpu.sim.simulator import Simulator
+
+    sim = Simulator(41)
+    buggify.disable()
+    g_trace.clear()
+    try:
+        dev = FaultInjectingEngine(
+            OracleConflictEngine(),
+            rates=FaultRates(exception=0, hang=0, slow=0, outage=0, flip=0.0))
+        eng = ResilientEngine(dev, ResilienceConfig(
+            dispatch_timeout=0.2, retry_budget=0, retry_backoff=0.02,
+            probe_rate=1.0, probation_batches=2, failover_min_batches=2),
+            record_journal=True)
+        CLEAN_BATCHES = 20
+
+        async def go():
+            rng = random.Random(5)
+            v = 0
+            for i in range(30):
+                if i == CLEAN_BATCHES:
+                    # the device starts corrupting: the NEXT dispatched
+                    # batch flips a verdict and the probe quarantines it
+                    dev.rates.flip = 1.0
+                v += rng.randrange(20, 100)
+                txns = []
+                for _ in range(rng.randrange(1, 6)):
+                    t = CommitTransaction(
+                        read_snapshot=max(0, v - rng.randrange(1, 300)))
+                    for _ in range(rng.randrange(1, 3)):
+                        k = b"q/%03d" % rng.randrange(40)
+                        t.read_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+                        t.write_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+                    txns.append(t)
+                await eng.resolve(txns, v, max(0, v - 1500))
+
+        sim.sched.run_until(sim.sched.spawn(go()), until=1000)
+        assert eng.state == QUARANTINED
+        events = g_trace.find("ResolverEngineQuarantine")
+        assert events, "no quarantine SevError emitted"
+        records = events[0]["FlightRecorder"]
+        assert records, "quarantine event carries no flight-recorder records"
+        ring = int(SERVER_KNOBS.resolver_flight_recorder_size)
+        # the last N dispatches that led up to the corruption, bounded by
+        # the ring knob: all CLEAN_BATCHES clean dispatches are on record
+        assert len(records) <= ring
+        assert len(records) == min(ring, CLEAN_BATCHES)
+        versions = [r["version"] for r in records]
+        assert versions == sorted(versions)
+        for r in records:
+            assert r["txns"] > 0 and r["digest"] and r["state"]
+        # post-mortem parity: replaying the recorded stream through a clean
+        # oracle reproduces every recorded abort-set digest (the emitted
+        # stream was oracle-correct right up to the quarantine)
+        clean = OracleConflictEngine()
+        by_version = {version: (txns, new_oldest)
+                      for version, txns, new_oldest, _verdicts in eng.journal}
+        replayed = 0
+        for version, (txns, new_oldest) in sorted(by_version.items()):
+            want = clean.resolve(list(txns), version, new_oldest)
+            rec = next((r for r in records if r["version"] == version), None)
+            if rec is not None:
+                assert rec["digest"] == abort_set_digest(want), version
+                replayed += 1
+        assert replayed == len(records)
+    finally:
+        set_scheduler(None)
 
 
 @pytest.mark.slow

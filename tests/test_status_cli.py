@@ -134,6 +134,45 @@ def test_cli_commands():
     assert "1 row(s)" in text
 
 
+def test_resolver_telemetry_in_status_and_cli():
+    """The unified telemetry chain (docs/observability.md): the resolver's
+    engine-health telemetry fragment rides ratekeeper -> master status ->
+    CC status doc (qos.resolver_telemetry), and the CLI's `telemetry`
+    subcommand renders it."""
+    c = build_dynamic_cluster(seed=77, cfg=DynamicClusterConfig())
+    sim = c.sim
+    db = c.new_client()
+
+    async def work():
+        from foundationdb_tpu.sim.loop import delay
+
+        for i in range(4):
+            async def w(tr, i=i):
+                tr.set(b"tel%02d" % i, b"v")
+            await db.run(w)
+        await delay(1.0)   # a ratekeeper poll past the traffic
+        return await db.get_status()
+
+    doc = sim.run_until(sim.sched.spawn(work(), name="w"), until=60.0)
+    tel = doc["qos"]["resolver_telemetry"]
+    assert tel, doc["qos"]
+    for addr, frag in tel.items():
+        # the dynamic cluster wraps resolver engines in the supervisor by
+        # default, so the flight-recorder depth reports; oracle engines
+        # have no EnginePerf, so engine_perf is optional here
+        assert frag.get("flight_recorder_entries", 0) > 0, (addr, frag)
+
+    out = io.StringIO()
+    cli = Cli(c, out=out)
+    assert cli.run_command("telemetry")
+    text = out.getvalue()
+    assert "resolver " in text
+    assert "recent dispatch records" in text
+    out.truncate(0)
+    assert cli.run_command("telemetry json")
+    assert "resolver_telemetry" in out.getvalue()
+
+
 def test_counters_in_status():
     """Per-role counters (flow/Stats.h analog) flow into the status doc."""
     c = build_dynamic_cluster(seed=74, cfg=DynamicClusterConfig())

@@ -4,7 +4,7 @@ the database's \\xff/metrics/ keyspace and reconstructable at any time."""
 import pytest
 
 from foundationdb_tpu.client.metric_logger import read_metric, run_metric_logger
-from foundationdb_tpu.core.tdmetric import TDMetricCollection
+from foundationdb_tpu.core.tdmetric import MAX_BUFFERED, TDMetricCollection
 from foundationdb_tpu.server.cluster import (
     DynamicClusterConfig,
     build_dynamic_cluster,
@@ -35,6 +35,77 @@ def test_tdmetric_semantics():
     drained = col.drain_all()
     assert set(drained) == {"proxy.commits", "proxy.events"}
     assert col.drain_all() == {}   # drained
+
+
+def test_record_during_drain_cycle_is_never_dropped():
+    """A metric recorded while the logger is mid-drain-cycle (after
+    drain_all(), while the block write is still in flight) buffers into
+    the fresh list and lands in a later block — the logger's best-effort
+    drop applies only to the drained block itself, never to concurrent
+    records."""
+    t = {"now": 0.0}
+    col = TDMetricCollection(now=lambda: t["now"])
+    m = col.continuous("interleave.events")
+    m.log(1)
+    drained = col.drain_all()
+    assert [v for _t, v in drained["interleave.events"]] == [1]
+    # "during the drain cycle": the drained block is still being written
+    # when new records arrive — they must accumulate for the NEXT drain
+    m.log(2)
+    m.log(3)
+    assert [v for _t, v in m.buffer] == [2, 3]
+    drained2 = col.drain_all()
+    assert [v for _t, v in drained2["interleave.events"]] == [2, 3]
+
+
+def test_record_during_drain_persists_e2e():
+    """Same property through the real logger actor: entries recorded in
+    the window between two drains (i.e. while a drain's transaction may
+    still be committing) all read back from \\xff/metrics/."""
+    c = build_dynamic_cluster(seed=63, cfg=DynamicClusterConfig())
+    sim = c.sim
+    db = c.new_client()
+
+    async def scenario():
+        col = TDMetricCollection(now=lambda: sim.sched.time)
+        m = col.continuous("drainrace.events")
+        spawn(run_metric_logger(db, col, "proc-b", interval=0.4),
+              name="metricLogger")
+        # log continuously at a period incommensurate with the drain
+        # interval so records land at every phase of the drain cycle
+        for i in range(20):
+            m.log(i)
+            await delay(0.13)
+        await delay(3.0)
+        series = await read_metric(db, "proc-b", "drainrace.events")
+        assert [v for _t, v in series] == list(range(20)), series
+        return True
+
+    assert sim.run_until(sim.sched.spawn(scenario(), name="s"), until=300.0)
+
+
+def test_max_buffered_trimming_keeps_newest_entries():
+    """The bounded in-memory buffer drops the OLDEST entries: after
+    overflowing, the buffer holds exactly the newest MAX_BUFFERED."""
+    t = {"now": 0.0}
+    col = TDMetricCollection(now=lambda: t["now"])
+    m = col.continuous("bound.events")
+    extra = 250
+    for i in range(MAX_BUFFERED + extra):
+        t["now"] = float(i)
+        m.log(i)
+    assert len(m.buffer) == MAX_BUFFERED
+    values = [v for _t, v in m.buffer]
+    assert values[0] == extra                      # oldest got trimmed
+    assert values[-1] == MAX_BUFFERED + extra - 1  # newest survived
+    assert values == list(range(extra, MAX_BUFFERED + extra))
+    # levels trim the same way
+    lvl = col.int64("bound.level")
+    for i in range(MAX_BUFFERED + extra):
+        t["now"] = float(i)
+        lvl.set(i + 1)
+    assert len(lvl.buffer) == MAX_BUFFERED
+    assert [v for _t, v in lvl.buffer][-1] == MAX_BUFFERED + extra
 
 
 def test_metric_logger_persists_and_reads_back():
