@@ -253,6 +253,7 @@ def main():
     e2e_pipelined = CFG.max_txns / (max(device_ms_per_batch, host_pack_ms) / 1e3)
     native_cpu = native_baseline_txns_per_sec()
     sharded = sharded_cpu_numbers()
+    floor = history_floor_section()
 
     print(json.dumps({
         "metric": "resolved_txns_per_sec_per_chip",
@@ -274,6 +275,7 @@ def main():
         "sharded_cpu_mesh": sharded,
         "sharded_tpu_weak_scale": weak8,
         "bucket_ladder": ladder,
+        "history_floor": floor,
         "latency_curve": curve,
         "latency_under_load": under_load,
         "latency_attribution": attribution,
@@ -555,6 +557,36 @@ def bucket_ladder_section(smoke: bool = False):
     sec["device_txns_per_sec_by_bucket"] = {
         str(t): round(t / (v / 1e3), 1) for t, v in sorted(dev_ms.items())}
     return sec
+
+
+def history_floor_section(smoke: bool = False):
+    """The history-search floor proof (docs/perf.md "History search
+    modes"): device ms/batch vs boundary-table occupancy n at a FIXED
+    512-txn batch, for both history-query strategies. The fused_sort path
+    re-sorts the capacity-H table with every step — the ~1.1 ms device
+    floor BENCH_r05's latency curve showed at small batches — while
+    bsearch replaces it with a batch-only sort + vectorized binary search
+    whose cost tracks the batch. tools/floor_bench.py owns the
+    methodology (synthesized table at exact occupancy, read-only batches,
+    scan timing, zero-recompile counters); `make bench-smoke` drives the
+    same sweep on CPU."""
+    from foundationdb_tpu.tools.floor_bench import run_floor_sweep
+
+    # pallas is the production fixpoint; the xla fallback keeps the
+    # section alive on backends without the fused kernel (CPU runs) —
+    # the fixpoint choice is mode-independent, so the floor gap it
+    # measures is the same either way
+    for fixpoint in ("pallas", "xla"):
+        cfg = ck.KernelConfig(
+            key_words=4, capacity=CFG.capacity,
+            max_point_reads=1024, max_point_writes=1024,
+            max_reads=64, max_writes=64, max_txns=512, fixpoint=fixpoint,
+        )
+        try:
+            return run_floor_sweep(cfg, scan_steps=64 if smoke else 256)
+        except Exception:
+            continue
+    return None
 
 
 def sharded_cpu_numbers():

@@ -138,6 +138,17 @@ def _make_server_knobs() -> Knobs:
     k.init("resolver_p99_budget_ms", 2.5)
     #: EWMA smoothing for observed per-bucket device latency (0 < a <= 1)
     k.init("resolver_latency_ewma_alpha", 0.25)
+    #: history-query strategy of the conflict kernel (docs/perf.md
+    #: "History search modes"): "fused_sort" re-sorts the capacity-H
+    #: boundary table with every batch; "bsearch" sorts only the batch
+    #: rows and binary-searches the already-sorted table; "auto" (default)
+    #: picks per compiled bucket — bsearch when the batch rows are small
+    #: relative to the table (T << H). Abort sets are bit-identical either
+    #: way (the parity suite cross-checks the modes); this knob only moves
+    #: device time. Engines take a `history_search=` constructor override.
+    #: Deliberately no BUGGIFY randomizer: the modes are proven equivalent
+    #: directly, and a randomizer draw would shift every sim's rng stream.
+    k.init("resolver_history_search_mode", "auto")
     # Observability (docs/observability.md).
     #: commit-path span collection (core/trace.py): 0 disables span
     #: recording entirely — instrumented sites pay one attribute check and

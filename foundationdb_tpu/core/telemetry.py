@@ -116,11 +116,18 @@ class TelemetryHub:
                 td.int64(f"engine.{label}.bucket_hits.{bucket}").set(hits)
             for scan, n in perf.scan_dispatches.items():
                 td.int64(f"engine.{label}.scan_dispatches.{scan}").set(n)
+            # history-search mode picks (docs/perf.md): chunks dispatched
+            # per mode, so `tools/cli.py telemetry` and the Prometheus
+            # exposition surface `search_mode_hits_*` with no extra wiring
+            for mode, n in getattr(perf, "search_mode_hits", {}).items():
+                td.int64(f"engine.{label}.search_mode_hits.{mode}").set(n)
         for label, b in self._live(self._batchers):
             # EWMAs are floats; the Int64 series stores microseconds so the
-            # persisted change history stays integral
-            for bucket, ms in b.ewma_ms.items():
-                td.int64(f"batcher.{label}.ewma_us.{bucket}").set(
+            # persisted change history stays integral. Keys are per
+            # (bucket, history-search mode) — the two modes have different
+            # device-time floors for the same shape
+            for (bucket, mode), ms in b.ewma_ms.items():
+                td.int64(f"batcher.{label}.ewma_us.{bucket}.{mode}").set(
                     int(ms * 1000))
         for label, eng in self._live(self._health):
             st = eng.stats

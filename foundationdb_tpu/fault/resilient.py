@@ -204,6 +204,14 @@ class ResilientEngine:
         fn = getattr(target, "rewarm_target", None)
         return fn() if fn is not None else target
 
+    def history_search_modes(self):
+        """Pass-through to a bucketed device engine's resolved per-bucket
+        history-search modes (docs/perf.md), so a supervised resolver's
+        BudgetBatcher still keys its EWMAs per (bucket, mode); {} for
+        engines without a ladder (the oracle)."""
+        fn = getattr(self._rewarm_engine(), "history_search_modes", None)
+        return fn() if fn is not None else {}
+
     async def resolve(self, transactions, now_v, new_oldest):
         """One batch through the supervisor; callers (server/resolver.py,
         pipeline/service.py) enter strictly in commit-version order."""

@@ -48,6 +48,18 @@ from . import keypack
 
 NEG_VERSION = jnp.int32(-(2**30))
 
+#: history-query strategies of local_phases (docs/perf.md):
+#:   fused_sort — ONE lax.sort of table ++ batch rows yields every lower
+#:                bound positionally (the original path; cost scales with
+#:                the capacity-H table on every step),
+#:   bsearch    — sort ONLY the O(T) batch rows and recover every lower
+#:                bound into hkeys[0:n] with a branchless vectorized
+#:                K-word binary search (cost scales with the batch),
+#:   auto       — pick per config: bsearch when the batch is small
+#:                relative to the table (T << H, i.e. small ladder
+#:                buckets on a large capacity).
+HISTORY_SEARCH_MODES = ("fused_sort", "bsearch", "auto")
+
 
 @dataclass(frozen=True)
 class KernelConfig:
@@ -63,6 +75,11 @@ class KernelConfig:
     #: "pallas" (one fused TPU kernel, fixpoint_pallas.py), or
     #: "pallas_interpret" (the same kernel on the interpreter, for CPU CI)
     fixpoint: str = "xla"
+    #: history-query strategy (HISTORY_SEARCH_MODES); "auto" resolves per
+    #: config at trace time via pick_history_search, so a bucket ladder
+    #: built from an auto config picks bsearch for its small buckets and
+    #: fused_sort for shapes whose batch rivals the table
+    history_search: str = "auto"
 
     @property
     def lanes(self) -> int:     # K: words per packed key incl. length
@@ -135,7 +152,29 @@ class KernelConfig:
             max_point_reads=scale(self.rp),
             max_point_writes=scale(self.wp),
             fixpoint=self.fixpoint,
+            history_search=self.history_search,
         )
+
+
+def pick_history_search(cfg: "KernelConfig") -> str:
+    """The `auto` rule: bsearch when the batch rows are small relative to
+    the boundary table (T << H). The crossover is where the batch-only
+    sort + O(T*K*log H) search beats re-sorting the capacity-H table with
+    the batch: with the fused sort's ~(H+B)*K*log^2(H+B) comparator cost
+    vs the search's B gathers per level, batch rows at <= a quarter of
+    the capacity is comfortably on the search side on both TPU and CPU
+    (tools/floor_bench.py sweeps the actual curve)."""
+    return "bsearch" if cfg.batch_rows * 4 <= cfg.capacity else "fused_sort"
+
+
+def resolved_history_search(cfg: "KernelConfig") -> str:
+    """Concrete mode ("fused_sort" | "bsearch") a given config traces."""
+    mode = cfg.history_search
+    if mode not in HISTORY_SEARCH_MODES:
+        raise ValueError(
+            f"unknown history_search mode {mode!r}; expected one of "
+            f"{HISTORY_SEARCH_MODES}")
+    return pick_history_search(cfg) if mode == "auto" else mode
 
 
 def _key_less(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -167,6 +206,30 @@ def _present(table: jnp.ndarray, q: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
     """1 iff q occurs in the table, given s = lower_bound(q): one row gather.
     upper_bound(q) == s + _present(table, q, s)."""
     return _key_eq(table[s], q).astype(jnp.int32)
+
+
+def _lower_bound(cfg: KernelConfig, hkeys: jnp.ndarray, n: jnp.ndarray,
+                 q: jnp.ndarray) -> jnp.ndarray:
+    """Branchless vectorized K-word binary search: lower_bound of every
+    query row q[i] into the key-sorted valid prefix hkeys[0:n] — the
+    16-way pipelined CheckMax of the reference skip list (SkipList.cpp)
+    recast as `levels` rounds of [Q, K] row gathers, all Q queries probing
+    in lockstep. Invariant per round: the answer lies in [lo, hi]; a
+    converged lane (lo == hi) is frozen by the `active` mask, so
+    cfg.levels (= ceil(log2 H) + 1) unrolled rounds pin every lane.
+    Matches the fused sort's tie discipline exactly: table rows sort AFTER
+    equal batch keys there, so its positional count equals this standard
+    lower bound (first index with hkeys[i] >= q)."""
+    Q = q.shape[0]
+    lo = jnp.zeros((Q,), jnp.int32)
+    hi = jnp.broadcast_to(n.astype(jnp.int32), (Q,))
+    for _ in range(cfg.levels):
+        active = lo < hi
+        mid = (lo + hi) >> 1
+        go_right = _key_less(hkeys[mid], q)
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+    return lo
 
 
 def _build_sparse_max(cfg: KernelConfig, vers: jnp.ndarray, n: jnp.ndarray) -> jnp.ndarray:
@@ -222,16 +285,29 @@ def _pack_bits(bits: jnp.ndarray, n_words: int) -> jnp.ndarray:
 def local_phases(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[str, jnp.ndarray]):
     """Phases 1-2, shard-local: reads vs. history + intra-batch overlap edges.
 
-    ONE fused lax.sort serves the entire step: the boundary table and every
-    batch row sort together, so a single pass yields (a) every lower bound
-    into the table (count of table rows preceding a row's sorted position),
-    (b) endpoint order for range-row overlap tests, and (c) per-key group
-    ids that decide point-vs-point overlap by integer equality — the
-    dominant row class needs no synthesized end rows at all. Tie codes at
-    equal keys (end-read < end-write < begin-write < {begin-read, point} <
-    point-write < table) make position compares exact half-open interval
-    logic, the getCharacter trick (SkipList.cpp:147-177) extended with a
-    point-write level so `range-begin <= point` resolves positionally.
+    Two interchangeable history-query strategies (cfg.history_search,
+    bit-identical outputs — tests/test_kernel_parity.py cross-checks them):
+
+      fused_sort: ONE fused lax.sort serves the entire step — the boundary
+      table and every batch row sort together, so a single pass yields (a)
+      every lower bound into the table (count of table rows preceding a
+      row's sorted position), (b) endpoint order for range-row overlap
+      tests, and (c) per-key group ids that decide point-vs-point overlap
+      by integer equality — the dominant row class needs no synthesized
+      end rows at all.
+
+      bsearch: the table is ALREADY sorted (apply_writes_and_gc emits it
+      key-sorted), so only the O(T) batch rows sort (for (b) and (c)) and
+      (a) comes from a branchless vectorized binary search (_lower_bound)
+      — the per-step fixed cost no longer scales with the capacity-H
+      table, which is what flattens the small-batch device-time floor
+      (docs/perf.md "History search modes").
+
+    Tie codes at equal keys (end-read < end-write < begin-write <
+    {begin-read, point} < point-write < table) make position compares
+    exact half-open interval logic, the getCharacter trick
+    (SkipList.cpp:147-177) extended with a point-write level so
+    `range-begin <= point` resolves positionally.
 
     Returns (hist_hits int32 [T], edges, wpos) where edges holds the
     intra-batch overlap structure — "ovw" uint32 [r_all, wr_words] (reads
@@ -282,71 +358,146 @@ def local_phases(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[s
     H = cfg.capacity
     empty_r = ~_key_less(rb, re)
 
-    # ---- THE fused sort: table ++ batch rows, one pass ----
-    # Tie codes at equal keys (ascending): end-read 0, end-write 1,
-    # begin-write 2, begin-read/point-read 3, point-write 4, table 5.
-    # Table rows sort after every equal batch key, so
-    #   lower_bound(row) = # valid table rows before row's sorted position
-    # for every batch row at once. bump(rb) rows ride along only to provide
-    # upper_bound(rb) for non-empty range reads' history query.
-    #
-    # Operand packing: invalid rows carry all-ones key words (no real key
-    # reaches length 2^32-1, so they sort after everything), and the tie
-    # code + original index share one word (code in the high bits; the
-    # composite is unique per row, so the order is total and no separate
-    # stability payload is needed). 6 sort operands instead of 8 — the
-    # sort is the step's dominant cost and scales with operand width.
-    groups = (
-        (rpb, 3, rp_valid),       # point reads
-        (rb, 3, r_valid),         # range-read begins
-        (re, 0, r_valid),         # range-read ends
-        (_bump(rb), 0, r_valid),  # upper-bound probes for range reads
-        (wpb, 4, wp_valid),       # point writes
-        (wb, 2, w_valid),         # range-write begins
-        (we, 1, w_valid),         # range-write ends
-    )
-    bkeys = jnp.concatenate([g[0] for g in groups], axis=0)
-    B = bkeys.shape[0]
-    bcode = jnp.concatenate(
-        [jnp.full((g[0].shape[0],), g[1], jnp.uint32) for g in groups])
-    bvalid = jnp.concatenate([g[2] for g in groups])
-    N = H + B
-    idx_bits = max(1, (N - 1).bit_length())
-    keys_all = jnp.concatenate([hkeys, bkeys], axis=0)
-    code_all = jnp.concatenate([jnp.full((H,), 5, jnp.uint32), bcode])
-    valid_all = jnp.concatenate([jnp.arange(H) < n, bvalid])
-    keys_eff = jnp.where(valid_all[:, None], keys_all, jnp.uint32(0xFFFFFFFF))
-    idx = jnp.arange(N, dtype=jnp.uint32)
-    codeidx = (jnp.where(valid_all, code_all, jnp.uint32(7)) << idx_bits) | idx
-    ops = tuple(keys_eff[:, c] for c in range(K)) + (codeidx,)
-    s = lax.sort(ops, num_keys=K + 1)
-    sidx = s[K] & jnp.uint32((1 << idx_bits) - 1)
-    skeys = jnp.stack(s[:K], axis=1)
-    pos = jnp.zeros((N,), jnp.int32).at[sidx].set(jnp.arange(N, dtype=jnp.int32))
+    mode = resolved_history_search(cfg)
+    if mode == "fused_sort":
+        # ---- THE fused sort: table ++ batch rows, one pass ----
+        # Tie codes at equal keys (ascending): end-read 0, end-write 1,
+        # begin-write 2, begin-read/point-read 3, point-write 4, table 5.
+        # Table rows sort after every equal batch key, so
+        #   lower_bound(row) = # valid table rows before row's sorted position
+        # for every batch row at once. bump(rb) rows ride along only to provide
+        # upper_bound(rb) for non-empty range reads' history query.
+        #
+        # Operand packing: invalid rows carry all-ones key words (no real key
+        # reaches length 2^32-1, so they sort after everything), and the tie
+        # code + original index share one word (code in the high bits; the
+        # composite is unique per row, so the order is total and no separate
+        # stability payload is needed). 6 sort operands instead of 8 — the
+        # sort is the step's dominant cost and scales with operand width.
+        groups = (
+            (rpb, 3, rp_valid),       # point reads
+            (rb, 3, r_valid),         # range-read begins
+            (re, 0, r_valid),         # range-read ends
+            (_bump(rb), 0, r_valid),  # upper-bound probes for range reads
+            (wpb, 4, wp_valid),       # point writes
+            (wb, 2, w_valid),         # range-write begins
+            (we, 1, w_valid),         # range-write ends
+        )
+        bkeys = jnp.concatenate([g[0] for g in groups], axis=0)
+        B = bkeys.shape[0]
+        bcode = jnp.concatenate(
+            [jnp.full((g[0].shape[0],), g[1], jnp.uint32) for g in groups])
+        bvalid = jnp.concatenate([g[2] for g in groups])
+        N = H + B
+        idx_bits = max(1, (N - 1).bit_length())
+        keys_all = jnp.concatenate([hkeys, bkeys], axis=0)
+        code_all = jnp.concatenate([jnp.full((H,), 5, jnp.uint32), bcode])
+        valid_all = jnp.concatenate([jnp.arange(H) < n, bvalid])
+        keys_eff = jnp.where(valid_all[:, None], keys_all, jnp.uint32(0xFFFFFFFF))
+        idx = jnp.arange(N, dtype=jnp.uint32)
+        codeidx = (jnp.where(valid_all, code_all, jnp.uint32(7)) << idx_bits) | idx
+        ops = tuple(keys_eff[:, c] for c in range(K)) + (codeidx,)
+        s = lax.sort(ops, num_keys=K + 1)
+        sidx = s[K] & jnp.uint32((1 << idx_bits) - 1)
+        skeys = jnp.stack(s[:K], axis=1)
+        pos = jnp.zeros((N,), jnp.int32).at[sidx].set(jnp.arange(N, dtype=jnp.int32))
 
-    # Lower bounds: inclusive cumsum of valid-table rows in sorted order;
-    # a batch row contributes 0, so gathering at its position counts exactly
-    # the table rows before it.
-    is_tab = (sidx < H) & (sidx.astype(jnp.int32) < n)
-    cum_tab = jnp.cumsum(is_tab.astype(jnp.int32))
-    # Per-key group ids: a new group starts where the sorted key differs
-    # from its predecessor. Point-point overlap is gid equality — no end
-    # rows, no position algebra, for the dominant row class.
-    prev = jnp.concatenate([skeys[:1] + 1, skeys[:-1]], axis=0)
-    gid_sorted = jnp.cumsum(jnp.any(skeys != prev, axis=-1).astype(jnp.int32))
+        # Lower bounds: inclusive cumsum of valid-table rows in sorted order;
+        # a batch row contributes 0, so gathering at its position counts exactly
+        # the table rows before it.
+        is_tab = (sidx < H) & (sidx.astype(jnp.int32) < n)
+        cum_tab = jnp.cumsum(is_tab.astype(jnp.int32))
+        # Per-key group ids: a new group starts where the sorted key differs
+        # from its predecessor. Point-point overlap is gid equality — no end
+        # rows, no position algebra, for the dominant row class.
+        prev = jnp.concatenate([skeys[:1] + 1, skeys[:-1]], axis=0)
+        gid_sorted = jnp.cumsum(jnp.any(skeys != prev, axis=-1).astype(jnp.int32))
 
-    bpos = pos[H:]
-    lb = cum_tab[bpos]
-    gid = gid_sorted[bpos]
-    o = 0
-    pos_rpb, lb_rp, gid_rp = bpos[o:o + Rp], lb[o:o + Rp], gid[o:o + Rp]; o += Rp
-    pos_rb, lb_rb = bpos[o:o + Rr], lb[o:o + Rr]; o += Rr
-    pos_re, s_re = bpos[o:o + Rr], lb[o:o + Rr]; o += Rr
-    lb_rbb = lb[o:o + Rr]; o += Rr                     # lower(bump(rb))
-    pos_wpb, s_wpb, gid_wp = bpos[o:o + Wp], lb[o:o + Wp], gid[o:o + Wp]; o += Wp
-    pos_wb, s_wb = bpos[o:o + Wr], lb[o:o + Wr]; o += Wr
-    pos_we, s_we = bpos[o:o + Wr], lb[o:o + Wr]
-    s_rp = lb_rp
+        bpos = pos[H:]
+        lb = cum_tab[bpos]
+        gid = gid_sorted[bpos]
+        o = 0
+        pos_rpb, lb_rp, gid_rp = bpos[o:o + Rp], lb[o:o + Rp], gid[o:o + Rp]; o += Rp
+        pos_rb, lb_rb = bpos[o:o + Rr], lb[o:o + Rr]; o += Rr
+        pos_re, s_re = bpos[o:o + Rr], lb[o:o + Rr]; o += Rr
+        lb_rbb = lb[o:o + Rr]; o += Rr                     # lower(bump(rb))
+        pos_wpb, s_wpb, gid_wp = bpos[o:o + Wp], lb[o:o + Wp], gid[o:o + Wp]; o += Wp
+        pos_wb, s_wb = bpos[o:o + Wr], lb[o:o + Wr]; o += Wr
+        pos_we, s_we = bpos[o:o + Wr], lb[o:o + Wr]
+        s_rp = lb_rp
+    else:
+        # ---- batch-only sort + vectorized binary search ----
+        # apply_writes_and_gc emits the boundary table fully key-sorted, so
+        # re-sorting it with every batch (the fused path) pays an
+        # O((H+T)*K*log(H+T)) fixed floor per step regardless of batch
+        # size. Search-in-sorted-structure instead: sort ONLY the O(T)
+        # batch rows (same tie-code comparator, minus the table level and
+        # the bump probes — those rows existed purely to read lower bounds
+        # off the fused order) and recover every lower bound into
+        # hkeys[0:n] with _lower_bound, O(T*K*log H).
+        #
+        # Bit-exactness: intra-batch positional compares and per-key group
+        # ids only ever relate batch rows to batch rows, and removing the
+        # interleaved table/bump rows preserves both the relative order of
+        # the remaining rows (keys, then the same code ladder, then
+        # original index — group order here matches the fused operand
+        # order) and key-equality classes; the searched lower bounds equal
+        # the fused path's positional counts because table rows sort AFTER
+        # equal batch keys there (see _lower_bound). Everything downstream
+        # — wpos, both phases, the fixpoint — is byte-for-byte shared.
+        groups = (
+            (rpb, 3, rp_valid),       # point reads
+            (rb, 3, r_valid),         # range-read begins
+            (re, 0, r_valid),         # range-read ends
+            (wpb, 4, wp_valid),       # point writes
+            (wb, 2, w_valid),         # range-write begins
+            (we, 1, w_valid),         # range-write ends
+        )
+        bkeys = jnp.concatenate([g[0] for g in groups], axis=0)
+        B = bkeys.shape[0]
+        bcode = jnp.concatenate(
+            [jnp.full((g[0].shape[0],), g[1], jnp.uint32) for g in groups])
+        bvalid = jnp.concatenate([g[2] for g in groups])
+        idx_bits = max(1, (B - 1).bit_length())
+        keys_eff = jnp.where(bvalid[:, None], bkeys, jnp.uint32(0xFFFFFFFF))
+        idx = jnp.arange(B, dtype=jnp.uint32)
+        codeidx = (jnp.where(bvalid, bcode, jnp.uint32(7)) << idx_bits) | idx
+        ops = tuple(keys_eff[:, c] for c in range(K)) + (codeidx,)
+        s = lax.sort(ops, num_keys=K + 1)
+        sidx = s[K] & jnp.uint32((1 << idx_bits) - 1)
+        skeys = jnp.stack(s[:K], axis=1)
+        pos = jnp.zeros((B,), jnp.int32).at[sidx].set(jnp.arange(B, dtype=jnp.int32))
+        prev = jnp.concatenate([skeys[:1] + 1, skeys[:-1]], axis=0)
+        gid_sorted = jnp.cumsum(jnp.any(skeys != prev, axis=-1).astype(jnp.int32))
+        gid = gid_sorted[pos]
+
+        # One packed search serves every query class (invalid rows keep the
+        # all-ones override so their lower bound lands at n, exactly the
+        # fused path's count). bump(rb) is searched directly — no probe
+        # rows ride through the sort.
+        qvalid = jnp.concatenate(
+            [rp_valid, r_valid, r_valid, r_valid, wp_valid, w_valid, w_valid])
+        qkeys = jnp.concatenate(
+            [rpb, rb, _bump(rb), re, wpb, wb, we], axis=0)
+        q_eff = jnp.where(qvalid[:, None], qkeys, jnp.uint32(0xFFFFFFFF))
+        lb = _lower_bound(cfg, hkeys, n, q_eff)
+
+        o = 0
+        pos_rpb, gid_rp = pos[o:o + Rp], gid[o:o + Rp]; o += Rp
+        pos_rb = pos[o:o + Rr]; o += Rr
+        pos_re = pos[o:o + Rr]; o += Rr
+        pos_wpb, gid_wp = pos[o:o + Wp], gid[o:o + Wp]; o += Wp
+        pos_wb = pos[o:o + Wr]; o += Wr
+        pos_we = pos[o:o + Wr]
+        o = 0
+        lb_rp = lb[o:o + Rp]; o += Rp
+        lb_rb = lb[o:o + Rr]; o += Rr
+        lb_rbb = lb[o:o + Rr]; o += Rr                     # lower(bump(rb))
+        s_re = lb[o:o + Rr]; o += Rr
+        s_wpb = lb[o:o + Wp]; o += Wp
+        s_wb = lb[o:o + Wr]; o += Wr
+        s_we = lb[o:o + Wr]
+        s_rp = lb_rp
 
     # Equality gathers (one table row each) derive every upper bound:
     eq_rp = _present(hkeys, rpb, s_rp)

@@ -459,6 +459,13 @@ class EnginePerf:
     bucket_hits: Dict[int, int] = field(default_factory=dict)
     #: dispatches per fused-scan length (1 = single-chunk program)
     scan_dispatches: Dict[int, int] = field(default_factory=dict)
+    #: resolved history-search mode per bucket T (docs/perf.md): what the
+    #: `resolver_history_search_mode` knob / auto rule picked at ladder
+    #: build — the mode each compiled program actually traces
+    search_modes: Dict[int, str] = field(default_factory=dict)
+    #: chunks dispatched per history-search mode (the mode-pick counters
+    #: core/telemetry.py exports as `search_mode_hits.*`)
+    search_mode_hits: Dict[str, int] = field(default_factory=dict)
     warmup_ms: float = 0.0
     warmed: bool = False
     #: flight recorder (docs/observability.md): a bounded ring of recent
@@ -474,12 +481,19 @@ class EnginePerf:
         self.recent.append(rec)
         return rec
 
+    def record_search_mode(self, bucket: int, chunks: int) -> None:
+        mode = self.search_modes.get(bucket, "fused_sort")
+        self.search_mode_hits[mode] = self.search_mode_hits.get(mode, 0) + chunks
+
     def as_dict(self) -> dict:
         return {
             "compiles": self.compiles,
             "bucket_hits": {str(k): v for k, v in sorted(self.bucket_hits.items())},
             "scan_dispatches": {str(k): v
                                 for k, v in sorted(self.scan_dispatches.items())},
+            "search_modes": {str(k): v
+                             for k, v in sorted(self.search_modes.items())},
+            "search_mode_hits": dict(sorted(self.search_mode_hits.items())),
             "warmup_ms": round(self.warmup_ms, 1),
             "warmed": self.warmed,
             "recent_dispatches": len(self.recent),
@@ -520,9 +534,11 @@ class RoutedConflictEngineBase:
     def __init__(self, cfg: KernelConfig, shards: KeyShardMap,
                  ladder: Optional[Sequence[int]] = None,
                  scan_sizes: Sequence[int] = (2, 4, 8),
-                 arena: bool = True):
+                 arena: bool = True,
+                 history_search: Optional[str] = None):
         # Subclasses seed their device state (incl. any initial version, as a
         # base-relative offset) via _reset_device_state.
+        cfg = self._resolve_history_search(cfg, history_search)
         self.cfg = cfg
         self.shards = shards
         self.n_shards = shards.n_shards
@@ -551,7 +567,9 @@ class RoutedConflictEngineBase:
         #: (bucket_T, n_chunks) -> device program (engine-specific handle)
         self._programs: Dict[Tuple[int, int], Any] = {}
         self.perf = EnginePerf(
-            bucket_hits={b.max_txns: 0 for b in self.buckets})
+            bucket_hits={b.max_txns: 0 for b in self.buckets},
+            search_modes={b.max_txns: ck.resolved_history_search(b)
+                          for b in self.buckets})
         self.arena: Optional[HostPackArena] = HostPackArena() if arena else None
         # unified telemetry (core/telemetry.py): perf counters become
         # TDMetric series a MetricLogger can persist; registration draws no
@@ -559,6 +577,37 @@ class RoutedConflictEngineBase:
         from ..core import telemetry
 
         telemetry.hub().register_engine_perf(self.perf, name=self.name)
+
+    # -- history search mode (docs/perf.md) ---------------------------------
+    @staticmethod
+    def _resolve_history_search(cfg: KernelConfig, requested: Optional[str]) -> KernelConfig:
+        """Fold the mode request into the config the ladder is built from.
+        Precedence: explicit constructor argument > a non-auto
+        cfg.history_search > the `resolver_history_search_mode` knob. The
+        result may still be "auto": the per-bucket pick then happens at
+        trace time (small buckets on a large capacity go bsearch)."""
+        from ..core.knobs import SERVER_KNOBS
+
+        mode = requested
+        if mode is None:
+            mode = cfg.history_search
+        if mode == "auto":
+            mode = str(getattr(SERVER_KNOBS, "resolver_history_search_mode",
+                               "auto") or "auto").strip()
+        if mode not in ck.HISTORY_SEARCH_MODES:
+            raise ValueError(
+                f"unknown history search mode {mode!r}; expected one of "
+                f"{ck.HISTORY_SEARCH_MODES}")
+        if mode == cfg.history_search:
+            return cfg
+        import dataclasses
+
+        return dataclasses.replace(cfg, history_search=mode)
+
+    def history_search_modes(self) -> Dict[int, str]:
+        """Resolved history-search mode per ladder bucket {T: mode} — what
+        BudgetBatcher keys its per-(bucket, mode) EWMAs by."""
+        return dict(self.perf.search_modes)
 
     # -- bucket ladder / program cache --------------------------------------
     def bucket_for(self, n_txns: int, n_reads: int, n_writes: int) -> KernelConfig:
@@ -994,6 +1043,7 @@ class RoutedConflictEngineBase:
             run = chunks[i:j]
             self.perf.bucket_hits[bucket.max_txns] = (
                 self.perf.bucket_hits.get(bucket.max_txns, 0) + len(run))
+            self.perf.record_search_mode(bucket.max_txns, len(run))
             for c in self._split_run(len(run)):
                 sub, run = run[:c], run[c:]
                 unit = self._dispatch_unit(bucket, [ch[0] for ch in sub])
@@ -1051,6 +1101,9 @@ class RoutedConflictEngineBase:
         S = self.n_shards
         n = len(routed)
         assert n <= cfg.max_txns
+        # general-router chunks always run the top shape; count its mode
+        # pick so the telemetry counters cover the slow path too
+        self.perf.record_search_mode(cfg.max_txns, 1)
 
         too_old = np.zeros((cfg.max_txns,), bool)
         t_ok = np.zeros((cfg.max_txns,), bool)
@@ -1240,9 +1293,11 @@ class SubshardedConflictEngine(RoutedConflictEngineBase):
                  initial_version: Version = 0,
                  ladder: Optional[Sequence[int]] = None,
                  scan_sizes: Sequence[int] = (2, 4, 8),
-                 arena: bool = True):
+                 arena: bool = True,
+                 history_search: Optional[str] = None):
         super().__init__(cfg, shards, ladder=ladder, scan_sizes=scan_sizes,
-                         arena=arena)
+                         arena=arena, history_search=history_search)
+        cfg = self.cfg   # base resolved the history-search mode into it
         self._reset_device_state(initial_version)
         self.tier_map = VersionIntervalMap(initial_version)
         self._detect = jax.jit(functools.partial(ck.detect_step_stacked, cfg))
@@ -1328,9 +1383,12 @@ class JaxConflictEngine(RoutedConflictEngineBase):
     def __init__(self, cfg: KernelConfig = KernelConfig(), initial_version: Version = 0,
                  ladder: Optional[Sequence[int]] = None,
                  scan_sizes: Sequence[int] = (2, 4, 8),
-                 arena: bool = True):
+                 arena: bool = True,
+                 history_search: Optional[str] = None):
         super().__init__(cfg, KeyShardMap([]), ladder=ladder,
-                         scan_sizes=scan_sizes, arena=arena)
+                         scan_sizes=scan_sizes, arena=arena,
+                         history_search=history_search)
+        cfg = self.cfg   # base resolved the history-search mode into it
         self.state = ck.initial_state(cfg, version_rel=initial_version)
         self.tier_map = VersionIntervalMap(initial_version)
         # Split-step programs for the long-key tier path, compiled lazily

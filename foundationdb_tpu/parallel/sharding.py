@@ -190,6 +190,7 @@ class ShardedConflictEngine(RoutedConflictEngineBase):
         ladder=None,
         scan_sizes=(2, 4, 8),
         arena: bool = True,
+        history_search=None,
     ):
         if mesh is None:
             devs = jax.devices()
@@ -197,7 +198,9 @@ class ShardedConflictEngine(RoutedConflictEngineBase):
             mesh = jax.make_mesh((n,), ("shard",), devices=devs[:n])
         (n_devices,) = mesh.devices.shape
         super().__init__(cfg, shards or KeyShardMap.uniform(n_devices),
-                         ladder=ladder, scan_sizes=scan_sizes, arena=arena)
+                         ladder=ladder, scan_sizes=scan_sizes, arena=arena,
+                         history_search=history_search)
+        cfg = self.cfg   # base resolved the history-search mode into it
         assert self.n_shards == n_devices
         self.mesh = mesh
         self._sharding = NamedSharding(mesh, P("shard"))

@@ -201,6 +201,7 @@ def run_latency_under_load(
     batch_interval_ms: Optional[float] = None,
     device_ms_by_bucket: Optional[Dict[int, float]] = None,
     budget_ms: Optional[float] = None,
+    search_mode_by_bucket: Optional[Dict[int, str]] = None,
     collect_spans: bool = False,
     engine_factory=None,
     resilient: bool = False,
@@ -280,6 +281,9 @@ def run_latency_under_load(
             # commit batches to the largest in-budget bucket
             device_ms_by_bucket=device_ms_by_bucket,
             p99_budget_ms=budget_ms,
+            # per-(bucket, mode) EWMA keying (docs/perf.md history search
+            # modes); None = whatever the resolver engine reports
+            search_mode_by_bucket=search_mode_by_bucket,
         ),
         max_commit_batch=batch_txns,
         # One slot beyond the service depth: `depth` batches in service at

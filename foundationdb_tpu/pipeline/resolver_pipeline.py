@@ -54,11 +54,21 @@ class BudgetBatcher:
     Shared by the wall-clock ResolverPipeline (observing force() wall
     times) and the sim PipelinedResolverService (observing virtual-time
     service delays); seed_ms pre-loads bench-measured device times so the
-    first batches are not sized blind."""
+    first batches are not sized blind.
+
+    EWMAs are keyed per (bucket, history-search mode): the two kernel
+    history paths (docs/perf.md "History search modes") have genuinely
+    different device-time floors for the same bucket shape, so a mode
+    change (knob flip, engine rebuild under a different pick) must not
+    poison the other mode's estimate. `bucket_modes` maps each bucket to
+    its engine's resolved mode (RoutedConflictEngineBase
+    .history_search_modes()); unmapped buckets default to "fused_sort",
+    the pre-ladder behavior."""
 
     def __init__(self, ladder: Sequence[int], budget_ms: Optional[float] = None,
                  pack_ms_per_txn: float = 0.0, alpha: Optional[float] = None,
-                 seed_ms: Optional[Dict[int, float]] = None):
+                 seed_ms: Optional[Dict[int, float]] = None,
+                 bucket_modes: Optional[Dict[int, str]] = None):
         from ..core.knobs import SERVER_KNOBS
 
         self.ladder = sorted(set(int(t) for t in ladder))
@@ -69,12 +79,35 @@ class BudgetBatcher:
         self.pack_ms_per_txn = pack_ms_per_txn
         self.alpha = (float(SERVER_KNOBS.resolver_latency_ewma_alpha)
                       if alpha is None else float(alpha))
-        self.ewma_ms: Dict[int, float] = dict(seed_ms or {})
+        self.bucket_modes: Dict[int, str] = {
+            int(t): str(m) for t, m in (bucket_modes or {}).items()}
+        #: (bucket, mode) -> EWMA of observed service ms
+        self.ewma_ms: Dict[Tuple[int, str], float] = {
+            (int(t), self.mode_of(int(t))): float(v)
+            for t, v in (seed_ms or {}).items()}
         # unified telemetry (core/telemetry.py): the per-bucket EWMAs the
         # whole cluster steers by become persistable TDMetric series
         from ..core import telemetry
 
         telemetry.hub().register_batcher(self)
+
+    def mode_of(self, bucket: int) -> str:
+        """The history-search mode a bucket's observations file under."""
+        return self.bucket_modes.get(bucket, "fused_sort")
+
+    def set_bucket_modes(self, modes: Dict[int, str]) -> None:
+        """Adopt an engine's resolved per-bucket modes. A seed recorded
+        under a bucket's PREVIOUS mode migrates iff the new mode has no
+        estimate of its own — a seed is 'this bucket's best prior', while
+        a real observation under the old mode stays where it belongs."""
+        for t, m_new in modes.items():
+            t = int(t)
+            m_old = self.mode_of(t)
+            self.bucket_modes[t] = str(m_new)
+            old_key, new_key = (t, m_old), (t, str(m_new))
+            if old_key != new_key and old_key in self.ewma_ms \
+                    and new_key not in self.ewma_ms:
+                self.ewma_ms[new_key] = self.ewma_ms.pop(old_key)
 
     def bucket_of(self, n_txns: int) -> int:
         """Smallest ladder bucket holding an n_txns batch (top if none)."""
@@ -83,16 +116,20 @@ class BudgetBatcher:
                 return t
         return self.ladder[-1]
 
-    def observe(self, bucket: int, service_ms: float) -> None:
-        cur = self.ewma_ms.get(bucket)
-        self.ewma_ms[bucket] = (service_ms if cur is None
-                                else cur + self.alpha * (service_ms - cur))
+    def observe(self, bucket: int, service_ms: float,
+                mode: Optional[str] = None) -> None:
+        key = (bucket, mode if mode is not None else self.mode_of(bucket))
+        cur = self.ewma_ms.get(key)
+        self.ewma_ms[key] = (service_ms if cur is None
+                             else cur + self.alpha * (service_ms - cur))
 
-    def predicted_ms(self, bucket: int, depth: int) -> Optional[float]:
+    def predicted_ms(self, bucket: int, depth: int,
+                     mode: Optional[str] = None) -> Optional[float]:
         """Client-visible latency estimate at `depth` in flight: own pack +
         up to `depth` device services ahead of the verdict (the in-order
-        device chain). None until the bucket has an observation."""
-        dev = self.ewma_ms.get(bucket)
+        device chain). None until the (bucket, mode) has an observation."""
+        dev = self.ewma_ms.get(
+            (bucket, mode if mode is not None else self.mode_of(bucket)))
         if dev is None:
             return None
         return self.pack_ms_per_txn * bucket + max(1, depth) * dev
@@ -116,8 +153,10 @@ class BudgetBatcher:
             "ladder": list(self.ladder),
             "budget_ms": self.budget_ms,
             "pack_ms_per_txn": round(self.pack_ms_per_txn, 6),
-            "ewma_ms": {str(t): round(v, 4)
-                        for t, v in sorted(self.ewma_ms.items())},
+            "bucket_modes": {str(t): m
+                             for t, m in sorted(self.bucket_modes.items())},
+            "ewma_ms": {f"{t}:{m}": round(v, 4)
+                        for (t, m), v in sorted(self.ewma_ms.items())},
         }
 
 
@@ -190,9 +229,13 @@ class ResolverPipeline:
         self._queue: deque = deque()
         self._can_overlap = hasattr(engine, "columnar_pack")
         #: budget-driven batch sizing: when set, force() wall times feed the
-        #: per-bucket EWMA and suggested_batch_txns() tracks the largest
-        #: in-budget bucket (callers size their submissions to it)
+        #: per-(bucket, mode) EWMA and suggested_batch_txns() tracks the
+        #: largest in-budget bucket (callers size their submissions to it)
         self.batcher = batcher
+        if batcher is not None and hasattr(engine, "history_search_modes"):
+            # the engine is the authority on which history-search mode each
+            # bucket's compiled program traces; observations file under it
+            batcher.set_bucket_modes(engine.history_search_modes())
 
     def suggested_batch_txns(self) -> Optional[int]:
         if self.batcher is None:

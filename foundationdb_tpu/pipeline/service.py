@@ -58,6 +58,12 @@ class PipelineConfig:
                           predicted latency fits p99_budget_ms.
     p99_budget_ms       — commit-latency budget the adaptive target fits
                           (None = the resolver_p99_budget_ms knob).
+    search_mode_by_bucket — resolved history-search mode per bucket
+                          {T: "fused_sort" | "bsearch"} (docs/perf.md;
+                          an engine's history_search_modes()). Keys the
+                          BudgetBatcher's per-(bucket, mode) EWMAs so a
+                          mode flip never poisons the other mode's
+                          latency estimate.
     """
 
     depth: int = 2
@@ -66,6 +72,7 @@ class PipelineConfig:
     max_batch_txns: int = 4096
     device_ms_by_bucket: Optional[Dict[int, float]] = None
     p99_budget_ms: Optional[float] = None
+    search_mode_by_bucket: Optional[Dict[int, str]] = None
 
     def as_dict(self) -> dict:
         return {"depth": self.depth,
@@ -74,7 +81,10 @@ class PipelineConfig:
                 "max_batch_txns": self.max_batch_txns,
                 "device_ms_by_bucket": (dict(self.device_ms_by_bucket)
                                         if self.device_ms_by_bucket else None),
-                "p99_budget_ms": self.p99_budget_ms}
+                "p99_budget_ms": self.p99_budget_ms,
+                "search_mode_by_bucket": (dict(self.search_mode_by_bucket)
+                                          if self.search_mode_by_bucket
+                                          else None)}
 
 
 class PipelinedResolverService:
@@ -94,12 +104,16 @@ class PipelinedResolverService:
         #: point the proxy's commit batcher is capped to (via ratekeeper)
         self.batcher: Optional[BudgetBatcher] = None
         if cfg.device_ms_by_bucket:
+            bucket_modes = dict(cfg.search_mode_by_bucket or {})
+            if not bucket_modes and hasattr(engine, "history_search_modes"):
+                bucket_modes = engine.history_search_modes()
             self.batcher = BudgetBatcher(
                 ladder=list(cfg.device_ms_by_bucket),
                 budget_ms=cfg.p99_budget_ms,
                 pack_ms_per_txn=cfg.pack_ms_per_txn,
                 seed_ms={int(t): float(v)
                          for t, v in cfg.device_ms_by_bucket.items()},
+                bucket_modes=bucket_modes,
             )
 
     @property

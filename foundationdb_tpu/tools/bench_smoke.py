@@ -10,7 +10,11 @@ Runs the same code paths as bench.py's perf sections at toy sizes:
   * latency_under_load — a mini latency curve through the e2e sim
     cluster (pipeline/latency_harness.py) with INJECTED device times and
     a per-bucket ladder table, production point filtered by the
-    resolver_p99_budget_ms knob.
+    resolver_p99_budget_ms knob;
+  * history_floor — the occupancy sweep of tools/floor_bench.py at toy
+    sizes, asserting ZERO post-warmup compiles for BOTH history-search
+    modes (docs/perf.md "History search modes") and cross-mode abort-set
+    parity on a driven batch stream.
 
 Prints one JSON line; any failed check exits non-zero. Device timings on
 the CPU backend are meaningless and deliberately not asserted — this
@@ -46,6 +50,44 @@ def main() -> int:
     if not ladder["scan_dispatches"].get("2"):
         failures.append("multi-chunk batch never took a fused-scan dispatch")
 
+    # History-search floor (docs/perf.md): both modes warmed, then timed
+    # with the REAL jax compile counter listening — any post-warmup
+    # compile (or an unavailable counter) fails the smoke. CPU timings are
+    # not asserted; the wiring and the zero-recompile claim are.
+    from foundationdb_tpu.tools.floor_bench import run_floor_sweep
+
+    floor = run_floor_sweep(occupancy_fracs=(0.25, 0.75), scan_steps=24)
+    comp = floor.get("steady_state_compiles")
+    if comp is None:
+        failures.append("history_floor: jax compile counter unavailable")
+    else:
+        for mode, cnt in sorted(comp.items()):
+            if cnt:
+                failures.append(
+                    f"history_floor {mode}: {cnt} post-warmup compiles")
+    if floor["auto_pick"] != "bsearch":
+        failures.append(
+            f"history_floor: auto picked {floor['auto_pick']} for a batch "
+            "far under capacity (expected bsearch)")
+    # cross-mode abort-set parity on a driven engine stream (the tier-1
+    # suite covers this broadly; the smoke keeps a canary in CI's quick lane)
+    from foundationdb_tpu.ops.host_engine import JaxConflictEngine
+    from foundationdb_tpu.tools.ladder_bench import make_point_txns
+    import numpy as np
+
+    engines = {m: JaxConflictEngine(cfg, history_search=m)
+               for m in ("fused_sort", "bsearch")}
+    rng = np.random.default_rng(11)
+    version = 500
+    for n in (16, 64, 128):
+        txns = make_point_txns(n, 256, rng, version)
+        version += 200
+        got = {m: [int(x) for x in e.resolve(txns, version, version - 400)]
+               for m, e in engines.items()}
+        if got["fused_sort"] != got["bsearch"]:
+            failures.append(f"history-search cross-mode mismatch at n={n}")
+            break
+
     # Mini latency curve: injected service times (the harness's time model
     # is virtual), bucket table + budget knob exactly as bench.py wires
     # them. Offered load near each shape's device-paced capacity.
@@ -75,7 +117,8 @@ def main() -> int:
 
     out = {"metric": "bench_smoke", "ok": not failures,
            "failures": failures,
-           "bucket_ladder": ladder, "latency_under_load": under_load}
+           "bucket_ladder": ladder, "history_floor": floor,
+           "latency_under_load": under_load}
     print(json.dumps(out))
     return 1 if failures else 0
 

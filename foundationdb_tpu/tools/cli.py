@@ -153,6 +153,11 @@ class Cli:
                                  sorted(perf.get("bucket_hits", {}).items()))
                 self._print(f"    engine   - compiles {perf.get('compiles')}, "
                             f"warmed {perf.get('warmed')}, bucket hits {{{hits}}}")
+                modes = perf.get("search_mode_hits") or {}
+                if modes:
+                    picks = ", ".join(f"{k}:{v}" for k, v in
+                                      sorted(modes.items()))
+                    self._print(f"    search   - mode hits {{{picks}}}")
             b = frag.get("batcher")
             if b:
                 ewma = ", ".join(f"{k}:{v}ms" for k, v in

@@ -1,0 +1,215 @@
+"""History-search floor micro-driver (docs/perf.md "History search modes").
+
+Sweeps device ms/batch vs boundary-table occupancy `n` at a FIXED batch
+shape, for both history-query strategies of `ops/conflict_kernel.py`:
+`fused_sort` re-sorts the capacity-H table together with the batch every
+step, so its per-batch device time carries a floor set by H regardless of
+batch size; `bsearch` sorts only the batch rows and binary-searches the
+already-sorted table, so its time tracks the batch. This sweep makes that
+floor visible and drift-checkable: bench.py's `history_floor` section runs
+it at the production capacity on the real chip, and `make bench-smoke`
+(tools/bench_smoke.py) runs the same code at toy sizes on the CPU backend
+with a zero-recompile assertion (real jax monitoring counters) for both
+modes after warmup.
+
+Methodology: the boundary table is synthesized directly at each target
+occupancy (sorted distinct packed keys at version 0) and the driven
+batches carry valid point READS only — the kernel's shapes are fixed, so
+row validity does not change device cost, and a write-free gc=0 batch
+leaves the table untouched: every timed step runs at exactly the target
+`n`. Timing is the scan methodology of bench.py (one compiled lax.scan of
+resolve_steps, device-resident operands, warm run first).
+
+    JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.floor_bench
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops import conflict_kernel as ck
+from ..ops import keypack
+
+#: CPU-sized default sweep shape: batch far under capacity so both the
+#: auto rule and the floor gap are visible in seconds, not minutes
+SMOKE_CFG = ck.KernelConfig(key_words=4, capacity=4096, max_txns=128,
+                            max_point_reads=256, max_point_writes=256,
+                            max_reads=32, max_writes=32)
+
+
+def _table_state(cfg: ck.KernelConfig, n: int) -> Dict[str, jnp.ndarray]:
+    """A boundary table holding exactly n sorted, distinct keys (version
+    0) — zero-padded decimal keys are byte-ordered, and keypack preserves
+    byte order, so the packed rows are already table-sorted."""
+    hkeys = np.zeros((cfg.capacity, cfg.lanes), np.uint32)
+    if n:
+        hkeys[:n] = keypack.pack_keys(
+            [b"fl/%08d" % i for i in range(n)], cfg.key_words)
+    hvers = np.full((cfg.capacity,), int(ck.NEG_VERSION), np.int32)
+    hvers[:n] = 0
+    return {"hkeys": jnp.asarray(hkeys), "hvers": jnp.asarray(hvers),
+            "n": jnp.asarray(n, jnp.int32)}
+
+
+def _read_batch(cfg: ck.KernelConfig, rng: np.random.Generator,
+                n: int) -> Dict[str, np.ndarray]:
+    """One full batch of valid point reads over the table's own keys
+    (snapshots above every stored version: nothing aborts, nothing is
+    written, the table stays at occupancy n across every scanned step)."""
+    K = cfg.lanes
+    Rp, Wp, T = cfg.rp, cfg.wp, cfg.max_txns
+    Rr, Wr = cfg.max_reads, cfg.max_writes
+    rpb = np.zeros((Rp, K), np.uint32)
+    rpb[:] = keypack.pack_keys(
+        [b"fl/%08d" % i for i in rng.integers(0, max(1, n), size=Rp)],
+        cfg.key_words)
+    return {
+        "rpb": rpb,
+        "rp_snap": np.full((Rp,), 100, np.int32),
+        "rp_txn": np.sort(rng.integers(0, T, size=Rp)).astype(np.int32),
+        "rp_valid": np.ones((Rp,), bool),
+        "rb": np.zeros((Rr, K), np.uint32),
+        "re": np.zeros((Rr, K), np.uint32),
+        "r_snap": np.zeros((Rr,), np.int32),
+        "r_txn": np.zeros((Rr,), np.int32),
+        "r_valid": np.zeros((Rr,), bool),
+        "wpb": np.zeros((Wp, K), np.uint32),
+        "wp_txn": np.zeros((Wp,), np.int32),
+        "wp_valid": np.zeros((Wp,), bool),
+        "wb": np.zeros((Wr, K), np.uint32),
+        "we": np.zeros((Wr, K), np.uint32),
+        "w_txn": np.zeros((Wr,), np.int32),
+        "w_valid": np.zeros((Wr,), bool),
+        "t_ok": np.ones((T,), bool),
+        "t_too_old": np.zeros((T,), bool),
+        "now": np.asarray(200, np.int32),
+        "gc": np.asarray(0, np.int32),
+    }
+
+
+class _CompileCounter:
+    """Counts real backend compiles via jax monitoring events (the same
+    counter tests/test_bucket_ladder.py pins tier-1 on); degrades to
+    None when the private monitoring module moves."""
+
+    def __init__(self) -> None:
+        self.events = 0
+        self._mon = None
+        try:
+            from jax._src import monitoring
+
+            self._mon = monitoring
+        except Exception:
+            return
+        self._cb = self._on_event
+        self._mon.register_event_listener(self._cb)
+
+    def _on_event(self, name, **kw):
+        if "compil" in name:
+            self.events += 1
+
+    def close(self) -> Optional[int]:
+        if self._mon is None:
+            return None
+        self._mon._unregister_event_listener_by_callback(self._cb)
+        return self.events
+
+
+def run_floor_sweep(
+    cfg: Optional[ck.KernelConfig] = None,
+    *,
+    occupancy_fracs: Sequence[float] = (0.25, 0.5, 0.75),
+    scan_steps: int = 128,
+    seed: int = 2026,
+) -> Dict:
+    """The `history_floor` section: device ms/batch at each occupancy for
+    both modes, plus the post-warmup steady-state compile count per mode
+    (must be 0 — a timed run that still compiles is measuring the
+    compiler)."""
+    cfg = cfg or SMOKE_CFG
+    rng = np.random.default_rng(seed)
+    runs = []   # (mode, frac, n, jitted_run, device_state)
+    for mode in ("fused_sort", "bsearch"):
+        mcfg = dataclasses.replace(cfg, history_search=mode)
+        for frac in occupancy_fracs:
+            n = max(1, int(frac * cfg.capacity))
+            batch = jax.device_put(_read_batch(cfg, rng, n))
+
+            def step(st, _, _cfg=mcfg, _batch=batch):
+                st, out = ck.resolve_step(_cfg, st, _batch)
+                return st, out["n"]
+
+            run = jax.jit(
+                lambda st, _step=step: lax.scan(_step, st, jnp.arange(scan_steps)))
+            runs.append((mode, frac, n, run, jax.device_put(_table_state(cfg, n))))
+
+    # warm every program first (compile + first execution), THEN time under
+    # the compile listener: any event in the timed phase is a retrace the
+    # warmup was supposed to make impossible
+    states = {}
+    for mode, frac, n, run, state in runs:
+        st, ns = run(state)
+        np.asarray(ns)
+        states[(mode, frac)] = st
+
+    compiles = {"fused_sort": 0, "bsearch": 0}
+    ms: Dict[tuple, float] = {}
+    monitored = True
+    for mode, frac, n, run, _state in runs:
+        counter = _CompileCounter()
+        t0 = time.perf_counter()
+        st, ns = run(states[(mode, frac)])
+        final_n = int(np.asarray(ns)[-1])
+        ms[(mode, frac)] = (time.perf_counter() - t0) / scan_steps * 1e3
+        assert final_n == n, f"occupancy drifted: {final_n} != {n}"
+        seen = counter.close()
+        if seen is None:
+            monitored = False
+        else:
+            compiles[mode] += seen
+
+    points = []
+    for frac in occupancy_fracs:
+        fused = ms[("fused_sort", frac)]
+        bs = ms[("bsearch", frac)]
+        points.append({
+            "occupancy_frac": frac,
+            "n": max(1, int(frac * cfg.capacity)),
+            "fused_sort_ms": round(fused, 4),
+            "bsearch_ms": round(bs, 4),
+            "bsearch_speedup": round(fused / bs, 3) if bs > 0 else None,
+        })
+    return {
+        "batch_txns": cfg.max_txns,
+        "capacity": cfg.capacity,
+        "auto_pick": ck.pick_history_search(cfg),
+        "scan_steps": scan_steps,
+        "points": points,
+        #: post-warmup compiles per mode; None when the jax monitoring
+        #: hook is unavailable (bench-smoke then fails its assertion
+        #: loudly rather than passing vacuously)
+        "steady_state_compiles": compiles if monitored else None,
+    }
+
+
+def main() -> int:
+    out = run_floor_sweep(scan_steps=48)
+    print(json.dumps({"metric": "history_floor", **out}))
+    comp = out["steady_state_compiles"]
+    if comp and any(comp.values()):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -60,6 +60,43 @@ def test_device_nemesis_smoke():
     assert not any(m.get("flight_digest_mismatches") for m in per_seed)
 
 
+def test_device_nemesis_bsearch_engine():
+    """DeviceNemesis once with the TPU kernel engine forced onto the
+    bsearch history path (docs/perf.md): attrition + clogging + dispatch
+    faults over a JaxConflictEngine with history_search="bsearch", the
+    DeviceFaultValidationWorkload replaying every journal through a clean
+    oracle — the mode must stay bit-identical through failover, shadow
+    rebuild and swap-back, not just on the happy path."""
+    from foundationdb_tpu.testing.specs import SPECS
+
+    def bsearch_factory():
+        from foundationdb_tpu.fault import (FaultInjectingEngine,
+                                            ResilienceConfig, ResilientEngine)
+        from foundationdb_tpu.ops.conflict_kernel import KernelConfig
+        from foundationdb_tpu.ops.host_engine import JaxConflictEngine
+
+        cfg = KernelConfig(key_words=4, capacity=1024, max_reads=256,
+                           max_writes=256, max_txns=64)
+        return ResilientEngine(
+            FaultInjectingEngine(JaxConflictEngine(
+                cfg, history_search="bsearch")),
+            ResilienceConfig(dispatch_timeout=0.3, retry_budget=1,
+                             retry_backoff=0.05, probe_rate=0.1,
+                             probation_batches=2, failover_min_batches=2),
+            record_journal=True)
+
+    spec = SPECS["DeviceNemesis"]()
+    spec.dynamic.engine_factory = bsearch_factory
+    res = run_spec(spec, SMOKE_SEEDS[0])
+    assert res.ok, (
+        "bsearch nemesis failed; replay with the bsearch factory at seed "
+        f"{SMOKE_SEEDS[0]}")
+    assert not res.metrics.get("parity_mismatches"), res.metrics
+    assert not res.metrics.get("engine_probe_mismatches"), res.metrics
+    assert not res.metrics.get("flight_digest_mismatches"), res.metrics
+    assert res.metrics.get("engine_dispatch_faults", 0) > 0
+
+
 def test_quarantine_sev_error_carries_flight_recorder():
     """A corrupting device's quarantine SevError must carry the last N
     flight-recorder dispatch records — the dispatches that LED UP to the

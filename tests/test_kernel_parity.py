@@ -3,6 +3,8 @@
 This is the round-1 analog of the reference's oracle strategy
 (SlowConflictSet, fdbserver/SkipList.cpp:59-88): every engine must produce
 identical verdict streams on randomized workloads."""
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -12,6 +14,10 @@ from foundationdb_tpu.ops.conflict_kernel import JaxConflictEngine, KernelConfig
 from foundationdb_tpu.ops.oracle import OracleConflictEngine
 
 SMALL = KernelConfig(key_words=2, capacity=512, max_reads=128, max_writes=128, max_txns=32)
+#: the two concrete history-query strategies (docs/perf.md); SMALL's own
+#: default is "auto", which resolves to fused_sort at this shape
+BSEARCH = dataclasses.replace(SMALL, history_search="bsearch")
+FUSED = dataclasses.replace(SMALL, history_search="fused_sort")
 
 
 def random_key(rng: DeterministicRandom, alphabet=b"ab\x00\xff", maxlen=6) -> bytes:
@@ -65,6 +71,40 @@ def test_random_parity(seed):
 
 def test_random_parity_empty_reads():
     assert run_stream(99, allow_empty_reads=True)
+
+
+@pytest.mark.parametrize("seed", [41, 42, 43])
+def test_random_parity_bsearch(seed):
+    """The batch-only-sort + binary-search history path vs the oracle on
+    the same randomized mixed point/range workloads as the fused path."""
+    assert run_stream(seed, cfg=BSEARCH)
+
+
+def test_random_parity_bsearch_empty_reads():
+    assert run_stream(98, allow_empty_reads=True, cfg=BSEARCH)
+
+
+def test_history_search_cross_mode_identical():
+    """fused_sort and bsearch verdict streams must be bit-identical on one
+    shared randomized stream — empty-range reads allowed, GC horizon
+    advancing on ~30% of batches (gc=0 / gc>0 interleaved) — with the
+    oracle as a third witness so a shared defect cannot hide."""
+    rng = DeterministicRandom(77)
+    fused = JaxConflictEngine(FUSED)
+    bsearch = JaxConflictEngine(BSEARCH)
+    oracle = OracleConflictEngine()
+    now, oldest = 10, 0
+    for b in range(40):
+        now += rng.random_int(1, 30)
+        if rng.random01() < 0.3:
+            oldest = max(oldest, now - rng.random_int(20, 120))
+        txns = [random_txn(rng, oldest, now, allow_empty_reads=True)
+                for _ in range(rng.random_int(1, 13))]
+        want = oracle.resolve(txns, now, oldest)
+        got_f = fused.resolve(txns, now, oldest)
+        got_b = bsearch.resolve(txns, now, oldest)
+        assert got_b == want, f"batch {b}"
+        assert got_f == got_b, f"batch {b}"
 
 
 def test_parity_hot_key_contention():
