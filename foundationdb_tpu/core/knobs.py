@@ -149,6 +149,19 @@ def _make_server_knobs() -> Knobs:
     #: Deliberately no BUGGIFY randomizer: the modes are proven equivalent
     #: directly, and a randomizer draw would shift every sim's rng stream.
     k.init("resolver_history_search_mode", "auto")
+    #: device-resident resolver loop (docs/perf.md "Device-resident
+    #: loop"), consulted by the engine-mode router
+    #: (host_engine.default_engine_mode — wall-clock nodes pick it up via
+    #: `real/node.py --engine auto`): "" keeps step dispatch; "on" routes
+    #: the single-chip engine through ops/device_loop.DeviceLoopEngine
+    #: (persistent on-device server step, double-buffered queue,
+    #: non-blocking result-ring drain); "pallas" additionally bakes the
+    #: fused Pallas commit fixpoint (ops/fixpoint_pallas.py) into every
+    #: loop body, with the interpreter fallback off-TPU. Abort sets are bit-identical in every
+    #: mode (tests/test_device_loop.py); this knob only moves per-batch
+    #: host/dispatch time. Deliberately no BUGGIFY randomizer: the modes
+    #: are proven equivalent directly, and a draw would shift sim rng.
+    k.init("resolver_device_loop", "")
     # Observability (docs/observability.md).
     #: commit-path span collection (core/trace.py): 0 disables span
     #: recording entirely — instrumented sites pay one attribute check and

@@ -121,14 +121,21 @@ class TelemetryHub:
             # exposition surface `search_mode_hits_*` with no extra wiring
             for mode, n in getattr(perf, "search_mode_hits", {}).items():
                 td.int64(f"engine.{label}.search_mode_hits.{mode}").set(n)
+            # dispatch mode (docs/perf.md "Device-resident loop"): step vs
+            # loop chunk counts, same frontends as the search-mode picks
+            for mode, n in getattr(perf, "dispatch_mode_hits", {}).items():
+                td.int64(f"engine.{label}.dispatch_mode_hits.{mode}").set(n)
         for label, b in self._live(self._batchers):
             # EWMAs are floats; the Int64 series stores microseconds so the
             # persisted change history stays integral. Keys are per
-            # (bucket, history-search mode) — the two modes have different
-            # device-time floors for the same shape
-            for (bucket, mode), ms in b.ewma_ms.items():
-                td.int64(f"batcher.{label}.ewma_us.{bucket}.{mode}").set(
-                    int(ms * 1000))
+            # (bucket, history-search mode, dispatch mode) — search modes
+            # have different device-time floors for the same shape, and
+            # the device loop removes per-batch dispatch cost the step
+            # path pays (docs/perf.md)
+            for (bucket, mode, dispatch), ms in b.ewma_ms.items():
+                td.int64(
+                    f"batcher.{label}.ewma_us.{bucket}.{mode}.{dispatch}"
+                ).set(int(ms * 1000))
         for label, eng in self._live(self._health):
             st = eng.stats
             for key in ("batches", "dispatch_faults", "retries", "failovers",

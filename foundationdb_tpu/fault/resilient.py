@@ -481,6 +481,10 @@ class ResilientEngine:
         window >= oldest_version (exactly what the shadow keeps) decides
         verdicts (the same argument that makes the oracle's GC
         representation-only)."""
+        # A device-loop engine's clear() drains its in-flight queue slots
+        # before touching the donated table (ops/device_loop.py enforces
+        # the drain-before-host-touch contract engine-side), so this
+        # rebuild needs no engine-specific handling.
         eng.clear(0)
         if self._oldest:
             # pin the too-old gate first; per-entry horizons below it are

@@ -1,0 +1,367 @@
+"""Device-resident resolver loop: a persistent on-device batch server.
+
+PR 5 left the production point (512-txn batches, ~1.46 ms end to end)
+dispatch-shaped, not compute-shaped: every batch still paid a host->device
+program launch plus a BLOCKING readback of its verdicts before the next
+batch could advance. This module moves the steady state onto the device —
+the SmartNIC-DPA move from PAPERS.md (push ordered-KV conflict work next
+to the data path; the host does I/O only), Harmonia's "stop synchronizing
+with the coordinator per request" applied to the accelerator link:
+
+  * the interval-table state lives on device and is owned by the server
+    step `conflict_kernel.resolve_server_loop` — a `lax.while_loop` that
+    consumes the filled prefix of a Q-chunk packed batch queue slot under
+    ONE dispatch (chunk count is a runtime scalar, so one AOT program per
+    ladder bucket serves every fill level; state is donated to the step
+    off-CPU, so the table never round-trips);
+  * a DOUBLE-BUFFERED device queue: `LoopSlotPool` keeps `queue_depth`
+    pinned host slot buffer sets per bucket shape — while slot A's
+    program runs asynchronously on the device, the host packs the next
+    batch's columns into slot B (`HostPackArena` feeds the chunk arrays;
+    the slot copy is the enqueue's device_put payload). A slot is reused
+    only after its program's outputs landed — the zero-copy keepalive
+    contract, enforced by the pool;
+  * a RESULT RING the host drains WITHOUT forcing a sync per batch: the
+    server step emits packed abort bitmaps (committed/too-old bit planes,
+    `status_words` — a 16x smaller readback than [T] int32 statuses),
+    and `poll()` decodes exactly the ready prefix via the non-blocking
+    `jax.Array.is_ready()` probe. Steady-state host work per batch is
+    therefore: pack columns into a slot, dispatch (async), poll.
+
+Sync accounting (the "zero blocking host syncs" acceptance):
+`loop_stats` counts every drain by kind — `drained_nonblocking` (result
+was ready when the host looked), `forced_waits` (the host needed a result
+that had not landed yet and poll-waited for readiness — the depth-1 /
+drain path), and `blocking_syncs` (the poll-wait deadline expired and the
+host fell back to a genuinely blocking device sync; 0 in any healthy
+run). `make bench-smoke` asserts blocking_syncs == 0 and a fully
+non-blocking drain of a pipelined drive; tools/floor_bench.run_loop_floor
+compares per-batch host time step vs loop at the production point.
+
+Failure/rebuild contract (docs/fault_tolerance.md): `drain_loop()` blocks
+until every in-flight slot's results landed and runs before anything
+touches the donated table from the host — enforced ENGINE-SIDE inside
+`clear()` (which is how `fault/resilient.py`'s shadow rebuild quiesces
+the loop before replaying the committed write history into it) and the
+split-step long-key path, so callers never carry the invariant. Failover
+collapses to step dispatch: the ResilientEngine's CPU oracle serves while
+the loop's table is rebuilt, bit-identically (tests/test_device_loop.py).
+
+Exactness: the loop body IS resolve_step — same programs phase for phase
+— and the bitmap decode is the same pure function of (committed,
+t_too_old) as `status_of`, so abort sets are bit-identical to the
+step-dispatch engines and the CPU oracle (the parity suite drives both
+across bucket boundaries, GC cadences and failover).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.knobs import SERVER_KNOBS
+from ..core.types import TransactionCommitResult, Version
+from . import conflict_kernel as ck
+from .conflict_kernel import KernelConfig
+from .host_engine import JaxConflictEngine, donate_state_kwargs
+
+#: legal values of the `resolver_device_loop` knob: "" (off — the router
+#: keeps step dispatch), "on" (loop engine, xla fixpoint), "pallas" (loop
+#: engine with the fused Pallas fixpoint baked into every loop body —
+#: interpreter fallback off-TPU, where the 0.4.3x dtype workaround in
+#: ops/fixpoint_pallas.py applies)
+DEVICE_LOOP_MODES = ("", "on", "pallas")
+
+
+def device_loop_requested() -> bool:
+    """True iff the `resolver_device_loop` knob asks for the loop engine."""
+    return bool(_loop_knob())
+
+
+def _loop_knob() -> str:
+    raw = str(getattr(SERVER_KNOBS, "resolver_device_loop", "") or "").strip()
+    if raw not in DEVICE_LOOP_MODES:
+        raise ValueError(
+            f"unknown resolver_device_loop mode {raw!r}; expected one of "
+            f"{DEVICE_LOOP_MODES}")
+    return raw
+
+
+def loop_kernel_config(cfg: KernelConfig) -> KernelConfig:
+    """Fold the `resolver_device_loop` knob into the loop engine's config:
+    "pallas" revives ops/fixpoint_pallas.py inside the loop bodies — the
+    fused sort+search+fixpoint chain runs as resolve_step's phases with
+    the commit fixpoint a single fused kernel instead of ~5 launch-bound
+    while_loop iterations. Off-TPU the interpreter fallback applies (the
+    int32-cast workaround makes it run rather than xfail); an explicit
+    non-xla cfg.fixpoint is always respected."""
+    if _loop_knob() != "pallas" or cfg.fixpoint != "xla":
+        return cfg
+    from . import fixpoint_pallas as fp
+
+    if not fp.supported(cfg):
+        return cfg
+    fixpoint = ("pallas" if jax.default_backend() == "tpu"
+                else "pallas_interpret")
+    return dataclasses.replace(cfg, fixpoint=fixpoint)
+
+
+def decode_status_bits(commit_words: np.ndarray, too_words: np.ndarray,
+                       n_txns: int) -> np.ndarray:
+    """[C, status_words] committed/too-old bit planes -> [C, T] int32
+    statuses. The same pure function of (committed, t_too_old) as
+    conflict_kernel.status_of, so decoded abort sets are bit-identical to
+    the step path's."""
+    idx = np.arange(n_txns)
+    w, b = idx >> 5, (idx & 31).astype(np.uint32)
+    commit = (commit_words[:, w] >> b) & 1
+    too = (too_words[:, w] >> b) & 1
+    return np.where(
+        too, np.int32(int(TransactionCommitResult.TOO_OLD)),
+        np.where(commit, np.int32(int(TransactionCommitResult.COMMITTED)),
+                 np.int32(int(TransactionCommitResult.CONFLICT)))
+    ).astype(np.int32)
+
+
+class _LoopTicket:
+    """One dispatched queue slot's place in the result ring."""
+
+    __slots__ = ("commit_dev", "too_dev", "ov_dev", "n_txns", "n_chunks",
+                 "slot", "status", "overflow", "done")
+
+    def __init__(self, commit_dev, too_dev, ov_dev, n_txns: int,
+                 n_chunks: int, slot: "_LoopSlot"):
+        self.commit_dev = commit_dev
+        self.too_dev = too_dev
+        self.ov_dev = ov_dev
+        self.n_txns = n_txns
+        self.n_chunks = n_chunks
+        self.slot = slot
+        self.status: Optional[np.ndarray] = None
+        self.overflow = False
+        self.done = False
+
+    def ready(self) -> bool:
+        """Non-blocking: have this slot's abort bitmaps landed?"""
+        return (self.commit_dev.is_ready() and self.too_dev.is_ready()
+                and self.ov_dev.is_ready())
+
+
+class _LoopSlot:
+    """One pinned host buffer set for a Q-chunk queue slot: the arrays a
+    dispatched server step reads (zero-copy on backends that alias
+    well-aligned numpy inputs), reused only after its program completed."""
+
+    __slots__ = ("arrays", "ticket")
+
+    def __init__(self, cfg: KernelConfig, q: int):
+        self.arrays: Dict[str, np.ndarray] = {
+            name: np.zeros(s.shape, s.dtype)
+            for name, s in ck.batch_struct(cfg, stack=(q,)).items()}
+        self.ticket: Optional[_LoopTicket] = None
+
+    def fill(self, chunks: List[Dict[str, np.ndarray]]) -> None:
+        for i, chunk in enumerate(chunks):
+            for name, dst in self.arrays.items():
+                dst[i] = chunk[name]
+
+
+class LoopSlotPool:
+    """`queue_depth` slots per bucket shape, handed out round-robin — the
+    double buffer: the host packs into one slot while the other's program
+    is still in flight. acquire() hands back a slot only once its previous
+    ticket drained (the engine drains through it first)."""
+
+    def __init__(self, queue_depth: int, slot_chunks: int):
+        self.queue_depth = max(2, int(queue_depth))
+        self.slot_chunks = max(1, int(slot_chunks))
+        self._slots: Dict[int, List[_LoopSlot]] = {}
+        self._next: Dict[int, int] = {}
+
+    def acquire(self, bucket: KernelConfig) -> _LoopSlot:
+        key = bucket.max_txns
+        slots = self._slots.get(key)
+        if slots is None:
+            slots = [_LoopSlot(bucket, self.slot_chunks)
+                     for _ in range(self.queue_depth)]
+            self._slots[key] = slots
+            self._next[key] = 0
+        i = self._next[key]
+        self._next[key] = (i + 1) % len(slots)
+        return slots[i]
+
+
+class DeviceLoopEngine(JaxConflictEngine):
+    """Fourth engine mode (alongside Jax / Subsharded / mesh-Sharded):
+    step dispatch replaced by the device-resident server loop. Drop-in for
+    JaxConflictEngine everywhere — resolve(), the columnar pack/dispatch
+    split the ResolverPipeline drives, the ladder/warmup contract, the
+    split-step long-key path (which drains the loop first) — with
+    bit-identical abort sets and `dispatch_mode = "loop"` telemetry."""
+
+    name = "device_loop"
+    dispatch_mode = "loop"
+
+    def __init__(self, cfg: KernelConfig = KernelConfig(),
+                 initial_version: Version = 0,
+                 ladder: Optional[Sequence[int]] = None,
+                 arena: bool = True,
+                 history_search: Optional[str] = None,
+                 queue_slots: int = 4,
+                 queue_depth: int = 2,
+                 drain_deadline_s: float = 5.0):
+        #: chunks per queue slot (Q): one compiled loop body per bucket
+        #: serves any fill 1..Q, so Q bounds chunks-per-dispatch, not
+        #: compile count
+        self.queue_slots = max(1, int(queue_slots))
+        self._pool = LoopSlotPool(queue_depth, self.queue_slots)
+        #: FIFO of dispatched-but-undrained tickets — the result ring
+        self._ring: deque = deque()
+        self.drain_deadline_s = drain_deadline_s
+        #: the sync-accounting shim (module docstring): every drain files
+        #: under exactly one of the three kinds
+        self.loop_stats = {"enqueued_chunks": 0, "units": 0,
+                           "drained_nonblocking": 0, "forced_waits": 0,
+                           "blocking_syncs": 0, "wait_ms": 0.0,
+                           #: measured host shares per side of the loop —
+                           #: what bench.py injects as the sim service's
+                           #: queue_enqueue_ms / result_drain_ms
+                           "enqueue_ms": 0.0, "decode_ms": 0.0}
+        super().__init__(loop_kernel_config(cfg),
+                         initial_version=initial_version, ladder=ladder,
+                         scan_sizes=(), arena=arena,
+                         history_search=history_search)
+
+    # -- programs ------------------------------------------------------------
+    def _program(self, bucket: KernelConfig, n_chunks: int):
+        # every chunk count maps to the ONE loop body per bucket (the fill
+        # level is a runtime scalar) — warmup() therefore compiles exactly
+        # len(buckets) programs
+        key = (bucket.max_txns, -1)
+        prog = self._programs.get(key)
+        if prog is None:
+            prog = self._make_program(bucket, self.queue_slots)
+            self._programs[key] = prog
+            self.perf.compiles += 1
+        return prog
+
+    def _make_program(self, bucket: KernelConfig, n_chunks: int):
+        fn = functools.partial(ck.resolve_server_loop, bucket)
+        st = ck.state_struct(bucket)
+        bt = ck.batch_struct(bucket, stack=(self.queue_slots,))
+        nc = jax.ShapeDtypeStruct((), jnp.int32)
+        return jax.jit(fn, **donate_state_kwargs()).lower(st, bt, nc).compile()
+
+    def _split_run(self, n: int) -> List[int]:
+        """Same-bucket runs split into queue-slot fills (≤ Q chunks each);
+        no scan-size ladder — the loop body takes any fill level."""
+        out = [self.queue_slots] * (n // self.queue_slots)
+        if n % self.queue_slots:
+            out.append(n % self.queue_slots)
+        return out
+
+    # -- enqueue / result ring -----------------------------------------------
+    def _dispatch_unit(self, bucket: KernelConfig,
+                       per_chunks: List[List[Dict[str, np.ndarray]]]):
+        C = len(per_chunks)
+        assert C <= self.queue_slots
+        prog = self._program(bucket, C)
+        slot = self._acquire_slot(bucket)
+        t_enq = time.perf_counter()
+        # the enqueue: pack the chunks' columns into the pinned slot (the
+        # chunk arrays came from the HostPackArena; after this copy the
+        # device program reads the SLOT, so arena leases are only pinned
+        # by the base force contract, never by the loop)
+        slot.fill([per[0] for per in per_chunks])
+        self.state, out = prog(self.state, slot.arrays, np.int32(C))
+        self.loop_stats["enqueue_ms"] += (time.perf_counter() - t_enq) * 1e3
+        ticket = _LoopTicket(out["commit_bits"], out["too_old_bits"],
+                             out["overflow"], bucket.max_txns, C, slot)
+        slot.ticket = ticket
+        self._ring.append(ticket)
+        self.loop_stats["units"] += 1
+        self.loop_stats["enqueued_chunks"] += C
+        # steady-state non-blocking poll: decode whatever already landed
+        self.poll()
+
+        def force() -> Tuple[np.ndarray, bool]:
+            self._drain_through(ticket)
+            return ticket.status, ticket.overflow
+
+        return force
+
+    def _acquire_slot(self, bucket: KernelConfig) -> _LoopSlot:
+        slot = self._pool.acquire(bucket)
+        if slot.ticket is not None and not slot.ticket.done:
+            # the double buffer wrapped around onto a still-in-flight slot:
+            # drain through its ticket before overwriting the arrays the
+            # device may still read (steady state never hits this — by the
+            # time the host wraps, that program finished)
+            self._drain_through(slot.ticket)
+        return slot
+
+    def poll(self) -> int:
+        """Drain the READY prefix of the result ring — the non-blocking
+        steady-state path. Returns the number of tickets completed."""
+        n = 0
+        while self._ring and self._ring[0].ready():
+            self._finish(self._ring.popleft())
+            self.loop_stats["drained_nonblocking"] += 1
+            n += 1
+        return n
+
+    def drain_loop(self) -> None:
+        """Block until every in-flight slot drained — the explicit barrier
+        before host code touches the donated table (clear, shadow rebuild,
+        split-step long-key path)."""
+        if self._ring:
+            self._drain_through(self._ring[-1])
+
+    def _drain_through(self, ticket: _LoopTicket) -> None:
+        while not ticket.done:
+            head = self._ring[0]
+            if not head.ready():
+                # the host needs a result that has not landed: poll-wait
+                # for readiness (the host is never inside a device sync
+                # call and could pack; only the deadline fallback is a
+                # true blocking sync)
+                self.loop_stats["forced_waits"] += 1
+                t0 = time.perf_counter()
+                deadline = t0 + self.drain_deadline_s
+                while not head.ready() and time.perf_counter() < deadline:
+                    time.sleep(2e-5)
+                self.loop_stats["wait_ms"] += (time.perf_counter() - t0) * 1e3
+                if not head.ready():
+                    self.loop_stats["blocking_syncs"] += 1
+            self._finish(self._ring.popleft())
+
+    def _finish(self, ticket: _LoopTicket) -> None:
+        t_dec = time.perf_counter()
+        commit = np.asarray(ticket.commit_dev)[:ticket.n_chunks]
+        too = np.asarray(ticket.too_dev)[:ticket.n_chunks]
+        ticket.status = decode_status_bits(commit, too, ticket.n_txns)
+        ticket.overflow = bool(np.asarray(ticket.ov_dev))
+        self.loop_stats["decode_ms"] += (time.perf_counter() - t_dec) * 1e3
+        ticket.done = True
+        if ticket.slot.ticket is ticket:
+            ticket.slot.ticket = None
+        ticket.commit_dev = ticket.too_dev = ticket.ov_dev = None
+
+    # -- host access to the donated table ------------------------------------
+    def _reset_device_state(self, version_rel: int) -> None:
+        if getattr(self, "_ring", None):
+            self.drain_loop()
+        super()._reset_device_state(version_rel)
+
+    def _run_detect(self, per_shard):
+        # split-step (long-key tier) path reads/writes self.state through
+        # the detect/fix/apply jits: the loop must be quiesced first
+        self.drain_loop()
+        return super()._run_detect(per_shard)

@@ -28,6 +28,19 @@ interpreter and by the bench's parity gate on hardware).
 
 Used by the single-device engines only: the mesh (multi-chip) engine keeps
 the XLA fixpoint, whose per-iteration psum is its collective round.
+
+jax 0.4.3x interpreter note: the Pallas INTERPRETER promotes the result
+dtype of integer reductions (`jnp.sum` over int32 lowers through an int64
+accumulator), so any reduction feeding the fixpoint's while_loop carry
+used to blow up mid-trace with an int32-vs-int64 carry mismatch — the
+pre-PR-6 xfail. Every kernel-side reduction below therefore casts back to
+I32 explicitly (a no-op on the compiled TPU path, where the reductions
+already produce int32); the carry entries are pinned to I32 at the loop
+boundary for the same reason. That workaround is what lets the fused
+kernel run on CPU CI and lets the device-resident loop
+(ops/device_loop.py, `resolver_device_loop` knob) bake the Pallas
+fixpoint into its loop bodies with an interpreter fallback instead of an
+xfail.
 """
 from __future__ import annotations
 
@@ -168,6 +181,7 @@ def _prep(cfg: KernelConfig, t_ok, hist_hits, edges, batch):
 
 def _or_reduce_scalar(x: jnp.ndarray) -> jnp.ndarray:
     """OR of every element of a 2D i32 array, by doubling (rank-0)."""
+    x = x.astype(I32)
     r = x.shape[0]
     while r > 1:
         h = r // 2
@@ -186,7 +200,9 @@ def _or_reduce_scalar(x: jnp.ndarray) -> jnp.ndarray:
         else:
             x = x[:, :h] | x[:, h:]
             l = h
-    return jnp.sum(x)
+    # .astype: the 0.4.3x interpreter's sum accumulates in int64 (see the
+    # module docstring); compiled TPU already yields int32, so this is free
+    return jnp.sum(x).astype(I32)
 
 
 def _prefix_max_rowmajor(x: jnp.ndarray) -> jnp.ndarray:
@@ -224,7 +240,7 @@ def _make_kernel(dims):
         lane = lane_tw()
         acc = jnp.zeros_like(word)
         for w in range(TW):
-            cw = jnp.sum(jnp.where(lane == w, c, 0))
+            cw = jnp.sum(jnp.where(lane == w, c, 0)).astype(I32)
             acc = acc | jnp.where(
                 word == w, lax.shift_right_logical(cw, shift) & one, 0)
         return acc
@@ -247,13 +263,13 @@ def _make_kernel(dims):
         w32 = lax.shift_left(one, lax.broadcasted_iota(I32, (1, 32), 1))
         for j in range(4):
             sl = bits[:, 32 * j:32 * (j + 1)]
-            parts.append(jnp.sum(sl * w32, axis=1, keepdims=True))
+            parts.append(jnp.sum(sl * w32, axis=1, keepdims=True).astype(I32))
         return jnp.concatenate(parts, axis=1)
 
     def word_scalar(packed, w):
         """Scalar word w out of a [R,4] packed block."""
         r, j = w // 4, w % 4
-        return jnp.sum(packed[r:r + 1, j:j + 1])
+        return jnp.sum(packed[r:r + 1, j:j + 1]).astype(I32)
 
     def kernel(base_ref, ppg2_ref, ppisw_ref, ppisread_ref,
                gword_ref, gshift_ref, sword_ref, sshift_ref,
@@ -293,7 +309,7 @@ def _make_kernel(dims):
                 plane = ovrp[w * RRR:(w + 1) * RRR]
                 hit_rp = hit_rp | jnp.where((plane & mv) != 0, 1, 0)
             hits = jnp.concatenate([hit_pp, hit_w, hit_rp], axis=0)
-            return scatter_or(hits, sword, sshift)
+            return scatter_or(hits, sword, sshift).astype(I32)
 
         def cond(carry):
             c, prev, it = carry
@@ -303,8 +319,10 @@ def _make_kernel(dims):
             c, prev, it = carry
             return base & ~blocked_words(c), c, it + 1
 
-        c0 = base
-        c1 = base & ~blocked_words(c0)
+        # carry entries pinned to I32: the interpreter's promoted
+        # intermediates must never leak into the while_loop signature
+        c0 = base.astype(I32)
+        c1 = (base & ~blocked_words(c0)).astype(I32)
         c, _, _ = lax.while_loop(cond, body, (c1, c0, jnp.int32(0)))
         out_ref[:] = c
 

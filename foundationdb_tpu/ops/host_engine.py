@@ -466,6 +466,11 @@ class EnginePerf:
     #: chunks dispatched per history-search mode (the mode-pick counters
     #: core/telemetry.py exports as `search_mode_hits.*`)
     search_mode_hits: Dict[str, int] = field(default_factory=dict)
+    #: chunks dispatched per dispatch mode ("step" = per-unit launch +
+    #: force, "loop" = device-resident server loop enqueue; docs/perf.md
+    #: "Device-resident loop") — exported as `dispatch_mode_hits.*` so the
+    #: telemetry frontends show which path served traffic
+    dispatch_mode_hits: Dict[str, int] = field(default_factory=dict)
     warmup_ms: float = 0.0
     warmed: bool = False
     #: flight recorder (docs/observability.md): a bounded ring of recent
@@ -485,6 +490,10 @@ class EnginePerf:
         mode = self.search_modes.get(bucket, "fused_sort")
         self.search_mode_hits[mode] = self.search_mode_hits.get(mode, 0) + chunks
 
+    def record_dispatch_mode(self, mode: str, chunks: int) -> None:
+        self.dispatch_mode_hits[mode] = (
+            self.dispatch_mode_hits.get(mode, 0) + chunks)
+
     def as_dict(self) -> dict:
         return {
             "compiles": self.compiles,
@@ -494,6 +503,7 @@ class EnginePerf:
             "search_modes": {str(k): v
                              for k, v in sorted(self.search_modes.items())},
             "search_mode_hits": dict(sorted(self.search_mode_hits.items())),
+            "dispatch_mode_hits": dict(sorted(self.dispatch_mode_hits.items())),
             "warmup_ms": round(self.warmup_ms, 1),
             "warmed": self.warmed,
             "recent_dispatches": len(self.recent),
@@ -530,6 +540,13 @@ class RoutedConflictEngineBase:
     one lax.scan dispatch (`scan_sizes`)."""
 
     name = "routed"
+    #: how columnar_dispatch hands chunks to the device: "step" launches a
+    #: program per dispatch unit and force() blocks on its outputs; "loop"
+    #: (ops/device_loop.py) enqueues onto the device-resident server
+    #: loop's queue and force() drains a result ring non-blockingly.
+    #: Telemetry (dispatch_mode_hits), the BudgetBatcher's EWMA keys and
+    #: the span split all key off this.
+    dispatch_mode = "step"
 
     def __init__(self, cfg: KernelConfig, shards: KeyShardMap,
                  ladder: Optional[Sequence[int]] = None,
@@ -1031,7 +1048,11 @@ class RoutedConflictEngineBase:
         any later chunks of the SAME batch were already dispatched (the
         serial path stops at the overflowing chunk); overflow is a fatal
         capacity error in both cases."""
+        from ..core.trace import g_spans, span_event, span_now
+
         chunks = plan["chunks"]
+        loop_mode = self.dispatch_mode == "loop"
+        t_enq = span_now() if g_spans.enabled else 0.0
         #: (unit_force, [n_txns per chunk], [leases per chunk], flight rec)
         outs: List[Tuple[Callable, List[int], List[Optional[ArenaLease]], dict]] = []
         i = 0
@@ -1044,6 +1065,7 @@ class RoutedConflictEngineBase:
             self.perf.bucket_hits[bucket.max_txns] = (
                 self.perf.bucket_hits.get(bucket.max_txns, 0) + len(run))
             self.perf.record_search_mode(bucket.max_txns, len(run))
+            self.perf.record_dispatch_mode(self.dispatch_mode, len(run))
             for c in self._split_run(len(run)):
                 sub, run = run[:c], run[c:]
                 unit = self._dispatch_unit(bucket, [ch[0] for ch in sub])
@@ -1054,6 +1076,12 @@ class RoutedConflictEngineBase:
                 outs.append((unit, [ch[1] for ch in sub],
                              [ch[3] for ch in sub], rec))
             i = j
+        if g_spans.enabled and loop_mode:
+            # loop engines: the dispatch loop above only packed queue slots
+            # and enqueued async server steps — the queue_enqueue share of
+            # what used to be one opaque device_dispatch segment
+            span_event("engine.queue_enqueue", plan.get("now"), t_enq,
+                       span_now(), units=len(outs))
         new_oldest = plan["new_oldest"]
         if new_oldest > self.oldest_version:
             self.tier_map.gc(new_oldest)
@@ -1087,9 +1115,13 @@ class RoutedConflictEngineBase:
                     if lease is not None:
                         lease.release()
             if g_spans.enabled:
-                # readback/force segment of the wall-clock engine path
-                span_event("engine.force", version, t_force, span_now(),
-                           units=len(outs))
+                # readback segment of the wall-clock engine path: a step
+                # engine blocks on device outputs here; a loop engine
+                # drains its result ring (ready results decode without a
+                # sync — the segment name keeps the two attributable)
+                span_event(
+                    "engine.result_drain" if loop_mode else "engine.force",
+                    version, t_force, span_now(), units=len(outs))
             return results
 
         return force
@@ -1102,8 +1134,9 @@ class RoutedConflictEngineBase:
         n = len(routed)
         assert n <= cfg.max_txns
         # general-router chunks always run the top shape; count its mode
-        # pick so the telemetry counters cover the slow path too
+        # picks so the telemetry counters cover the slow path too
         self.perf.record_search_mode(cfg.max_txns, 1)
+        self.perf.record_dispatch_mode(self.dispatch_mode, 1)
 
         too_old = np.zeros((cfg.max_txns,), bool)
         t_ok = np.zeros((cfg.max_txns,), bool)
@@ -1454,3 +1487,39 @@ class JaxConflictEngine(RoutedConflictEngineBase):
         self.state, overflow = self._apply(self.state, batch, cm, ctx["wpos"])
         status = ck.status_of(np.asarray(batch["t_too_old"]), committed)
         return np.asarray(status), bool(overflow)
+
+
+#: the engine-mode router: every device-backed ConflictSet family by its
+#: serving mode — "jax" (single chip, step dispatch), "subsharded" (S
+#: key-range sub-shards on one device), "sharded" (multi-chip mesh),
+#: "device_loop" (single chip, device-resident server loop;
+#: ops/device_loop.py). make_engine resolves lazily so importing this
+#: module never pulls the mesh or loop machinery.
+ENGINE_MODES = ("jax", "subsharded", "sharded", "device_loop")
+
+
+def default_engine_mode() -> str:
+    """The single-chip mode the `resolver_device_loop` knob selects:
+    "device_loop" when the knob is set, else "jax" (step dispatch)."""
+    from .device_loop import device_loop_requested
+
+    return "device_loop" if device_loop_requested() else "jax"
+
+
+def make_engine(mode: str, cfg: KernelConfig, **kw):
+    """Registry entry point: build the engine family `mode` names.
+    Sharded families take their KeyShardMap via kw (`shards=`)."""
+    if mode == "jax":
+        return JaxConflictEngine(cfg, **kw)
+    if mode == "subsharded":
+        return SubshardedConflictEngine(cfg, **kw)
+    if mode == "sharded":
+        from ..parallel.sharding import ShardedConflictEngine
+
+        return ShardedConflictEngine(cfg, **kw)
+    if mode == "device_loop":
+        from .device_loop import DeviceLoopEngine
+
+        return DeviceLoopEngine(cfg, **kw)
+    raise ValueError(
+        f"unknown engine mode {mode!r}; expected one of {ENGINE_MODES}")

@@ -98,7 +98,10 @@ ATTRIBUTION_SEGMENTS = (
     "queue_wait",        # resolver: version chain + service window slot
     "host_pack",         # resolver service: host pack stage
     "pipeline_wait",     # resolver service: in-order device chain wait
-    "device_dispatch",   # resolver service: device program (retry share removed)
+    "device_dispatch",   # step dispatch: device program (retry share removed)
+    "queue_enqueue",     # device loop: slot pack + async dispatch (no sync)
+    "device_resident",   # device loop: on-device server-step share
+    "result_drain",      # device loop: non-blocking abort-bitmap drain
     "retry",             # supervisor watchdog retries (fault/resilient.py)
     "force",             # verdict materialization / readback tail
     "resolve_overhead",  # resolver RPC residual: network + marshalling
@@ -130,6 +133,13 @@ def _attribute(records, by_trace) -> Optional[dict]:
         hp = tr.get("resolver.host_pack", 0.0)
         pw = tr.get("resolver.pipeline_wait", 0.0)
         dd = tr.get("resolver.device_dispatch", 0.0)
+        # device-loop dispatch (docs/perf.md "Device-resident loop"): the
+        # device_dispatch interval splits into enqueue / device-resident /
+        # drain segments; a step-dispatch run carries zeros here (and vice
+        # versa), so the partition identity holds in either mode
+        qe = tr.get("resolver.queue_enqueue", 0.0)
+        dr = tr.get("resolver.device_resident", 0.0)
+        rd = tr.get("resolver.result_drain", 0.0)
         fc = tr.get("resolver.force", 0.0)
         rt = tr.get("resolver.retry", 0.0)
         seg = {
@@ -138,10 +148,14 @@ def _attribute(records, by_trace) -> Optional[dict]:
             "queue_wait": qw,
             "host_pack": hp,
             "pipeline_wait": pw,
-            "device_dispatch": dd - rt,
+            "device_dispatch": (dd - rt) if dd else 0.0,
+            "queue_enqueue": qe,
+            "device_resident": (dr - rt) if dr else 0.0,
+            "result_drain": rd,
             "retry": rt,
             "force": fc,
-            "resolve_overhead": tr["proxy.resolve_rpc"] - (qw + hp + pw + dd + fc),
+            "resolve_overhead": tr["proxy.resolve_rpc"]
+                - (qw + hp + pw + dd + qe + dr + rd + fc),
             "meta_drain": tr["proxy.meta_drain"],
             "log_push": tr["proxy.log_push"],
         }
@@ -202,6 +216,9 @@ def run_latency_under_load(
     device_ms_by_bucket: Optional[Dict[int, float]] = None,
     budget_ms: Optional[float] = None,
     search_mode_by_bucket: Optional[Dict[int, str]] = None,
+    dispatch_mode: str = "step",
+    queue_enqueue_ms: float = 0.0,
+    result_drain_ms: float = 0.0,
     collect_spans: bool = False,
     engine_factory=None,
     resilient: bool = False,
@@ -284,6 +301,12 @@ def run_latency_under_load(
             # per-(bucket, mode) EWMA keying (docs/perf.md history search
             # modes); None = whatever the resolver engine reports
             search_mode_by_bucket=search_mode_by_bucket,
+            # device-loop dispatch model (docs/perf.md "Device-resident
+            # loop"): splits the device span into enqueue / resident /
+            # drain segments with the given injected host shares
+            dispatch_mode=dispatch_mode,
+            queue_enqueue_ms=queue_enqueue_ms,
+            result_drain_ms=result_drain_ms,
         ),
         max_commit_batch=batch_txns,
         # One slot beyond the service depth: `depth` batches in service at

@@ -56,19 +56,25 @@ class BudgetBatcher:
     service delays); seed_ms pre-loads bench-measured device times so the
     first batches are not sized blind.
 
-    EWMAs are keyed per (bucket, history-search mode): the two kernel
-    history paths (docs/perf.md "History search modes") have genuinely
-    different device-time floors for the same bucket shape, so a mode
-    change (knob flip, engine rebuild under a different pick) must not
-    poison the other mode's estimate. `bucket_modes` maps each bucket to
-    its engine's resolved mode (RoutedConflictEngineBase
-    .history_search_modes()); unmapped buckets default to "fused_sort",
-    the pre-ladder behavior."""
+    EWMAs are keyed per (bucket, history-search mode, dispatch mode): the
+    two kernel history paths (docs/perf.md "History search modes") have
+    genuinely different device-time floors for the same bucket shape, and
+    so do the two DISPATCH paths — step dispatch pays a per-batch
+    launch+force round trip the device-resident loop (docs/perf.md
+    "Device-resident loop") does not — so flipping either axis (knob
+    change, engine rebuild under a different pick, enabling the device
+    loop) must never poison the other key's estimate. `bucket_modes` maps
+    each bucket to its engine's resolved search mode
+    (RoutedConflictEngineBase.history_search_modes()); unmapped buckets
+    default to "fused_sort", the pre-ladder behavior. `dispatch_mode` is
+    the engine family's serving path ("step" | "loop"), one value per
+    batcher (an engine serves through exactly one at a time)."""
 
     def __init__(self, ladder: Sequence[int], budget_ms: Optional[float] = None,
                  pack_ms_per_txn: float = 0.0, alpha: Optional[float] = None,
                  seed_ms: Optional[Dict[int, float]] = None,
-                 bucket_modes: Optional[Dict[int, str]] = None):
+                 bucket_modes: Optional[Dict[int, str]] = None,
+                 dispatch_mode: str = "step"):
         from ..core.knobs import SERVER_KNOBS
 
         self.ladder = sorted(set(int(t) for t in ladder))
@@ -81,10 +87,10 @@ class BudgetBatcher:
                       if alpha is None else float(alpha))
         self.bucket_modes: Dict[int, str] = {
             int(t): str(m) for t, m in (bucket_modes or {}).items()}
-        #: (bucket, mode) -> EWMA of observed service ms
-        self.ewma_ms: Dict[Tuple[int, str], float] = {
-            (int(t), self.mode_of(int(t))): float(v)
-            for t, v in (seed_ms or {}).items()}
+        self.dispatch_mode = str(dispatch_mode)
+        #: (bucket, search mode, dispatch mode) -> EWMA of observed ms
+        self.ewma_ms: Dict[Tuple[int, str, str], float] = {
+            self.key_of(int(t)): float(v) for t, v in (seed_ms or {}).items()}
         # unified telemetry (core/telemetry.py): the per-bucket EWMAs the
         # whole cluster steers by become persistable TDMetric series
         from ..core import telemetry
@@ -95,6 +101,11 @@ class BudgetBatcher:
         """The history-search mode a bucket's observations file under."""
         return self.bucket_modes.get(bucket, "fused_sort")
 
+    def key_of(self, bucket: int, mode: Optional[str] = None) -> tuple:
+        """The full EWMA key a bucket's observations file under."""
+        return (bucket, mode if mode is not None else self.mode_of(bucket),
+                self.dispatch_mode)
+
     def set_bucket_modes(self, modes: Dict[int, str]) -> None:
         """Adopt an engine's resolved per-bucket modes. A seed recorded
         under a bucket's PREVIOUS mode migrates iff the new mode has no
@@ -104,10 +115,27 @@ class BudgetBatcher:
             t = int(t)
             m_old = self.mode_of(t)
             self.bucket_modes[t] = str(m_new)
-            old_key, new_key = (t, m_old), (t, str(m_new))
+            old_key, new_key = self.key_of(t, m_old), self.key_of(t, str(m_new))
             if old_key != new_key and old_key in self.ewma_ms \
                     and new_key not in self.ewma_ms:
                 self.ewma_ms[new_key] = self.ewma_ms.pop(old_key)
+
+    def set_dispatch_mode(self, dispatch: str) -> None:
+        """Adopt an engine family's dispatch path ("step" | "loop") —
+        mirrors set_bucket_modes: seeds filed under the previous dispatch
+        mode migrate iff the new key has no estimate, so enabling the
+        device loop starts from the prior without ever overwriting a real
+        step-path observation (and vice versa on failover back to step)."""
+        old = self.dispatch_mode
+        self.dispatch_mode = str(dispatch)
+        if old == self.dispatch_mode:
+            return
+        for (t, m, d), v in list(self.ewma_ms.items()):
+            if d != old:
+                continue
+            new_key = (t, m, self.dispatch_mode)
+            if new_key not in self.ewma_ms:
+                self.ewma_ms[new_key] = v
 
     def bucket_of(self, n_txns: int) -> int:
         """Smallest ladder bucket holding an n_txns batch (top if none)."""
@@ -118,7 +146,7 @@ class BudgetBatcher:
 
     def observe(self, bucket: int, service_ms: float,
                 mode: Optional[str] = None) -> None:
-        key = (bucket, mode if mode is not None else self.mode_of(bucket))
+        key = self.key_of(bucket, mode)
         cur = self.ewma_ms.get(key)
         self.ewma_ms[key] = (service_ms if cur is None
                              else cur + self.alpha * (service_ms - cur))
@@ -128,8 +156,7 @@ class BudgetBatcher:
         """Client-visible latency estimate at `depth` in flight: own pack +
         up to `depth` device services ahead of the verdict (the in-order
         device chain). None until the (bucket, mode) has an observation."""
-        dev = self.ewma_ms.get(
-            (bucket, mode if mode is not None else self.mode_of(bucket)))
+        dev = self.ewma_ms.get(self.key_of(bucket, mode))
         if dev is None:
             return None
         return self.pack_ms_per_txn * bucket + max(1, depth) * dev
@@ -155,8 +182,9 @@ class BudgetBatcher:
             "pack_ms_per_txn": round(self.pack_ms_per_txn, 6),
             "bucket_modes": {str(t): m
                              for t, m in sorted(self.bucket_modes.items())},
-            "ewma_ms": {f"{t}:{m}": round(v, 4)
-                        for (t, m), v in sorted(self.ewma_ms.items())},
+            "dispatch_mode": self.dispatch_mode,
+            "ewma_ms": {f"{t}:{m}:{d}": round(v, 4)
+                        for (t, m, d), v in sorted(self.ewma_ms.items())},
         }
 
 
@@ -236,6 +264,10 @@ class ResolverPipeline:
             # the engine is the authority on which history-search mode each
             # bucket's compiled program traces; observations file under it
             batcher.set_bucket_modes(engine.history_search_modes())
+        if batcher is not None:
+            # likewise for the dispatch path (step vs device loop): keyed
+            # so enabling the loop never poisons the step path's estimates
+            batcher.set_dispatch_mode(getattr(engine, "dispatch_mode", "step"))
 
     def suggested_batch_txns(self) -> Optional[int]:
         if self.batcher is None:

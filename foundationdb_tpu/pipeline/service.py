@@ -64,6 +64,19 @@ class PipelineConfig:
                           BudgetBatcher's per-(bucket, mode) EWMAs so a
                           mode flip never poisons the other mode's
                           latency estimate.
+    dispatch_mode       — how batches reach the device (docs/perf.md
+                          "Device-resident loop"): "step" is the
+                          launch-per-batch path whose device segment is
+                          one opaque span; "device_loop" models the
+                          device-resident server loop — the device span
+                          splits into queue_enqueue / device_resident /
+                          result_drain segments and the BudgetBatcher
+                          files EWMAs under the "loop" dispatch key.
+    queue_enqueue_ms    — loop mode: host cost to pack a queue slot and
+                          async-dispatch the server step (no sync).
+    result_drain_ms     — loop mode: host cost to poll + decode the
+                          batch's abort bitmaps from the result ring
+                          (non-blocking in steady state).
     """
 
     depth: int = 2
@@ -73,6 +86,9 @@ class PipelineConfig:
     device_ms_by_bucket: Optional[Dict[int, float]] = None
     p99_budget_ms: Optional[float] = None
     search_mode_by_bucket: Optional[Dict[int, str]] = None
+    dispatch_mode: str = "step"
+    queue_enqueue_ms: float = 0.0
+    result_drain_ms: float = 0.0
 
     def as_dict(self) -> dict:
         return {"depth": self.depth,
@@ -84,7 +100,10 @@ class PipelineConfig:
                 "p99_budget_ms": self.p99_budget_ms,
                 "search_mode_by_bucket": (dict(self.search_mode_by_bucket)
                                           if self.search_mode_by_bucket
-                                          else None)}
+                                          else None),
+                "dispatch_mode": self.dispatch_mode,
+                "queue_enqueue_ms": self.queue_enqueue_ms,
+                "result_drain_ms": self.result_drain_ms}
 
 
 class PipelinedResolverService:
@@ -114,6 +133,11 @@ class PipelinedResolverService:
                 seed_ms={int(t): float(v)
                          for t, v in cfg.device_ms_by_bucket.items()},
                 bucket_modes=bucket_modes,
+                # EWMAs file under the dispatch path serving this
+                # resolver, so a device-loop rollout never poisons the
+                # step path's estimates (docs/perf.md)
+                dispatch_mode=("loop" if cfg.dispatch_mode == "device_loop"
+                               else getattr(engine, "dispatch_mode", "step")),
             )
 
     @property
@@ -202,9 +226,20 @@ class PipelinedResolverService:
             await self._device_done.when_at_least(seq - 1)
             from ..sim.loop import now as _now
 
+            loop_mode = self.cfg.dispatch_mode == "device_loop"
             if spans_on:
                 t2 = span_now()
                 span_event("resolver.pipeline_wait", version, t1, t2)
+            if loop_mode and self.cfg.queue_enqueue_ms > 0:
+                # loop mode: the host's enqueue share — pack the queue
+                # slot + async-dispatch the server step (no sync)
+                await delay(self.cfg.queue_enqueue_ms / 1e3,
+                            TaskPriority.PROXY_RESOLVER_REPLY)
+            if spans_on and loop_mode:
+                t2 = span_now()
+                span_event("resolver.queue_enqueue", version,
+                           t2 - self.cfg.queue_enqueue_ms / 1e3, t2,
+                           txns=len(transactions))
             t_dev = _now()
             verdicts = self.engine.resolve(transactions, version, new_oldest)
             if hasattr(verdicts, "__await__"):
@@ -216,12 +251,26 @@ class PipelinedResolverService:
                 await delay(device_ms / 1e3, TaskPriority.PROXY_RESOLVER_REPLY)
             if spans_on:
                 t3 = span_now()
-                # the device segment covers the engine dispatch (including
-                # any supervisor watchdog/retry time — the retry share is
-                # emitted separately as resolver.retry by fault/resilient.py)
-                # plus the injected program time for this batch's bucket
-                span_event("resolver.device_dispatch", version, t2, t3,
-                           txns=len(transactions))
+                # step mode: the device segment covers the engine dispatch
+                # (including any supervisor watchdog/retry time — the retry
+                # share is emitted separately as resolver.retry by
+                # fault/resilient.py) plus the injected program time for
+                # this batch's bucket. Loop mode splits the same interval:
+                # the device-resident share here, the host's enqueue/drain
+                # shares as their own segments — the attribution that
+                # latency_attribution reassembles for the loop path.
+                span_event("resolver.device_resident" if loop_mode
+                           else "resolver.device_dispatch",
+                           version, t2, t3, txns=len(transactions))
+            if loop_mode and self.cfg.result_drain_ms > 0:
+                # loop mode: the host's drain share — non-blocking poll +
+                # bitmap decode off the result ring
+                await delay(self.cfg.result_drain_ms / 1e3,
+                            TaskPriority.PROXY_RESOLVER_REPLY)
+            if spans_on and loop_mode:
+                t3b = span_now()
+                span_event("resolver.result_drain", version, t3, t3b)
+                t3 = t3b   # the force tail starts after the drain segment
             if self.batcher is not None:
                 # observed device-stage time: injected program time plus any
                 # real engine/supervisor stalls (watchdog retries, failover)

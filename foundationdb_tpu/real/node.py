@@ -22,6 +22,17 @@ import sys
 
 
 def make_engine_factory(kind: str):
+    """Conflict-engine family for a wall-clock node. "auto" consults the
+    engine-mode router (ops/host_engine.py): the `resolver_device_loop`
+    knob promotes the single-chip device engine to the device-resident
+    loop (docs/perf.md "Device-resident loop"); unset, it stays step
+    dispatch ("jax")."""
+    if kind in ("jax", "device_loop", "auto"):
+        from ..ops.conflict_kernel import KernelConfig
+        from ..ops.host_engine import default_engine_mode, make_engine
+
+        mode = default_engine_mode() if kind == "auto" else kind
+        return lambda: make_engine(mode, KernelConfig())
     if kind == "native":
         try:
             from ..ops.native_engine import NativeConflictEngine
@@ -92,7 +103,8 @@ def main(argv=None) -> int:
     ap.add_argument("--resolvers", type=int, default=2)
     ap.add_argument("--proxies", type=int, default=1)
     ap.add_argument("--storage", type=int, default=2)
-    ap.add_argument("--engine", default="native", choices=["native", "oracle"])
+    ap.add_argument("--engine", default="native",
+                    choices=["native", "oracle", "jax", "device_loop", "auto"])
     ap.add_argument("--tls-cert", default=None)
     ap.add_argument("--tls-key", default=None)
     ap.add_argument("--tls-ca", default=None)

@@ -14,7 +14,15 @@ Runs the same code paths as bench.py's perf sections at toy sizes:
   * history_floor — the occupancy sweep of tools/floor_bench.py at toy
     sizes, asserting ZERO post-warmup compiles for BOTH history-search
     modes (docs/perf.md "History search modes") and cross-mode abort-set
-    parity on a driven batch stream.
+    parity on a driven batch stream;
+  * device_loop — the device-resident loop engine (ops/device_loop.py)
+    driven against step dispatch over identical streams: loop-vs-step
+    abort-set parity canary, ZERO post-warmup compiles on the real
+    jax-monitoring counter (one loop body per bucket), and the
+    zero-blocking-sync assertion via the loop's sync-counting shim
+    (`loop_stats`: blocking_syncs == 0, the pipelined drive drains the
+    result ring entirely through the non-blocking poll), plus the
+    loop_floor step-vs-loop host-time comparison at toy size.
 
 Prints one JSON line; any failed check exits non-zero. Device timings on
 the CPU backend are meaningless and deliberately not asserted — this
@@ -88,6 +96,86 @@ def main() -> int:
             failures.append(f"history-search cross-mode mismatch at n={n}")
             break
 
+    # Device-resident loop (docs/perf.md "Device-resident loop"): loop
+    # engine vs step engine over the identical mixed-size stream. Warmup
+    # compiles one loop body per bucket; the steady drive then runs under
+    # the REAL jax compile counter — any event is a retrace the AOT loop
+    # bodies were supposed to make impossible.
+    from foundationdb_tpu.ops.device_loop import DeviceLoopEngine
+    from foundationdb_tpu.pipeline.resolver_pipeline import ResolverPipeline
+    from foundationdb_tpu.tools.floor_bench import (_CompileCounter,
+                                                    run_loop_floor)
+
+    loop_eng = DeviceLoopEngine(cfg, ladder=[32, 64]).warmup()
+    step_eng = JaxConflictEngine(cfg, ladder=[32, 64], scan_sizes=()).warmup()
+    counter = _CompileCounter()
+    version = 5_000
+    loop_parity = True
+    for _ in range(2):
+        for n in (16, 31, 32, 33, 63, 64, 65, 128, 290):
+            txns = make_point_txns(n, 256, rng, version)
+            version += max(64, n)
+            new_oldest = max(0, version - 100_000)
+            got = [int(x) for x in loop_eng.resolve(txns, version, new_oldest)]
+            want = [int(x) for x in step_eng.resolve(txns, version, new_oldest)]
+            if got != want:
+                loop_parity = False
+    steady_compiles = counter.close()
+    if steady_compiles is None:
+        failures.append("device_loop: jax compile counter unavailable")
+    elif steady_compiles:
+        failures.append(
+            f"device_loop: {steady_compiles} post-warmup compiles")
+    if not loop_parity:
+        failures.append("device_loop: loop-vs-step abort-set mismatch")
+    if loop_eng.perf.compiles != len(loop_eng.buckets):
+        failures.append(
+            f"device_loop: {loop_eng.perf.compiles} loop bodies for "
+            f"{len(loop_eng.buckets)} buckets (want one per bucket)")
+    # pipelined drive: the whole result ring must drain through the
+    # NON-BLOCKING poll (steady-state zero-host-sync claim) — blocking
+    # syncs are never acceptable, in any phase
+    import time as _time
+
+    pipe = ResolverPipeline(loop_eng, depth=3)
+    handles = []
+    for _ in range(8):
+        txns = make_point_txns(64, 256, rng, version)
+        version += 128
+        handles.append(pipe.submit(txns, version, max(0, version - 100_000)))
+    deadline = _time.perf_counter() + 30.0
+    while loop_eng._ring and _time.perf_counter() < deadline:
+        loop_eng.poll()
+        _time.sleep(0.002)
+    if loop_eng._ring:
+        failures.append("device_loop: result ring never drained via poll()")
+    for h in handles:
+        h.result()
+    if loop_eng.loop_stats["blocking_syncs"]:
+        failures.append(
+            f"device_loop: {loop_eng.loop_stats['blocking_syncs']} blocking "
+            "host syncs (want 0)")
+    if not loop_eng.loop_stats["drained_nonblocking"]:
+        failures.append("device_loop: nothing drained non-blockingly")
+    loop_floor = run_loop_floor(
+        ck.KernelConfig(key_words=4, capacity=2048, max_txns=128,
+                        max_point_reads=256, max_point_writes=256,
+                        max_reads=32, max_writes=32),
+        n_batches=8, pool=256)
+    if not loop_floor["parity_ok"]:
+        failures.append("loop_floor: loop-vs-step abort-set mismatch")
+    if loop_floor["loop_stats"]["blocking_syncs"]:
+        failures.append("loop_floor: blocking host syncs in the loop drive")
+    device_loop = {
+        "steady_state_compiles": steady_compiles,
+        "loop_bodies_compiled": loop_eng.perf.compiles,
+        "buckets": [b.max_txns for b in loop_eng.buckets],
+        "parity_ok": loop_parity,
+        "loop_stats": dict(loop_eng.loop_stats),
+        "dispatch_mode_hits": dict(loop_eng.perf.dispatch_mode_hits),
+        "loop_floor": loop_floor,
+    }
+
     # Mini latency curve: injected service times (the harness's time model
     # is virtual), bucket table + budget knob exactly as bench.py wires
     # them. Offered load near each shape's device-paced capacity.
@@ -118,6 +206,7 @@ def main() -> int:
     out = {"metric": "bench_smoke", "ok": not failures,
            "failures": failures,
            "bucket_ladder": ladder, "history_floor": floor,
+           "device_loop": device_loop,
            "latency_under_load": under_load}
     print(json.dumps(out))
     return 1 if failures else 0

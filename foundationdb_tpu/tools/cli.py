@@ -158,12 +158,19 @@ class Cli:
                     picks = ", ".join(f"{k}:{v}" for k, v in
                                       sorted(modes.items()))
                     self._print(f"    search   - mode hits {{{picks}}}")
+                dmodes = perf.get("dispatch_mode_hits") or {}
+                if dmodes:
+                    picks = ", ".join(f"{k}:{v}" for k, v in
+                                      sorted(dmodes.items()))
+                    self._print(f"    dispatch - mode hits {{{picks}}}")
             b = frag.get("batcher")
             if b:
                 ewma = ", ".join(f"{k}:{v}ms" for k, v in
                                  sorted(b.get("ewma_ms", {}).items()))
-                self._print(f"    batcher  - budget {b.get('budget_ms')}ms, "
-                            f"ewma {{{ewma}}}")
+                disp = b.get("dispatch_mode")
+                self._print(f"    batcher  - budget {b.get('budget_ms')}ms"
+                            + (f", dispatch {disp}" if disp else "")
+                            + f", ewma {{{ewma}}}")
             if "flight_recorder_entries" in frag:
                 self._print(f"    flightrec- {frag['flight_recorder_entries']} "
                             "recent dispatch records")

@@ -200,6 +200,74 @@ def run_floor_sweep(
     }
 
 
+def run_loop_floor(
+    cfg: Optional[ck.KernelConfig] = None,
+    *,
+    n_batches: int = 24,
+    warm_batches: int = 4,
+    depth: int = 2,
+    pool: int = 512,
+    seed: int = 2027,
+) -> Dict:
+    """The `loop_floor` section (docs/perf.md "Device-resident loop"):
+    per-batch HOST wall time of the step-dispatch engine vs the
+    device-resident loop engine at a FIXED batch shape, both driven
+    through the wall-clock ResolverPipeline at `depth` over the IDENTICAL
+    transaction stream. Step dispatch pays a per-batch launch + blocking
+    force; the loop enqueues onto its device queue and drains abort
+    bitmaps non-blockingly — the difference is the dispatch floor the
+    tentpole removes. Verdict parity across the two engines is asserted
+    into the result (the bench canary), alongside the loop's sync
+    accounting (blocking_syncs MUST be 0)."""
+    from ..ops.device_loop import DeviceLoopEngine
+    from ..ops.host_engine import JaxConflictEngine
+    from ..pipeline.resolver_pipeline import ResolverPipeline
+    from .ladder_bench import make_point_txns
+
+    cfg = cfg or SMOKE_CFG
+    rng = np.random.default_rng(seed)
+    stream = []
+    version = 1_000
+    for _ in range(warm_batches + n_batches):
+        txns = make_point_txns(cfg.max_txns, pool, rng, version)
+        version += max(64, cfg.max_txns)
+        stream.append((txns, version, max(0, version - 100_000)))
+
+    def drive(engine):
+        engine.warmup()
+        pipe = ResolverPipeline(engine, depth=depth)
+        verdicts = []
+        for s in stream[:warm_batches]:
+            verdicts.append([int(x) for x in pipe.submit(*s).result()])
+        t0 = time.perf_counter()
+        handles = [pipe.submit(*s) for s in stream[warm_batches:]]
+        verdicts.extend([int(x) for x in h.result()] for h in handles)
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        return wall_ms / n_batches, verdicts
+
+    step_ms, step_verdicts = drive(JaxConflictEngine(cfg))
+    loop = DeviceLoopEngine(cfg)
+    loop_ms, loop_verdicts = drive(loop)
+    return {
+        "batch_txns": cfg.max_txns,
+        "depth": depth,
+        "n_batches": n_batches,
+        "step_host_ms_per_batch": round(step_ms, 4),
+        "loop_host_ms_per_batch": round(loop_ms, 4),
+        "loop_speedup": round(step_ms / loop_ms, 3) if loop_ms > 0 else None,
+        #: measured host shares of one loop batch — bench.py injects these
+        #: as the sim service's queue_enqueue_ms / result_drain_ms so the
+        #: loop-mode latency attribution carries real figures
+        "loop_enqueue_ms_per_batch": round(
+            loop.loop_stats["enqueue_ms"] / max(1, loop.loop_stats["units"]), 4),
+        "loop_decode_ms_per_batch": round(
+            loop.loop_stats["decode_ms"] / max(1, loop.loop_stats["units"]), 4),
+        #: the bench canary: loop and step verdict streams bit-identical
+        "parity_ok": step_verdicts == loop_verdicts,
+        "loop_stats": dict(loop.loop_stats),
+    }
+
+
 def main() -> int:
     out = run_floor_sweep(scan_steps=48)
     print(json.dumps({"metric": "history_floor", **out}))

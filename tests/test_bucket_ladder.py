@@ -209,10 +209,12 @@ def test_wallclock_pipeline_budget_batcher_observes():
         pipe.submit(txns, v, old).result()
     # every bucket the stream hit has an observation, so the target is the
     # largest (in-budget) bucket, not the never-observed fallback. EWMAs
-    # key per (bucket, history-search mode), filed under the mode the
-    # engine resolved for each bucket
-    assert {t for t, _mode in batcher.ewma_ms} == {32, 64, 128}
-    assert all(mode == batcher.mode_of(t) for t, mode in batcher.ewma_ms)
+    # key per (bucket, history-search mode, dispatch mode), filed under
+    # the modes the engine resolved for each bucket
+    assert {t for t, _mode, _d in batcher.ewma_ms} == {32, 64, 128}
+    assert all(mode == batcher.mode_of(t)
+               for t, mode, _d in batcher.ewma_ms)
+    assert all(d == "step" for _t, _m, d in batcher.ewma_ms)
     assert batcher.bucket_modes == pipe.engine.history_search_modes()
     assert all(ms > 0 for ms in batcher.ewma_ms.values())
     assert pipe.suggested_batch_txns() == 128

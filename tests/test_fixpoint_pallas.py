@@ -8,24 +8,18 @@ the compiled TPU path.
 import random
 
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp
 
-#: Pre-existing seed failure, version-gated so tier-1 reads green without
-#: hiding new regressions: the jax 0.4.3x Pallas INTERPRETER promotes
-#: int32 while_loop carries to int64 mid-trace (carry[1] int32[1,1] ->
-#: int64[1,1], a TypeError before the kernel even runs), so the fused
-#: fixpoint cannot execute on CPU CI under this pin. The compiled TPU
-#: path is unaffected (bench.py's parity gate covers it). Non-strict: a
-#: jax upgrade that fixes the interpreter turns these into XPASS, still
-#: green.
-pytestmark = pytest.mark.xfail(
-    jax.__version__.startswith("0.4.3"),
-    reason="jax 0.4.3x Pallas interpreter promotes while_loop carry dtypes "
-           "(int32 -> int64); pre-existing seed failure, CPU-interpret only",
-    strict=False)
+# The jax 0.4.3x Pallas INTERPRETER used to promote int32 reduction
+# results to int64 mid-trace, blowing up the fixpoint while_loop's carry
+# signature before the kernel even ran (the pre-PR-6 xfail). The kernel
+# now pins every reduction and the carry to int32 explicitly
+# (ops/fixpoint_pallas.py module docstring), so the interpreter path runs
+# on CPU CI — which is what lets the device-resident loop
+# (resolver_device_loop knob) gate onto the Pallas fixpoint with an
+# interpreter fallback instead of an xfail.
 
 from foundationdb_tpu.core.types import CommitTransaction, KeyRange
 from foundationdb_tpu.ops import conflict_kernel as ck
