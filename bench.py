@@ -255,6 +255,7 @@ def main():
     native_cpu = native_baseline_txns_per_sec()
     sharded = sharded_cpu_numbers()
     floor = history_floor_section()
+    chaos_served = served_under_chaos_section()
 
     print(json.dumps({
         "metric": "resolved_txns_per_sec_per_chip",
@@ -281,6 +282,7 @@ def main():
         "latency_curve": curve,
         "latency_under_load": under_load,
         "latency_attribution": attribution,
+        "served_under_chaos": chaos_served,
         "device": str(dev),
     }))
 
@@ -653,6 +655,28 @@ def loop_floor_section():
         return run_loop_floor(cfg, n_batches=32, pool=POOL // 4)
     except Exception:
         return None
+
+
+def served_under_chaos_section():
+    """The millions-of-users serving campaign's capacity model
+    (docs/real_cluster.md): a wall-clock Zipf-skew sweep through the REAL
+    transport with the network nemesis active — per skew s in {0, 0.9,
+    1.2}, the same overloaded serving point with per-tenant admission
+    control ON (p99 must hold inside the wall-clock budget) and OFF (the
+    uncontrolled queue must blow it — degradation demonstrated, not
+    assumed), plus a no-nemesis baseline converting the in-budget
+    sustained rate into users-served. Runs on CPU + localhost sockets
+    regardless of the bench chip; the budget is the knob product
+    resolver_p99_budget_ms x real_chaos_budget_factor (the wall-clock
+    serving point — see core/knobs.py)."""
+    try:
+        from foundationdb_tpu.real.nemesis import run_served_under_chaos
+
+        return run_served_under_chaos()
+    except Exception as e:  # noqa: BLE001 — a socketless/odd environment
+        #                     must not kill the chip bench (sibling
+        #                     sections guard the same way)
+        return {"error": f"{type(e).__name__}: {e}"}
 
 
 def sharded_cpu_numbers():

@@ -85,6 +85,9 @@ recruitment_failed = _define(1200, "recruitment_failed", "Role recruitment faile
 master_tlog_failed = _define(1205, "master_tlog_failed", "Master terminating because a TLog failed")
 movekeys_conflict = _define(1010, "movekeys_conflict", "Concurrent data-distribution move")
 database_locked = _define(1038, "database_locked", "Database is locked (DR switchover / management)")
+transaction_throttled = _define(
+    1213, "transaction_throttled",
+    "Tenant over its admission rate; retry after backoff", retryable=True)
 please_reboot = _define(1207, "please_reboot", "Process should reboot")
 io_error = _define(1510, "io_error", "Disk i/o operation failed")
 file_not_found = _define(1511, "file_not_found", "File not found")

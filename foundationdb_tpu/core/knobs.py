@@ -175,6 +175,36 @@ def _make_server_knobs() -> Knobs:
     #: bounded ring dumped into quarantine/failover trace events for
     #: post-mortem replay (fault/resilient.py)
     k.init("resolver_flight_recorder_size", 64)
+    # Wall-clock chaos (real/chaos.py; docs/real_cluster.md). Defaults for
+    # the seeded NetworkNemesis' background fault mix — a campaign's
+    # ChaosConfig reads these so `--knob`-style overrides steer injection
+    # without touching campaign code. Deliberately no BUGGIFY randomizers:
+    # these only matter in wall-clock mode, where buggify is off anyway.
+    #: probability a request draws added one-way latency
+    k.init("chaos_net_latency_prob", 0.05)
+    #: the added latency when the draw fires (uniform in [0.5x, 1.5x])
+    k.init("chaos_net_latency_ms", 2.0)
+    #: probability a request frame is dropped on the floor (the client sees
+    #: request_maybe_delivered, the redelivery semantics of the transport)
+    k.init("chaos_net_drop_prob", 0.02)
+    #: probability the peer connection is reset under a request
+    k.init("chaos_net_reset_prob", 0.01)
+    #: probability a fresh connection's handshake stalls (the peer accepts
+    #: but never answers the hello; real_handshake_timeout_s must bound it)
+    k.init("chaos_handshake_stall_prob", 0.05)
+    #: wall-clock SLO scale: the chaos campaign's p99 budget is
+    #: resolver_p99_budget_ms x this factor. The 2.5 ms budget prices a
+    #: chip-adjacent resolver (sub-ms device time, in-rack RTT); the
+    #: wall-clock mini-cluster the campaign drives pays ~1 ms in-process
+    #: TCP RTT per hop plus a ~8 ms modeled service slot on a CI box, so
+    #: its serving point sits ~24x higher. The ASSERTION CONTRACT is
+    #: identical — p99 outside injected-fault windows <= the budget knob
+    #: product — only the deployment's latency floor differs
+    #: (docs/real_cluster.md).
+    k.init("real_chaos_budget_factor", 24.0)
+    #: per-tenant admission burst window in seconds (server/ratekeeper.py
+    #: TenantAdmission token bucket: a tenant may burst rate*burst ahead)
+    k.init("tenant_admission_burst_s", 0.5)
     return k
 
 
@@ -199,6 +229,26 @@ def _make_flow_knobs() -> Knobs:
     k.init("min_delay", 0.0001)
     k.init("max_buggified_delay", 0.2)
     k.init("connection_latency", 0.0005)
+    # Real transport (real/transport.py; docs/real_cluster.md). These were
+    # three hardcoded `timeout=5.0` sites and a magic sleep — promoted so a
+    # chaos campaign (or an operator on a lossy link) can tune the failure
+    # detection window without editing the transport.
+    #: default per-request RPC timeout; request() callers may still pass an
+    #: explicit timeout, which also rides the frame as a propagated
+    #: deadline the server sheds expired work against
+    k.init("real_rpc_timeout_s", 5.0)
+    #: bound on the protocol-version handshake (a stalled or mismatched
+    #: peer surfaces as connection_failed within this, never a hang)
+    k.init("real_handshake_timeout_s", 5.0)
+    #: first reconnect backoff after a failed connect (doubles per
+    #: consecutive failure, jittered, until the max below; a request that
+    #: lands inside the backoff window fails fast instead of hammering a
+    #: dead peer with SYNs)
+    k.init("real_reconnect_backoff_initial_s", 0.05)
+    k.init("real_reconnect_backoff_max_s", 2.0)
+    #: jitter half-width as a fraction of the backoff (0.5 = x[0.5, 1.5)),
+    #: so a fleet of clients never reconnects in lockstep
+    k.init("real_reconnect_backoff_jitter", 0.5)
     return k
 
 

@@ -25,6 +25,7 @@ registering a source can never perturb a deterministic simulation.
 from __future__ import annotations
 
 import weakref
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from .tdmetric import TDMetricCollection
@@ -57,6 +58,9 @@ class TelemetryHub:
         #: label -> weakref to ResilientEngine
         self._health: Dict[str, "weakref.ref"] = {}
         self._seq = 0
+        #: bounded ring of recent nemesis/chaos events (real/chaos.py,
+        #: real/nemesis.py) — rendered by `tools/cli.py chaos-status`
+        self.chaos_events: deque = deque(maxlen=256)
 
     # -- registration --------------------------------------------------------
     def _label(self, kind: str, name: str) -> str:
@@ -96,6 +100,24 @@ class TelemetryHub:
         m = self.tdmetrics.int64(f"resolver.{label}.state")
         m.value = HEALTH_STATE_INDEX.get(state, -1)
         m._record(m.value)
+
+    def chaos_event(self, kind: str, **detail: Any) -> None:
+        """Record one injected fault / nemesis action: an Int64 counter per
+        kind (`chaos.<kind>` — rides every hub frontend: Prometheus text,
+        metric logger, status snapshots) plus a bounded event ring with the
+        details, so `tools/cli.py chaos-status` can show WHAT the nemesis
+        did, not just how often."""
+        m = self.tdmetrics.int64(f"chaos.{kind}")
+        m.increment()
+        self.chaos_events.append({"kind": kind, "t": span_now(), **detail})
+
+    def chaos_counts(self) -> Dict[str, int]:
+        """kind -> count for every chaos.* counter this process recorded."""
+        out: Dict[str, int] = {}
+        for name, m in self.tdmetrics.metrics.items():
+            if name.startswith("chaos."):
+                out[name[len("chaos."):]] = int(getattr(m, "value", 0))
+        return out
 
     # -- bridging ------------------------------------------------------------
     def sync(self) -> None:

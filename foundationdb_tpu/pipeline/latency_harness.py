@@ -42,6 +42,44 @@ def p99_budget_ms() -> float:
     return float(SERVER_KNOBS.resolver_p99_budget_ms)
 
 
+def in_any_window(t: float, windows) -> bool:
+    """True when t falls inside any (t0, t1) interval."""
+    return any(w0 <= t <= w1 for w0, w1 in windows)
+
+
+def percentile_index(n: int, p: float) -> int:
+    """THE quantile convention every SLO consumer shares (the nearest-rank
+    index the harness has always used); one definition so a future change
+    to the rule cannot leave two p99s disagreeing over the same data."""
+    return min(n - 1, int(p * n))
+
+
+def percentile_ms(sorted_ms, p: float) -> float:
+    """Percentile of an ascending latency list (ms); nan when empty."""
+    if not sorted_ms:
+        return float("nan")
+    return sorted_ms[percentile_index(len(sorted_ms), p)]
+
+
+def percentile_outside_windows(records, windows, p: float = 0.99):
+    """SLO percentile over ack records whose LIFETIME [t_submit,
+    t_submit + latency] intersects no excluded window — the chaos
+    campaign's assertion primitive (docs/real_cluster.md): p99 must hold
+    outside injected-fault windows; inside them the contract is graceful
+    degradation, not the budget. Interval intersection (not submit-time
+    membership) is the honest filter: a request submitted just before a
+    partition but caught inside it is a window casualty, while one
+    submitted earlier that completed before the window counts.
+
+    `records` are (t_submit, latency_s, ok, version) tuples — the same
+    shape run_latency_under_load accumulates and real/workload.py records.
+    Returns (percentile_ms, n_outside); (nan, 0) when nothing qualifies."""
+    lat_ms = sorted(
+        l * 1e3 for t0, l, _ok, _v in records
+        if not any(t0 <= w1 and t0 + l >= w0 for w0, w1 in windows))
+    return percentile_ms(lat_ms, p), len(lat_ms)
+
+
 @dataclass
 class HarnessResult:
     depth: int
@@ -166,7 +204,7 @@ def _attribute(records, by_trace) -> Optional[dict]:
     rows.sort(key=lambda r: r[0])
 
     def at(p: float) -> dict:
-        idx = min(len(rows) - 1, int(p * len(rows)))
+        idx = percentile_index(len(rows), p)
         w = max(1, int(0.02 * len(rows)))
         sel = rows[max(0, idx - w): idx + w + 1]
         segs = {k: sum(s[k] for _, s in sel) / len(sel) * 1e3
@@ -426,9 +464,7 @@ def run_latency_under_load(
     sustained_committed = sum(1 for _, _, ok, _v in window if ok) / max(span, 1e-9)
 
     def pct(p: float) -> float:
-        if not lat_ms:
-            return float("nan")
-        return lat_ms[min(len(lat_ms) - 1, int(p * len(lat_ms)))]
+        return percentile_ms(lat_ms, p)
 
     stats = cluster.resolvers[0].stats.as_dict()
     n_batches = max(1, stats.get("batches_resolved", 1))

@@ -247,10 +247,17 @@ class ResolverPipeline:
     """
 
     def __init__(self, engine, depth: int = 2, executor=None,
-                 batcher: Optional[BudgetBatcher] = None):
+                 batcher: Optional[BudgetBatcher] = None,
+                 transport_degraded_fn=None):
         assert depth >= 1
         self.engine = engine
         self.depth = depth
+        #: optional transport-health probe (RealNetwork.transport_degraded):
+        #: while it reports True the pipeline collapses to depth 1, exactly
+        #: as it does for a degraded ResilientEngine — keeping batches in
+        #: flight across a flapping link only multiplies the replay/requeue
+        #: work when it resets (docs/real_cluster.md)
+        self._transport_degraded_fn = transport_degraded_fn
         self._executor = executor
         #: batches in submission order, any mix of states; DONE batches are
         #: popped from the left as the window advances
@@ -269,11 +276,25 @@ class ResolverPipeline:
             # so enabling the loop never poisons the step path's estimates
             batcher.set_dispatch_mode(getattr(engine, "dispatch_mode", "step"))
 
+    @property
+    def degraded(self) -> bool:
+        """Engine-degraded OR transport-degraded: either collapses depth."""
+        if getattr(self.engine, "degraded", False):
+            return True
+        fn = self._transport_degraded_fn
+        return bool(fn()) if fn is not None else False
+
+    @property
+    def effective_depth(self) -> int:
+        """`depth` while healthy; 1 while the engine or the transport is
+        degraded (mirrors pipeline/service.py's engine-side collapse)."""
+        return 1 if self.degraded else self.depth
+
     def suggested_batch_txns(self) -> Optional[int]:
         if self.batcher is None:
             return None
         return self.batcher.target_batch_txns(
-            self.depth, degraded=getattr(self.engine, "degraded", False))
+            self.effective_depth, degraded=self.degraded)
 
     @property
     def in_flight(self) -> int:
@@ -288,8 +309,9 @@ class ResolverPipeline:
         #    base/oldest bookkeeping, which the earlier dispatch advances.
         self._dispatch_pending()
         # 2. Window backpressure: force the oldest beyond depth-1 so this
-        #    batch's dispatch keeps at most `depth` un-forced.
-        while self.in_flight >= self.depth:
+        #    batch's dispatch keeps at most `effective_depth` un-forced
+        #    (1 while the engine or transport is degraded).
+        while self.in_flight >= self.effective_depth:
             self._force_oldest()
         pb = PendingResolve(self, now, len(transactions))
         if not self._can_overlap:

@@ -244,14 +244,26 @@ def main(argv=None) -> int:
                     os.path.dirname(os.path.abspath(__file__)))),
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
 
-        # wait for every node to accept connections
+        # wait for every node to accept connections (the cluster join path)
+        from ..core import buggify
+
         deadline = time.time() + 60
         for port in ports:
             while True:
                 if time.time() > deadline:
                     raise TimeoutError(f"node on port {port} never came up")
+                if buggify.buggify():
+                    # slow joiner: the probe itself lags, so nodes come up
+                    # in a different order than they were spawned
+                    time.sleep(0.1)
                 try:
                     with socket.create_connection(("127.0.0.1", port), timeout=1.0):
+                        if buggify.buggify():
+                            # join flap: drop the successful probe once and
+                            # re-probe — the node must tolerate a client
+                            # connecting and vanishing mid-join
+                            time.sleep(0.05)
+                            continue
                         break
                 except OSError:
                     time.sleep(0.3)

@@ -49,6 +49,12 @@ class Child:
         self.backoff = INITIAL_BACKOFF
         self.started_at = 0.0
         self.restart_at = 0.0   # 0 = running or start now
+        #: consecutive sub-stable-uptime exits (the crash-loop counter,
+        #: surfaced in every restart status line; resets once the child
+        #: stays up past STABLE_SECONDS)
+        self.crash_count = 0
+        #: lifetime restarts (monitoring/tests; never reset)
+        self.restarts = 0
 
     def spawn(self, log_dir: str) -> None:
         log = open(os.path.join(log_dir, f"{self.section}.log"), "ab")
@@ -60,8 +66,36 @@ class Child:
             #               would leak one fd per restart of a crash-looper
         self.started_at = time.monotonic()
         self.restart_at = 0.0
-        print(f"fdbmonitor: started {self.section} (pid {self.proc.pid})",
+        print(f"fdbmonitor: started {self.section} (pid {self.proc.pid})"
+              + (f" [crash loop x{self.crash_count}]" if self.crash_count
+                 else ""),
               flush=True)
+
+    def note_stable(self, now: float) -> None:
+        """Uptime past the stable window resets backoff AND the crash-loop
+        counter — a recovered child is no longer crash-looping."""
+        if now - self.started_at > STABLE_SECONDS:
+            self.backoff = INITIAL_BACKOFF
+            self.crash_count = 0
+
+    def note_exit(self, now: float) -> int:
+        """Record an exit: schedule the restart after the CURRENT backoff,
+        then widen it for the next one. A fast-crashing child therefore
+        never respawns hot — every consecutive exit at least doubles the
+        wait, and the status line carries the crash-loop count."""
+        rc = self.proc.returncode if self.proc is not None else None
+        self.proc = None
+        self.crash_count += 1
+        self.restart_at = now + self.backoff
+        print(f"fdbmonitor: {self.section} exited rc={rc}; "
+              f"crash loop x{self.crash_count}; "
+              f"restart in {self.backoff:.1f}s", flush=True)
+        self.backoff = min(self.backoff * 2, MAX_BACKOFF)
+        return rc if rc is not None else -1
+
+    def due(self, now: float) -> bool:
+        """True when a scheduled restart's backoff has elapsed."""
+        return bool(self.restart_at) and now >= self.restart_at
 
     def stop(self) -> None:
         if self.proc is not None and self.proc.poll() is None:
@@ -71,6 +105,32 @@ class Child:
             except subprocess.TimeoutExpired:
                 self.proc.kill()
         self.proc = None
+
+
+def poll_children(children, log_dir: str, now: Optional[float] = None) -> bool:
+    """One supervision pass (extracted from main's loop so the restart
+    policy is unit-testable and reusable by the wall-clock nemesis,
+    real/nemesis.py): reap exits into backoff-scheduled restarts, respawn
+    the due, reset backoff on stable uptime. Returns whether any child is
+    alive or pending restart."""
+    if now is None:
+        now = time.monotonic()
+    any_alive = False
+    for c in children.values() if isinstance(children, dict) else children:
+        if c.proc is not None and c.proc.poll() is None:
+            any_alive = True
+            c.note_stable(now)
+            continue
+        if c.proc is not None:
+            c.note_exit(now)
+        if c.due(now):
+            c.restarts += 1
+            c.spawn(log_dir)
+            any_alive = True
+        # NB: a child merely WAITING OUT its backoff does not count as
+        # alive — preserving --once's original "every child has exited"
+        # exit condition
+    return any_alive
 
 
 def parse_conf(path: str):
@@ -161,30 +221,15 @@ def main(argv=None) -> int:
                     children[section].stop()
                     children[section].argv = new_argvs[section]
                     children[section].backoff = INITIAL_BACKOFF
+                    children[section].crash_count = 0
                     children[section].spawn(log_dir)
             for section, node_argv in new_argvs.items():
                 if section not in children:
                     c = Child(section, node_argv)
                     c.spawn(log_dir)
                     children[section] = c
-        # child liveness + backoff restarts
-        any_alive = False
-        for c in children.values():
-            if c.proc is not None and c.proc.poll() is None:
-                any_alive = True
-                if now - c.started_at > STABLE_SECONDS:
-                    c.backoff = INITIAL_BACKOFF
-                continue
-            if c.proc is not None:
-                rc = c.proc.returncode
-                c.proc = None
-                c.restart_at = now + c.backoff
-                print(f"fdbmonitor: {c.section} exited rc={rc}; "
-                      f"restart in {c.backoff:.1f}s", flush=True)
-                c.backoff = min(c.backoff * 2, MAX_BACKOFF)
-            if c.restart_at and now >= c.restart_at:
-                c.spawn(log_dir)
-                any_alive = True
+        # child liveness + crash-loop-counted backoff restarts
+        any_alive = poll_children(children, log_dir, now)
         if args.once and not any_alive:
             break
 

@@ -64,7 +64,7 @@ async def amain(args) -> None:
     proc = NodeProcess(args.host, args.port, machine_id=f"m{args.port}", dc_id="dc0")
     proc.dispatcher = make_dispatcher(sched)
     await proc.start()
-    net = RealNetClient(sched)
+    net = RealNetClient(sched, name=proc.address)
     world = RealWorld(sched, net, args.datadir)
 
     coords = args.coordinators.split(",")

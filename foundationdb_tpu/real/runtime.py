@@ -151,9 +151,9 @@ class RealNetClient:
         def on_failed(self, _addr: str, _cb) -> None:
             return None
 
-    def __init__(self, sched: RealScheduler):
+    def __init__(self, sched: RealScheduler, name: str = ""):
         self.sched = sched
-        self.raw = RealNetwork()
+        self.raw = RealNetwork(name=name)
         self.monitor = RealNetClient._Monitor()
         #: strong refs — asyncio keeps only weak ones; a GC'd RPC task
         #: would leave its scheduler Future unresolved forever
@@ -166,10 +166,16 @@ class RealNetClient:
     def request(self, src: str, ep, payload: Any,
                 priority: int = TaskPriority.DEFAULT_ENDPOINT,
                 timeout: Optional[float] = None) -> Future:
+        # None defers to the real_rpc_timeout_s knob (transport default);
+        # explicit timeouts also ride the frame as a propagated deadline
         return aio_to_sim(
-            self.raw.request(src, ep, payload, priority,
-                             timeout=timeout or 5.0),
+            self.raw.request(src, ep, payload, priority, timeout=timeout),
             self._tasks)
+
+    def transport_degraded(self) -> bool:
+        """Transport-level degradation signal (reconnect backoff active on
+        any peer) — the depth-collapse input for wall-clock pipelines."""
+        return self.raw.transport_degraded()
 
     def one_way(self, src: str, ep, payload: Any,
                 priority: int = TaskPriority.DEFAULT_ENDPOINT) -> None:
@@ -311,11 +317,17 @@ class RealWorld:
 
 def make_dispatcher(sched: RealScheduler):
     """Transport dispatcher: run a role handler on the node's cooperative
-    scheduler and hand asyncio an awaitable for the reply."""
+    scheduler and hand asyncio an awaitable for the reply. The scheduler
+    Task rides on the future as `sim_task` so deadline shedding
+    (real/transport.RealProcess._answer) can cancel the HANDLER, not just
+    the asyncio bridge — expired work stops running, it doesn't finish
+    into a reply nobody awaits."""
 
     def dispatch(handler, body):
         t = sched.spawn(handler(body), TaskPriority.DEFAULT_ENDPOINT,
                         name=f"rpc:{getattr(handler, '__name__', 'handler')}")
-        return sim_to_aio(t)
+        af = sim_to_aio(t)
+        af.sim_task = t
+        return af
 
     return dispatch

@@ -22,10 +22,12 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import socket as _socket
 import struct
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from ..core import buggify, error, wire
+from ..core.knobs import FLOW_KNOBS
 from ..sim.network import Endpoint
 
 
@@ -74,68 +76,135 @@ async def _read_frame(reader: asyncio.StreamReader) -> Any:
     (n,) = _LEN.unpack(hdr)
     if n > MAX_FRAME:
         raise error.connection_failed("oversized frame")
+    if buggify.buggify():
+        # straddled frame: the body arrives a beat after the header —
+        # readers must tolerate a frame split across socket reads
+        await asyncio.sleep(0)
     return wire.loads(await reader.readexactly(n))
+
+
+def _nodelay(writer: asyncio.StreamWriter) -> None:
+    """Disable Nagle on an RPC connection: with several small frames in
+    flight per connection, Nagle + delayed ACK serializes successive
+    writes into ~40 ms stalls — the classic small-RPC latency cliff. Every
+    serious RPC transport (the reference's FlowTransport included) runs
+    NODELAY; measured here as a 30-60 ms p99 tail under concurrency."""
+    sock = writer.get_extra_info("socket")
+    if sock is not None:
+        try:
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        except (OSError, ValueError):
+            pass   # non-TCP transport (tests may stub); nothing to tune
 
 
 def _write_frame(writer: asyncio.StreamWriter, payload: Any) -> None:
     raw = wire.dumps(payload)
+    if buggify.buggify():
+        # torn write: header and body leave in separate writes, so the
+        # peer's reader sees a partial frame on the wire mid-request
+        writer.write(_LEN.pack(len(raw)))
+        writer.write(raw)
+        return
     writer.write(_LEN.pack(len(raw)) + raw)
 
 
 class _Peer:
-    """One outgoing connection + its in-flight request table."""
+    """One outgoing connection + its in-flight request table, with
+    jittered-exponential reconnect backoff: consecutive connect failures
+    widen `retry_at`, and requests landing inside the window fail fast
+    (connection_failed) instead of hammering a dead peer with SYNs."""
 
-    def __init__(self, addr: str):
+    def __init__(self, addr: str, src: str = "", chaos=None):
         self.addr = addr
+        #: owning network's process name (chaos targets faults by name)
+        self.src = src
+        #: optional NetworkNemesis hook (real/chaos.py): consulted at
+        #: connect time for injected handshake stalls
+        self.chaos = chaos
         self.reader: Optional[asyncio.StreamReader] = None
         self.writer: Optional[asyncio.StreamWriter] = None
         self.pending: Dict[int, asyncio.Future] = {}
         self.lock = asyncio.Lock()
         self._pump: Optional[asyncio.Task] = None
+        #: consecutive failed connects; 0 after any successful handshake
+        self.fail_streak = 0
+        #: loop time before which reconnect attempts fail fast
+        self.retry_at = 0.0
+
+    def note_connect_failure(self, rng01=None) -> float:
+        """Advance the backoff window after a failed connect; returns the
+        backoff applied. Jitter draws from `rng01` when given (a seeded
+        campaign), else the peer spreads itself with hash-derived jitter."""
+        self.fail_streak += 1
+        base = float(FLOW_KNOBS.real_reconnect_backoff_initial_s)
+        cap = float(FLOW_KNOBS.real_reconnect_backoff_max_s)
+        jit = float(FLOW_KNOBS.real_reconnect_backoff_jitter)
+        backoff = min(base * (2 ** (self.fail_streak - 1)), cap)
+        if jit > 0:
+            u = rng01() if rng01 is not None else (
+                (hash((self.addr, self.fail_streak)) & 0xFFFF) / 0xFFFF)
+            backoff *= (1 - jit) + 2 * jit * u
+        self.retry_at = asyncio.get_running_loop().time() + backoff
+        return backoff
 
     async def connect(self) -> None:
         from . import tls
 
         # ONE snapshot for the whole connection: a concurrent set_tls()
-        # can't desync the handshake context from the subject rules
+        # can't desync the handshake context from the subject rules.
+        # The half-open connection lives in LOCALS until the handshake
+        # completes: a concurrent _fail_all() (reset fault, pump death of
+        # the previous incarnation) must not be able to null out the
+        # writer mid-handshake — it simply never sees this one until it
+        # is published whole.
         snap = tls.current()
         host, port = self.addr.rsplit(":", 1)
-        self.reader, self.writer = await asyncio.open_connection(
+        reader, writer = await asyncio.open_connection(
             host, int(port), ssl=snap.client_ctx if snap else None)
-        if snap is not None and not tls.verify_peer(self.writer, snap):
-            self.writer.close()
-            self.reader = self.writer = None
-            raise error.connection_failed("peer failed TLS subject check")
-        # protocol-version handshake BEFORE the reply pump owns the reader:
-        # hello out, hello back, versions must match
-        _write_frame(self.writer, {"kind": "hello", "id": 0,
-                                   "token": "", "body": PROTOCOL_VERSION})
-        await self.writer.drain()
+        _nodelay(writer)
         try:
-            reply = await asyncio.wait_for(_read_frame(self.reader), timeout=5.0)
-        except asyncio.TimeoutError:
-            self.writer.close()
-            self.reader = self.writer = None
-            raise error.connection_failed("handshake timeout")
-        except asyncio.IncompleteReadError:
-            # no timeout happened: the peer CLOSED mid-handshake — the
-            # classic symptom of a plaintext/TLS listener mismatch
-            self.writer.close()
-            self.reader = self.writer = None
-            raise error.connection_failed(
-                "connection closed during handshake (TLS mismatch?)")
-        if reply.get("kind") == "err":
-            self.writer.close()
-            self.reader = self.writer = None
-            raise error.connection_failed(
-                f"peer refused connection: {reply.get('body')}")
-        if reply.get("kind") != "hello" or reply.get("body") != PROTOCOL_VERSION:
-            self.writer.close()
-            self.reader = self.writer = None
-            raise error.connection_failed(
-                f"protocol version mismatch: ours {PROTOCOL_VERSION}, "
-                f"theirs {reply.get('body')}")
+            if snap is not None and not tls.verify_peer(writer, snap):
+                raise error.connection_failed("peer failed TLS subject check")
+
+            async def _handshake():
+                # EVERYTHING between accept and a validated hello counts
+                # against the handshake bound — including an injected
+                # chaos stall, so a stall longer than the knob surfaces
+                # as connection_failed, never as an unbounded hang
+                if self.chaos is not None:
+                    await self.chaos.on_connect(self.src, self.addr)
+                # protocol-version handshake BEFORE the reply pump owns
+                # the reader: hello out, hello back, versions must match
+                _write_frame(writer, {"kind": "hello", "id": 0,
+                                      "token": "", "body": PROTOCOL_VERSION})
+                await writer.drain()
+                return await _read_frame(reader)
+
+            try:
+                reply = await asyncio.wait_for(
+                    _handshake(),
+                    timeout=float(FLOW_KNOBS.real_handshake_timeout_s))
+            except asyncio.TimeoutError:
+                raise error.connection_failed("handshake timeout")
+            except asyncio.IncompleteReadError:
+                # no timeout happened: the peer CLOSED mid-handshake — the
+                # classic symptom of a plaintext/TLS listener mismatch
+                raise error.connection_failed(
+                    "connection closed during handshake (TLS mismatch?)")
+            if reply.get("kind") == "err":
+                raise error.connection_failed(
+                    f"peer refused connection: {reply.get('body')}")
+            if reply.get("kind") != "hello" or reply.get("body") != PROTOCOL_VERSION:
+                raise error.connection_failed(
+                    f"protocol version mismatch: ours {PROTOCOL_VERSION}, "
+                    f"theirs {reply.get('body')}")
+        except BaseException:
+            writer.close()
+            raise
+        self.reader, self.writer = reader, writer
         self._pump = asyncio.create_task(self._pump_replies())
+        self.fail_streak = 0
+        self.retry_at = 0.0
 
     async def _pump_replies(self) -> None:
         try:
@@ -196,6 +265,10 @@ class RealProcess:
         #: scheduler instead (handlers there await scheduler Futures,
         #: which asyncio cannot drive)
         self.dispatcher: Optional[Callable] = None
+        #: requests shed because their propagated deadline (frame ttl)
+        #: expired before the handler finished — work nobody was waiting
+        #: for anymore (docs/real_cluster.md, deadline propagation)
+        self.shed_expired = 0
 
     @property
     def address(self) -> str:
@@ -232,6 +305,7 @@ class RealProcess:
         from . import tls
 
         self._conns.add(writer)
+        _nodelay(writer)
         shaken = False
         try:
             if self._tls is not None and not tls.verify_peer(writer,
@@ -240,7 +314,9 @@ class RealProcess:
                 # with unread bytes degenerates to an RST that destroys
                 # the diagnostic frame below
                 try:
-                    await asyncio.wait_for(_read_frame(reader), 5.0)
+                    await asyncio.wait_for(
+                        _read_frame(reader),
+                        float(FLOW_KNOBS.real_handshake_timeout_s))
                 except (asyncio.TimeoutError, asyncio.IncompleteReadError,
                         ConnectionError, OSError):
                     pass
@@ -304,16 +380,42 @@ class RealProcess:
 
     async def _answer(self, writer: asyncio.StreamWriter, msg) -> None:
         if buggify.buggify():
-            await asyncio.sleep(0.05)   # slow service: client timeouts race
+            # slow service: client timeouts race (knob-derived, was 0.05)
+            await asyncio.sleep(float(FLOW_KNOBS.max_buggified_delay) / 4)
         handler = self.handlers.get(msg["token"])
+        #: propagated client deadline (seconds of budget left at send time):
+        #: handler work is bounded by it — a reply the client stopped
+        #: waiting for is shed as request_maybe_delivered instead of
+        #: occupying the service path (deadline propagation,
+        #: docs/real_cluster.md)
+        ttl = msg.get("ttl")
         try:
             if handler is None:
                 raise error.FDBError(error.request_maybe_delivered("").code,
                                      "request_maybe_delivered")
             if self.dispatcher is not None:
-                body = await self.dispatcher(handler, msg["body"])
+                work = self.dispatcher(handler, msg["body"])
             else:
-                body = await handler(msg["body"])
+                work = handler(msg["body"])
+            if ttl is not None:
+                try:
+                    body = await asyncio.wait_for(work, float(ttl))
+                except asyncio.TimeoutError:
+                    # cancel the HANDLER too (scheduler-dispatched work
+                    # carries its Task as sim_task): shedding must stop
+                    # the work, not just abandon its reply. Work a
+                    # handler already handed to a role-internal batcher
+                    # still completes — the cancel bounds everything
+                    # upstream of that handoff.
+                    task = getattr(work, "sim_task", None)
+                    if task is not None:
+                        task.cancel()
+                    self.shed_expired += 1
+                    raise error.FDBError(
+                        error.request_maybe_delivered("").code,
+                        "request_maybe_delivered")
+            else:
+                body = await work
             reply = {"kind": "reply", "id": msg["id"], "body": body}
         except error.FDBError as e:
             reply = {"kind": "err", "id": msg["id"], "body": (e.code, e.name)}
@@ -329,33 +431,95 @@ class RealProcess:
 
 class RealNetwork:
     """The sender half: the sim network's request/one_way surface over
-    real sockets. One instance per OS process; peers cached per address."""
+    real sockets. One instance per OS process; peers cached per address.
 
-    def __init__(self):
+    `name` is this process's identity for fault targeting (real/chaos.py
+    partitions between NAMED processes); `chaos` is an optional
+    NetworkNemesis handed down to peers for connect-time injection."""
+
+    def __init__(self, name: str = "", chaos=None):
+        self.name = name
+        self.chaos = chaos
         self._peers: Dict[str, _Peer] = {}
         self._next_id = 0
+        #: degradation counters (docs/real_cluster.md): reconnect attempts
+        #: gated by backoff fail fast here instead of SYN-flooding the peer
+        self.backoff_failfasts = 0
+        self.reconnects = 0
 
-    async def _peer(self, addr: str) -> _Peer:
+    def transport_degraded(self) -> bool:
+        """True while any peer is inside a reconnect-backoff window — the
+        transport-level analog of ResilientEngine.degraded, consumed by
+        depth-collapse (pipeline/resolver_pipeline.py) and admission."""
+        return any(p.fail_streak > 0 for p in self._peers.values())
+
+    async def _peer(self, addr: str, deadline: Optional[float] = None) -> _Peer:
         p = self._peers.get(addr)
         if p is None:
-            p = self._peers[addr] = _Peer(addr)
-        async with p.lock:
-            if p.writer is None:
+            p = self._peers[addr] = _Peer(addr, src=self.name,
+                                          chaos=self.chaos)
+
+        async def ensure_connected() -> None:
+            async with p.lock:
+                if p.writer is not None:
+                    return
+                loop_now = asyncio.get_running_loop().time()
+                if loop_now < p.retry_at:
+                    # inside the backoff window: fail fast — the caller's
+                    # retry policy owns pacing, not a per-request SYN storm
+                    self.backoff_failfasts += 1
+                    raise error.connection_failed(
+                        f"reconnect backoff ({p.retry_at - loop_now:.3f}s left)")
                 try:
+                    if p.fail_streak:
+                        self.reconnects += 1
                     await p.connect()
+                except error.FDBError:
+                    p.note_connect_failure()
+                    raise
                 except (ConnectionError, OSError) as e:
+                    p.note_connect_failure()
                     raise error.connection_failed(str(e))
+
+        if p.writer is not None or deadline is None:
+            # hot path: an already-connected peer skips the wait_for
+            # task/timer allocation entirely (every request carries a
+            # deadline, so this is the per-RPC steady state)
+            await ensure_connected()
+            return p
+        # the request budget is end to end: the connect phase — including
+        # TCP to a SYN-blackholed host and waiting out another request's
+        # in-flight connect on the peer lock — must not outlive it
+        remaining = deadline - asyncio.get_running_loop().time()
+        if remaining <= 0:
+            raise error.connection_failed("deadline exceeded before connect")
+        try:
+            await asyncio.wait_for(ensure_connected(), remaining)
+        except asyncio.TimeoutError:
+            raise error.connection_failed("connect deadline exceeded")
         return p
 
     async def request(self, src: str, ep: Endpoint, payload: Any,
-                      priority: int = 0, timeout: float = 5.0) -> Any:
-        p = await self._peer(ep.address)
+                      priority: int = 0,
+                      timeout: Optional[float] = None) -> Any:
+        if timeout is None:
+            timeout = float(FLOW_KNOBS.real_rpc_timeout_s)
+        # deadline propagation: the budget is END TO END — connect (incl.
+        # handshake) and the reply wait share it, and the remaining budget
+        # rides the frame as `ttl` so the server can shed work whose
+        # client already gave up (a healed partition flushes a backlog of
+        # frames nobody is waiting on; resolving them only adds queue)
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        p = await self._peer(ep.address, deadline)
         self._next_id += 1
         rid = self._next_id
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        fut: asyncio.Future = loop.create_future()
         p.pending[rid] = fut
         try:
-            frame = {"kind": "req", "id": rid, "token": ep.token, "body": payload}
+            ttl = max(0.001, deadline - loop.time())
+            frame = {"kind": "req", "id": rid, "token": ep.token,
+                     "body": payload, "ttl": round(ttl, 4)}
             _write_frame(p.writer, frame)
             if buggify.buggify():
                 # duplicate delivery (the transport's redelivery semantics):
@@ -368,7 +532,8 @@ class RealNetwork:
             p._fail_all()
             raise error.connection_failed(str(e))
         try:
-            return await asyncio.wait_for(fut, timeout)
+            return await asyncio.wait_for(
+                fut, max(0.001, deadline - loop.time()))
         except asyncio.TimeoutError:
             p.pending.pop(rid, None)
             raise error.request_maybe_delivered("request timed out")

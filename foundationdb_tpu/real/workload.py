@@ -1,0 +1,225 @@
+"""Open-loop Zipfian workload fleet for the wall-clock cluster.
+
+The bench's latency harness drives the SIM cluster with a Poisson stream;
+nothing modelled realistic traffic against the real transport (ROADMAP
+item 4). This fleet does: multi-tenant open-loop streams over real
+sockets, each tenant an independent Poisson arrival process at its own
+target txn/s over its own Zipf(s)-skewed hot-key pool. Open-loop is the
+honest shape (Harmonia-style offered load): a txn is submitted at its
+arrival time regardless of outstanding ones, so server-side queueing
+shows up as client latency, never as politely reduced load. Skew is the
+point — Proust's design-space analysis (PAPERS.md) shows optimistic
+schemes bite under hot-key contention, so robustness is proven at
+s ∈ {0, 0.9, 1.2}, not under uniform smoke traffic.
+
+The fleet is transport-agnostic: it drives a `submit(spec, reads,
+writes)` coroutine (real/nemesis.py supplies one over ChaosTransport) and
+records (t_submit, latency_s, ok, version, err_name) per tenant — the
+shape `pipeline/latency_harness.percentile_outside_windows` asserts SLOs
+over (docs/real_cluster.md).
+"""
+from __future__ import annotations
+
+import asyncio
+import bisect
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..core.rng import DeterministicRandom
+
+#: ack error names that are honest, full-path verdicts (their latency
+#: belongs in the SLO population, like the sim harness's conflict acks)
+VERDICT_ERRORS = ("not_committed", "transaction_too_old")
+#: fast typed rejection from per-tenant admission control — NOT a latency
+#: sample (the tenant was told to back off in microseconds); reported as
+#: rejected_frac instead
+THROTTLE_ERROR = "transaction_throttled"
+
+
+def zipf_cdf(n_keys: int, s: float) -> List[float]:
+    """Cumulative Zipf(s) distribution over ranks 1..n (s=0 -> uniform)."""
+    weights = [1.0 / (k ** s) for k in range(1, n_keys + 1)]
+    total = sum(weights)
+    cdf, acc = [], 0.0
+    for w in weights:
+        acc += w / total
+        cdf.append(acc)
+    cdf[-1] = 1.0
+    return cdf
+
+
+class ZipfKeySampler:
+    """Seeded rank-Zipf sampler: rank 0 is the hottest key. Inverse-CDF
+    via bisect — O(log n) per draw, no numpy in the hot path."""
+
+    def __init__(self, n_keys: int, s: float, rng: DeterministicRandom):
+        self.n_keys = n_keys
+        self.s = s
+        self.rng = rng
+        self._cdf = zipf_cdf(n_keys, s)
+
+    def sample(self) -> int:
+        return bisect.bisect_left(self._cdf, self.rng.random01())
+
+
+@dataclass
+class TenantSpec:
+    """One tenant's stream: open-loop Poisson at `target_tps` over a
+    `n_keys` pool with Zipf skew `s` (0 = uniform)."""
+
+    name: str
+    target_tps: float
+    s: float = 0.0
+    n_keys: int = 512
+    reads_per_txn: int = 2
+    writes_per_txn: int = 2
+    key_prefix: bytes = b""
+
+    def prefix(self) -> bytes:
+        return self.key_prefix or self.name.encode()
+
+
+@dataclass
+class FleetReport:
+    """What the fleet observed, per tenant and overall."""
+
+    #: tenant -> [(t_submit, latency_s, ok, version, err_name)]
+    records: Dict[str, List[Tuple]] = field(default_factory=dict)
+    #: tenant -> error name -> count (transport errors, throttles, ...)
+    errors: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    offered: Dict[str, int] = field(default_factory=dict)
+    t_start: float = 0.0
+    t_end: float = 0.0
+
+    def ack_records(self, tenant: Optional[str] = None) -> List[Tuple]:
+        """Latency-population records (committed + verdict acks): the SLO
+        sample set, as (t0, lat, ok, version) 4-tuples."""
+        out = []
+        for name, recs in self.records.items():
+            if tenant is not None and name != tenant:
+                continue
+            for t0, lat, ok, version, err in recs:
+                if ok or err in VERDICT_ERRORS:
+                    out.append((t0, lat, ok, version))
+        out.sort(key=lambda r: r[0])
+        return out
+
+    def counts(self, tenant: Optional[str] = None) -> Dict[str, int]:
+        sel = [(n, r) for n, r in self.records.items()
+               if tenant is None or n == tenant]
+        committed = sum(1 for _n, recs in sel for r in recs if r[2])
+        conflicted = sum(1 for _n, recs in sel for r in recs
+                         if not r[2] and r[4] in VERDICT_ERRORS)
+        throttled = sum(e.get(THROTTLE_ERROR, 0)
+                        for n, e in self.errors.items()
+                        if tenant is None or n == tenant)
+        transport = sum(c for n, e in self.errors.items()
+                        if tenant is None or n == tenant
+                        for k, c in e.items()
+                        if k not in VERDICT_ERRORS + (THROTTLE_ERROR,))
+        offered = sum(c for n, c in self.offered.items()
+                      if tenant is None or n == tenant)
+        return {"offered": offered, "committed": committed,
+                "conflicted": conflicted, "throttled": throttled,
+                "transport_errors": transport}
+
+    def sustained_tps(self, tenant: Optional[str] = None) -> float:
+        acks = self.ack_records(tenant)
+        if len(acks) < 2:
+            return 0.0
+        span = acks[-1][0] - acks[0][0]
+        return len(acks) / max(span, 1e-9)
+
+
+class WorkloadFleet:
+    """Drive every tenant's open-loop stream concurrently on asyncio."""
+
+    def __init__(self, tenants: List[TenantSpec],
+                 submit: Callable, seed: int = 0,
+                 duration_s: float = 5.0,
+                 max_outstanding: int = 2048,
+                 report: Optional[FleetReport] = None):
+        self.tenants = tenants
+        self.submit = submit
+        self.seed = seed
+        self.duration_s = duration_s
+        #: open-loop guard rail: past this many outstanding submissions a
+        #: tenant sheds locally (records a client_overload error) instead
+        #: of growing the task set without bound while the server is
+        #: partitioned away — the open-loop contract holds far beyond any
+        #: SLO-passing regime, this only bounds memory in the failed one
+        self.max_outstanding = max_outstanding
+        #: pass an existing report to APPEND a phase (the campaign's
+        #: post-recovery cooldown records into the same population)
+        self.report = report if report is not None else FleetReport()
+        self._outstanding: Dict[str, int] = {}
+        self._phase_start = 0.0
+
+    async def _one_txn(self, spec: TenantSpec, sampler: ZipfKeySampler) -> None:
+        from ..core import error as _error
+
+        rep = self.report
+        pfx = spec.prefix()
+        reads = [b"%s/%06d" % (pfx, sampler.sample())
+                 for _ in range(spec.reads_per_txn)]
+        writes = [b"%s/%06d" % (pfx, sampler.sample())
+                  for _ in range(spec.writes_per_txn)]
+        t0 = time.monotonic()
+        ok, version, err = False, None, None
+        try:
+            version = await self.submit(spec, reads, writes)
+            ok = True
+        except _error.FDBError as e:
+            err = e.name
+        except (ConnectionError, OSError) as e:
+            err = type(e).__name__
+        lat = time.monotonic() - t0
+        if err is not None and err not in VERDICT_ERRORS:
+            rep.errors[spec.name][err] = rep.errors[spec.name].get(err, 0) + 1
+        if ok or err in VERDICT_ERRORS:
+            rep.records[spec.name].append((t0, lat, ok, version, err))
+        self._outstanding[spec.name] -= 1
+
+    async def _tenant_stream(self, spec: TenantSpec,
+                             rng: DeterministicRandom) -> None:
+        sampler = ZipfKeySampler(spec.n_keys, spec.s,
+                                 DeterministicRandom(rng.random_int(0, 2**31 - 1)))
+        lam = max(spec.target_tps, 1e-3)
+        t_end = self._phase_start + self.duration_s
+        tasks: set = set()
+        while time.monotonic() < t_end:
+            await asyncio.sleep(-math.log(max(rng.random01(), 1e-12)) / lam)
+            self.report.offered[spec.name] = \
+                self.report.offered.get(spec.name, 0) + 1
+            if self._outstanding[spec.name] >= self.max_outstanding:
+                e = self.report.errors[spec.name]
+                e["client_overload"] = e.get("client_overload", 0) + 1
+                continue
+            self._outstanding[spec.name] += 1
+            t = asyncio.ensure_future(self._one_txn(spec, sampler))
+            tasks.add(t)
+            t.add_done_callback(tasks.discard)
+        if tasks:
+            await asyncio.wait(tasks, timeout=10.0)
+
+    async def run(self) -> FleetReport:
+        rng = DeterministicRandom(self.seed)
+        rep = self.report
+        if not rep.t_start:
+            rep.t_start = time.monotonic()
+        self._phase_start = time.monotonic()
+        for spec in self.tenants:
+            rep.records.setdefault(spec.name, [])
+            rep.errors.setdefault(spec.name, {})
+            rep.offered.setdefault(spec.name, 0)
+            self._outstanding[spec.name] = 0
+        streams = [
+            self._tenant_stream(spec,
+                                DeterministicRandom(rng.random_int(0, 2**31 - 1)))
+            for spec in self.tenants
+        ]
+        await asyncio.gather(*streams)
+        rep.t_end = time.monotonic()
+        return rep

@@ -158,6 +158,12 @@ class GetReadVersionReply:
 @dataclass
 class CommitTransactionRequest:
     transaction: CommitTransaction
+    #: multi-tenant QoS identity (docs/real_cluster.md): None rides the
+    #: legacy single-tenant path untouched; set, the proxy's per-tenant
+    #: admission control (server/ratekeeper.py TenantAdmission) may shed
+    #: this commit with the typed transaction_throttled error instead of
+    #: letting one hot tenant queue every other tenant past the SLO
+    tenant: Optional[str] = None
 
 
 @dataclass

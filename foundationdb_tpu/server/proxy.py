@@ -202,6 +202,12 @@ class ProxyConfig:
     #: tiny batches stalled at the version chain. Size it to the resolver
     #: pipeline depth + 1 (one batch accumulating, `depth` in service).
     commit_pipeline_window: Optional[int] = None
+    #: per-tenant admission control (server/ratekeeper.py TenantAdmission;
+    #: docs/real_cluster.md): None = off (every request rides the legacy
+    #: path). Set, commits carrying a tenant id are token-bucket gated on
+    #: the ratekeeper-published rate — one hot tenant sheds as fast typed
+    #: transaction_throttled errors instead of queueing every tenant
+    tenant_admission: Optional[object] = None
 
 
 class Proxy:
@@ -294,6 +300,10 @@ class Proxy:
                 self._tps_limit = reply.tps_limit
                 self._commit_batch_target = getattr(
                     reply, "commit_batch_target", None)
+                if self.cfg.tenant_admission is not None:
+                    # the same published rate that meters GRV release also
+                    # feeds the per-tenant commit admission buckets
+                    self.cfg.tenant_admission.set_rate(reply.tps_limit)
             except error.FDBError:
                 pass
             await delay(SERVER_KNOBS.ratekeeper_update_interval, TaskPriority.RATEKEEPER)
@@ -434,6 +444,17 @@ class Proxy:
     # -- commit path -----------------------------------------------------------
     async def commit(self, req: CommitTransactionRequest) -> CommitReply:
         self.stats.add("txn_commit_in")
+        adm = self.cfg.tenant_admission
+        tenant = getattr(req, "tenant", None)
+        if adm is not None and tenant is not None:
+            from ..sim.loop import now as _now
+
+            if not adm.admit(tenant, _now()):
+                # shed BEFORE the batcher: a rejected commit costs the
+                # tenant a typed error and a client-side backoff, never a
+                # slot in the batch queue (docs/real_cluster.md)
+                self.stats.add("txn_commit_throttled")
+                raise error.transaction_throttled(f"tenant {tenant}")
         p = Promise()
         self._commit_queue.send((req.transaction, p))
         return await p.future

@@ -70,6 +70,78 @@ class GetRateInfoReply:
     commit_batch_target: Optional[int] = None
 
 
+class TenantAdmission:
+    """Per-tenant token-bucket admission control for the commit path.
+
+    The ratekeeper publishes ONE cluster rate; under multi-tenant skewed
+    load that lets a single hot tenant consume the whole budget and queue
+    everyone else past the p99 SLO (docs/real_cluster.md). This splits the
+    published rate into per-tenant buckets by weight: `admit()` spends a
+    token or answers False, and the proxy (server/proxy.py) turns False
+    into the typed `transaction_throttled` error — a microsecond rejection
+    the client retries with backoff, instead of a multi-second queue entry
+    that blows the budget for every tenant.
+
+    Fed from the same ratekeeper reply the proxy already fetches
+    (GetRateInfoReply.tps_limit, refreshed by `set_rate`); the wall-clock
+    chaos server (real/nemesis.py) feeds it a degraded-fraction rate the
+    same way the ratekeeper's resolver-health signal scales tps_limit.
+    Clock-agnostic: callers pass `now` (sim virtual time or monotonic)."""
+
+    def __init__(self, weights: Optional[Dict[str, float]] = None,
+                 burst_s: Optional[float] = None):
+        #: tenant -> relative weight (unknown tenants weigh 1.0)
+        self.weights: Dict[str, float] = dict(weights or {})
+        self.burst_s = float(burst_s if burst_s is not None
+                             else SERVER_KNOBS.tenant_admission_burst_s)
+        #: total admission rate across tenants (inf = admission off)
+        self.rate_limit: float = float("inf")
+        #: tenant -> [tokens, last_refill_t]
+        self._buckets: Dict[str, List[float]] = {}
+        self.admitted: Dict[str, int] = {}
+        self.rejected: Dict[str, int] = {}
+
+    def set_rate(self, tps_limit: float) -> None:
+        self.rate_limit = float(tps_limit)
+
+    def tenant_rate(self, tenant: str) -> float:
+        """This tenant's share: weight-proportional slice of the published
+        rate across every tenant seen so far (plus this one)."""
+        if self.rate_limit == float("inf"):
+            return float("inf")
+        active = set(self._buckets) | {tenant}
+        total_w = sum(self.weights.get(t, 1.0) for t in active)
+        return self.rate_limit * self.weights.get(tenant, 1.0) / max(total_w, 1e-9)
+
+    def admit(self, tenant: str, now: float) -> bool:
+        rate = self.tenant_rate(tenant)
+        if rate == float("inf"):
+            self.admitted[tenant] = self.admitted.get(tenant, 0) + 1
+            return True
+        bucket = self._buckets.get(tenant)
+        burst = max(1.0, rate * self.burst_s)
+        if bucket is None:
+            bucket = self._buckets[tenant] = [burst, now]
+        tokens, last = bucket
+        tokens = min(burst, tokens + rate * max(0.0, now - last))
+        if tokens >= 1.0:
+            bucket[0], bucket[1] = tokens - 1.0, now
+            self.admitted[tenant] = self.admitted.get(tenant, 0) + 1
+            return True
+        bucket[0], bucket[1] = tokens, now
+        self.rejected[tenant] = self.rejected.get(tenant, 0) + 1
+        return False
+
+    def as_dict(self) -> dict:
+        return {
+            "rate_limit": (None if self.rate_limit == float("inf")
+                           else round(self.rate_limit, 1)),
+            "burst_s": self.burst_s,
+            "admitted": dict(self.admitted),
+            "rejected": dict(self.rejected),
+        }
+
+
 class Ratekeeper:
     """Polls storage; computes the cluster TPS limit (rateKeeper:509)."""
 

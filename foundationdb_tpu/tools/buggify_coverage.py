@@ -22,7 +22,7 @@ from __future__ import annotations
 import argparse
 import sys
 from pathlib import Path
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 REPO = Path(__file__).resolve().parent.parent.parent
 
@@ -59,6 +59,17 @@ def sim_reachable(sites: List[Tuple[str, int]]) -> List[Tuple[str, int]]:
     """Real-transport sites can only fire in real mode; everything else is
     reachable from the simulation battery."""
     return [(f, l) for f, l in sites if "/real/" not in f]
+
+
+def real_sites(sites: Optional[List[Tuple[str, int]]] = None) -> List[Tuple[str, int]]:
+    """Injection sites in the wall-clock layer (real/): frame read/write
+    tears, cluster join flaps, slow service. They fire under a buggified
+    real-mode run, not the sim battery — the report lists them separately
+    so the real layer's injection inventory is visible (and a zero count
+    flags the layer losing its fault hooks; tests/test_buggify_coverage.py
+    pins it non-zero)."""
+    return [(f, l) for f, l in (sites if sites is not None else static_sites())
+            if "/real/" in f]
 
 
 def run_battery(spec_names: List[str], seeds: List[int], out=sys.stdout):
@@ -102,8 +113,14 @@ def report(activated, fired, out=sys.stdout) -> float:
         except ValueError:
             return f
 
-    print(f"\nbuggify sites: {len(total)} static, {len(reachable)} sim-reachable",
-          file=out)
+    real = real_sites(total)
+    print(f"\nbuggify sites: {len(total)} static, {len(reachable)} "
+          f"sim-reachable, {len(real)} real-layer", file=out)
+    if real:
+        print("real-layer sites (fire under buggified wall-clock runs, "
+              "not this battery):", file=out)
+        for f, l in real:
+            print(f"  {rel(f)}:{l}", file=out)
     print(f"activated at least once: "
           f"{len([s for s in reachable if s in activated])}/{len(reachable)}",
           file=out)

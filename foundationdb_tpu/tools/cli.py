@@ -37,6 +37,9 @@ commands:
   telemetry [json]     resolver engine telemetry: health, perf counters,
                        budget-batcher EWMAs (docs/observability.md)
   telemetry read PROCESS METRIC   read a persisted \\xff/metrics/ series
+  chaos-status [FILE]  nemesis event counts from this process's telemetry
+                       hub, or from a campaign report JSON written by
+                       `python -m foundationdb_tpu.real.nemesis --json`
   help                 this text
   exit                 quit
 Keys/values are text; prefix with 0x for hex bytes."""
@@ -174,6 +177,41 @@ class Cli:
             if "flight_recorder_entries" in frag:
                 self._print(f"    flightrec- {frag['flight_recorder_entries']} "
                             "recent dispatch records")
+
+    def do_chaos_status(self, args: List[str]) -> None:
+        """Nemesis activity (docs/real_cluster.md): chaos.* counters + the
+        recent event ring from the telemetry hub — the live view after an
+        in-process campaign — or the aggregated counts of a campaign
+        report file (real/nemesis.py --json)."""
+        if args:
+            with open(args[0]) as f:
+                doc = json.load(f)
+            totals: dict = {}
+            campaigns = doc.get("campaigns", [])
+            for rep in campaigns:
+                for kind, n in (rep.get("chaos_counts") or {}).items():
+                    totals[kind] = totals.get(kind, 0) + n
+            self._print(f"{len(campaigns)} campaign(s) in {args[0]}")
+            if not totals:
+                self._print("no nemesis events recorded")
+                return
+            self._print("nemesis event counts (all campaigns):")
+            for kind in sorted(totals):
+                self._print(f"  {kind:<18} {totals[kind]}")
+            for rep in campaigns:
+                eng = rep.get("engine_stats") or {}
+                self._print(
+                    f"  seed {rep.get('cfg_seed')} [{rep.get('engine_mode')}]"
+                    f" p99_outside={rep.get('p99_outside_ms'):.3f}ms"
+                    f" failovers={eng.get('failovers', 0)}"
+                    f" swap_backs={eng.get('swap_backs', 0)}"
+                    f" parity={rep.get('parity_checked')}"
+                    f"/{rep.get('parity_mismatches')}mm")
+            return
+        from ..real.chaos import chaos_status_lines
+
+        for line in chaos_status_lines():
+            self._print(line)
 
     def do_get(self, args: List[str]) -> None:
         (key,) = args
@@ -329,7 +367,7 @@ class Cli:
             return True
         if not parts:
             return True
-        cmd, args = parts[0].lower(), parts[1:]
+        cmd, args = parts[0].lower().replace("-", "_"), parts[1:]
         if cmd in ("exit", "quit"):
             return False
         if cmd == "help":
@@ -364,8 +402,23 @@ def main(argv=None) -> int:
 
     ap = argparse.ArgumentParser(description="cli over a simulated cluster")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("command", nargs="*", default=[],
+                    help="run one command and exit (e.g. "
+                         "`chaos-status reports.json`, `status`)")
     args = ap.parse_args(argv)
+    if args.command and args.command[0].replace("-", "_") == "chaos_status":
+        # no cluster needed: renders the hub / a campaign report file
+        cli = Cli.__new__(Cli)
+        cli.out = sys.stdout
+        cli.do_chaos_status(args.command[1:])
+        return 0
     cluster = build_dynamic_cluster(seed=args.seed, cfg=DynamicClusterConfig())
+    if args.command:
+        # one-shot mode: boot, run the single command, exit
+        cli = Cli(cluster)
+        cli.sim.run(until=3.0)
+        cli.run_command(shlex.join(args.command))
+        return 0
     cli = Cli(cluster)
     cli.sim.run(until=3.0)   # let the cluster bootstrap
     print("connected to simulated cluster (seed %d); `help' for commands" % args.seed)

@@ -24,6 +24,24 @@ def test_site_count_floor():
     assert len(sites) >= 60, f"only {len(sites)} BUGGIFY sites"
 
 
+def test_real_layer_sites_exist():
+    """The wall-clock layer must carry its own injection sites (ISSUE 8:
+    frame read/write tears in real/transport.py, join-path flaps in
+    real/cluster.py). They are excluded from the sim battery's fired
+    fraction but must exist — zero means the real layer lost its fault
+    hooks."""
+    from foundationdb_tpu.tools.buggify_coverage import real_sites
+
+    sites = static_sites()
+    real = real_sites(sites)
+    assert len(real) >= 4, f"only {len(real)} real-layer BUGGIFY sites: {real}"
+    files = {Path(f).name for f, _ in real}
+    assert "transport.py" in files, files
+    assert "cluster.py" in files, files
+    # real sites are exactly the static minus sim-reachable split
+    assert len(real) + len(sim_reachable(sites)) == len(sites)
+
+
 BATTERY = [
     ("DurableCycleAttrition", 11), ("DurableCycleAttrition", 17),
     ("DataDistributionAttrition", 12), ("CycleTestRestart", 13),

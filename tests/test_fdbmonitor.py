@@ -25,6 +25,61 @@ def free_ports(n):
     return ports
 
 
+@pytest.mark.timeout(60)
+def test_crash_loop_counter_and_backoff(tmp_path, capsys):
+    """Satellite regression (ISSUE 8): a fast-crashing child must NOT
+    respawn hot — every consecutive exit at least doubles the backoff,
+    the crash-loop counter climbs and is surfaced in the status line, and
+    stable uptime resets both."""
+    from foundationdb_tpu.real.monitor import (
+        INITIAL_BACKOFF, Child, poll_children)
+
+    # a child that exits immediately with rc=3
+    child = Child("node.crashy", [sys.executable, "-c", "raise SystemExit(3)"])
+    child.backoff = 0.1   # campaign-paced for the test
+    child.spawn(str(tmp_path))
+    child.proc.wait(timeout=10)
+
+    # first poll: reaps the exit, schedules the restart — NO hot respawn
+    poll_children([child], str(tmp_path))
+    assert child.proc is None, "respawned hot with no backoff"
+    assert child.crash_count == 1
+    assert child.restart_at > 0
+    out = capsys.readouterr().out
+    assert "crash loop x1" in out and "restart in 0.1s" in out
+    assert child.backoff == pytest.approx(0.2)   # doubled for next time
+
+    # polling again BEFORE the backoff elapses must not respawn
+    poll_children([child], str(tmp_path))
+    assert child.proc is None and child.restarts == 0
+
+    # after the backoff: respawn, crash again, counter climbs, backoff doubles
+    time.sleep(0.12)
+    poll_children([child], str(tmp_path))
+    assert child.restarts == 1 and child.proc is not None
+    child.proc.wait(timeout=10)
+    poll_children([child], str(tmp_path))
+    assert child.crash_count == 2
+    assert child.backoff == pytest.approx(0.4)
+    out = capsys.readouterr().out
+    assert "crash loop x2" in out
+
+    # stable uptime resets the loop accounting
+    child.stop()
+    stable = Child("node.stable", [sys.executable, "-c",
+                                   "import time; time.sleep(60)"])
+    stable.spawn(str(tmp_path))
+    try:
+        stable.crash_count = 3
+        stable.backoff = 8.0
+        stable.started_at -= 100   # simulate long uptime
+        poll_children([stable], str(tmp_path))
+        assert stable.crash_count == 0
+        assert stable.backoff == INITIAL_BACKOFF
+    finally:
+        stable.stop()
+
+
 @pytest.mark.timeout(300)
 def test_monitor_supervises_restarts_and_cluster_serves():
     import asyncio

@@ -124,6 +124,99 @@ def test_reconnect_after_listener_restart():
     run(go())
 
 
+def test_stalled_handshake_bounded_by_knob():
+    """Satellite regression (ISSUE 8): a peer that ACCEPTS but never
+    answers the protocol hello must surface as connection_failed within
+    the real_handshake_timeout_s knob bound — not hang for the old
+    hardcoded 5 s (or forever)."""
+    from foundationdb_tpu.core.knobs import FLOW_KNOBS
+
+    async def go():
+        silent_conns = []
+
+        async def silent(reader, writer):
+            silent_conns.append(writer)   # accept, read nothing, say nothing
+
+        server = await asyncio.start_server(silent, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        saved = FLOW_KNOBS.real_handshake_timeout_s
+        FLOW_KNOBS._values["real_handshake_timeout_s"] = 0.3
+        net = RealNetwork()
+        try:
+            t0 = asyncio.get_running_loop().time()
+            with pytest.raises(error.FDBError) as ei:
+                await net.request("c", Endpoint(f"127.0.0.1:{port}", "x"),
+                                  None, timeout=10.0)
+            elapsed = asyncio.get_running_loop().time() - t0
+            assert ei.value.code == error.connection_failed("").code
+            assert "handshake" in str(ei.value) or elapsed < 2.0
+            assert elapsed < 1.5, f"stall not bounded by the knob: {elapsed}s"
+        finally:
+            FLOW_KNOBS._values["real_handshake_timeout_s"] = saved
+            net.close()
+            for w in silent_conns:
+                w.close()
+            server.close()
+            await server.wait_closed()
+
+    run(go())
+
+
+def test_reconnect_backoff_fails_fast_then_recovers():
+    """Consecutive connect failures open a jittered-exponential backoff
+    window; requests inside it fail fast (no SYN storm), and a successful
+    reconnect resets the streak."""
+    from foundationdb_tpu.core.knobs import FLOW_KNOBS
+
+    async def go():
+        saved = (FLOW_KNOBS.real_reconnect_backoff_initial_s,
+                 FLOW_KNOBS.real_reconnect_backoff_max_s)
+        FLOW_KNOBS._values["real_reconnect_backoff_initial_s"] = 0.2
+        FLOW_KNOBS._values["real_reconnect_backoff_max_s"] = 1.0
+        net = RealNetwork()
+        # a port with no listener
+        import socket as s
+
+        probe = s.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        addr = f"127.0.0.1:{port}"
+        try:
+            with pytest.raises(error.FDBError):
+                await net.request("c", Endpoint(addr, "x"), None, timeout=1.0)
+            peer = net._peers[addr]
+            assert peer.fail_streak == 1 and peer.retry_at > 0
+            # inside the window: fail FAST with the backoff message
+            t0 = asyncio.get_running_loop().time()
+            with pytest.raises(error.FDBError) as ei:
+                await net.request("c", Endpoint(addr, "x"), None, timeout=1.0)
+            assert asyncio.get_running_loop().time() - t0 < 0.15
+            assert "backoff" in str(ei.value)
+            assert net.backoff_failfasts >= 1
+            assert net.transport_degraded()
+            # bring a listener up on that port; after the window the
+            # reconnect succeeds and the streak resets
+            proc2 = RealProcess(port=port)
+
+            async def ping(body):
+                return body
+
+            proc2.register(PING_TOKEN, ping)
+            await proc2.start()
+            await asyncio.sleep(0.35)
+            assert await net.request("c", Endpoint(addr, PING_TOKEN), 5) == 5
+            assert peer.fail_streak == 0
+            assert not net.transport_degraded()
+            await proc2.stop()
+        finally:
+            (FLOW_KNOBS._values["real_reconnect_backoff_initial_s"],
+             FLOW_KNOBS._values["real_reconnect_backoff_max_s"]) = saved
+            net.close()
+
+    run(go())
+
+
 def test_two_os_processes():
     """THE bar: a second OS process serves requests over real TCP."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
