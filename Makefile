@@ -27,18 +27,30 @@ chaos:
 	python -m pytest tests/test_device_nemesis.py -q -m slow
 	python -m foundationdb_tpu.tools.buggify_coverage --seeds 4 --min-frac 0.5
 
+# Distributed-tracing smoke (docs/observability.md "Distributed
+# tracing", seconds): boots a 2-OS-process cluster (a --serve traced
+# commit server child), drives a traced fleet, asserts >= 1
+# cross-process waterfall reconstructs with the sum identity, the
+# disabled-span allocation guard still passes with context propagation
+# compiled in, and the exported Chrome trace JSON loads (schema check).
+trace-smoke:
+	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.trace_smoke
+
 # Wall-clock chaos (docs/real_cluster.md): seeded nemesis campaigns against
 # the REAL transport under jax AND device_loop engine modes — every SLO
 # machine-asserted (p99 outside injected-fault windows <= the budget-knob
 # product, bit-identical oracle journal replay, blocking_syncs == 0,
 # >= 1 failover AND swap-back, supervised child restart) — plus the
 # served_under_chaos Zipf sweep (admission holds p99 in budget; the
-# uncontrolled runs must blow it). Solo-CPU: do not overlap with tier-1.
+# uncontrolled runs must blow it). Every campaign exports tail-sampled
+# cross-process Chrome trace JSON (chaos_real_traces/; `cli trace FILE`
+# renders one). Solo-CPU: do not overlap with tier-1.
 chaos-real:
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.real.nemesis \
 		--seeds 2 --engine-modes jax,device_loop --sweep \
+		--trace-dir chaos_real_traces \
 		--json chaos_real_report.json
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.cli \
 		chaos-status chaos_real_report.json
 
-.PHONY: check bench bench-smoke telemetry-smoke chaos chaos-real
+.PHONY: check bench bench-smoke telemetry-smoke trace-smoke chaos chaos-real

@@ -171,6 +171,19 @@ def _make_server_knobs() -> Knobs:
     #: no rng, but enabling it mid-battery would grow the span buffer for
     #: nothing.
     k.init("trace_span_sample_rate", 0.0)
+    # Distributed tracing (docs/observability.md "Distributed tracing").
+    # Tail-based retention: the trace export decides WHICH traces to keep
+    # after the outcome is known — every faulted/retried/throttled request
+    # is always kept, plus the slowest clean acks as p99 candidates.
+    # Deliberately no BUGGIFY randomizers: retention draws no rng and only
+    # matters to wall-clock exports.
+    #: fraction of the slowest clean acks retained as p99-candidate traces
+    #: (0.02 = every ack at or above ~p98 — a margin around p99 wide
+    #: enough that the p99 ack itself is always in the export)
+    k.init("trace_tail_latency_frac", 0.02)
+    #: hard cap on retained traces per export (report/JSON size bound;
+    #: forced-retain error traces take precedence under the cap)
+    k.init("trace_tail_max_traces", 512)
     #: dispatch records the ResilientEngine's flight recorder retains — the
     #: bounded ring dumped into quarantine/failover trace events for
     #: post-mortem replay (fault/resilient.py)

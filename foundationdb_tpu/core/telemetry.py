@@ -57,6 +57,9 @@ class TelemetryHub:
         self._batchers: Dict[str, "weakref.ref"] = {}
         #: label -> weakref to ResilientEngine
         self._health: Dict[str, "weakref.ref"] = {}
+        #: label -> weakref to DeviceLoopEngine (queue/ring gauges —
+        #: ops/device_loop.py loop_stats + occupancy)
+        self._loops: Dict[str, "weakref.ref"] = {}
         self._seq = 0
         #: bounded ring of recent nemesis/chaos events (real/chaos.py,
         #: real/nemesis.py) — rendered by `tools/cli.py chaos-status`
@@ -80,6 +83,14 @@ class TelemetryHub:
     def register_health(self, engine, name: str = "resilient") -> str:
         label = self._label("resilient", name)
         self._health[label] = weakref.ref(engine)
+        return label
+
+    def register_loop(self, engine, name: str = "loop") -> str:
+        """A device-resident loop engine's queue/ring gauges
+        (ops/device_loop.py): slot occupancy, result-ring depth and the
+        sync-accounting counters, synced as `loop.<label>.*` series."""
+        label = self._label("loop", name)
+        self._loops[label] = weakref.ref(engine)
         return label
 
     @staticmethod
@@ -164,6 +175,20 @@ class TelemetryHub:
                         "swap_backs", "probes", "probe_mismatches",
                         "oracle_batches"):
                 td.int64(f"resolver.{label}.{key}").set(st.get(key, 0))
+        for label, eng in self._live(self._loops):
+            # device-loop eyes (ops/device_loop.py): the double buffer's
+            # slot occupancy, the result ring's depth, and every
+            # sync-accounting counter — blocking_syncs must read 0 on any
+            # healthy scrape
+            st = eng.loop_stats
+            for key in ("enqueued_chunks", "units", "drained_nonblocking",
+                        "forced_waits", "blocking_syncs"):
+                td.int64(f"loop.{label}.{key}").set(int(st.get(key, 0)))
+            td.int64(f"loop.{label}.wait_us").set(
+                int(st.get("wait_ms", 0.0) * 1000))
+            td.int64(f"loop.{label}.ring_depth").set(eng.ring_depth())
+            td.int64(f"loop.{label}.slots_in_flight").set(
+                eng.slots_in_flight())
 
     def snapshot(self) -> dict:
         """Live values for status documents (no TDMetric round trip)."""
@@ -174,21 +199,71 @@ class TelemetryHub:
                          for label, b in self._live(self._batchers)},
             "health": {label: eng.health_stats()
                        for label, eng in self._live(self._health)},
+            "loops": {label: eng.loop_stats_snapshot()
+                      for label, eng in self._live(self._loops)},
         }
 
+    #: per-family HELP strings for the exposition (families are the first
+    #: dotted component of a series name; anything else gets the generic)
+    _PROM_HELP = {
+        "engine": "conflict-engine perf counters (compiles, bucket/scan/"
+                  "search/dispatch-mode hits); series label = the dotted "
+                  "series name under engine.",
+        "batcher": "budget-batcher latency EWMAs in microseconds, keyed "
+                   "(bucket, search mode, dispatch mode)",
+        "resolver": "supervised-resolver health counters and state index "
+                    "(fault/resilient.py)",
+        "loop": "device-resident loop queue/ring gauges "
+                "(ops/device_loop.py; blocking_syncs must be 0)",
+        "chaos": "injected nemesis fault events (real/chaos.py)",
+        "demo": "demo KV per-op counters (real/demo_server.py)",
+    }
+
+    @staticmethod
+    def _prom_name(s: str) -> str:
+        """Sanitize to the metric-name charset [a-zA-Z0-9_:]."""
+        out = "".join(c if (c.isascii() and (c.isalnum() or c == "_"))
+                      else "_" for c in s)
+        return out if out and not out[0].isdigit() else "_" + out
+
+    @staticmethod
+    def _prom_escape(s: str) -> str:
+        """Label-value escaping per the exposition format: backslash,
+        double quote and newline must be escaped or a scraper rejects
+        (or silently mis-parses) the whole exposition."""
+        return (s.replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+
     def prometheus_text(self) -> str:
-        """Current value of every registered metric, Prometheus text
-        exposition style (one `fdbtpu_<name> <value>` line per series)."""
+        """Current value of every registered series as a Prometheus text
+        exposition a REAL scraper parses cleanly: one metric family per
+        first dotted component (`fdbtpu_engine`, `fdbtpu_chaos`, ...),
+        each preceded by its `# HELP`/`# TYPE` lines, with the full
+        dotted series name carried in the `series` label — label VALUES
+        may contain dots, slashes, quotes or anything else an engine
+        label picked up, so they are escaped, not sanitized away."""
         self.sync()
-        lines: List[str] = ["# fdbtpu telemetry exposition"]
+        groups: Dict[str, List[tuple]] = {}
         for name in sorted(self.tdmetrics.metrics):
             m = self.tdmetrics.metrics[name]
             value = getattr(m, "value", None)
             if value is None:   # ContinuousMetric: expose the event count
                 value = len(m.buffer)
-            safe = (name.replace(".", "_").replace("-", "_")
-                    .replace("/", "_").replace(":", "_"))
-            lines.append(f"fdbtpu_{safe} {value}")
+            family, _, rest = name.partition(".")
+            groups.setdefault(family, []).append((rest, value))
+        lines: List[str] = []
+        for family in sorted(groups):
+            fam = "fdbtpu_" + self._prom_name(family)
+            help_text = self._PROM_HELP.get(
+                family, f"fdb-tpu telemetry series under '{family}.'")
+            lines.append(f"# HELP {fam} {help_text}")
+            lines.append(f"# TYPE {fam} gauge")
+            for rest, value in groups[family]:
+                if rest:
+                    lines.append(
+                        f'{fam}{{series="{self._prom_escape(rest)}"}} {value}')
+                else:
+                    lines.append(f"{fam} {value}")
         return "\n".join(lines) + "\n"
 
 

@@ -7,7 +7,10 @@ fail simulation tests, like the reference harness.
 """
 from __future__ import annotations
 
+import contextvars
+import dataclasses
 import json
+import os
 import sys
 import threading
 import time
@@ -237,6 +240,8 @@ class Span:
             rec.update(self.details)
         if details:
             rec.update(details)
+        if _process_name[0] and "Proc" not in rec:
+            rec["Proc"] = _process_name[0]
         g_spans.add(rec)
 
     def __enter__(self) -> "Span":
@@ -289,6 +294,8 @@ def span_event(name: str, trace_id: Any, t0: float, t1: float,
         rec["Parent"] = parent
     if details:
         rec.update(details)
+    if _process_name[0] and "Proc" not in rec:
+        rec["Proc"] = _process_name[0]
     g_spans.add(rec)
 
 
@@ -298,6 +305,137 @@ def spans_enabled() -> bool:
 
 def set_span_collection(enabled: bool) -> None:
     g_spans.enabled = bool(enabled)
+
+
+# -- distributed trace context -----------------------------------------------
+#
+# Cross-process tracing (docs/observability.md "Distributed tracing"): a
+# TraceContext is the tiny propagated half of a span — (trace id, parent
+# span name, sampling bit) — that rides RPC frames under the "tc" key
+# (real/transport.py attaches the caller's ambient context to every
+# request/one-way frame; the serving side installs the inbound context
+# around the handler), so spans recorded in different OS processes join
+# into one causal tree. Trace ids follow the PR 4 convention: BATCH spans
+# use the commit version; per-request client/server spans use a
+# process-unique request id (next_trace_id), with the serving side's
+# request span carrying the resolved commit version as a detail — the
+# link the waterfall reconstruction (tools/trace_export.py) joins on.
+#
+# Ambient propagation is a contextvars.ContextVar: full task-local
+# semantics under plain asyncio (each asyncio task runs in its own
+# context copy). Handlers dispatched onto the cooperative scheduler
+# (real/runtime.make_dispatcher) are wrapped so the inbound context is
+# installed when the handler coroutine starts — but scheduler tasks
+# interleave inside ONE asyncio task, so there the context is only
+# guaranteed during a handler's SYNCHRONOUS PREFIX: capture it at entry
+# (`ctx = current_trace_context()`) before the first await, as
+# ChaosCommitServer._commit does.
+#
+# Cost discipline: context attach/install sites are gated on
+# `g_spans.enabled` exactly like span sites — with sampling off, frames
+# carry no "tc", nothing is installed, and nothing allocates (the
+# allocation-counter regression guard covers the propagation sites too).
+#
+# Clock note: span timestamps are comparable ACROSS processes on one
+# machine because time.perf_counter()/time.monotonic() both read
+# CLOCK_MONOTONIC on Linux (shared epoch since boot); cross-machine
+# traces would need an offset estimate this repo does not attempt.
+
+
+@dataclasses.dataclass
+class TraceContext:
+    """The propagated context: trace id + parent span name + sampling bit.
+    A wire-registered record, so it rides RPC frames as a typed,
+    schema-evolvable payload (core/wire.py named records)."""
+
+    trace_id: Any = None
+    parent: Optional[str] = None
+    sampled: bool = True
+
+
+# registered at import (real/transport.py imports this module before any
+# frame is built); core/wire.py also lists this module as a lazy
+# registrar so a decode-first process resolves the record too
+from . import wire as _wire  # noqa: E402  (leaf module; no import cycle)
+
+_wire.register_record(TraceContext, "TraceContext")
+
+#: this process's identity on span records ("Proc"), set once at startup
+#: by wall-clock processes (demo_server --trace, nemesis --serve, smoke
+#: drivers); "" (the default) stamps nothing
+_process_name: List[str] = [""]
+
+
+def set_process_name(name: str) -> None:
+    _process_name[0] = str(name or "")
+
+
+def process_name() -> str:
+    return _process_name[0]
+
+
+_trace_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "fdbtpu_trace_context", default=None)
+
+
+def current_trace_context() -> Optional[TraceContext]:
+    """The ambient inbound/outbound context (None when not tracing)."""
+    return _trace_ctx.get()
+
+
+def push_trace_context(ctx: Optional[TraceContext]):
+    """Install `ctx` as the ambient context; returns the reset token."""
+    return _trace_ctx.set(ctx)
+
+
+def pop_trace_context(token) -> None:
+    _trace_ctx.reset(token)
+
+
+class use_trace_context:
+    """`with use_trace_context(ctx): ...` — scoped ambient context."""
+
+    __slots__ = ("ctx", "_token")
+
+    def __init__(self, ctx: Optional[TraceContext]):
+        self.ctx = ctx
+
+    def __enter__(self) -> Optional[TraceContext]:
+        self._token = _trace_ctx.set(self.ctx)
+        return self.ctx
+
+    def __exit__(self, *exc) -> None:
+        _trace_ctx.reset(self._token)
+
+
+_trace_seq = [0]
+
+
+def next_trace_id(prefix: str = "r") -> str:
+    """Process-unique request trace id (`r<pid-hex>.<seq>`): never collides
+    with a commit-version (int) trace id, and two processes' ids never
+    collide with each other's."""
+    _trace_seq[0] += 1
+    return f"{prefix}{os.getpid():x}.{_trace_seq[0]}"
+
+
+#: the ONE RPC token every traced process serves its span ring on
+#: (real/demo_server.py, real/nemesis.ChaosCommitServer register it; the
+#: fetch side — tools/trace_export.fetch_spans, `cli trace fetch` — pulls
+#: it); lives here, next to the ring it exports, so the runtime layer
+#: never imports tools/ for a constant
+SPANS_TOKEN = "trace.spans"
+
+
+def export_spans(limit: int = 100_000) -> Dict[str, Any]:
+    """This process's bounded span ring, for the `trace.spans` RPC
+    endpoint (real/demo_server.py, real/nemesis.ChaosCommitServer) that
+    `tools/cli.py trace fetch` and the campaign reconstruction pull:
+    {"proc": <process name>, "spans": [span records]}."""
+    spans = g_spans.spans
+    if limit and len(spans) > limit:
+        spans = spans[-limit:]
+    return {"proc": _process_name[0], "spans": list(spans)}
 
 
 class TraceBatch:

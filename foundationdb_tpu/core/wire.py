@@ -63,6 +63,9 @@ def register_adapter(cls: Type, name: str, to_state: Callable, from_state: Calla
 #: the defining module was imported)
 _LAZY_REGISTRARS = (
     "foundationdb_tpu.core.types",
+    # TraceContext — the propagated distributed-tracing context that rides
+    # RPC frames under the "tc" key (core/trace.py; real/transport.py)
+    "foundationdb_tpu.core.trace",
     "foundationdb_tpu.server.coordination",
     "foundationdb_tpu.server.coordinated_state",
     "foundationdb_tpu.server.log_system",

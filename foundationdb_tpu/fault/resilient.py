@@ -212,6 +212,13 @@ class ResilientEngine:
         fn = getattr(self._rewarm_engine(), "history_search_modes", None)
         return fn() if fn is not None else {}
 
+    def loop_stats_snapshot(self):
+        """Pass-through to a device-loop engine's sync-accounting/occupancy
+        snapshot (ops/device_loop.py) — the span/flight-record attachment
+        survives supervision; None for step-dispatch engines."""
+        fn = getattr(self._rewarm_engine(), "loop_stats_snapshot", None)
+        return fn() if fn is not None else None
+
     async def resolve(self, transactions, now_v, new_oldest):
         """One batch through the supervisor; callers (server/resolver.py,
         pipeline/service.py) enter strictly in commit-version order."""
@@ -232,6 +239,12 @@ class ResilientEngine:
         else:
             verdicts = await self._healthy_batch(transactions, now_v, new_oldest)
         self._record(now_v, transactions, new_oldest, verdicts)
+        # flight records name the device's dispatch path and, for loop
+        # engines, snapshot the queue/ring state at this dispatch — so a
+        # quarantine dump from a loop-mode engine is diagnosable (was the
+        # ring backed up? did a drain fall back to a blocking sync?)
+        inner = self._rewarm_engine()
+        loop_snap = self.loop_stats_snapshot()
         self.flight.record(
             version=now_v,
             new_oldest=new_oldest,
@@ -244,6 +257,8 @@ class ResilientEngine:
             retries=self._batch_retries,
             ms=round((span_now() - t_dispatch) * 1e3, 4),
             digest=abort_set_digest(verdicts),
+            dispatch_mode=getattr(inner, "dispatch_mode", "step"),
+            **({"loop_stats": loop_snap} if loop_snap is not None else {}),
         )
         return verdicts
 
@@ -335,7 +350,7 @@ class ResilientEngine:
             finally:
                 if t_retry is not None and g_spans.enabled:
                     span_event("resolver.retry", now_v, t_retry, span_now(),
-                               attempt=i)
+                               attempt=i, parent="resolver.device_dispatch")
         raise last if last is not None else error.device_fault("no attempts")
 
     async def _dispatch_once(self, transactions, now_v, new_oldest):

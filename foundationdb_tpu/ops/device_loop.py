@@ -66,6 +66,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..core import telemetry
 from ..core.knobs import SERVER_KNOBS
 from ..core.types import TransactionCommitResult, Version
 from . import conflict_kernel as ck
@@ -238,6 +239,33 @@ class DeviceLoopEngine(JaxConflictEngine):
                          initial_version=initial_version, ladder=ladder,
                          scan_sizes=(), arena=arena,
                          history_search=history_search)
+        # the loop's queue/ring gauges flow into the unified telemetry hub
+        # (docs/observability.md): `loop.<label>.*` series alongside the
+        # EnginePerf counters the base class registered above
+        self._loop_telemetry_label = telemetry.hub().register_loop(
+            self, name=self.name)
+
+    # -- telemetry ------------------------------------------------------------
+    def ring_depth(self) -> int:
+        """Dispatched-but-undrained tickets in the result ring."""
+        return len(self._ring)
+
+    def slots_in_flight(self) -> int:
+        """Queue slots whose program may still read their host buffers —
+        the occupancy side of the double buffer."""
+        return sum(1 for slots in self._pool._slots.values() for s in slots
+                   if s.ticket is not None and not s.ticket.done)
+
+    def loop_stats_snapshot(self) -> Dict[str, float]:
+        """One batch-attachable snapshot of the sync accounting plus the
+        live queue/ring occupancy gauges — what rides the
+        `resolver.device_resident` / `engine.result_drain` spans and the
+        flight recorder's per-dispatch records."""
+        snap = {k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in self.loop_stats.items()}
+        snap["ring_depth"] = self.ring_depth()
+        snap["slots_in_flight"] = self.slots_in_flight()
+        return snap
 
     # -- programs ------------------------------------------------------------
     def _program(self, bucket: KernelConfig, n_chunks: int):

@@ -1026,7 +1026,8 @@ class RoutedConflictEngineBase:
             # wall-clock host-pack segment of the engine's columnar fast
             # path, keyed by the batch's commit version like every other
             # commit-path span
-            span_event("engine.host_pack", now, t_pack, span_now(), txns=ntx)
+            span_event("engine.host_pack", now, t_pack, span_now(), txns=ntx,
+                       parent="resolver.queue_wait")
         return {"chunks": chunks, "new_oldest": new_oldest, "now": now,
                 "chunk_buckets": [c[2].max_txns for c in chunks]}
 
@@ -1081,7 +1082,8 @@ class RoutedConflictEngineBase:
             # and enqueued async server steps — the queue_enqueue share of
             # what used to be one opaque device_dispatch segment
             span_event("engine.queue_enqueue", plan.get("now"), t_enq,
-                       span_now(), units=len(outs))
+                       span_now(), units=len(outs),
+                       parent="resolver.queue_wait")
         new_oldest = plan["new_oldest"]
         if new_oldest > self.oldest_version:
             self.tier_map.gc(new_oldest)
@@ -1118,10 +1120,18 @@ class RoutedConflictEngineBase:
                 # readback segment of the wall-clock engine path: a step
                 # engine blocks on device outputs here; a loop engine
                 # drains its result ring (ready results decode without a
-                # sync — the segment name keeps the two attributable)
+                # sync — the segment name keeps the two attributable) and
+                # attaches its batch-time loop_stats snapshot (queue/ring
+                # occupancy + sync accounting, ops/device_loop.py) so the
+                # span says whether the ring was backed up
+                extra = {}
+                if loop_mode:
+                    snap_fn = getattr(self, "loop_stats_snapshot", None)
+                    if snap_fn is not None:
+                        extra["loop_stats"] = snap_fn()
                 span_event(
                     "engine.result_drain" if loop_mode else "engine.force",
-                    version, t_force, span_now(), units=len(outs))
+                    version, t_force, span_now(), units=len(outs), **extra)
             return results
 
         return force

@@ -397,7 +397,7 @@ class ResolverPipeline:
                 # the wall-clock analog of the sim service's force segment:
                 # host blocked on the dispatched batch's device values
                 span_event("pipeline.force", pb.version, t_span, span_now(),
-                           txns=pb.n_txns)
+                           txns=pb.n_txns, parent="resolver.queue_wait")
             pb._force = None
             pb._state = _DONE
 

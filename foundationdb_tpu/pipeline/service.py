@@ -222,14 +222,16 @@ class PipelinedResolverService:
             if spans_on:
                 t1 = span_now()
                 span_event("resolver.host_pack", version, t0, t1,
-                           txns=len(transactions))
+                           txns=len(transactions),
+                           parent="resolver.queue_wait")
             await self._device_done.when_at_least(seq - 1)
             from ..sim.loop import now as _now
 
             loop_mode = self.cfg.dispatch_mode == "device_loop"
             if spans_on:
                 t2 = span_now()
-                span_event("resolver.pipeline_wait", version, t1, t2)
+                span_event("resolver.pipeline_wait", version, t1, t2,
+                           parent="resolver.queue_wait")
             if loop_mode and self.cfg.queue_enqueue_ms > 0:
                 # loop mode: the host's enqueue share — pack the queue
                 # slot + async-dispatch the server step (no sync)
@@ -239,7 +241,8 @@ class PipelinedResolverService:
                 t2 = span_now()
                 span_event("resolver.queue_enqueue", version,
                            t2 - self.cfg.queue_enqueue_ms / 1e3, t2,
-                           txns=len(transactions))
+                           txns=len(transactions),
+                           parent="resolver.queue_wait")
             t_dev = _now()
             verdicts = self.engine.resolve(transactions, version, new_oldest)
             if hasattr(verdicts, "__await__"):
@@ -258,10 +261,23 @@ class PipelinedResolverService:
                 # this batch's bucket. Loop mode splits the same interval:
                 # the device-resident share here, the host's enqueue/drain
                 # shares as their own segments — the attribution that
-                # latency_attribution reassembles for the loop path.
+                # latency_attribution reassembles for the loop path. A real
+                # loop engine behind this service (device_loop service
+                # mode) attaches its batch-time loop_stats snapshot —
+                # queue/ring occupancy and the sync accounting — to the
+                # device_resident span, so a slow batch's trace says
+                # whether the ring was backed up when it ran.
+                extra = {}
+                if loop_mode:
+                    snap_fn = getattr(self.engine, "loop_stats_snapshot",
+                                      None)
+                    snap = snap_fn() if snap_fn is not None else None
+                    if snap is not None:
+                        extra["loop_stats"] = snap
                 span_event("resolver.device_resident" if loop_mode
                            else "resolver.device_dispatch",
-                           version, t2, t3, txns=len(transactions))
+                           version, t2, t3, txns=len(transactions),
+                           parent="resolver.queue_wait", **extra)
             if loop_mode and self.cfg.result_drain_ms > 0:
                 # loop mode: the host's drain share — non-blocking poll +
                 # bitmap decode off the result ring
@@ -269,7 +285,8 @@ class PipelinedResolverService:
                             TaskPriority.PROXY_RESOLVER_REPLY)
             if spans_on and loop_mode:
                 t3b = span_now()
-                span_event("resolver.result_drain", version, t3, t3b)
+                span_event("resolver.result_drain", version, t3, t3b,
+                           parent="resolver.queue_wait")
                 t3 = t3b   # the force tail starts after the drain segment
             if self.batcher is not None:
                 # observed device-stage time: injected program time plus any
@@ -283,7 +300,8 @@ class PipelinedResolverService:
                 # in the sim model (readback rides the injected device
                 # figure); named so the wall-clock pipeline's real force
                 # segment and the sim's line up in attribution output
-                span_event("resolver.force", version, t3, span_now())
+                span_event("resolver.force", version, t3, span_now(),
+                           parent="resolver.queue_wait")
             return verdicts
         finally:
             # On any exit (including cancellation mid-wait) unblock the

@@ -113,6 +113,8 @@ def poll_children(children, log_dir: str, now: Optional[float] = None) -> bool:
     real/nemesis.py): reap exits into backoff-scheduled restarts, respawn
     the due, reset backoff on stable uptime. Returns whether any child is
     alive or pending restart."""
+    from ..core import telemetry
+
     if now is None:
         now = time.monotonic()
     any_alive = False
@@ -122,10 +124,19 @@ def poll_children(children, log_dir: str, now: Optional[float] = None) -> bool:
             c.note_stable(now)
             continue
         if c.proc is not None:
-            c.note_exit(now)
+            rc = c.note_exit(now)
+            # supervised-process churn lands in the chaos timeline
+            # (telemetry hub event ring -> campaign reports and the
+            # Chrome trace's nemesis track), so a child death is
+            # correlatable with the SLO windows around it
+            telemetry.hub().chaos_event("child_exit", section=c.section,
+                                        rc=rc, crash_count=c.crash_count)
         if c.due(now):
             c.restarts += 1
             c.spawn(log_dir)
+            telemetry.hub().chaos_event("child_respawn", section=c.section,
+                                        crash_count=c.crash_count,
+                                        restarts=c.restarts)
             any_alive = True
         # NB: a child merely WAITING OUT its backoff does not count as
         # alive — preserving --once's original "every child has exited"

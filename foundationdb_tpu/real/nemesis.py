@@ -46,7 +46,19 @@ from typing import Dict, List, Optional, Tuple
 
 from ..core import error, telemetry
 from ..core.knobs import SERVER_KNOBS
-from ..core.trace import g_spans, span_event, span_now
+from ..core.trace import (
+    SPANS_TOKEN,
+    TraceContext,
+    current_trace_context,
+    export_spans,
+    g_spans,
+    next_trace_id,
+    pop_trace_context,
+    push_trace_context,
+    span_event,
+    span_now,
+)
+from ..tools import trace_export
 from ..core.types import CommitTransaction, KeyRange, TransactionCommitResult
 from ..sim.network import Endpoint
 from .chaos import ChaosConfig, ChaosTransport, NetworkNemesis
@@ -114,17 +126,28 @@ class ChaosCommitServer:
                  admission_burst_s: Optional[float] = None,
                  batch_interval_s: float = 0.004, max_batch: int = 48,
                  service_floor_s: float = 0.0,
-                 transport_degraded_fn=None):
+                 transport_degraded_fn=None, port: int = 0):
         from ..server.ratekeeper import TenantAdmission
         from .runtime import make_dispatcher
 
         self.sched = sched
         self.engine_mode = engine_mode
         self.inner, self.injector, self.engine = make_chaos_engine(engine_mode)
-        self.proc = RealProcess()
+        self.proc = RealProcess(port=port)
         self.proc.dispatcher = make_dispatcher(sched)
         self.proc.register(COMMIT_TOKEN, self._commit)
         self.proc.register(STATUS_TOKEN, self._status)
+        # bounded span-ring export (docs/observability.md "Distributed
+        # tracing"): tools/cli.py `trace fetch` and the smoke driver pull
+        # this process's spans to reconstruct cross-process waterfalls
+        self.proc.register(SPANS_TOKEN, self._spans)
+        #: span-record recorder label: the process's self-declared name
+        #: when it has one (a --serve child), else the in-campaign
+        #: logical name — two traced server processes must not collapse
+        #: into one indistinguishable pid lane in the Chrome export
+        from ..core.trace import process_name
+
+        self._span_proc = process_name() or "server"
         self.batch_interval_s = batch_interval_s
         self.max_batch = max_batch
         #: injected per-batch service floor: the campaign's stand-in for
@@ -189,16 +212,48 @@ class ChaosCommitServer:
     async def _commit(self, body):
         from ..sim.loop import Promise, now
 
+        # distributed tracing: the inbound context must be captured in the
+        # synchronous prefix (before the first await — core/trace.py's
+        # scheduler-dispatch discipline). The server.commit span emitted on
+        # every exit path carries the resolved commit VERSION as the link
+        # detail the waterfall reconstruction joins batch spans on.
+        ctx = current_trace_context() if g_spans.enabled else None
+        t_recv = span_now() if ctx is not None else 0.0
         tenant, reads, writes, snapshot = body
         if self.admission is not None and not self.admission.admit(tenant, now()):
+            if ctx is not None:
+                span_event("server.commit", ctx.trace_id, t_recv, span_now(),
+                           parent=ctx.parent, err="transaction_throttled",
+                           tenant=tenant, Proc=self._span_proc)
             raise error.transaction_throttled(f"tenant {tenant}")
         txn = CommitTransaction(
             read_snapshot=int(snapshot),
             read_conflict_ranges=[KeyRange(k, k + b"\x00") for k in reads],
             write_conflict_ranges=[KeyRange(k, k + b"\x00") for k in writes])
         p = Promise()
-        self._pending.append((txn, p, now()))
-        return await p.future
+        #: meta cell: the batcher writes the batch's commit version here
+        #: before dispatch, so even a conflicted/too-old verdict's server
+        #: span can name the version that judged it. Only allocated for
+        #: traced requests — the disabled path stays allocation-free.
+        meta: Optional[Dict[str, int]] = {} if ctx is not None else None
+        self._pending.append((txn, p, now(), meta))
+        try:
+            v = await p.future
+        except error.FDBError as e:
+            if ctx is not None:
+                span_event("server.commit", ctx.trace_id, t_recv, span_now(),
+                           parent=ctx.parent, err=e.name,
+                           version=meta.get("version"), tenant=tenant,
+                           Proc=self._span_proc)
+            raise
+        if ctx is not None:
+            span_event("server.commit", ctx.trace_id, t_recv, span_now(),
+                       parent=ctx.parent, version=int(v), tenant=tenant,
+                       Proc=self._span_proc)
+        return v
+
+    async def _spans(self, _body):
+        return export_spans()
 
     async def _status(self, _body):
         out = {
@@ -249,13 +304,18 @@ class ChaosCommitServer:
             self._version += VERSIONS_PER_BATCH
             v = self._version
             new_oldest = max(0, v - GC_LAG_BATCHES * VERSIONS_PER_BATCH)
-            txns = [t for t, _p, _t0 in batch]
-            t_open = min(t0 for _t, _p, t0 in batch)
+            txns = [t for t, _p, _t0, _m in batch]
+            t_open = min(t0 for _t, _p, t0, _m in batch)
+            for _t, _p, _t0, meta in batch:
+                # link every traced member's request to this batch BEFORE
+                # dispatch: a faulted verdict still names its version
+                if meta is not None:
+                    meta["version"] = v
             t0 = span_now()
             try:
                 verdicts = await self.engine.resolve(txns, v, new_oldest)
             except error.FDBError as e:
-                for _t, p, _t0 in batch:
+                for _t, p, _t0, _m in batch:
                     if not p.is_set:
                         p.send_error(e)
                 continue
@@ -268,9 +328,11 @@ class ChaosCommitServer:
             self.batches += 1
             self._committed = v
             if g_spans.enabled:
-                span_event("chaos.queue_wait", v, t_open, t0, txns=len(txns))
-                span_event("chaos.resolve", v, t0, t1, txns=len(txns))
-            for (txn, p, _t0), verdict in zip(batch, verdicts):
+                span_event("chaos.queue_wait", v, t_open, t0, txns=len(txns),
+                           Proc=self._span_proc)
+                span_event("chaos.resolve", v, t0, t1, txns=len(txns),
+                           Proc=self._span_proc)
+            for (txn, p, _t0, _m), verdict in zip(batch, verdicts):
                 if p.is_set:
                     continue   # deadline-shed by the transport meanwhile
                 if int(verdict) == committed:
@@ -309,6 +371,9 @@ class NemesisConfig:
     kill_child: bool = True
     child_backoff_s: float = 0.3
     collect_spans: bool = True
+    #: write this campaign's tail-sampled cross-process Chrome trace JSON
+    #: here (None = no file; the report's trace summary is kept either way)
+    trace_export: Optional[str] = None
     batch_interval_s: float = 0.004
     #: cold-start grace excluded from the SLO as a recorded window, the
     #: wall-clock analog of the sim harness's warmup_frac head-drop:
@@ -382,6 +447,13 @@ class CampaignReport:
     suffered: Dict[str, Dict[str, int]] = field(default_factory=dict)
     transport: Dict[str, int] = field(default_factory=dict)
     attribution: Optional[dict] = None
+    #: tail-sampled waterfall population (tools/trace_export.trace_summary)
+    traces: Optional[dict] = None
+    #: dominant segment of the worst retained trace — what an SLO-breach
+    #: report names first (tools/trace_export.root_cause)
+    slo_root_cause: Optional[dict] = None
+    #: path of the exported Chrome trace JSON (None = not written)
+    trace_file: Optional[str] = None
     depth_collapses: int = 0
     shed_expired: int = 0
     wall_s: float = 0.0
@@ -619,15 +691,37 @@ async def _campaign(cfg: NemesisConfig) -> CampaignReport:
                 refreshing[tenant] = False
 
         async def submit(spec: TenantSpec, reads, writes):
+            # distributed tracing: one context per request, attached to the
+            # RPC frame by the transport and RE-ATTACHED verbatim on any
+            # retry (the ambient context is re-read per send), so the
+            # serving process's spans join this request's trace. Gated on
+            # the span switch — with tracing off, nothing allocates.
+            ctx = None
+            if g_spans.enabled:
+                ctx = TraceContext(trace_id=next_trace_id(),
+                                   parent="client.commit")
+                tok = push_trace_context(ctx)
+                t_sub = span_now()
             try:
                 v = await transports[spec.name].request(
                     f"client-{spec.name}", commit_ep,
                     (spec.name, reads, writes, versions[spec.name]),
                     timeout=cfg.rpc_timeout_s)
             except error.FDBError as e:
+                if ctx is not None:
+                    span_event("client.commit", ctx.trace_id, t_sub,
+                               span_now(), err=e.name, tenant=spec.name,
+                               Proc=f"client-{spec.name}")
                 if e.name == "transaction_too_old":
                     asyncio.ensure_future(refresh_version(spec.name))
                 raise
+            finally:
+                if ctx is not None:
+                    pop_trace_context(tok)
+            if ctx is not None:
+                span_event("client.commit", ctx.trace_id, t_sub, span_now(),
+                           version=int(v), tenant=spec.name,
+                           Proc=f"client-{spec.name}")
             versions[spec.name] = max(versions[spec.name], int(v))
             return int(v)
 
@@ -719,6 +813,35 @@ async def _campaign(cfg: NemesisConfig) -> CampaignReport:
                  if not any(r[0] <= w1 and r[0] + r[1] >= w0
                             for w0, w1 in windows)],
                 cfg.resolved_budget_ms())
+            # cross-process waterfalls + tail-sampled trace export
+            # (docs/observability.md "Distributed tracing"): reconstruct
+            # every request's client->server->resolve waterfall, retain
+            # the p99 candidates and every faulted/throttled/retried
+            # request, name the worst offender's dominant segment (what
+            # an assert_slos breach leads with), and write the Chrome
+            # trace-event JSON with the nemesis fault windows on the
+            # same timeline
+            spans = list(g_spans.spans)
+            waterfalls = trace_export.build_waterfalls(spans)
+            retained = trace_export.tail_sample(waterfalls)
+            report.traces = trace_export.trace_summary(waterfalls, retained)
+            report.slo_root_cause = trace_export.root_cause(retained)
+            if cfg.trace_export:
+                window_dicts = list(nemesis.windows)
+                window_dicts += [{"kind": "device_incident", "t0": a, "t1": b}
+                                 for a, b in incident_windows]
+                if cfg.warmup_frac > 0:
+                    window_dicts.append({
+                        "kind": "warmup", "t0": rep.t_start,
+                        "t1": rep.t_start + cfg.duration_s * cfg.warmup_frac})
+                doc = trace_export.chrome_trace(
+                    trace_export.spans_for_traces(spans, retained),
+                    window_dicts)
+                os.makedirs(os.path.dirname(os.path.abspath(cfg.trace_export)),
+                            exist_ok=True)
+                with open(cfg.trace_export, "w") as f:
+                    json.dump(doc, f, default=str)
+                report.trace_file = cfg.trace_export
     finally:
         if gc_was_enabled:
             gc.enable()
@@ -750,9 +873,13 @@ def assert_slos(report: CampaignReport, cfg: NemesisConfig,
     assert report.n_outside >= min_outside, \
         (f"only {report.n_outside} acks outside fault windows "
          f"(need >= {min_outside} for a meaningful p99): {ctx}")
+    root = report.slo_root_cause or {}
     assert report.p99_outside_ms <= budget, \
         (f"p99 outside injected-fault windows {report.p99_outside_ms:.3f} ms "
-         f"exceeds budget {budget} ms: {ctx}")
+         f"exceeds budget {budget} ms — worst retained trace's dominant "
+         f"segment: {root.get('dominant_segment')} "
+         f"({root.get('dominant_ms')} ms of {root.get('client_ms')} ms, "
+         f"trace {root.get('rid')} v{root.get('version')}): {ctx}")
     if cfg.device_faults:
         assert report.engine_stats.get("failovers", 0) >= 1, \
             f"no failover observed: {ctx}"
@@ -773,6 +900,18 @@ def assert_slos(report: CampaignReport, cfg: NemesisConfig,
     if cfg.collect_spans:
         assert report.attribution is not None, \
             f"span attribution empty (spans not collected?): {ctx}"
+        tr = report.traces or {}
+        assert tr.get("retained", 0) >= 1, \
+            f"tail sampling retained no traces: {ctx}"
+        # the completeness contract: every retained verdict-bearing ack
+        # (p99 candidate or faulted) reconstructs a COMPLETE cross-process
+        # waterfall — only transport-failed requests may be client-only
+        assert tr.get("retained_ack_incomplete", 0) == 0, \
+            (f"{tr.get('retained_ack_incomplete')} retained ack(s) lack a "
+             f"complete waterfall: {ctx}")
+        assert tr.get("max_sum_err_ms", 0.0) <= 0.05, \
+            (f"waterfall segments do not sum to client latency "
+             f"(max err {tr.get('max_sum_err_ms')} ms): {ctx}")
 
 
 # -- the bench capacity model -------------------------------------------------
@@ -868,6 +1007,34 @@ def run_served_under_chaos(skews=(0.0, 0.9, 1.2), seconds: float = 4.0,
     }
 
 
+# -- solo traced commit server (the 2-process trace smoke's child) ------------
+
+async def _serve_commit(port: int) -> None:
+    """Run ONE traced ChaosCommitServer solo: the child half of `make
+    trace-smoke`'s 2-OS-process cluster. Spans are on and the process
+    names itself, so fetched span rings identify their recorder."""
+    from ..core.trace import set_process_name, set_span_collection
+    from ..sim.loop import set_scheduler
+    from .runtime import RealScheduler
+
+    set_span_collection(True)
+    set_process_name(f"commit-server:{port}")
+    sched = RealScheduler(seed=0)
+    set_scheduler(sched)
+    run_task = asyncio.ensure_future(sched.run_async())
+    server = ChaosCommitServer(sched, engine_mode="oracle", port=port)
+    try:
+        await server.start()
+        print(f"listening on {server.address}", flush=True)
+        while True:
+            await asyncio.sleep(3600)
+    finally:
+        await server.stop()
+        sched.shutdown()
+        run_task.cancel()
+        set_scheduler(None)
+
+
 # -- CLI ----------------------------------------------------------------------
 
 def main(argv=None) -> int:
@@ -886,7 +1053,20 @@ def main(argv=None) -> int:
     ap.add_argument("--sweep", action="store_true",
                     help="also run the served_under_chaos Zipf sweep")
     ap.add_argument("--json", default=None, help="write reports to this file")
+    ap.add_argument("--trace-dir", default=None,
+                    help="write each campaign's tail-sampled cross-process "
+                         "Chrome trace JSON into this directory "
+                         "(chrome://tracing / Perfetto loadable)")
+    ap.add_argument("--serve", type=int, default=None, metavar="PORT",
+                    help="run a traced commit server solo on PORT "
+                         "(the trace-smoke child process) and never return")
     args = ap.parse_args(argv)
+    if args.serve is not None:
+        try:
+            asyncio.run(_serve_commit(args.serve))
+        except KeyboardInterrupt:
+            pass
+        return 0
 
     # compile-cache like tests/conftest.py: repeated campaigns must not
     # repay the kernel compile (solo-CPU friendliness)
@@ -911,12 +1091,25 @@ def main(argv=None) -> int:
         duration = args.duration if mode == "oracle" else max(args.duration, 8.0)
         for i in range(args.seeds):
             seed = args.base_seed + i
+            trace_path = (os.path.join(args.trace_dir,
+                                       f"trace_{mode}_s{seed}.json")
+                          if args.trace_dir else None)
             cfg = NemesisConfig(seed=seed, engine_mode=mode,
                                 duration_s=duration,
-                                budget_ms=args.budget_ms)
+                                budget_ms=args.budget_ms,
+                                trace_export=trace_path)
             print(f"campaign: engine={mode} seed={seed} ...", flush=True)
             rep = run_campaign(cfg)
             reports.append(rep.as_dict())
+            if rep.trace_file:
+                # schema-check every export right here: a campaign whose
+                # trace JSON would not load is a failed campaign
+                with open(rep.trace_file) as f:
+                    n_events = trace_export.validate_chrome_trace(json.load(f))
+                tr = rep.traces or {}
+                print(f"  traces -> {rep.trace_file} ({n_events} events, "
+                      f"{tr.get('retained')} retained of "
+                      f"{tr.get('n_waterfalls')} waterfalls)", flush=True)
             try:
                 assert_slos(rep, cfg)
                 print(f"  OK  p99_outside={rep.p99_outside_ms:.3f}ms "

@@ -27,7 +27,7 @@ import os
 import time as _time
 from typing import Any, Callable, Dict, List, Optional
 
-from ..core import error
+from ..core import error, trace
 from ..sim.actors import ActorCollection
 from ..sim.loop import Future, Scheduler, Task, TaskPriority
 from .transport import RealNetwork, RealProcess
@@ -315,16 +315,44 @@ class RealWorld:
         return d
 
 
+async def _run_with_trace_context(ctx, handler, body):
+    """Install the inbound trace context (possibly None) around a
+    scheduler-dispatched handler. Scheduler tasks interleave inside ONE
+    asyncio task (run_async drives every step in its own context), so the
+    ambient context is only guaranteed during the handler's synchronous
+    prefix — the set here runs in the same step as that prefix, and
+    handlers capture the context at entry, before their first await
+    (core/trace.py's discipline). The finally CLEARS the variable rather
+    than token-resetting it: interleaved handlers pop out of LIFO order,
+    and a token reset would re-install a completed sibling's context as
+    the shared ambient value — a context-less handler dispatched after it
+    would then record spans under a foreign trace id."""
+    from ..core import trace
+
+    trace.push_trace_context(ctx)
+    try:
+        return await handler(body)
+    finally:
+        trace.push_trace_context(None)
+
+
 def make_dispatcher(sched: RealScheduler):
     """Transport dispatcher: run a role handler on the node's cooperative
     scheduler and hand asyncio an awaitable for the reply. The scheduler
     Task rides on the future as `sim_task` so deadline shedding
     (real/transport.RealProcess._answer) can cancel the HANDLER, not just
     the asyncio bridge — expired work stops running, it doesn't finish
-    into a reply nobody awaits."""
+    into a reply nobody awaits. With tracing active every handler is
+    wrapped so its synchronous prefix sees exactly its own request's
+    inbound context (or None) — never a sibling's leftovers; with spans
+    off nothing wraps and nothing allocates."""
 
     def dispatch(handler, body):
-        t = sched.spawn(handler(body), TaskPriority.DEFAULT_ENDPOINT,
+        ctx = trace.current_trace_context()
+        coro = (_run_with_trace_context(ctx, handler, body)
+                if (ctx is not None or trace.spans_enabled())
+                else handler(body))
+        t = sched.spawn(coro, TaskPriority.DEFAULT_ENDPOINT,
                         name=f"rpc:{getattr(handler, '__name__', 'handler')}")
         af = sim_to_aio(t)
         af.sim_task = t

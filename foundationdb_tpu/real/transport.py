@@ -9,7 +9,10 @@ encoding are the same bytes, like flow/serialize.h serving both.
 
     frame := [u32 len][wire payload]
     payload := {"kind": "req"|"reply"|"err"|"oneway",
-                "id": int, "token": str, "body": any}
+                "id": int, "token": str, "body": any,
+                "ttl": float?,        # propagated deadline budget
+                "tc": TraceContext?}  # propagated trace context
+                                      # (core/trace.py; spans enabled only)
 
 Every dataclass in server/messages.py is wire-registered at import, so
 role interfaces serialize without pickle. Connections are per-peer,
@@ -28,6 +31,12 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from ..core import buggify, error, wire
 from ..core.knobs import FLOW_KNOBS
+from ..core.trace import (
+    current_trace_context,
+    g_spans,
+    pop_trace_context,
+    push_trace_context,
+)
 from ..sim.network import Endpoint
 
 
@@ -356,7 +365,8 @@ class RealProcess:
                     handler = self.handlers.get(msg["token"])
                     if handler is not None:
                         self._track(asyncio.create_task(
-                            self._run_oneway(handler, msg["body"])))
+                            self._run_oneway(handler, msg["body"],
+                                             msg.get("tc"))))
                     continue
                 self._track(asyncio.create_task(self._answer(writer, msg)))
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
@@ -369,7 +379,10 @@ class RealProcess:
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
 
-    async def _run_oneway(self, handler, body) -> None:
+    async def _run_oneway(self, handler, body, tc=None) -> None:
+        # inbound trace context installed task-locally (this coroutine IS
+        # its own asyncio task, so the set never leaks to other requests)
+        tok = push_trace_context(tc) if tc is not None else None
         try:
             if self.dispatcher is not None:
                 await self.dispatcher(handler, body)
@@ -377,11 +390,27 @@ class RealProcess:
                 await handler(body)
         except Exception:
             pass
+        finally:
+            if tok is not None:
+                pop_trace_context(tok)
 
     async def _answer(self, writer: asyncio.StreamWriter, msg) -> None:
         if buggify.buggify():
             # slow service: client timeouts race (knob-derived, was 0.05)
             await asyncio.sleep(float(FLOW_KNOBS.max_buggified_delay) / 4)
+        # inbound trace context: installed for the whole handler await —
+        # task-local (each _answer is its own asyncio task), and handed
+        # across the cooperative-scheduler boundary by the dispatcher
+        # (real/runtime.make_dispatcher wraps the handler coroutine)
+        tc = msg.get("tc")
+        tok = push_trace_context(tc) if tc is not None else None
+        try:
+            await self._answer_inner(writer, msg)
+        finally:
+            if tok is not None:
+                pop_trace_context(tok)
+
+    async def _answer_inner(self, writer: asyncio.StreamWriter, msg) -> None:
         handler = self.handlers.get(msg["token"])
         #: propagated client deadline (seconds of budget left at send time):
         #: handler work is bounded by it — a reply the client stopped
@@ -504,6 +533,13 @@ class RealNetwork:
                       timeout: Optional[float] = None) -> Any:
         if timeout is None:
             timeout = float(FLOW_KNOBS.real_rpc_timeout_s)
+        # distributed tracing: capture the ambient context NOW, in the
+        # caller's synchronous prefix — on a cooperative-scheduler node
+        # the shared ambient var is only guaranteed before the first
+        # suspension (core/trace.py's discipline), and the connect below
+        # suspends. The captured value is re-attached on every send, so a
+        # retry after a reset/backoff/failover re-joins the same trace.
+        tc = current_trace_context() if g_spans.enabled else None
         # deadline propagation: the budget is END TO END — connect (incl.
         # handshake) and the reply wait share it, and the remaining budget
         # rides the frame as `ttl` so the server can shed work whose
@@ -520,6 +556,8 @@ class RealNetwork:
             ttl = max(0.001, deadline - loop.time())
             frame = {"kind": "req", "id": rid, "token": ep.token,
                      "body": payload, "ttl": round(ttl, 4)}
+            if tc is not None:
+                frame["tc"] = tc
             _write_frame(p.writer, frame)
             if buggify.buggify():
                 # duplicate delivery (the transport's redelivery semantics):
@@ -542,10 +580,15 @@ class RealNetwork:
                       priority: int = 0) -> None:
         if buggify.buggify():
             return   # unreliable by contract: drop outright
+        # context captured before the first suspension (see request())
+        tc = current_trace_context() if g_spans.enabled else None
         try:
             p = await self._peer(ep.address)
-            _write_frame(p.writer, {"kind": "oneway", "id": 0,
-                                    "token": ep.token, "body": payload})
+            frame = {"kind": "oneway", "id": 0,
+                     "token": ep.token, "body": payload}
+            if tc is not None:
+                frame["tc"] = tc
+            _write_frame(p.writer, frame)
             await p.writer.drain()
         except (error.FDBError, ConnectionError, OSError):
             pass   # unreliable by contract

@@ -253,7 +253,8 @@ class Resolver:
             self._inflight[req.version] = p
             if g_spans.enabled:
                 span_event("resolver.queue_wait", req.version,
-                           t_enter, span_now())
+                           t_enter, span_now(),
+                           parent="proxy.resolve_rpc")
             try:
                 verdicts = await self._engine_resolve(
                     transactions, req.version, new_oldest)
@@ -299,7 +300,8 @@ class Resolver:
         self._inflight[req.version] = p
         self.version.set(req.version)
         if g_spans.enabled:
-            span_event("resolver.queue_wait", req.version, t_enter, span_now())
+            span_event("resolver.queue_wait", req.version, t_enter,
+                       span_now(), parent="proxy.resolve_rpc")
         try:
             verdicts = await self._service.resolve(
                 transactions, req.version, new_oldest)
@@ -341,7 +343,8 @@ class Resolver:
             # serial path: no service stages, so the whole engine dispatch
             # is the device segment (pack rides inside it in zero vtime)
             span_event("resolver.device_dispatch", version, t0, span_now(),
-                       txns=len(transactions))
+                       txns=len(transactions),
+                       parent="resolver.queue_wait")
         return r
 
     def _finish(self, version: Version, verdicts, prepended: bool,

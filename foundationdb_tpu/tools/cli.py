@@ -40,6 +40,12 @@ commands:
   chaos-status [FILE]  nemesis event counts from this process's telemetry
                        hub, or from a campaign report JSON written by
                        `python -m foundationdb_tpu.real.nemesis --json`
+  trace FILE.json      validate + summarize an exported Chrome trace
+                       (a campaign's --trace-dir output)
+  trace fetch ADDR [ADDR...] [OUT.json]
+                       fetch live span rings over RPC (trace.spans token),
+                       reconstruct per-commit waterfalls, optionally write
+                       Chrome trace JSON (docs/observability.md)
   help                 this text
   exit                 quit
 Keys/values are text; prefix with 0x for hex bytes."""
@@ -212,6 +218,73 @@ class Cli:
 
         for line in chaos_status_lines():
             self._print(line)
+
+    def do_trace(self, args: List[str]) -> None:
+        """Distributed-trace workflows (docs/observability.md "Distributed
+        tracing"): validate+summarize an exported Chrome trace JSON, or
+        fetch live span rings over the `trace.spans` RPC token and
+        reconstruct cross-process per-commit waterfalls."""
+        import asyncio
+
+        from . import trace_export as tx
+
+        if not args:
+            self._print("usage: trace FILE.json | "
+                        "trace fetch ADDR [ADDR...] [OUT.json]")
+            return
+        if args[0] == "fetch":
+            addrs = [a for a in args[1:] if ":" in a]
+            out = next((a for a in args[1:] if a.endswith(".json")), None)
+            if not addrs:
+                self._print("trace fetch: need at least one HOST:PORT")
+                return
+            spans = asyncio.run(tx.fetch_spans(addrs))
+            waterfalls = tx.build_waterfalls(spans)
+            retained = tx.tail_sample(waterfalls)
+            self._print(f"{len(spans)} spans from {len(addrs)} process(es); "
+                        f"{len(waterfalls)} waterfalls, "
+                        f"{len(retained)} retained by tail sampling")
+            for w in retained[:20]:
+                path = (f"{w.get('proc_client') or '?'} -> "
+                        f"{w.get('proc_server') or 'UNREACHED'}")
+                err = f" err={w['err']}" if w["err"] else ""
+                self._print(
+                    f"  {str(w['rid']):<16} v={w['version']} "
+                    f"{w['client_ms']:>9.3f}ms "
+                    f"dominant={w['dominant_segment']}{err}  [{path}]")
+            if out is not None:
+                doc = tx.chrome_trace(tx.spans_for_traces(spans, retained))
+                with open(out, "w") as f:
+                    json.dump(doc, f, default=str)
+                self._print(f"chrome trace -> {out}")
+            return
+        with open(args[0]) as f:
+            doc = json.load(f)
+        n = tx.validate_chrome_trace(doc)
+        events = doc.get("traceEvents", [])
+        # args is optional per the trace-event format: a metadata event
+        # without it is valid, it just leaves the pid unnamed
+        procs = {ev["pid"]: ev.get("args", {}).get("name", str(ev["pid"]))
+                 for ev in events if ev.get("ph") == "M"
+                 and ev.get("name") == "process_name"}
+        per_proc: dict = {}
+        for ev in events:
+            if ev.get("ph") == "X":
+                name = procs.get(ev["pid"], str(ev["pid"]))
+                per_proc[name] = per_proc.get(name, 0) + 1
+        self._print(f"{args[0]}: valid Chrome trace, {n} duration events "
+                    f"across {len(procs)} process(es)")
+        for name in sorted(per_proc):
+            self._print(f"  {name:<24} {per_proc[name]} events")
+        slowest = sorted((ev for ev in events if ev.get("ph") == "X"
+                          and ev.get("cat") == "span"),
+                         key=lambda e: -e.get("dur", 0))[:5]
+        if slowest:
+            self._print("slowest spans:")
+            for ev in slowest:
+                ev_args = ev.get("args") or {}
+                self._print(f"  {ev['name']:<24} {ev['dur'] / 1e3:>9.3f}ms "
+                            f"trace={ev_args.get('Trace')}")
 
     def do_get(self, args: List[str]) -> None:
         (key,) = args
@@ -406,11 +479,16 @@ def main(argv=None) -> int:
                     help="run one command and exit (e.g. "
                          "`chaos-status reports.json`, `status`)")
     args = ap.parse_args(argv)
-    if args.command and args.command[0].replace("-", "_") == "chaos_status":
-        # no cluster needed: renders the hub / a campaign report file
+    if args.command and args.command[0].replace("-", "_") in (
+            "chaos_status", "trace"):
+        # no cluster needed: renders the hub / a report or trace file /
+        # a live span-ring fetch over RPC
         cli = Cli.__new__(Cli)
         cli.out = sys.stdout
-        cli.do_chaos_status(args.command[1:])
+        if args.command[0].replace("-", "_") == "chaos_status":
+            cli.do_chaos_status(args.command[1:])
+        else:
+            cli.do_trace(args.command[1:])
         return 0
     cluster = build_dynamic_cluster(seed=args.seed, cfg=DynamicClusterConfig())
     if args.command:

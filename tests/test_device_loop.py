@@ -146,8 +146,14 @@ def test_loop_vs_step_vs_oracle_boundaries_and_gc():
     from foundationdb_tpu.core import telemetry
 
     text = telemetry.hub().prometheus_text()
-    assert "dispatch_mode_hits_loop" in text
+    assert 'dispatch_mode_hits.loop"' in text
     assert "search_mode_hits" in text
+    # the loop's queue/ring gauges ride the same exposition (ISSUE 9):
+    # result-ring depth, slot occupancy, and the sync accounting — with
+    # blocking_syncs readable (and 0) on any healthy scrape
+    assert "# TYPE fdbtpu_loop gauge" in text
+    assert 'ring_depth"' in text and 'slots_in_flight"' in text
+    assert 'blocking_syncs"} 0' in text
 
 
 @pytest.mark.parametrize("depth", [2, 3])
@@ -339,6 +345,17 @@ def test_resilient_loop_engine_failover_and_rebuild():
     # the rebuilt loop engine's queue is quiesced (drain/rebuild contract)
     assert not dev.inner._ring
     assert dev.inner.loop_stats["blocking_syncs"] == 0
+
+    # flight records from a loop-mode engine are diagnosable (ISSUE 9):
+    # every record names the dispatch path and snapshots the queue/ring
+    # state + sync accounting at that dispatch
+    records = eng.flight.dump()
+    assert records and all(r["dispatch_mode"] == "loop" for r in records)
+    last = records[-1]
+    assert "loop_stats" in last
+    for key in ("ring_depth", "slots_in_flight", "blocking_syncs",
+                "forced_waits", "drained_nonblocking"):
+        assert key in last["loop_stats"], key
 
     # journal replay parity: the emitted abort stream is bit-identical to
     # a clean oracle living through the same batches

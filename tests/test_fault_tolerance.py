@@ -126,6 +126,11 @@ def test_timeout_retry_recovers(sim):
     assert st["state"] == HEALTHY
     assert st["dispatch_faults"] == 1 and st["retries"] == 1
     assert st["failovers"] == 0
+    # flight records name the dispatch path (ISSUE 9): a step-dispatch
+    # engine files "step" and carries no loop snapshot
+    records = eng.flight.dump()
+    assert records and all(r["dispatch_mode"] == "step" for r in records)
+    assert all("loop_stats" not in r for r in records)
 
 
 def test_retry_exhaustion_fails_over_bit_identical(sim):

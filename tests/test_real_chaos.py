@@ -294,6 +294,284 @@ def test_pipeline_depth_collapses_on_degraded_transport():
     assert pipe.effective_depth == 3
 
 
+# -- distributed trace-context propagation under chaos (ISSUE 9) -------------
+
+def test_trace_context_survives_reset_and_reconnect_backoff():
+    """The propagated context rides every send of a request id: after an
+    injected connection reset the retry reconnects and re-attaches the
+    SAME trace id, and after a real connect failure the retry waits out
+    the reconnect-backoff window (fail-fast inside it) and still joins."""
+    from foundationdb_tpu.core.trace import (
+        TraceContext,
+        current_trace_context,
+        g_spans,
+        use_trace_context,
+    )
+
+    async def go():
+        telemetry.reset()
+        seen = []
+        proc = RealProcess()
+
+        async def ping(body):
+            seen.append(getattr(current_trace_context(), "trace_id", None))
+            return body
+
+        proc.register("t.ping", ping)
+        await proc.start()
+        port = proc.port
+        ep = Endpoint(proc.address, "t.ping")
+        nem = NetworkNemesis(9, ChaosConfig(latency_prob=0, drop_prob=0,
+                                            reset_prob=1.0,
+                                            handshake_stall_prob=0))
+        nem.enabled = False
+        t = ChaosTransport(RealNetwork(), nem, name="resetter")
+        g_spans.enabled = True
+        try:
+            with use_trace_context(TraceContext(trace_id="rid-reset",
+                                                parent="client.commit")):
+                assert await t.request("c", ep, 1, timeout=2.0) == 1
+                nem.enabled = True   # next request: reset tears the peer
+                with pytest.raises(error.FDBError):
+                    await t.request("c", ep, 2, timeout=1.0)
+                nem.enabled = False
+                # the retry reconnects and carries the SAME trace id
+                assert await t.request("c", ep, 3, timeout=2.0) == 3
+            assert seen == ["rid-reset", "rid-reset"]
+            # now a genuine connect failure -> backoff window -> fail fast
+            # -> server restarts on the same port -> retry still joins
+            await proc.stop()
+            with use_trace_context(TraceContext(trace_id="rid-backoff")):
+                with pytest.raises(error.FDBError):
+                    # the live connection dies under this request
+                    await t.request("c", ep, 4, timeout=0.5)
+                with pytest.raises(error.FDBError):
+                    # reconnect refused -> backoff window opens
+                    await t.request("c", ep, 4, timeout=0.5)
+                peer = t.inner._peers[proc.address]
+                assert peer.fail_streak >= 1 and peer.retry_at > 0
+                with pytest.raises(error.FDBError):   # inside the window
+                    await t.request("c", ep, 5, timeout=0.5)
+                assert t.inner.backoff_failfasts >= 1
+                proc2 = RealProcess("127.0.0.1", port)
+                proc2.register("t.ping", ping)
+                await proc2.start()
+                await asyncio.sleep(0.12)   # > max jittered first backoff
+                assert await t.request("c", ep, 6, timeout=2.0) == 6
+                await proc2.stop()
+            assert seen[-1] == "rid-backoff"
+        finally:
+            g_spans.enabled = False
+            t.close()
+            await proc.stop()
+
+    run(go())
+
+
+def test_trace_context_reattached_on_retry_after_resolver_failure():
+    """A commit whose first attempt dies in the resolver (typed
+    device_fault — the failover signature) is retried by the client under
+    the same context: the serving side observes the SAME trace id on both
+    attempts, so the retry's spans join the original trace."""
+    from foundationdb_tpu.core.trace import (
+        TraceContext,
+        current_trace_context,
+        g_spans,
+        use_trace_context,
+    )
+
+    async def go():
+        calls = []
+        proc = RealProcess()
+
+        async def flaky_commit(body):
+            calls.append(getattr(current_trace_context(), "trace_id", None))
+            if len(calls) == 1:
+                raise error.device_fault("injected resolver failover")
+            return body
+
+        proc.register("t.commit", flaky_commit)
+        await proc.start()
+        net = RealNetwork(name="retrier")
+        g_spans.enabled = True
+        try:
+            ep = Endpoint(proc.address, "t.commit")
+            with use_trace_context(TraceContext(trace_id="rid-retry",
+                                                parent="client.commit")):
+                got = None
+                for _attempt in range(3):
+                    try:
+                        got = await net.request("c", ep, 7, timeout=1.0)
+                        break
+                    except error.FDBError:
+                        continue
+            assert got == 7
+            assert calls == ["rid-retry", "rid-retry"]
+        finally:
+            g_spans.enabled = False
+            net.close()
+            await proc.stop()
+
+    run(go())
+
+
+def test_restarted_process_spans_join_right_trace(tmp_path):
+    """Kill a traced demo node and supervise it back up: the restarted
+    incarnation's spans still join the trace id the client propagates —
+    a fresh process needs nothing but the frame's context to take part."""
+    import os
+    import sys as _sys
+
+    from foundationdb_tpu.core.trace import (
+        TraceContext,
+        g_spans,
+        use_trace_context,
+    )
+    from foundationdb_tpu.real.cluster import free_ports
+    from foundationdb_tpu.real.monitor import Child, poll_children
+    from foundationdb_tpu.tools import trace_export as tx
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    (port,) = free_ports(1)
+    code = ("import sys; sys.path.insert(0, %r); "
+            "from foundationdb_tpu.real.demo_server import main; "
+            "sys.exit(main(['--port', '%d', '--trace']))" % (repo_root, port))
+    child = Child("node.trace", [_sys.executable, "-c", code])
+    child.backoff = 0.2
+
+    async def traced_ping(rid: str) -> bool:
+        net = RealNetwork(name="trace-client")
+        try:
+            ep = Endpoint(f"127.0.0.1:{port}", "demo.ping")
+            with use_trace_context(TraceContext(trace_id=rid,
+                                                parent="client.ping")):
+                for _ in range(100):
+                    try:
+                        if await net.request("c", ep, 1, timeout=0.5) == 1:
+                            return True
+                    except (error.FDBError, ConnectionError, OSError):
+                        await asyncio.sleep(0.1)
+            return False
+        finally:
+            net.close()
+
+    async def go():
+        g_spans.enabled = True
+        try:
+            child.spawn(str(tmp_path))
+            assert await traced_ping("rid-incarnation-1")
+            ring1 = await tx.fetch_spans([f"127.0.0.1:{port}"])
+            assert any(s.get("Trace") == "rid-incarnation-1" for s in ring1)
+            assert any(s.get("Proc", "").startswith("demo:") for s in ring1)
+            # kill it; retries of the SAME request id span the dead window
+            # and land on the supervised restart
+            child.proc.kill()
+            deadline = time.monotonic() + 10
+            restarted = False
+            while time.monotonic() < deadline and not restarted:
+                poll_children([child], str(tmp_path))
+                restarted = child.restarts >= 1
+                await asyncio.sleep(0.1)
+            assert restarted
+            assert await traced_ping("rid-incarnation-2")
+            ring2 = await tx.fetch_spans([f"127.0.0.1:{port}"])
+            # the fresh incarnation joined the propagated trace; its ring
+            # is its own (the first incarnation's spans died with it)
+            assert any(s.get("Trace") == "rid-incarnation-2" for s in ring2)
+            assert not any(s.get("Trace") == "rid-incarnation-1"
+                           for s in ring2)
+        finally:
+            g_spans.enabled = False
+            child.stop()
+
+    run(go())
+
+
+def test_commit_server_waterfalls_and_tail_sampling():
+    """The scheduler-dispatched commit handler adopts the propagated
+    context (captured in its synchronous prefix), links it to the batch's
+    commit version, and the reconstruction yields complete waterfalls
+    whose segments sum to the client-observed latency — with throttled
+    requests force-retained by tail sampling."""
+    from foundationdb_tpu.core.trace import (
+        TraceContext,
+        g_spans,
+        next_trace_id,
+        pop_trace_context,
+        push_trace_context,
+        span_event,
+        span_now,
+    )
+    from foundationdb_tpu.real.nemesis import COMMIT_TOKEN, ChaosCommitServer
+    from foundationdb_tpu.real.runtime import RealScheduler
+    from foundationdb_tpu.sim.loop import set_scheduler
+    from foundationdb_tpu.tools import trace_export as tx
+
+    async def go():
+        telemetry.reset()
+        g_spans.enabled = True
+        g_spans.clear()
+        sched = RealScheduler(seed=3)
+        set_scheduler(sched)
+        run_task = asyncio.ensure_future(sched.run_async())
+        server = ChaosCommitServer(sched, engine_mode="oracle",
+                                   admission_tps=30.0, admission_burst_s=0.2)
+        net = RealNetwork(name="client-t")
+        committed = throttled = 0
+        snapshot = 0
+        try:
+            await server.start()
+            ep = Endpoint(server.address, COMMIT_TOKEN)
+            for i in range(40):
+                rid = next_trace_id()
+                tok = push_trace_context(
+                    TraceContext(trace_id=rid, parent="client.commit"))
+                t0 = span_now()
+                try:
+                    # unique keys + a tracked snapshot: admission is the
+                    # only source of non-committed verdicts here
+                    v = await net.request(
+                        "c", ep,
+                        ("t", [b"k%d" % i], [b"k%d" % i], snapshot),
+                        timeout=5.0)
+                except error.FDBError as e:
+                    span_event("client.commit", rid, t0, span_now(),
+                               err=e.name, Proc="client-t")
+                    throttled += e.name == "transaction_throttled"
+                else:
+                    committed += 1
+                    snapshot = max(snapshot, int(v))
+                    span_event("client.commit", rid, t0, span_now(),
+                               version=int(v), Proc="client-t")
+                finally:
+                    pop_trace_context(tok)
+                await asyncio.sleep(0.01)
+        finally:
+            net.close()
+            await server.stop()
+            sched.shutdown()
+            run_task.cancel()
+            set_scheduler(None)
+        spans = list(g_spans.spans)
+        g_spans.enabled = False
+        g_spans.clear()
+        assert committed >= 10, (committed, throttled)
+        wfs = tx.build_waterfalls(spans)
+        complete = [w for w in wfs if w["complete"]]
+        assert len(complete) == 40, "every request's server span joined"
+        decomposed = [w for w in complete
+                      if "server_resolve" in w["segments_ms"]]
+        assert decomposed, "no waterfall joined its batch resolve span"
+        for w in complete:
+            assert abs(w["sum_ms"] - w["client_ms"]) <= 0.05, w
+        if throttled:
+            retained = tx.tail_sample(wfs)
+            assert any(w["err"] == "transaction_throttled"
+                       for w in retained), "throttled trace not retained"
+
+    run(go())
+
+
 # -- the campaign itself ------------------------------------------------------
 
 FAST_SEED = 11
@@ -338,6 +616,37 @@ def test_real_chaos_fast_seed():
     att = rep.attribution
     assert att and att["p99"]["server_resolve_ms"] >= 0
     assert att["p99"]["client_ms"] >= att["p99"]["server_resolve_ms"]
+    # distributed traces (ISSUE 9): waterfalls reconstructed, tail
+    # sampling retained the p99 candidates + every faulted request with
+    # complete decompositions (assert_slos already enforced the sum
+    # identity and ack completeness), and the report names a root cause
+    tr = rep.traces
+    assert tr and tr["n_waterfalls"] > 100 and tr["retained"] >= 1
+    assert tr["retained_ack_incomplete"] == 0
+    assert rep.slo_root_cause is not None
+    assert rep.slo_root_cause["dominant_segment"] in \
+        rep.slo_root_cause["segments_ms"]
+
+
+def test_campaign_trace_export_chrome_json(tmp_path):
+    """A campaign with trace_export set writes Chrome trace JSON that
+    loads, validates, and shows nemesis fault windows on the timeline
+    alongside spans from client and server recorders."""
+    from foundationdb_tpu.tools import trace_export as tx
+
+    path = str(tmp_path / "campaign_trace.json")
+    cfg = _fast_cfg(FAST_SEED + 60, kill_child=False, device_faults=False,
+                    trace_export=path)
+    rep = run_campaign(cfg)
+    assert rep.trace_file == path
+    with open(path) as f:
+        doc = json.load(f)
+    assert tx.validate_chrome_trace(doc) >= 1
+    events = doc["traceEvents"]
+    names = {ev["args"]["name"] for ev in events if ev.get("ph") == "M"}
+    assert "nemesis" in names and "server" in names
+    assert any(n.startswith("client-") for n in names)
+    assert any(ev.get("cat") == "chaos" for ev in events)
 
 
 def test_journal_parity_helper_detects_mismatch():

@@ -1,0 +1,182 @@
+"""Distributed-trace reconstruction + export (ISSUE 9): waterfall
+segment math and the sum identity, knob-driven tail sampling, Chrome
+trace-event schema validation, and the Prometheus exposition format
+(# HELP/# TYPE + escaped label values) a real scraper must parse."""
+import json
+
+import pytest
+
+from foundationdb_tpu.core import telemetry
+from foundationdb_tpu.core.knobs import SERVER_KNOBS, reset_all
+from foundationdb_tpu.tools import trace_export as tx
+
+
+def _span(name, trace, t0, t1, proc, **d):
+    return {"Name": name, "Trace": trace, "Begin": t0, "End": t1,
+            "Proc": proc, **d}
+
+
+def _trace_set():
+    """Two requests batched at version 100 (one committed, one conflicted),
+    one throttled (no batch span), one that never reached the server."""
+    return [
+        _span("client.commit", "r1", 0.000, 0.010, "client-a", version=100),
+        _span("server.commit", "r1", 0.001, 0.009, "server", version=100),
+        _span("client.commit", "r2", 0.001, 0.011, "client-b",
+              err="not_committed"),
+        _span("server.commit", "r2", 0.002, 0.010, "server", version=100,
+              err="not_committed"),
+        _span("chaos.queue_wait", 100, 0.001, 0.004, "server", txns=2),
+        _span("chaos.resolve", 100, 0.004, 0.007, "server", txns=2),
+        _span("client.commit", "r3", 0.002, 0.003, "client-a",
+              err="transaction_throttled"),
+        _span("server.commit", "r3", 0.0025, 0.0028, "server",
+              err="transaction_throttled"),
+        _span("client.commit", "r4", 0.005, 0.055, "client-b",
+              err="connection_failed"),
+    ]
+
+
+def test_waterfall_segments_sum_to_client_latency():
+    wfs = {w["rid"]: w for w in tx.build_waterfalls(_trace_set())}
+    w = wfs["r1"]
+    assert w["complete"] and w["version"] == 100 and w["ok"]
+    seg = w["segments_ms"]
+    # full decomposition through the batch resolve span, all named
+    assert set(seg) == {"request_net", "server_queue_wait",
+                        "server_resolve", "server_reply", "reply_net"}
+    assert seg["server_resolve"] == pytest.approx(3.0)
+    assert seg["server_queue_wait"] == pytest.approx(3.0)
+    # the sum identity: segments telescope onto the client interval
+    assert w["sum_ms"] == pytest.approx(w["client_ms"], abs=1e-6)
+    assert w["client_ms"] == pytest.approx(10.0)
+    # cross-process join recorded both recorders
+    assert (w["proc_client"], w["proc_server"]) == ("client-a", "server")
+    # a conflicted ack still decomposes through ITS batch version
+    w2 = wfs["r2"]
+    assert w2["complete"] and w2["err"] == "not_committed"
+    assert w2["version"] == 100
+    assert "server_resolve" in w2["segments_ms"]
+    assert w2["sum_ms"] == pytest.approx(w2["client_ms"], abs=1e-6)
+    # throttled before batching: the server interval is one named segment
+    w3 = wfs["r3"]
+    assert "server_commit" in w3["segments_ms"]
+    assert w3["sum_ms"] == pytest.approx(w3["client_ms"], abs=1e-6)
+    # never reached the server: honest single named residual, incomplete
+    w4 = wfs["r4"]
+    assert not w4["complete"]
+    assert w4["segments_ms"] == {"client_unreached": pytest.approx(50.0)}
+    assert w4["dominant_segment"] == "client_unreached"
+
+
+def test_tail_sampling_keeps_errors_and_p99_candidates():
+    # 200 clean acks with latency i ms + the error traces from _trace_set
+    spans = _trace_set()
+    for i in range(200):
+        rid = f"c{i}"
+        spans.append(_span("client.commit", rid, 1.0 + i, 1.0 + i + i * 1e-3,
+                           "client-a", version=100))
+        spans.append(_span("server.commit", rid, 1.0 + i + 1e-4,
+                           1.0 + i + i * 1e-3 - 1e-4, "server", version=100))
+    wfs = tx.build_waterfalls(spans)
+    retained = tx.tail_sample(wfs, latency_frac=0.02, max_traces=512)
+    rids = {w["rid"] for w in retained}
+    # every faulted/throttled/transport-failed request retained
+    assert {"r2", "r3", "r4"} <= rids
+    # the slowest 2% of clean acks (p99 candidates) retained — the very
+    # slowest clean ack is always there
+    slowest_clean = max((w for w in wfs if w["err"] is None),
+                        key=lambda w: w["client_ms"])
+    assert slowest_clean["rid"] in rids
+    # fast clean acks are NOT retained
+    assert "c0" not in rids
+    # the cap binds, errors first
+    capped = tx.tail_sample(wfs, latency_frac=0.5, max_traces=5)
+    assert len(capped) == 5
+    assert all(w["err"] is not None for w in capped[:3])
+    # knob-driven defaults resolve from the registry
+    reset_all()
+    assert tx.tail_sample(wfs)  # uses trace_tail_* knobs
+    assert float(SERVER_KNOBS.trace_tail_latency_frac) > 0
+
+
+def test_trace_summary_and_root_cause():
+    wfs = tx.build_waterfalls(_trace_set())
+    retained = tx.tail_sample(wfs, latency_frac=1.0, max_traces=512)
+    summary = tx.trace_summary(wfs, retained)
+    assert summary["n_waterfalls"] == 4
+    assert summary["retained_ack_incomplete"] == 0   # acks r1/r2 complete
+    assert summary["max_sum_err_ms"] <= 0.001
+    root = tx.root_cause(retained)
+    # acks take precedence over the slower transport-failed r4: the p99
+    # SLO is computed over acks, so the breach names an ack's segment
+    assert root["rid"] == "r2"
+    assert root["dominant_segment"] in root["segments_ms"]
+    assert tx.root_cause([]) is None
+
+
+def test_chrome_trace_export_and_schema():
+    spans = _trace_set()
+    windows = [{"kind": "partition", "t0": 0.002, "t1": 0.004,
+                "src": "client-a", "dst": "server"}]
+    doc = tx.chrome_trace(spans, windows)
+    # survives a JSON round trip and validates
+    doc = json.loads(json.dumps(doc, default=str))
+    n = tx.validate_chrome_trace(doc)
+    assert n == len(spans) + len(windows)
+    # one pid per process + the nemesis track, named via metadata events
+    names = {ev["args"]["name"] for ev in doc["traceEvents"]
+             if ev.get("ph") == "M"}
+    assert {"client-a", "client-b", "server", "nemesis"} <= names
+    # the fault window rides the same timeline as the spans
+    chaos = [ev for ev in doc["traceEvents"] if ev.get("cat") == "chaos"]
+    assert chaos and chaos[0]["name"] == "partition"
+    assert chaos[0]["dur"] == pytest.approx(2000.0)   # 2 ms in us
+    # malformed documents are rejected
+    with pytest.raises(ValueError):
+        tx.validate_chrome_trace({"traceEvents": "nope"})
+    with pytest.raises(ValueError):
+        tx.validate_chrome_trace({"traceEvents": [{"name": "x", "ph": "X",
+                                                   "pid": 1, "ts": 0.0,
+                                                   "dur": -1.0}]})
+    with pytest.raises(ValueError):
+        tx.validate_chrome_trace({"traceEvents": [{"ph": "X", "pid": 1,
+                                                   "ts": 0, "dur": 0}]})
+
+
+# -- the Prometheus exposition format (ISSUE 9 satellite) ---------------------
+
+def test_prometheus_exposition_help_type_and_escaping():
+    telemetry.reset()
+    hub = telemetry.hub()
+    hub.tdmetrics.int64("chaos.partition").set(3)
+    hub.tdmetrics.int64("engine.jax.1.bucket_hits.512").set(7)
+    # a hostile series name: quotes, backslash and newline must be escaped
+    hub.tdmetrics.int64('weird.la"bel\\x\ny').set(1)
+    text = hub.prometheus_text()
+    lines = text.strip().split("\n")
+    import re
+
+    sample_re = re.compile(
+        r'^fdbtpu_[a-zA-Z_][a-zA-Z0-9_]*'
+        r'(\{series="(\\.|[^"\\\n])*"\})? -?\d+(\.\d+)?$')
+    seen_families = set()
+    for ln in lines:
+        if ln.startswith("# HELP ") or ln.startswith("# TYPE "):
+            fam = ln.split()[2]
+            if ln.startswith("# TYPE "):
+                assert ln.split()[3] == "gauge"
+                # TYPE follows HELP, both precede the family's samples
+                assert fam in seen_families
+            seen_families.add(fam)
+            continue
+        m = sample_re.match(ln)
+        assert m, f"unparseable exposition line: {ln!r}"
+        assert ln.split("{")[0].split()[0] in seen_families, \
+            f"sample before its # HELP/# TYPE header: {ln!r}"
+    assert '# TYPE fdbtpu_chaos gauge' in text
+    assert 'fdbtpu_chaos{series="partition"} 3' in text
+    assert 'fdbtpu_engine{series="jax.1.bucket_hits.512"} 7' in text
+    # escaped label value, raw newline/quote nowhere in the sample line
+    assert 'fdbtpu_weird{series="la\\"bel\\\\x\\ny"} 1' in text
+    telemetry.reset()

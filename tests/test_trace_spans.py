@@ -82,9 +82,16 @@ def test_raising_file_sink_does_not_break_emission():
 # -- near-zero-cost when off (the knob-guarded regression) -------------------
 
 def test_disabled_span_sites_allocate_nothing():
+    from foundationdb_tpu.core.trace import (
+        TraceContext,
+        current_trace_context,
+        use_trace_context,
+    )
+
     g_spans.enabled = False
     before_alloc = span_allocations[0]
     before_spans = len(g_spans.spans)
+    ctx = TraceContext(trace_id="r0.1", parent="client.commit")
     for i in range(1000):
         sp = span("resolver.device_dispatch", i)
         sp.child("x").finish()
@@ -92,9 +99,43 @@ def test_disabled_span_sites_allocate_nothing():
         span_event("resolver.retry", i, 0.0, 1.0)
         with span("engine.host_pack", i):
             pass
+        # the context-propagation sites (real/transport.py) read the
+        # ambient context through this exact path — still zero-span
+        with use_trace_context(ctx):
+            assert current_trace_context() is ctx
+            span_event("client.commit", ctx.trace_id, 0.0, 1.0)
     assert span("anything") is NULL_SPAN
     assert span_allocations[0] == before_alloc
     assert len(g_spans.spans) == before_spans
+
+
+def test_span_records_carry_process_name_and_export():
+    """Wall-clock processes name themselves (set_process_name); records
+    stamp "Proc" (an explicit detail wins), and export_spans returns the
+    {proc, spans} ring shape the trace.spans RPC endpoint serves."""
+    from foundationdb_tpu.core.trace import (
+        export_spans,
+        set_process_name,
+    )
+
+    g_spans.enabled = True
+    try:
+        g_spans.clear()
+        set_process_name("proc-a")
+        span_event("phase.x", 1, 0.0, 1.0)
+        span_event("phase.y", 1, 1.0, 2.0, Proc="explicit-b")
+        with span("phase.z", trace_id=2):
+            pass
+        ring = export_spans()
+        assert ring["proc"] == "proc-a"
+        by_name = {s["Name"]: s for s in ring["spans"]}
+        assert by_name["phase.x"]["Proc"] == "proc-a"
+        assert by_name["phase.y"]["Proc"] == "explicit-b"
+        assert by_name["phase.z"]["Proc"] == "proc-a"
+    finally:
+        set_process_name("")
+        g_spans.enabled = False
+        g_spans.clear()
 
 
 def test_enabled_spans_record_and_disable_restores():
