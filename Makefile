@@ -27,6 +27,16 @@ chaos:
 	python -m pytest tests/test_device_nemesis.py -q -m slow
 	python -m foundationdb_tpu.tools.buggify_coverage --seeds 4 --min-frac 0.5
 
+# Keyspace-heat smoke (docs/observability.md "Keyspace heat &
+# occupancy", ~45s CPU): a planted hot-key stream must surface its keys
+# at the top of the aggregated hot ranges, suggested split points must
+# partition the measured load within tolerance, the Prometheus
+# exposition (heat.* + engine verdict split) must pass the strict PR 8
+# parser, and the disabled path (resolver_heat_buckets=0) must build no
+# aggregator and emit no heat outputs from any program.
+heat-smoke:
+	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.heat_smoke
+
 # Distributed-tracing smoke (docs/observability.md "Distributed
 # tracing", seconds): boots a 2-OS-process cluster (a --serve traced
 # commit server child), drives a traced fleet, asserts >= 1
@@ -53,4 +63,4 @@ chaos-real:
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.cli \
 		chaos-status chaos_real_report.json
 
-.PHONY: check bench bench-smoke telemetry-smoke trace-smoke chaos chaos-real
+.PHONY: check bench bench-smoke telemetry-smoke heat-smoke trace-smoke chaos chaos-real

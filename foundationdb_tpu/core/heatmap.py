@@ -1,0 +1,366 @@
+"""Decaying keyspace-heat aggregator: the host half of the resolver-state
+observability layer (docs/observability.md "Keyspace heat & occupancy").
+
+The device side (`ops/conflict_kernel.heat_of`) emits one small packed
+aggregate per resolved batch — a read/write/conflict histogram over B
+bucket-boundary keys sampled from the interval table, verdict counts,
+table occupancy, GC-reclaimed rows, and a first-witness abort attribution
+per transaction. This module merges those aggregates across batches into
+a decayed per-key-range weight map and answers the questions the device
+cannot:
+
+  * where in the keyspace do conflicts concentrate (`hot_ranges`,
+    `concentration` — a normalized Herfindahl index of the load split);
+  * how full is the history table and how hard is GC working
+    (`occupancy`, headroom, reclaimed totals);
+  * where should key-range shard boundaries go (`split_points` — the
+    direct input to ROADMAP item 1's multi-chip key-range sharding:
+    Harmonia-style partitioned conflict detection needs a measured load
+    split, and this IS the measurement).
+
+Merging is keyed by the decoded boundary BEGIN key, not the bucket index:
+the device's bucket grid shifts as the table evolves (and differs per
+sub-shard), but a key is a key — so step, sub-sharded, mesh and loop
+engines all merge through the same path, and multi-shard aggregates
+interleave correctly.
+
+Bit-safety: the aggregator only ever consumes outputs; it can never touch
+a verdict. Everything here is plain numpy/python — no jax import, so the
+disabled path (`resolver_heat_buckets = 0`) costs nothing and imports
+nothing device-side.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: heat histogram lanes (must match ops/conflict_kernel.HEAT_HIST_LANES)
+LANE_READS, LANE_WRITES, LANE_CONFLICTS = 0, 1, 2
+#: counts lanes (ops/conflict_kernel.HEAT_COUNT_LANES)
+C_COMMITTED, C_CONFLICTS, C_TOO_OLD, C_RECLAIMED = 0, 1, 2, 3
+
+
+def _fmt_key(key: bytes) -> str:
+    """Render a boundary key for humans/JSON: printable ASCII as text,
+    anything else as 0x-hex (the `tools/cli.py` convention)."""
+    try:
+        s = key.decode()
+        if s.isascii() and s.isprintable():
+            return s
+    except UnicodeDecodeError:
+        pass
+    return "0x" + key.hex()
+
+
+def _unpack_key(row: np.ndarray, key_words: int) -> bytes:
+    """Packed (words..., length) row -> key bytes (keypack inverse,
+    numpy-only so the aggregator never imports the ops package)."""
+    length = int(row[key_words])
+    raw = np.ascontiguousarray(row[:key_words], dtype=np.uint32) \
+        .astype(">u4").tobytes()
+    return raw[: min(length, 4 * key_words)]
+
+
+def _unpack_keys(bounds: np.ndarray, key_words: int) -> List[bytes]:
+    """All B boundary rows decoded in one vectorized pass — this runs on
+    the serving force/drain path once per merged chunk, so no per-word
+    Python byte juggling."""
+    kw4 = 4 * key_words
+    raw = np.ascontiguousarray(bounds[:, :key_words], dtype=np.uint32) \
+        .astype(">u4").tobytes()
+    lens = np.minimum(bounds[:, key_words].astype(np.int64), kw4)
+    return [raw[b * kw4: b * kw4 + int(lens[b])]
+            for b in range(bounds.shape[0])]
+
+
+class KeyRangeHeatAggregator:
+    """Decayed per-key-range weights merged from per-batch device heat
+    aggregates. One instance per engine (ops/host_engine.py constructs it
+    when the config's heat_buckets > 0); thread-safe enough for the
+    pipeline's pack/force interleave because merge() and readers only
+    touch python dicts under the GIL and never iterate while mutating."""
+
+    #: retained key-range entries (boundary grids shift as the table
+    #: evolves; pruning keeps the map bounded without losing hot ranges)
+    MAX_RANGES = 512
+    #: retained first-witness attribution samples
+    MAX_ATTRIBUTION = 64
+
+    def __init__(self, key_words: int, capacity: int,
+                 buckets: int, decay: float = 0.98):
+        self.key_words = int(key_words)
+        self.capacity = int(capacity)
+        self.buckets = int(buckets)
+        #: per-merge multiplicative decay of every existing weight — the
+        #: `resolver_heat_decay` knob; 1.0 = lifetime totals, smaller =
+        #: faster forgetting (a diurnal hot-spot shift stops dominating
+        #: split planning after ~1/(1-decay) batches)
+        self.decay = float(decay)
+        #: begin-key bytes -> float64 [reads, writes, conflicts]
+        self._w: Dict[bytes, np.ndarray] = {}
+        self.batches = 0
+        self.occupancy = 0
+        self.gc_reclaimed_total = 0
+        self.verdict_totals = {"committed": 0, "conflicts": 0, "too_old": 0}
+        #: recent first-witness abort attributions: which prior write
+        #: (version) killed a transaction, and in which key range
+        self.attribution: deque = deque(maxlen=self.MAX_ATTRIBUTION)
+
+    # -- merging -------------------------------------------------------------
+    def merge(self, heat: Dict[str, np.ndarray], base: int = 0,
+              version: Optional[int] = None) -> None:
+        """Fold ONE single-shard batch's device heat aggregate (unstacked
+        leaves, as emitted by resolve_step) into the decayed map. `base`
+        is the engine's version base (device versions are base-relative);
+        `version` is the batch's commit version when the caller knows it
+        (attribution samples carry it)."""
+        self.merge_shards([heat], base=base, version=version)
+
+    def merge_shards(self, per_shard: Sequence[Dict[str, np.ndarray]],
+                     base: int = 0, version: Optional[int] = None) -> None:
+        """Fold ONE batch resolved across `len(per_shard)` key-range
+        shards (sub-sharded / mesh engines: each shard's own table
+        delimits its buckets, all for the SAME transactions). The
+        histogram merges per shard keyed by boundary key, but the
+        batch-GLOBAL lanes are counted once: committed/conflicts/too_old
+        are replicated across shards (the stacked-batch contract), decay
+        ticks once per batch, and occupancy SUMS the shard tables (the
+        capacity passed at construction is the summed capacity too).
+        gc_reclaimed is shard-local and sums."""
+        self.batches += 1
+        counts0 = np.asarray(per_shard[0]["counts"], dtype=np.int64)
+        self.verdict_totals["committed"] += int(counts0[C_COMMITTED])
+        self.verdict_totals["conflicts"] += int(counts0[C_CONFLICTS])
+        self.verdict_totals["too_old"] += int(counts0[C_TOO_OLD])
+        self.occupancy = sum(int(np.asarray(h["occupancy"]))
+                             for h in per_shard)
+        if self.decay < 1.0 and self._w:
+            for w in self._w.values():
+                w *= self.decay
+        samples = 0
+        for heat in per_shard:
+            bounds = np.asarray(heat["bounds"])
+            hist = np.asarray(heat["hist"], dtype=np.int64)
+            self.gc_reclaimed_total += int(
+                np.asarray(heat["counts"], dtype=np.int64)[C_RECLAIMED])
+            keys = _unpack_keys(bounds, self.key_words)
+            for b, key in enumerate(keys):
+                row = hist[b]
+                if not row.any():
+                    continue
+                w = self._w.get(key)
+                if w is None:
+                    w = np.zeros((3,), np.float64)
+                    self._w[key] = w
+                w += row
+            # first-witness attribution samples (a handful per batch; a
+            # multi-shard txn may witness on the shard that owns the row)
+            wb = np.asarray(heat["wit_bucket"])
+            if wb.size and samples < 4:
+                aborted = np.flatnonzero(wb >= 0)
+                wv = np.asarray(heat["wit_ver"])
+                for t in aborted[: 4 - samples]:
+                    samples += 1
+                    self.attribution.append({
+                        "txn_index": int(t),
+                        "version": version,
+                        "witness_version": int(wv[t]) + base,
+                        "range_begin": _fmt_key(keys[int(wb[t])]),
+                    })
+        self._prune()
+
+    def reset_weights(self) -> None:
+        """Drop the accumulated range weights and attribution samples
+        (verdict/occupancy totals stay). Useful after a warm-up phase:
+        while the table is still filling, the bucket grid shifts batch to
+        batch and spreads one key's load across neighboring begin keys —
+        resetting once the keyspace is populated measures the steady
+        state on a stationary grid."""
+        self._w.clear()
+        self.attribution.clear()
+
+    def _prune(self) -> None:
+        if len(self._w) <= self.MAX_RANGES:
+            return
+        ranked = sorted(self._w.items(), key=lambda kv: -float(kv[1].sum()))
+        self._w = dict(ranked[: self.MAX_RANGES])
+
+    # -- read model ----------------------------------------------------------
+    def _sorted_items(self) -> List[Tuple[bytes, np.ndarray]]:
+        return sorted(self._w.items(), key=lambda kv: kv[0])
+
+    def total_load(self) -> float:
+        """The split-planning load measure: write rows + conflict rows
+        (conflicts weigh where contention actually bites, not just where
+        bytes land)."""
+        if not self._w:
+            return 0.0
+        return float(sum(w[LANE_WRITES] + w[LANE_CONFLICTS]
+                         for w in self._w.values()))
+
+    def hot_ranges(self, top_n: int = 8) -> List[dict]:
+        """Top-N key ranges by write+conflict load, with each range's end
+        key (the next boundary in key order; None = +inf)."""
+        items = self._sorted_items()
+        total = self.total_load() or 1.0
+        scored = []
+        for i, (key, w) in enumerate(items):
+            end = items[i + 1][0] if i + 1 < len(items) else None
+            load = float(w[LANE_WRITES] + w[LANE_CONFLICTS])
+            scored.append({
+                "begin": _fmt_key(key),
+                "end": _fmt_key(end) if end is not None else None,
+                "reads": round(float(w[LANE_READS]), 1),
+                "writes": round(float(w[LANE_WRITES]), 1),
+                "conflicts": round(float(w[LANE_CONFLICTS]), 1),
+                "share": round(load / total, 4),
+            })
+        scored.sort(key=lambda r: -r["share"])
+        return scored[:top_n]
+
+    def concentration(self) -> float:
+        """Normalized Herfindahl index of the write+conflict load split
+        across ranges: 0 = perfectly even, 1 = all load in one range.
+        Monotone in workload skew — the `conflict_heat` bench asserts it
+        tracks the fleet's Zipf s."""
+        loads = np.array([w[LANE_WRITES] + w[LANE_CONFLICTS]
+                          for w in self._w.values()], np.float64)
+        n = loads.size
+        total = float(loads.sum())
+        if n <= 1 or total <= 0:
+            return 0.0
+        f = loads / total
+        hhi = float(np.sum(f * f))
+        return max(0.0, (hhi - 1.0 / n) / (1.0 - 1.0 / n))
+
+    def split_points(self, shards: Optional[int] = None) -> List[bytes]:
+        """`shards - 1` suggested key-range split keys that equalize the
+        measured write+conflict load — the direct input to multi-chip
+        key-range sharding (ROADMAP item 1). Split i is the first range
+        boundary whose cumulative load reaches i/shards of the total, so
+        per-shard imbalance is bounded by the heaviest single bucket's
+        share (finer device bucket grids tighten it)."""
+        if shards is None:
+            shards = self.default_split_shards()
+        items = self._sorted_items()
+        if not items or shards < 2:
+            return []
+        loads = np.array([w[LANE_WRITES] + w[LANE_CONFLICTS]
+                          for _k, w in items], np.float64)
+        total = float(loads.sum())
+        if total <= 0:
+            return []
+        cum = np.cumsum(loads)
+        out: List[bytes] = []
+        for i in range(1, shards):
+            j = int(np.searchsorted(cum, total * i / shards))
+            j = min(j + 1, len(items) - 1)   # split at the NEXT begin key
+            key = items[j][0]
+            if not out or key > out[-1]:
+                out.append(key)
+        return out
+
+    def split_balance(self, shards: Optional[int] = None,
+                      splits: Optional[Sequence[bytes]] = None) -> List[float]:
+        """Measured load fraction per shard under `splits` (default: the
+        suggested split_points) — what the heat-smoke/bench assert stays
+        within tolerance of 1/shards."""
+        if shards is None:
+            shards = self.default_split_shards()
+        if splits is None:
+            splits = self.split_points(shards)
+        items = self._sorted_items()
+        total = self.total_load()
+        if not items or total <= 0:
+            return []
+        frac = [0.0] * (len(splits) + 1)
+        for key, w in items:
+            s = 0
+            for sp in splits:
+                if key >= sp:
+                    s += 1
+                else:
+                    break
+            frac[s] += float(w[LANE_WRITES] + w[LANE_CONFLICTS]) / total
+        return frac
+
+    @staticmethod
+    def default_split_shards() -> int:
+        from .knobs import SERVER_KNOBS
+
+        return int(getattr(SERVER_KNOBS, "resolver_heat_split_shards", 8))
+
+    # -- snapshots -----------------------------------------------------------
+    def occupancy_frac(self) -> float:
+        return self.occupancy / self.capacity if self.capacity else 0.0
+
+    def brief(self) -> dict:
+        """Tiny span/flight-record attachment: enough to say whether a
+        slow or quarantined batch ran under hot-key pressure. Runs on the
+        supervisor's per-batch path, so it is one argmax pass over the
+        raw weights — no sorting, and only the winning key is formatted
+        (hot_ranges would format every retained range)."""
+        best_key, best_load, total = None, 0.0, 0.0
+        for key, w in self._w.items():
+            load = float(w[LANE_WRITES] + w[LANE_CONFLICTS])
+            total += load
+            if load > best_load:
+                best_load, best_key = load, key
+        return {
+            "conflicts": self.verdict_totals["conflicts"],
+            "occupancy_frac": round(self.occupancy_frac(), 4),
+            "concentration": round(self.concentration(), 4),
+            "top_range": _fmt_key(best_key) if best_key is not None else None,
+            "top_share": round(best_load / total, 4) if total > 0 else 0.0,
+        }
+
+    def snapshot(self, top_n: int = 8, brief: bool = False) -> dict:
+        """The status-document / CLI fragment: hot ranges, occupancy
+        headroom, verdict totals, and the suggested split points."""
+        if brief:
+            return self.brief()
+        shards = self.default_split_shards()
+        splits = self.split_points(shards)
+        return {
+            "batches": self.batches,
+            "buckets": self.buckets,
+            "capacity": self.capacity,
+            "occupancy": self.occupancy,
+            "occupancy_frac": round(self.occupancy_frac(), 4),
+            "gc_reclaimed": self.gc_reclaimed_total,
+            "verdicts": dict(self.verdict_totals),
+            "concentration": round(self.concentration(), 4),
+            "hot_ranges": self.hot_ranges(top_n=top_n),
+            "split_shards": shards,
+            "split_points": [_fmt_key(k) for k in splits],
+            "split_balance": [round(f, 4)
+                              for f in self.split_balance(shards, splits)],
+            "recent_attribution": list(self.attribution)[-top_n:],
+        }
+
+
+def heat_buckets_from_knobs() -> int:
+    """The `resolver_heat_buckets` knob: device-side histogram buckets per
+    resolve step; 0 disables the whole layer (no heat outputs in any
+    program, no aggregator, nothing allocated)."""
+    from .knobs import SERVER_KNOBS
+
+    return int(getattr(SERVER_KNOBS, "resolver_heat_buckets", 0) or 0)
+
+
+def aggregator_for(cfg, n_shards: int = 1) -> Optional[KeyRangeHeatAggregator]:
+    """Aggregator for an engine's KernelConfig, or None when heat is off.
+    `n_shards` scales the capacity gauge: each key-range shard owns a
+    capacity-H table, and merge_shards sums their occupancies."""
+    if getattr(cfg, "heat_buckets", 0) <= 0:
+        return None
+    from .knobs import SERVER_KNOBS
+
+    return KeyRangeHeatAggregator(
+        key_words=cfg.key_words,
+        capacity=cfg.capacity * max(1, n_shards),
+        buckets=cfg.heat_buckets,
+        decay=float(getattr(SERVER_KNOBS, "resolver_heat_decay", 0.98)),
+    )

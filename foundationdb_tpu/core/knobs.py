@@ -188,6 +188,26 @@ def _make_server_knobs() -> Knobs:
     #: bounded ring dumped into quarantine/failover trace events for
     #: post-mortem replay (fault/resilient.py)
     k.init("resolver_flight_recorder_size", 64)
+    # Keyspace heat & history-occupancy observability
+    # (docs/observability.md "Keyspace heat & occupancy"). Deliberately no
+    # BUGGIFY randomizers: heat is proven observational (bit-identical
+    # abort sets either way) and a randomizer draw would shift sim rng.
+    #: key-range histogram buckets the resolve step aggregates ON DEVICE
+    #: per batch (boundary keys sampled from the interval table delimit
+    #: them). 0 disables the whole layer: programs emit no heat outputs,
+    #: engines build no aggregator, nothing allocates. Default 64 — the
+    #: aggregate is a few KB riding an already-async readback, and the
+    #: `conflict_heat` bench pins the device-time overhead < 3% at the
+    #: production point.
+    k.init("resolver_heat_buckets", 64)
+    #: per-batch multiplicative decay of the host aggregator's key-range
+    #: weights (core/heatmap.py): 1.0 = lifetime totals; 0.98 forgets a
+    #: shifted hot spot in ~50 batches so split planning tracks the
+    #: CURRENT load, the same windowing rationale as resolution_metrics
+    k.init("resolver_heat_decay", 0.98)
+    #: shards the aggregator proposes equal-load split points for — the
+    #: measured input to multi-chip key-range sharding (ROADMAP item 1)
+    k.init("resolver_heat_split_shards", 8)
     # Wall-clock chaos (real/chaos.py; docs/real_cluster.md). Defaults for
     # the seeded NetworkNemesis' background fault mix — a campaign's
     # ChaosConfig reads these so `--knob`-style overrides steer injection

@@ -60,6 +60,9 @@ class TelemetryHub:
         #: label -> weakref to DeviceLoopEngine (queue/ring gauges —
         #: ops/device_loop.py loop_stats + occupancy)
         self._loops: Dict[str, "weakref.ref"] = {}
+        #: label -> weakref to KeyRangeHeatAggregator (core/heatmap.py —
+        #: keyspace heat, occupancy headroom, split planning)
+        self._heat: Dict[str, "weakref.ref"] = {}
         self._seq = 0
         #: bounded ring of recent nemesis/chaos events (real/chaos.py,
         #: real/nemesis.py) — rendered by `tools/cli.py chaos-status`
@@ -91,6 +94,14 @@ class TelemetryHub:
         sync-accounting counters, synced as `loop.<label>.*` series."""
         label = self._label("loop", name)
         self._loops[label] = weakref.ref(engine)
+        return label
+
+    def register_heat(self, aggregator, name: str = "heat") -> str:
+        """An engine's keyspace-heat aggregator (core/heatmap.py): hot-range
+        concentration, occupancy headroom, GC pressure and verdict totals,
+        synced as `heat.<label>.*` series."""
+        label = self._label("heat", name)
+        self._heat[label] = weakref.ref(aggregator)
         return label
 
     @staticmethod
@@ -158,6 +169,11 @@ class TelemetryHub:
             # loop chunk counts, same frontends as the search-mode picks
             for mode, n in getattr(perf, "dispatch_mode_hits", {}).items():
                 td.int64(f"engine.{label}.dispatch_mode_hits.{mode}").set(n)
+            # abort-cause split (docs/observability.md "Keyspace heat &
+            # occupancy"): committed vs conflicts vs too_old, aggregated —
+            # previously only visible per batch in status_of
+            for kind, n in getattr(perf, "verdicts", {}).items():
+                td.int64(f"engine.{label}.verdicts.{kind}").set(n)
         for label, b in self._live(self._batchers):
             # EWMAs are floats; the Int64 series stores microseconds so the
             # persisted change history stays integral. Keys are per
@@ -189,6 +205,24 @@ class TelemetryHub:
             td.int64(f"loop.{label}.ring_depth").set(eng.ring_depth())
             td.int64(f"loop.{label}.slots_in_flight").set(
                 eng.slots_in_flight())
+        for label, agg in self._live(self._heat):
+            # keyspace heat & occupancy (core/heatmap.py): contention
+            # concentration, table headroom and GC pressure as integer
+            # gauges (x1000 fixed-point for the [0,1] fractions). brief()
+            # is the single-pass read (one argmax, one key formatted) —
+            # hot_ranges would sort and format every retained range per
+            # sync tick
+            b = agg.brief()
+            td.int64(f"heat.{label}.batches").set(agg.batches)
+            td.int64(f"heat.{label}.occupancy").set(agg.occupancy)
+            td.int64(f"heat.{label}.occupancy_frac_x1000").set(
+                int(b["occupancy_frac"] * 1000))
+            td.int64(f"heat.{label}.gc_reclaimed").set(
+                agg.gc_reclaimed_total)
+            td.int64(f"heat.{label}.concentration_x1000").set(
+                int(b["concentration"] * 1000))
+            td.int64(f"heat.{label}.top_range_share_x1000").set(
+                int(b["top_share"] * 1000))
 
     def snapshot(self) -> dict:
         """Live values for status documents (no TDMetric round trip)."""
@@ -201,6 +235,8 @@ class TelemetryHub:
                        for label, eng in self._live(self._health)},
             "loops": {label: eng.loop_stats_snapshot()
                       for label, eng in self._live(self._loops)},
+            "heat": {label: agg.snapshot()
+                     for label, agg in self._live(self._heat)},
         }
 
     #: per-family HELP strings for the exposition (families are the first
@@ -215,6 +251,8 @@ class TelemetryHub:
                     "(fault/resilient.py)",
         "loop": "device-resident loop queue/ring gauges "
                 "(ops/device_loop.py; blocking_syncs must be 0)",
+        "heat": "keyspace heat & history-occupancy gauges "
+                "(core/heatmap.py; fractions are x1000 fixed-point)",
         "chaos": "injected nemesis fault events (real/chaos.py)",
         "demo": "demo KV per-op counters (real/demo_server.py)",
     }

@@ -219,6 +219,14 @@ class ResilientEngine:
         fn = getattr(self._rewarm_engine(), "loop_stats_snapshot", None)
         return fn() if fn is not None else None
 
+    def heat_snapshot(self, top_n: int = 8, brief: bool = False):
+        """Pass-through to the device engine's keyspace-heat/occupancy
+        snapshot (core/heatmap.py) — engine_health, spans and the flight
+        recorder keep their heat context under supervision; None for
+        engines without the layer (the oracle, heat off)."""
+        fn = getattr(self._rewarm_engine(), "heat_snapshot", None)
+        return fn(top_n=top_n, brief=brief) if fn is not None else None
+
     async def resolve(self, transactions, now_v, new_oldest):
         """One batch through the supervisor; callers (server/resolver.py,
         pipeline/service.py) enter strictly in commit-version order."""
@@ -245,6 +253,11 @@ class ResilientEngine:
         # ring backed up? did a drain fall back to a blocking sync?)
         inner = self._rewarm_engine()
         loop_snap = self.loop_stats_snapshot()
+        # heat/occupancy context rides next to the abort-set digest: a
+        # quarantine or failover dump says whether the keyspace was hot
+        # and how full the history table was when the batch ran
+        # (docs/observability.md "Keyspace heat & occupancy")
+        heat_snap = self.heat_snapshot(brief=True)
         self.flight.record(
             version=now_v,
             new_oldest=new_oldest,
@@ -259,6 +272,7 @@ class ResilientEngine:
             digest=abort_set_digest(verdicts),
             dispatch_mode=getattr(inner, "dispatch_mode", "step"),
             **({"loop_stats": loop_snap} if loop_snap is not None else {}),
+            **({"heat": heat_snap} if heat_snap is not None else {}),
         )
         return verdicts
 

@@ -80,6 +80,15 @@ class KernelConfig:
     #: built from an auto config picks bsearch for its small buckets and
     #: fused_sort for shapes whose batch rivals the table
     history_search: str = "auto"
+    #: keyspace-heat observability (docs/observability.md "Keyspace heat &
+    #: occupancy"): number of key-range histogram buckets the resolve step
+    #: aggregates on device (boundary keys sampled from the interval table
+    #: delimit the buckets, so binning adapts to the served keyspace).
+    #: 0 (default) disables — programs emit no heat outputs and the step
+    #: is byte-for-byte today's program; > 0 adds a `heat` subtree to
+    #: every step/scan/loop output. Abort sets are bit-identical either
+    #: way (the heat pass only READS the verdict path's values).
+    heat_buckets: int = 0
 
     @property
     def lanes(self) -> int:     # K: words per packed key incl. length
@@ -153,6 +162,7 @@ class KernelConfig:
             max_point_writes=scale(self.wp),
             fixpoint=self.fixpoint,
             history_search=self.history_search,
+            heat_buckets=self.heat_buckets,
         )
 
 
@@ -577,6 +587,21 @@ def local_phases(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[s
         "gid_rp": gid_rp,
         "gid_wp": gid_wp,
     }
+    if cfg.heat_buckets > 0:
+        # Row-level history-witness context for the heat aggregate
+        # (heat_of): which read rows hit history, at what stored version.
+        # Rides inside `edges` so every (hist, edges, wpos) unpack site
+        # stays untouched; absent when heat is off, so the heat-off
+        # pytrees — and programs — are byte-for-byte unchanged. The
+        # fixpoint engines read edges by key and ignore these.
+        edges["heat_hhit_p"] = hit_p
+        edges["heat_hver_p"] = vmax_p
+        if Rr > 0:
+            edges["heat_hhit_r"] = hit_rg
+            edges["heat_hver_r"] = rmax
+        else:
+            edges["heat_hhit_r"] = jnp.zeros((0,), jnp.bool_)
+            edges["heat_hver_r"] = jnp.zeros((0,), jnp.int32)
     return hist_hits, edges, wpos
 
 
@@ -599,27 +624,20 @@ def _read_group_bounds(cfg: KernelConfig, batch: Dict[str, jnp.ndarray]):
     return ps, pe, rs, re_
 
 
-def _blocked_txns(
+def _blocked_rows(
     cfg: KernelConfig,
     edges: Dict[str, jnp.ndarray],
     batch: Dict[str, jnp.ndarray],
     c: jnp.ndarray,
-    bounds=None,
-) -> jnp.ndarray:
-    """One shard's per-txn blocked counts [T] given the current committed
-    mask c [T] — the body of each fixpoint iteration. Additive across
-    disjoint key shards (counts, not bools), so callers combine shards with
-    psum (mesh) or a leading-axis sum (single-device sub-shards)."""
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-read-row intra-batch blocked flags under committed mask c:
+    (point rows [Rp], range rows [Rr]). The shared inner step of every
+    fixpoint iteration — also reused by heat_of with the FINAL committed
+    mask to attribute intra-batch aborts to their witness rows (same ops,
+    so the heat pass can never diverge from the verdict path)."""
     T = cfg.max_txns
     Rp = cfg.rp
     G = cfg.gid_space
-    ps, pe, rs, re_ = bounds if bounds is not None else _read_group_bounds(cfg, batch)
-
-    def seg_count(hit, starts, ends):
-        csum = jnp.concatenate([jnp.zeros((1,), jnp.int32),
-                                jnp.cumsum(hit.astype(jnp.int32))])
-        return csum[ends] - csum[starts]
-
     cwp = c[batch["wp_txn"]] & batch["wp_valid"]                     # [Wp]
     cwr = c[batch["w_txn"]] & batch["w_valid"]                       # [Wr]
     maskw = _pack_bits(cwr, cfg.wr_words)
@@ -633,10 +651,29 @@ def _blocked_txns(
         jnp.where(cwp, edges["gid_wp"], G + 1)
     ].min(batch["wp_txn"], mode="drop")
     hit_pp = mn[edges["gid_rp"]] < batch["rp_txn"]                   # [Rp]
-    return (
-        seg_count(hit_w[:Rp] | hit_pp, ps, pe)
-        + seg_count(hit_w[Rp:] | hit_rp, rs, re_)
-    )
+    return hit_w[:Rp] | hit_pp, hit_w[Rp:] | hit_rp
+
+
+def _blocked_txns(
+    cfg: KernelConfig,
+    edges: Dict[str, jnp.ndarray],
+    batch: Dict[str, jnp.ndarray],
+    c: jnp.ndarray,
+    bounds=None,
+) -> jnp.ndarray:
+    """One shard's per-txn blocked counts [T] given the current committed
+    mask c [T] — the body of each fixpoint iteration. Additive across
+    disjoint key shards (counts, not bools), so callers combine shards with
+    psum (mesh) or a leading-axis sum (single-device sub-shards)."""
+    ps, pe, rs, re_ = bounds if bounds is not None else _read_group_bounds(cfg, batch)
+
+    def seg_count(hit, starts, ends):
+        csum = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                jnp.cumsum(hit.astype(jnp.int32))])
+        return csum[ends] - csum[starts]
+
+    hit_point, hit_range = _blocked_rows(cfg, edges, batch, c)
+    return seg_count(hit_point, ps, pe) + seg_count(hit_range, rs, re_)
 
 
 def commit_fixpoint(
@@ -696,12 +733,15 @@ def apply_writes_and_gc(
     batch: Dict[str, jnp.ndarray],
     committed: jnp.ndarray,
     wpos: Dict[str, jnp.ndarray],
-) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray, jnp.ndarray]:
     """Phases 3-5, shard-local: committed-write union, boundary-table merge,
-    GC/rebase. Returns (new_state, overflow). `wpos` carries the OLD-table
-    positions of every write-interval endpoint (precomputed by the step's
-    fused search in local_phases), so this phase performs NO binary search —
-    union rows recover their positions through the sort's pidx payload."""
+    GC/rebase. Returns (new_state, overflow, reclaimed) — reclaimed is the
+    int32 count of boundary rows the GC compaction dropped (0 on gc == 0
+    batches), the occupancy-pressure signal the heat aggregate carries.
+    `wpos` carries the OLD-table positions of every write-interval endpoint
+    (precomputed by the step's fused search in local_phases), so this phase
+    performs NO binary search — union rows recover their positions through
+    the sort's pidx payload."""
     hkeys, hvers, n = state["hkeys"], state["hvers"], state["n"]
     Wa = cfg.w_all
     H = cfg.capacity
@@ -854,7 +894,8 @@ def apply_writes_and_gc(
     # dtype would silently retrace/recompile the serving program on the
     # SECOND batch (the bucket ladder's AOT executables reject it loudly).
     new_state = {"hkeys": hk, "hvers": hv, "n": n2.astype(jnp.int32)}
-    return new_state, overflow
+    reclaimed = (n1 - n2).astype(jnp.int32)   # rows the GC branch dropped
+    return new_state, overflow, reclaimed
 
 
 def detect_step(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[str, jnp.ndarray]):
@@ -876,7 +917,148 @@ def apply_step(cfg: KernelConfig, state: Dict[str, jnp.ndarray],
                wpos: Dict[str, jnp.ndarray]):
     """Apply the globally-agreed committed writes (+GC). Returns
     (new_state, overflow)."""
-    return apply_writes_and_gc(cfg, state, batch, committed, wpos)
+    new_state, overflow, _ = apply_writes_and_gc(cfg, state, batch, committed, wpos)
+    return new_state, overflow
+
+
+#: lanes of the heat aggregate's per-bucket histogram (heat_of)
+HEAT_HIST_LANES = 3          # 0: read rows, 1: write rows, 2: conflict rows
+#: lanes of the heat aggregate's scalar counts vector
+HEAT_COUNT_LANES = 4         # 0: committed, 1: conflicts, 2: too_old, 3: gc_reclaimed
+
+
+def _heat_bounds(cfg: KernelConfig, hkeys: jnp.ndarray, n: jnp.ndarray) -> jnp.ndarray:
+    """B boundary keys sampled at equally spaced POSITIONS of the sorted
+    valid table prefix hkeys[0:n] — the bucket delimiters of the heat
+    histogram. Position sampling (not value sampling) makes the bucket
+    grid adapt to the actual served key distribution: each bucket spans
+    ~n/B of the table's distinct boundary keys, so a dense key region
+    gets proportionally fine buckets. Bucket i covers [bounds[i],
+    bounds[i+1]) (the last bucket extends to +inf; keys below bounds[0]
+    fold into bucket 0)."""
+    B = cfg.heat_buckets
+    pos = (jnp.arange(B, dtype=jnp.int32) * jnp.maximum(n, 1)) // B
+    return hkeys[pos]                                        # [B, K]
+
+
+def _heat_bucket_of(cfg: KernelConfig, bounds: jnp.ndarray,
+                    q: jnp.ndarray) -> jnp.ndarray:
+    """Bucket index of every query key row q[i] under `bounds`: the last
+    boundary <= q (clamped to 0 below bounds[0]) — a branchless binary
+    search in the style of _lower_bound, ceil(log2 B)+1 unrolled rounds."""
+    B = cfg.heat_buckets
+    Q = q.shape[0]
+    lo = jnp.zeros((Q,), jnp.int32)
+    hi = jnp.full((Q,), B, jnp.int32)
+    for _ in range(max(1, B.bit_length())):
+        active = lo < hi
+        mid = (lo + hi) >> 1
+        # go right iff bounds[mid] <= q  (upper_bound discipline)
+        go_right = ~_key_less(q, bounds[jnp.minimum(mid, B - 1)])
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+    return jnp.maximum(lo - 1, 0)
+
+
+def heat_of(
+    cfg: KernelConfig,
+    state: Dict[str, jnp.ndarray],      # POST-apply state (bounds source)
+    batch: Dict[str, jnp.ndarray],
+    committed: jnp.ndarray,             # final fixpoint verdicts [T]
+    edges: Dict[str, jnp.ndarray],      # incl. the heat_* witness context
+    reclaimed: jnp.ndarray,             # GC-dropped rows (apply_writes_and_gc)
+) -> Dict[str, jnp.ndarray]:
+    """The per-batch keyspace-heat aggregate (docs/observability.md
+    "Keyspace heat & occupancy"), computed ON DEVICE so it rides the
+    existing dispatch with zero extra host syncs:
+
+      bounds     uint32 [B, K]  sampled bucket-boundary keys (begin of each)
+      hist       int32  [B, 3]  read / write / conflict-attributed rows
+      counts     int32  [4]     committed, conflicts, too_old, gc_reclaimed
+      occupancy  int32  []      boundary-table rows after this batch
+      wit_ver    int32  [T]     first-witness conflicting-write version
+                                (history hits: the stored version that beat
+                                the snapshot; intra-batch: `now`), relative
+                                to the engine base; NEG_VERSION when the
+                                txn did not conflict
+      wit_bucket int32  [T]     the witness read row's bucket; -1 when none
+
+    Purely observational: every input is a value the verdict path already
+    produced (the final committed mask, the phase-1 hit context riding in
+    `edges`, the intra-batch blocked rows recomputed with the SAME
+    _blocked_rows the fixpoint iterates) — so abort sets with heat on are
+    bit-identical to heat off (tests/test_heat.py pins this across both
+    history-search modes, step and loop dispatch)."""
+    B = cfg.heat_buckets
+    T = cfg.max_txns
+    Rp, Rr = cfg.rp, cfg.max_reads
+    bounds = _heat_bounds(cfg, state["hkeys"], state["n"])
+    conflicted = batch["t_ok"] & ~committed
+    counts = jnp.stack([
+        jnp.sum(committed.astype(jnp.int32)),
+        jnp.sum(conflicted.astype(jnp.int32)),
+        jnp.sum(batch["t_too_old"].astype(jnp.int32)),
+        reclaimed.astype(jnp.int32),
+    ])
+
+    # One packed bucket search serves every row class (read begins + write
+    # begins; range rows bin by their begin key).
+    qkeys = jnp.concatenate(
+        [batch["rpb"], batch["rb"], batch["wpb"], batch["wb"]], axis=0)
+    bk = _heat_bucket_of(cfg, bounds, qkeys)
+    rbk = bk[:Rp + Rr]                                       # read rows
+    wbk = bk[Rp + Rr:]                                       # write rows
+    rvalid = jnp.concatenate([batch["rp_valid"], batch["r_valid"]])
+    wvalid = jnp.concatenate([batch["wp_valid"], batch["w_valid"]])
+    r_txn_all = jnp.concatenate([batch["rp_txn"], batch["r_txn"]])
+    crow = rvalid & conflicted[r_txn_all]                    # conflict rows
+    hist = (
+        jnp.zeros((B, HEAT_HIST_LANES), jnp.int32)
+        .at[jnp.where(rvalid, rbk, B), 0].add(1, mode="drop")
+        .at[jnp.where(wvalid, wbk, B), 1].add(1, mode="drop")
+        .at[jnp.where(crow, rbk, B), 2].add(1, mode="drop")
+    )
+
+    # First-witness abort attribution: for each conflicted txn, its first
+    # (lowest-index) read row that was hit — by history (witness = the
+    # stored version that beat the snapshot) or by an earlier committed
+    # write in this batch (witness = `now`, the batch's own version).
+    ihit_p, ihit_r = _blocked_rows(cfg, edges, batch, committed)
+    hhit_p, hver_p = edges["heat_hhit_p"], edges["heat_hver_p"]
+    hhit_r, hver_r = edges["heat_hhit_r"], edges["heat_hver_r"]
+    now = batch["now"]
+    act = jnp.concatenate([
+        batch["rp_valid"] & (hhit_p | ihit_p),
+        batch["r_valid"] & (hhit_r | ihit_r)]) & conflicted[r_txn_all]
+    wver = jnp.concatenate([
+        jnp.where(hhit_p, hver_p, now),
+        jnp.where(hhit_r, hver_r, now)])
+    R = Rp + Rr
+    ridx = jnp.arange(R, dtype=jnp.int32)
+    first = jnp.full((T,), R, jnp.int32).at[
+        jnp.where(act, r_txn_all, T)].min(ridx, mode="drop")
+    has = first < R
+    fc = jnp.minimum(first, R - 1)
+    wit_ver = jnp.where(has, wver[fc], NEG_VERSION)
+    wit_bucket = jnp.where(has, rbk[fc], -1)
+    return {"bounds": bounds, "hist": hist, "counts": counts,
+            "occupancy": state["n"], "wit_ver": wit_ver,
+            "wit_bucket": wit_bucket}
+
+
+def heat_struct(cfg: KernelConfig, stack: Tuple[int, ...] = ()) -> Dict[str, jax.ShapeDtypeStruct]:
+    """Abstract shapes of one batch's heat aggregate (what the server loop
+    zero-initializes its per-slot planes from)."""
+    B, K, T = cfg.heat_buckets, cfg.lanes, cfg.max_txns
+    s = jax.ShapeDtypeStruct
+    return {
+        "bounds": s(stack + (B, K), jnp.uint32),
+        "hist": s(stack + (B, HEAT_HIST_LANES), jnp.int32),
+        "counts": s(stack + (HEAT_COUNT_LANES,), jnp.int32),
+        "occupancy": s(stack + (), jnp.int32),
+        "wit_ver": s(stack + (T,), jnp.int32),
+        "wit_bucket": s(stack + (T,), jnp.int32),
+    }
 
 
 def status_of(t_too_old: jnp.ndarray, committed: jnp.ndarray) -> jnp.ndarray:
@@ -910,15 +1092,22 @@ def _fixpoint(cfg: KernelConfig, t_ok, hist_hits, edges, batch) -> jnp.ndarray:
 
 def resolve_step(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[str, jnp.ndarray]) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
     """One single-shard resolver batch: (state, batch) -> (state', outputs).
-    Pure; jit me. See local_phases for the batch layout."""
+    Pure; jit me. See local_phases for the batch layout. With
+    cfg.heat_buckets > 0 the outputs additionally carry the per-batch
+    `heat` aggregate (heat_of) — observational only, abort sets are
+    bit-identical either way."""
     hist_hits, edges, wpos = local_phases(cfg, state, batch)
     committed = _fixpoint(cfg, batch["t_ok"], hist_hits, edges, batch)
-    new_state, overflow = apply_writes_and_gc(cfg, state, batch, committed, wpos)
+    new_state, overflow, reclaimed = apply_writes_and_gc(
+        cfg, state, batch, committed, wpos)
     out = {
         "status": status_of(batch["t_too_old"], committed),
         "overflow": overflow,
         "n": new_state["n"],
     }
+    if cfg.heat_buckets > 0:
+        out["heat"] = heat_of(cfg, new_state, batch, committed, edges,
+                              reclaimed)
     return new_state, out
 
 
@@ -974,7 +1163,7 @@ def resolve_step_stacked(
         lambda st, b: local_phases(cfg, st, b))(state, batch)
     t_ok = batch["t_ok"][0]
     committed = commit_fixpoint_stacked(cfg, t_ok, hist, edges, batch)
-    new_state, overflow = jax.vmap(
+    new_state, overflow, reclaimed = jax.vmap(
         lambda st, b, w: apply_writes_and_gc(cfg, st, b, committed, w)
     )(state, batch, wpos)
     out = {
@@ -982,6 +1171,12 @@ def resolve_step_stacked(
         "overflow": jnp.any(overflow),
         "n": new_state["n"],
     }
+    if cfg.heat_buckets > 0:
+        # per-sub-shard aggregates (each shard's table delimits its own
+        # buckets); the host merges them keyed by boundary key
+        out["heat"] = jax.vmap(
+            lambda st, b, e, r: heat_of(cfg, st, b, committed, e, r)
+        )(new_state, batch, edges, reclaimed)
     return new_state, out
 
 
@@ -995,7 +1190,7 @@ def fix_step_stacked(cfg: KernelConfig, t_ok, hist_stacked, edges, batch):
 
 
 def apply_step_stacked(cfg: KernelConfig, state, batch, committed, wpos):
-    new_state, overflow = jax.vmap(
+    new_state, overflow, _ = jax.vmap(
         lambda st, b, w: apply_writes_and_gc(cfg, st, b, committed, w)
     )(state, batch, wpos)
     return new_state, jnp.any(overflow)
@@ -1010,14 +1205,19 @@ def resolve_step_scan(
     resolve_step threading the interval-table state across chunks, so a
     multi-chunk batch costs one dispatch instead of C. Scan order equals
     the per-chunk dispatch order on the single device queue, so the
-    status/overflow stacks are bit-identical to C serial resolve_steps."""
+    status/overflow stacks are bit-identical to C serial resolve_steps.
+    With heat on, the per-chunk aggregates stack under the same leading
+    [C] axis."""
 
     def body(st, b):
         st, out = resolve_step(cfg, st, b)
-        return st, (out["status"], out["overflow"])
+        return st, (out["status"], out["overflow"], out.get("heat", {}))
 
-    state, (status, overflow) = lax.scan(body, state, batches)
-    return state, {"status": status, "overflow": overflow}
+    state, (status, overflow, heat) = lax.scan(body, state, batches)
+    out = {"status": status, "overflow": overflow}
+    if cfg.heat_buckets > 0:
+        out["heat"] = heat
+    return state, out
 
 
 def resolve_step_stacked_scan(
@@ -1029,10 +1229,13 @@ def resolve_step_stacked_scan(
 
     def body(st, b):
         st, out = resolve_step_stacked(cfg, st, b)
-        return st, (out["status"], out["overflow"])
+        return st, (out["status"], out["overflow"], out.get("heat", {}))
 
-    state, (status, overflow) = lax.scan(body, state, batches)
-    return state, {"status": status, "overflow": overflow}
+    state, (status, overflow, heat) = lax.scan(body, state, batches)
+    out = {"status": status, "overflow": overflow}
+    if cfg.heat_buckets > 0:
+        out["heat"] = heat              # leaves [C, S, ...]
+    return state, out
 
 
 def status_words(cfg: KernelConfig) -> int:
@@ -1070,9 +1273,12 @@ def resolve_server_loop(
         are bit-identical to the step path (tests/test_device_loop.py).
     Loop order equals the slot fill order equals the dispatch order on
     the device queue, so state evolution matches C serial resolve_steps.
-    Rows beyond n_chunks are never read (the while_loop exits first)."""
+    Rows beyond n_chunks are never read (the while_loop exits first).
+    With cfg.heat_buckets > 0 the per-chunk heat aggregates ride the same
+    readback as [Q, ...] planes (zeros beyond the filled prefix)."""
     Q = batches["t_ok"].shape[0]
     TW = status_words(cfg)
+    heat_on = cfg.heat_buckets > 0
     committed_code = jnp.int32(int(TransactionCommitResult.COMMITTED))
     too_old_code = jnp.int32(int(TransactionCommitResult.TOO_OLD))
 
@@ -1080,7 +1286,7 @@ def resolve_server_loop(
         return carry[0] < n_chunks
 
     def body(carry):
-        i, st, cbits, tbits, ov = carry
+        i, st, cbits, tbits, ov, heat = carry
         b = jax.tree.map(
             lambda x: lax.dynamic_index_in_dim(x, i, axis=0, keepdims=False),
             batches)
@@ -1089,15 +1295,25 @@ def resolve_server_loop(
             cbits, _pack_bits(out["status"] == committed_code, TW), i, axis=0)
         tbits = lax.dynamic_update_index_in_dim(
             tbits, _pack_bits(out["status"] == too_old_code, TW), i, axis=0)
-        return i + 1, st, cbits, tbits, ov | out["overflow"]
+        if heat_on:
+            heat = jax.tree.map(
+                lambda acc, h: lax.dynamic_update_index_in_dim(
+                    acc, h.astype(acc.dtype), i, axis=0),
+                heat, out["heat"])
+        return i + 1, st, cbits, tbits, ov | out["overflow"], heat
 
+    heat0 = ({name: jnp.zeros(s.shape, s.dtype)
+              for name, s in heat_struct(cfg, stack=(Q,)).items()}
+             if heat_on else {})
     carry = (jnp.int32(0), state,
              jnp.zeros((Q, TW), jnp.uint32),
              jnp.zeros((Q, TW), jnp.uint32),
-             jnp.asarray(False))
-    _, state, cbits, tbits, overflow = lax.while_loop(cond, body, carry)
-    return state, {"commit_bits": cbits, "too_old_bits": tbits,
-                   "overflow": overflow}
+             jnp.asarray(False), heat0)
+    _, state, cbits, tbits, overflow, heat = lax.while_loop(cond, body, carry)
+    out = {"commit_bits": cbits, "too_old_bits": tbits, "overflow": overflow}
+    if heat_on:
+        out["heat"] = heat
+    return state, out
 
 
 def state_struct(cfg: KernelConfig, stack: Tuple[int, ...] = ()) -> Dict[str, jax.ShapeDtypeStruct]:

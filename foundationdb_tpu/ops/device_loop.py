@@ -134,14 +134,21 @@ def decode_status_bits(commit_words: np.ndarray, too_words: np.ndarray,
 class _LoopTicket:
     """One dispatched queue slot's place in the result ring."""
 
-    __slots__ = ("commit_dev", "too_dev", "ov_dev", "n_txns", "n_chunks",
-                 "slot", "status", "overflow", "done")
+    __slots__ = ("commit_dev", "too_dev", "ov_dev", "heat_dev", "heat_base",
+                 "heat_version", "n_txns", "n_chunks", "slot", "status",
+                 "overflow", "done")
 
     def __init__(self, commit_dev, too_dev, ov_dev, n_txns: int,
-                 n_chunks: int, slot: "_LoopSlot"):
+                 n_chunks: int, slot: "_LoopSlot", heat_dev=None,
+                 heat_base: int = 0, heat_version=None):
         self.commit_dev = commit_dev
         self.too_dev = too_dev
         self.ov_dev = ov_dev
+        #: the slot's stacked [Q, ...] heat planes (None when heat is off);
+        #: decoded alongside the bitmaps in the same non-blocking drain
+        self.heat_dev = heat_dev
+        self.heat_base = heat_base
+        self.heat_version = heat_version
         self.n_txns = n_txns
         self.n_chunks = n_chunks
         self.slot = slot
@@ -150,9 +157,13 @@ class _LoopTicket:
         self.done = False
 
     def ready(self) -> bool:
-        """Non-blocking: have this slot's abort bitmaps landed?"""
-        return (self.commit_dev.is_ready() and self.too_dev.is_ready()
-                and self.ov_dev.is_ready())
+        """Non-blocking: have this slot's abort bitmaps (and heat planes,
+        when heat is on) landed?"""
+        r = (self.commit_dev.is_ready() and self.too_dev.is_ready()
+             and self.ov_dev.is_ready())
+        if r and self.heat_dev is not None:
+            r = all(v.is_ready() for v in self.heat_dev.values())
+        return r
 
 
 class _LoopSlot:
@@ -215,6 +226,7 @@ class DeviceLoopEngine(JaxConflictEngine):
                  ladder: Optional[Sequence[int]] = None,
                  arena: bool = True,
                  history_search: Optional[str] = None,
+                 heat_buckets: Optional[int] = None,
                  queue_slots: int = 4,
                  queue_depth: int = 2,
                  drain_deadline_s: float = 5.0):
@@ -238,7 +250,8 @@ class DeviceLoopEngine(JaxConflictEngine):
         super().__init__(loop_kernel_config(cfg),
                          initial_version=initial_version, ladder=ladder,
                          scan_sizes=(), arena=arena,
-                         history_search=history_search)
+                         history_search=history_search,
+                         heat_buckets=heat_buckets)
         # the loop's queue/ring gauges flow into the unified telemetry hub
         # (docs/observability.md): `loop.<label>.*` series alongside the
         # EnginePerf counters the base class registered above
@@ -311,7 +324,9 @@ class DeviceLoopEngine(JaxConflictEngine):
         self.state, out = prog(self.state, slot.arrays, np.int32(C))
         self.loop_stats["enqueue_ms"] += (time.perf_counter() - t_enq) * 1e3
         ticket = _LoopTicket(out["commit_bits"], out["too_old_bits"],
-                             out["overflow"], bucket.max_txns, C, slot)
+                             out["overflow"], bucket.max_txns, C, slot,
+                             heat_dev=out.get("heat"), heat_base=self.base,
+                             heat_version=self._heat_version)
         slot.ticket = ticket
         self._ring.append(ticket)
         self.loop_stats["units"] += 1
@@ -376,11 +391,21 @@ class DeviceLoopEngine(JaxConflictEngine):
         too = np.asarray(ticket.too_dev)[:ticket.n_chunks]
         ticket.status = decode_status_bits(commit, too, ticket.n_txns)
         ticket.overflow = bool(np.asarray(ticket.ov_dev))
+        if ticket.heat_dev is not None:
+            # heat planes landed with the same program's outputs: merge the
+            # filled prefix into the aggregator (still no blocking sync —
+            # the bitmaps above were already ready)
+            self._merge_heat(
+                {k: np.asarray(v)[:ticket.n_chunks]
+                 for k, v in ticket.heat_dev.items()},
+                version=ticket.heat_version, base=ticket.heat_base,
+                layout="c")
         self.loop_stats["decode_ms"] += (time.perf_counter() - t_dec) * 1e3
         ticket.done = True
         if ticket.slot.ticket is ticket:
             ticket.slot.ticket = None
         ticket.commit_dev = ticket.too_dev = ticket.ov_dev = None
+        ticket.heat_dev = None
 
     # -- host access to the donated table ------------------------------------
     def _reset_device_state(self, version_rel: int) -> None:

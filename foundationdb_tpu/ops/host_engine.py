@@ -471,6 +471,12 @@ class EnginePerf:
     #: "Device-resident loop") — exported as `dispatch_mode_hits.*` so the
     #: telemetry frontends show which path served traffic
     dispatch_mode_hits: Dict[str, int] = field(default_factory=dict)
+    #: abort-cause counters: transactions by final verdict (committed /
+    #: conflicts / too_old), aggregated across every dispatch path —
+    #: before this, the verdict split was only visible per batch in
+    #: status_of and never aggregated anywhere (docs/observability.md
+    #: "Keyspace heat & occupancy")
+    verdicts: Dict[str, int] = field(default_factory=dict)
     warmup_ms: float = 0.0
     warmed: bool = False
     #: flight recorder (docs/observability.md): a bounded ring of recent
@@ -494,6 +500,20 @@ class EnginePerf:
         self.dispatch_mode_hits[mode] = (
             self.dispatch_mode_hits.get(mode, 0) + chunks)
 
+    def record_verdicts(self, status) -> None:
+        """Fold one batch's final statuses (any int iterable / np array of
+        TransactionCommitResult codes) into the abort-cause counters."""
+        arr = np.asarray(status, dtype=np.int64)
+        if arr.size == 0:
+            return
+        committed = int(np.sum(arr == int(TransactionCommitResult.COMMITTED)))
+        too_old = int(np.sum(arr == int(TransactionCommitResult.TOO_OLD)))
+        v = self.verdicts
+        v["committed"] = v.get("committed", 0) + committed
+        v["too_old"] = v.get("too_old", 0) + too_old
+        v["conflicts"] = (v.get("conflicts", 0)
+                          + int(arr.size) - committed - too_old)
+
     def as_dict(self) -> dict:
         return {
             "compiles": self.compiles,
@@ -504,6 +524,7 @@ class EnginePerf:
                              for k, v in sorted(self.search_modes.items())},
             "search_mode_hits": dict(sorted(self.search_mode_hits.items())),
             "dispatch_mode_hits": dict(sorted(self.dispatch_mode_hits.items())),
+            "verdicts": dict(sorted(self.verdicts.items())),
             "warmup_ms": round(self.warmup_ms, 1),
             "warmed": self.warmed,
             "recent_dispatches": len(self.recent),
@@ -552,10 +573,12 @@ class RoutedConflictEngineBase:
                  ladder: Optional[Sequence[int]] = None,
                  scan_sizes: Sequence[int] = (2, 4, 8),
                  arena: bool = True,
-                 history_search: Optional[str] = None):
+                 history_search: Optional[str] = None,
+                 heat_buckets: Optional[int] = None):
         # Subclasses seed their device state (incl. any initial version, as a
         # base-relative offset) via _reset_device_state.
         cfg = self._resolve_history_search(cfg, history_search)
+        cfg = self._resolve_heat(cfg, heat_buckets)
         self.cfg = cfg
         self.shards = shards
         self.n_shards = shards.n_shards
@@ -588,12 +611,22 @@ class RoutedConflictEngineBase:
             search_modes={b.max_txns: ck.resolved_history_search(b)
                           for b in self.buckets})
         self.arena: Optional[HostPackArena] = HostPackArena() if arena else None
+        # keyspace-heat aggregator (core/heatmap.py): merges the device's
+        # per-batch heat aggregates; None when the layer is off — the
+        # disabled path allocates nothing
+        from ..core import heatmap
+
+        self.heat = heatmap.aggregator_for(cfg, n_shards=self.n_shards)
+        #: batch version the in-flight dispatch belongs to (heat labels)
+        self._heat_version = None
         # unified telemetry (core/telemetry.py): perf counters become
         # TDMetric series a MetricLogger can persist; registration draws no
         # rng and costs one list append
         from ..core import telemetry
 
         telemetry.hub().register_engine_perf(self.perf, name=self.name)
+        if self.heat is not None:
+            telemetry.hub().register_heat(self.heat, name=self.name)
 
     # -- history search mode (docs/perf.md) ---------------------------------
     @staticmethod
@@ -625,6 +658,88 @@ class RoutedConflictEngineBase:
         """Resolved history-search mode per ladder bucket {T: mode} — what
         BudgetBatcher keys its per-(bucket, mode) EWMAs by."""
         return dict(self.perf.search_modes)
+
+    # -- keyspace heat (docs/observability.md "Keyspace heat & occupancy") ---
+    @staticmethod
+    def _resolve_heat(cfg: KernelConfig, requested: Optional[int]) -> KernelConfig:
+        """Fold the heat-bucket request into the config the ladder is
+        built from. Precedence: explicit constructor argument > a non-zero
+        cfg.heat_buckets > the `resolver_heat_buckets` knob. The resolved
+        count is baked into every bucket's compiled program (bucket()
+        clones propagate it), so warmup covers the heat outputs too."""
+        b = requested
+        if b is None:
+            b = cfg.heat_buckets
+            if b == 0:
+                from ..core.heatmap import heat_buckets_from_knobs
+
+                b = heat_buckets_from_knobs()
+        b = int(b)
+        if b < 0:
+            raise ValueError(f"resolver_heat_buckets must be >= 0, got {b}")
+        if b == cfg.heat_buckets:
+            return cfg
+        import dataclasses
+
+        return dataclasses.replace(cfg, heat_buckets=b)
+
+    def heat_snapshot(self, top_n: int = 8, brief: bool = False):
+        """The keyspace-heat/occupancy fragment (core/heatmap.py) riding
+        engine_health -> ratekeeper -> CC status doc -> `cli heat`, spans
+        and flight-recorder records; None when the layer is off."""
+        if self.heat is None:
+            return None
+        return self.heat.snapshot(top_n=top_n, brief=brief)
+
+    def _merge_heat(self, heat_host, version=None, base=None,
+                    layout: str = "") -> None:
+        """Merge a forced heat subtree into the aggregator. `layout`
+        names the leading axes of the leaves so chunk and shard axes are
+        NOT conflated — a chunk ([C] fused scan, [Q] loop slot) is a
+        distinct set of transactions and counts fully, while a shard
+        axis ([S]) re-describes the SAME transactions across key-range
+        shards and must fold through ONE merge_shards call (counting the
+        replicated committed/conflicts/too_old per shard would inflate
+        the verdict totals n_shards-fold and tick the decay S times per
+        batch):
+
+          ""   — one single-shard chunk (resolve_step)
+          "c"  — chunk-leading [C, ...] (fused scan / loop slot prefix)
+          "s"  — shard-leading [S, ...], one chunk (stacked/mesh step)
+          "cs" — [C, S, ...] (sub-sharded fused scan)
+          "sc" — [S, C, ...] (mesh fused scan: shard axis outermost)
+
+        `base` is the engine version base the batch was packed against
+        (witness versions are base-relative); default: the current base."""
+        if self.heat is None or heat_host is None:
+            return
+        if base is None:
+            base = self.base
+
+        def at(tree, i):
+            return {k: np.asarray(v)[i] for k, v in tree.items()}
+
+        n = np.asarray(heat_host["bounds"]).shape[0] if layout else 0
+        if layout == "":
+            self.heat.merge({k: np.asarray(v) for k, v in heat_host.items()},
+                            base=base, version=version)
+        elif layout == "c":
+            for c in range(n):
+                self._merge_heat(at(heat_host, c), version, base, "")
+        elif layout == "s":
+            self.heat.merge_shards([at(heat_host, s) for s in range(n)],
+                                   base=base, version=version)
+        elif layout == "cs":
+            for c in range(n):
+                self._merge_heat(at(heat_host, c), version, base, "s")
+        elif layout == "sc":
+            per_shard = [at(heat_host, s) for s in range(n)]
+            n_chunks = np.asarray(per_shard[0]["bounds"]).shape[0]
+            for c in range(n_chunks):
+                self.heat.merge_shards([at(sh, c) for sh in per_shard],
+                                       base=base, version=version)
+        else:
+            raise ValueError(f"unknown heat layout {layout!r}")
 
     # -- bucket ladder / program cache --------------------------------------
     def bucket_for(self, n_txns: int, n_reads: int, n_writes: int) -> KernelConfig:
@@ -1053,6 +1168,9 @@ class RoutedConflictEngineBase:
 
         chunks = plan["chunks"]
         loop_mode = self.dispatch_mode == "loop"
+        #: batch version for heat-attribution labels: _dispatch_unit
+        #: closures capture it at dispatch time (cleared after the loop)
+        self._heat_version = plan.get("now")
         t_enq = span_now() if g_spans.enabled else 0.0
         #: (unit_force, [n_txns per chunk], [leases per chunk], flight rec)
         outs: List[Tuple[Callable, List[int], List[Optional[ArenaLease]], dict]] = []
@@ -1077,6 +1195,7 @@ class RoutedConflictEngineBase:
                 outs.append((unit, [ch[1] for ch in sub],
                              [ch[3] for ch in sub], rec))
             i = j
+        self._heat_version = None
         if g_spans.enabled and loop_mode:
             # loop engines: the dispatch loop above only packed queue slots
             # and enqueued async server steps — the queue_enqueue share of
@@ -1109,6 +1228,9 @@ class RoutedConflictEngineBase:
                         f"a shard's boundary table needs > {capacity} rows"
                     )
                 for c, n in enumerate(ns):
+                    # abort-cause counters aggregate the verdict split that
+                    # was previously only visible per batch in status_of
+                    self.perf.record_verdicts(status[c, :n])
                     results.extend(
                         TransactionCommitResult(int(v)) for v in status[c, :n])
                 # the unit's outputs are forced: its programs can no longer
@@ -1129,6 +1251,11 @@ class RoutedConflictEngineBase:
                     snap_fn = getattr(self, "loop_stats_snapshot", None)
                     if snap_fn is not None:
                         extra["loop_stats"] = snap_fn()
+                if self.heat is not None:
+                    # hot-key-pressure context rides the readback span, so
+                    # a slow batch's trace says whether the keyspace was
+                    # hot when it ran (docs/observability.md)
+                    extra["heat"] = self.heat.brief()
                 span_event(
                     "engine.result_drain" if loop_mode else "engine.force",
                     version, t_force, span_now(), units=len(outs), **extra)
@@ -1147,6 +1274,7 @@ class RoutedConflictEngineBase:
         # picks so the telemetry counters cover the slow path too
         self.perf.record_search_mode(cfg.max_txns, 1)
         self.perf.record_dispatch_mode(self.dispatch_mode, 1)
+        self._heat_version = now
 
         too_old = np.zeros((cfg.max_txns,), bool)
         t_ok = np.zeros((cfg.max_txns,), bool)
@@ -1215,6 +1343,7 @@ class RoutedConflictEngineBase:
                     f"a shard's boundary table needs > {cfg.capacity} rows"
                 )
             results = [TransactionCommitResult(int(v)) for v in status[:n]]
+            self.perf.record_verdicts(status[:n])
             if chunk_has_rwrites:
                 self._tier_record(routed, results, now, new_oldest)
             elif new_oldest > self.oldest_version:
@@ -1287,6 +1416,7 @@ class RoutedConflictEngineBase:
                 f"a shard's boundary table needs > {cfg.capacity} rows"
             )
         results = [TransactionCommitResult(int(v)) for v in status[:n]]
+        self.perf.record_verdicts(status[:n])
         self._tier_record(routed, results, now, new_oldest)
         return results
 
@@ -1337,9 +1467,11 @@ class SubshardedConflictEngine(RoutedConflictEngineBase):
                  ladder: Optional[Sequence[int]] = None,
                  scan_sizes: Sequence[int] = (2, 4, 8),
                  arena: bool = True,
-                 history_search: Optional[str] = None):
+                 history_search: Optional[str] = None,
+                 heat_buckets: Optional[int] = None):
         super().__init__(cfg, shards, ladder=ladder, scan_sizes=scan_sizes,
-                         arena=arena, history_search=history_search)
+                         arena=arena, history_search=history_search,
+                         heat_buckets=heat_buckets)
         cfg = self.cfg   # base resolved the history-search mode into it
         self._reset_device_state(initial_version)
         self.tier_map = VersionIntervalMap(initial_version)
@@ -1385,11 +1517,17 @@ class SubshardedConflictEngine(RoutedConflictEngineBase):
                      for k in per_chunks[0][0]}
         self.state, out = prog(self.state, batch)
         status_dev, overflow_dev = out["status"], out["overflow"]
+        heat_dev = out.get("heat")           # [S, ...] or [C, S, ...]
+        heat_layout = "s" if C == 1 else "cs"
+        heat_base, heat_version = self.base, self._heat_version
         keep = batch   # zero-copy keepalive (see _dispatch_unit contract)
 
         def force() -> Tuple[np.ndarray, bool]:
             status = np.asarray(status_dev)
             overflow = bool(np.any(np.asarray(overflow_dev)))
+            if heat_dev is not None:
+                self._merge_heat(heat_dev, version=heat_version,
+                                 base=heat_base, layout=heat_layout)
             _ = keep   # pinned until the outputs above were forced
             return (status[None] if C == 1 else status), overflow
 
@@ -1427,10 +1565,12 @@ class JaxConflictEngine(RoutedConflictEngineBase):
                  ladder: Optional[Sequence[int]] = None,
                  scan_sizes: Sequence[int] = (2, 4, 8),
                  arena: bool = True,
-                 history_search: Optional[str] = None):
+                 history_search: Optional[str] = None,
+                 heat_buckets: Optional[int] = None):
         super().__init__(cfg, KeyShardMap([]), ladder=ladder,
                          scan_sizes=scan_sizes, arena=arena,
-                         history_search=history_search)
+                         history_search=history_search,
+                         heat_buckets=heat_buckets)
         cfg = self.cfg   # base resolved the history-search mode into it
         self.state = ck.initial_state(cfg, version_rel=initial_version)
         self.tier_map = VersionIntervalMap(initial_version)
@@ -1467,11 +1607,17 @@ class JaxConflictEngine(RoutedConflictEngineBase):
                      for k in per_chunks[0][0]}
         self.state, out = prog(self.state, batch)
         status_dev, overflow_dev = out["status"], out["overflow"]
+        heat_dev = out.get("heat")           # unstacked or [C, ...]
+        heat_layout = "" if C == 1 else "c"
+        heat_base, heat_version = self.base, self._heat_version
         keep = batch   # zero-copy keepalive (see _dispatch_unit contract)
 
         def force() -> Tuple[np.ndarray, bool]:
             status = np.asarray(status_dev)
             overflow = bool(np.any(np.asarray(overflow_dev)))
+            if heat_dev is not None:
+                self._merge_heat(heat_dev, version=heat_version,
+                                 base=heat_base, layout=heat_layout)
             _ = keep   # pinned until the outputs above were forced
             return (status[None] if C == 1 else status), overflow
 

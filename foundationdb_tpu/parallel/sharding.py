@@ -88,12 +88,18 @@ def make_sharded_step(cfg: KernelConfig, mesh: Mesh, axis: str = "shard"):
             cfg, batch["t_ok"], hist_hits, ovp, batch,
             allreduce=lambda x: lax.psum(x, axis),
         )
-        new_state, overflow = ck.apply_writes_and_gc(cfg, state, batch, committed, wpos)
+        new_state, overflow, reclaimed = ck.apply_writes_and_gc(
+            cfg, state, batch, committed, wpos)
         out = {
             "status": ck.status_of(batch["t_too_old"], committed),
             "overflow": overflow,
             "n": new_state["n"],
         }
+        if cfg.heat_buckets > 0:
+            # per-shard aggregate (each shard's own table delimits its
+            # buckets); stays shard-local — the host merges by boundary key
+            out["heat"] = ck.heat_of(cfg, new_state, batch, committed, ovp,
+                                     reclaimed)
         return jax.tree.map(lambda x: jnp.asarray(x)[None], (new_state, out))
 
     mapped = _shard_map(step, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P(axis))
@@ -120,11 +126,17 @@ def make_sharded_scan_step(cfg: KernelConfig, mesh: Mesh, n_chunks: int,
                 cfg, b["t_ok"], hist_hits, ovp, b,
                 allreduce=lambda x: lax.psum(x, axis),
             )
-            new_state, overflow = ck.apply_writes_and_gc(cfg, st, b, committed, wpos)
-            return new_state, (ck.status_of(b["t_too_old"], committed), overflow)
+            new_state, overflow, reclaimed = ck.apply_writes_and_gc(
+                cfg, st, b, committed, wpos)
+            heat = (ck.heat_of(cfg, new_state, b, committed, ovp, reclaimed)
+                    if cfg.heat_buckets > 0 else {})
+            return new_state, (ck.status_of(b["t_too_old"], committed),
+                               overflow, heat)
 
-        state, (status, overflow) = lax.scan(body, state, batches)
+        state, (status, overflow, heat) = lax.scan(body, state, batches)
         out = {"status": status, "overflow": overflow}
+        if cfg.heat_buckets > 0:
+            out["heat"] = heat          # leaves [C, ...], shard-local
         return jax.tree.map(lambda x: jnp.asarray(x)[None], (state, out))
 
     mapped = _shard_map(step, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P(axis))
@@ -160,7 +172,8 @@ def make_sharded_split_steps(cfg: KernelConfig, mesh: Mesh, axis: str = "shard")
         batch = jax.tree.map(lambda x: x[0], batch)
         committed = committed[0]
         wpos = jax.tree.map(lambda x: x[0], wpos)
-        new_state, overflow = ck.apply_writes_and_gc(cfg, state, batch, committed, wpos)
+        new_state, overflow, _ = ck.apply_writes_and_gc(
+            cfg, state, batch, committed, wpos)
         return jax.tree.map(lambda x: jnp.asarray(x)[None], (new_state, overflow))
 
     detect_m = jax.jit(_shard_map(
@@ -191,6 +204,7 @@ class ShardedConflictEngine(RoutedConflictEngineBase):
         scan_sizes=(2, 4, 8),
         arena: bool = True,
         history_search=None,
+        heat_buckets=None,
     ):
         if mesh is None:
             devs = jax.devices()
@@ -199,7 +213,8 @@ class ShardedConflictEngine(RoutedConflictEngineBase):
         (n_devices,) = mesh.devices.shape
         super().__init__(cfg, shards or KeyShardMap.uniform(n_devices),
                          ladder=ladder, scan_sizes=scan_sizes, arena=arena,
-                         history_search=history_search)
+                         history_search=history_search,
+                         heat_buckets=heat_buckets)
         cfg = self.cfg   # base resolved the history-search mode into it
         assert self.n_shards == n_devices
         self.mesh = mesh
@@ -263,11 +278,17 @@ class ShardedConflictEngine(RoutedConflictEngineBase):
                 lambda x: jax.device_put(x, self._sharding), stacked)
         self.state, out = prog(self.state, batch)
         status_dev, overflow_dev = out["status"], out["overflow"]
+        heat_dev = out.get("heat")   # shard-local, [S, ...] or [S, C, ...]
+        heat_layout = "s" if C == 1 else "sc"
+        heat_base, heat_version = self.base, self._heat_version
         keep = batch
 
         def force() -> Tuple[np.ndarray, bool]:
             status = np.asarray(status_dev)[0]   # identical across shards
             overflow = bool(np.any(np.asarray(overflow_dev)))
+            if heat_dev is not None:
+                self._merge_heat(heat_dev, version=heat_version,
+                                 base=heat_base, layout=heat_layout)
             _ = keep
             return (status[None] if C == 1 else status), overflow
 

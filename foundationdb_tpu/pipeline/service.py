@@ -274,6 +274,14 @@ class PipelinedResolverService:
                     snap = snap_fn() if snap_fn is not None else None
                     if snap is not None:
                         extra["loop_stats"] = snap
+                # keyspace-heat context (core/heatmap.py): the batch-time
+                # hot-range pressure rides the device span, so a slow
+                # batch's trace says whether the keyspace was hot
+                heat_fn = getattr(self.engine, "heat_snapshot", None)
+                if heat_fn is not None:
+                    heat = heat_fn(brief=True)
+                    if heat is not None:
+                        extra["heat"] = heat
                 span_event("resolver.device_resident" if loop_mode
                            else "resolver.device_dispatch",
                            version, t2, t3, txns=len(transactions),

@@ -439,6 +439,10 @@ class CampaignReport:
     parity_checked: int = 0
     parity_mismatches: int = 0
     loop_stats: Optional[dict] = None
+    #: keyspace heat & occupancy snapshot (core/heatmap.py) of the
+    #: campaign engine — lets `cli heat REPORT.json` correlate SLO
+    #: breaches with hot-key pressure after the fact
+    heat: Optional[dict] = None
     admission: Optional[dict] = None
     child_restarts: int = 0
     child_crash_count: int = 0
@@ -790,6 +794,9 @@ async def _campaign(cfg: NemesisConfig) -> CampaignReport:
         report.engine_stats = dict(server.engine.stats)
         report.parity_checked, report.parity_mismatches = \
             replay_journal_parity(server.engine.journal)
+        heat_fn = getattr(server.engine, "heat_snapshot", None)
+        if heat_fn is not None:
+            report.heat = heat_fn()
         loop_stats = getattr(server.inner, "loop_stats", None)
         if loop_stats is not None:
             # quiesce the loop before reading sync accounting

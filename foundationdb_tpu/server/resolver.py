@@ -158,6 +158,14 @@ class Resolver:
         flight = getattr(self.engine, "flight", None)
         if flight is not None:
             tel["flight_recorder_entries"] = len(flight)
+        # keyspace heat & occupancy (core/heatmap.py): hot ranges, table
+        # headroom and suggested split points ride the same poll ->
+        # ratekeeper -> CC status doc -> `tools/cli.py heat`
+        heat_fn = getattr(self.engine, "heat_snapshot", None)
+        if heat_fn is not None:
+            heat = heat_fn()
+            if heat is not None:
+                tel["heat"] = heat
         if tel:
             out["telemetry"] = tel
         return out

@@ -1344,6 +1344,22 @@ class DeviceFaultValidationWorkload(TestWorkload):
             flight_by_version = {rec["version"]: rec
                                  for rec in eng.flight.dump()}
             self.ctx.count("flight_records", len(flight_by_version))
+            # heat/occupancy snapshots riding the records (PR 10): replay
+            # tolerates their presence and checks the fields are sane —
+            # a malformed snapshot in an incident dump is itself a bug
+            for rec in flight_by_version.values():
+                heat = rec.get("heat")
+                if heat is None:
+                    continue
+                self.ctx.count("flight_heat_records")
+                frac = heat.get("occupancy_frac", 0.0)
+                if not (0.0 <= frac <= 1.0) or heat.get("conflicts", 0) < 0:
+                    TraceEvent("FlightRecorderHeatMalformed",
+                               severity=Severity.ERROR) \
+                        .detail("Version", rec["version"]) \
+                        .detail("Heat", heat).log()
+                    self.ctx.count("flight_heat_malformed")
+                    ok = False
             clean = OracleConflictEngine()
             for version, txns, new_oldest, verdicts in eng.journal:
                 want = clean.resolve(list(txns), version, new_oldest)

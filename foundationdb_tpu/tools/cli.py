@@ -35,8 +35,15 @@ commands:
                        change the database configuration (applies at the
                        next recovery; replication drives DD team growth)
   telemetry [json]     resolver engine telemetry: health, perf counters,
-                       budget-batcher EWMAs (docs/observability.md)
+                       abort-cause split, budget-batcher EWMAs
+                       (docs/observability.md)
   telemetry read PROCESS METRIC   read a persisted \\xff/metrics/ series
+  heat [json|FILE.json]  keyspace heat & history occupancy: top hot
+                       ranges, occupancy headroom, suggested equal-load
+                       shard split points — live from the cluster's
+                       status doc, or from a campaign report JSON
+                       (real/nemesis.py --json) / bench artifact with a
+                       conflict_heat section (docs/observability.md)
   chaos-status [FILE]  nemesis event counts from this process's telemetry
                        hub, or from a campaign report JSON written by
                        `python -m foundationdb_tpu.real.nemesis --json`
@@ -172,6 +179,13 @@ class Cli:
                     picks = ", ".join(f"{k}:{v}" for k, v in
                                       sorted(dmodes.items()))
                     self._print(f"    dispatch - mode hits {{{picks}}}")
+                verdicts = perf.get("verdicts") or {}
+                if verdicts:
+                    # abort-cause split (docs/observability.md "Keyspace
+                    # heat & occupancy"): aggregated, not per batch
+                    split = ", ".join(f"{k}:{v}" for k, v in
+                                      sorted(verdicts.items()))
+                    self._print(f"    verdicts - {{{split}}}")
             b = frag.get("batcher")
             if b:
                 ewma = ", ".join(f"{k}:{v}ms" for k, v in
@@ -183,6 +197,96 @@ class Cli:
             if "flight_recorder_entries" in frag:
                 self._print(f"    flightrec- {frag['flight_recorder_entries']} "
                             "recent dispatch records")
+
+    def _render_heat(self, label: str, heat: dict) -> None:
+        """One engine's keyspace-heat snapshot (core/heatmap.py layout)."""
+        self._print(f"  {label}:")
+        occ = heat.get("occupancy", 0)
+        cap = heat.get("capacity", 0) or 1
+        frac = heat.get("occupancy_frac", occ / cap)
+        verd = heat.get("verdicts") or {}
+        self._print(f"    occupancy    - {occ}/{cap} rows "
+                    f"({frac * 100:.1f}%, headroom {(1 - frac) * 100:.1f}%), "
+                    f"gc reclaimed {heat.get('gc_reclaimed', 0)}")
+        self._print(f"    verdicts     - committed {verd.get('committed', 0)}, "
+                    f"conflicts {verd.get('conflicts', 0)}, "
+                    f"too_old {verd.get('too_old', 0)} "
+                    f"over {heat.get('batches', 0)} batches")
+        self._print(f"    concentration- {heat.get('concentration', 0):.3f} "
+                    "(0 = even load, 1 = one hot range)")
+        hot = heat.get("hot_ranges") or []
+        if hot:
+            self._print("    hot ranges   - (share of write+conflict load)")
+            for r in hot:
+                end = r.get("end")
+                self._print(
+                    f"      [{r['begin']!r:<24} .. "
+                    f"{(end if end is not None else '+inf')!r:<24}) "
+                    f"{r['share'] * 100:5.1f}%  w={r['writes']:.0f} "
+                    f"c={r['conflicts']:.0f} r={r['reads']:.0f}")
+        splits = heat.get("split_points") or []
+        if splits:
+            bal = heat.get("split_balance") or []
+            shards = heat.get("split_shards", len(splits) + 1)
+            self._print(f"    split points - {shards} equal-load shards "
+                        "(ROADMAP item 1 input):")
+            for s in splits:
+                self._print(f"      {s!r}")
+            if bal:
+                self._print("    shard load   - "
+                            + ", ".join(f"{f * 100:.1f}%" for f in bal))
+        for a in (heat.get("recent_attribution") or [])[-4:]:
+            self._print(
+                f"    abort@v{a.get('version')} <- write v"
+                f"{a.get('witness_version')} in [{a.get('range_begin')!r} ..)")
+
+    def do_heat(self, args: List[str]) -> None:
+        """Keyspace heat & history occupancy (docs/observability.md
+        "Keyspace heat & occupancy"): hot key ranges, interval-table
+        headroom and suggested equal-load shard split points — live from
+        the cluster status doc's qos.resolver_telemetry fragment, or from
+        a campaign report / bench JSON artifact."""
+        if args and args[0].endswith(".json"):
+            with open(args[0]) as f:
+                doc = json.load(f)
+            rendered = 0
+            for rep in doc.get("campaigns", []):
+                heat = rep.get("heat")
+                if heat:
+                    self._render_heat(
+                        f"seed {rep.get('cfg_seed')} "
+                        f"[{rep.get('engine_mode')}]", heat)
+                    rendered += 1
+            ch = (doc.get("parsed", doc)).get("conflict_heat")
+            if ch:
+                for row in ch.get("sweep", []):
+                    if row.get("heat"):
+                        self._render_heat(f"zipf s={row.get('s')}",
+                                          row["heat"])
+                        rendered += 1
+            if not rendered:
+                self._print(f"no heat snapshots in {args[0]} (campaign "
+                            "engines without the layer, or an old report)")
+            return
+        doc = self._drive(self.db.get_status())
+        if doc is None:
+            self._print("status unavailable (no cluster controller reachable)")
+            return
+        tel = (doc.get("qos") or {}).get("resolver_telemetry") or {}
+        if args and args[0] == "json":
+            self._print(json.dumps(
+                {addr: frag.get("heat") for addr, frag in tel.items()},
+                indent=2, sort_keys=True))
+            return
+        rendered = 0
+        for addr in sorted(tel):
+            heat = (tel.get(addr) or {}).get("heat")
+            if heat:
+                self._render_heat(f"resolver {addr}", heat)
+                rendered += 1
+        if not rendered:
+            self._print("no keyspace heat yet (oracle engines, "
+                        "resolver_heat_buckets=0, or no traffic)")
 
     def do_chaos_status(self, args: List[str]) -> None:
         """Nemesis activity (docs/real_cluster.md): chaos.* counters + the
@@ -479,14 +583,18 @@ def main(argv=None) -> int:
                     help="run one command and exit (e.g. "
                          "`chaos-status reports.json`, `status`)")
     args = ap.parse_args(argv)
-    if args.command and args.command[0].replace("-", "_") in (
-            "chaos_status", "trace"):
-        # no cluster needed: renders the hub / a report or trace file /
-        # a live span-ring fetch over RPC
+    cmd0 = args.command[0].replace("-", "_") if args.command else ""
+    if cmd0 in ("chaos_status", "trace") or (
+            cmd0 == "heat" and len(args.command) > 1
+            and args.command[1].endswith(".json")):
+        # no cluster needed: renders the hub / a report, trace or heat
+        # artifact file / a live span-ring fetch over RPC
         cli = Cli.__new__(Cli)
         cli.out = sys.stdout
-        if args.command[0].replace("-", "_") == "chaos_status":
+        if cmd0 == "chaos_status":
             cli.do_chaos_status(args.command[1:])
+        elif cmd0 == "heat":
+            cli.do_heat(args.command[1:])
         else:
             cli.do_trace(args.command[1:])
         return 0

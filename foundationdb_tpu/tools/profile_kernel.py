@@ -217,7 +217,7 @@ def main(variants):
         def body(carry, i):
             st, now = carry
             b = get_batch(i, now)
-            st2, _ = ck.apply_writes_and_gc(CFG, st, b, committed0, wpos)
+            st2, _, _ = ck.apply_writes_and_gc(CFG, st, b, committed0, wpos)
             return (st2, now + 7), None
         timed_scan("apply", body, (jax.tree.map(jnp.copy, state), jnp.copy(now0)), donate=True)
 

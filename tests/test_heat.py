@@ -1,0 +1,381 @@
+"""Keyspace heat & history-occupancy observability (PR 10;
+docs/observability.md "Keyspace heat & occupancy").
+
+The load-bearing guarantee: heat instrumentation is OBSERVATIONAL — abort
+sets with heat on are bit-identical to heat off (and to the reference
+oracle) across both history-search modes, bucket-ladder boundaries
+k-1/k/k+1, fused-scan chunking, step and loop dispatch, and GC cadences —
+and a warmed heat-on engine adds zero steady-state compiles. Plus the
+host aggregator's unit semantics (decay, split planning, concentration),
+the disabled path's nothing-allocated contract, the engine_health /
+flight-recorder fragments, and the `cli heat` render paths."""
+import io
+import json
+import random
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.core import telemetry
+from foundationdb_tpu.core.heatmap import KeyRangeHeatAggregator
+from foundationdb_tpu.core.types import CommitTransaction, KeyRange
+from foundationdb_tpu.ops import conflict_kernel as ck
+from foundationdb_tpu.ops import keypack
+from foundationdb_tpu.ops.device_loop import DeviceLoopEngine
+from foundationdb_tpu.ops.host_engine import JaxConflictEngine, SubshardedConflictEngine
+from foundationdb_tpu.ops.oracle import OracleConflictEngine
+
+CFG = ck.KernelConfig(key_words=4, capacity=2048, max_txns=64,
+                      max_reads=64, max_writes=64)
+LADDER = [32]
+#: bucket boundary sizes k-1 / k / k+1 for the 32 bucket, plus a
+#: 2x-top-shape batch that splits into two top-bucket chunks and rides
+#: the fused-scan dispatch (heat leaves gain the [C] axis there)
+BOUNDARY_SIZES = (31, 32, 33, 64, 128)
+HEAT_B = 16
+
+
+def point_txns(rng, n, version, pool=160):
+    txns = []
+    for _ in range(n):
+        t = CommitTransaction(read_snapshot=max(0, version - rng.randrange(1, 400)))
+        for _ in range(2):
+            k = b"ht/%05d" % rng.randrange(pool)
+            t.read_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+        for _ in range(2):
+            k = b"ht/%05d" % rng.randrange(pool)
+            t.write_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+        txns.append(t)
+    return txns
+
+
+def drive_pair(eng_on, eng_off, seed=901, gc_every=3):
+    """Identical stream through both engines + a clean oracle; returns
+    (on, off, oracle) verdict streams. GC interleaves (new_oldest
+    advances every gc_every batches) so the reclaimed-rows lane and the
+    gc compaction branch are exercised with heat on."""
+    ora = OracleConflictEngine()
+    rng = random.Random(seed)
+    v = 1_000
+    on, off, want = [], [], []
+    for i, n in enumerate(BOUNDARY_SIZES * 2):
+        v += rng.randrange(80, 400)
+        txns = point_txns(rng, n, v)
+        oldest = max(0, v - (600 if i % gc_every == 0 else 100_000))
+        on.append([int(x) for x in eng_on.resolve(txns, v, oldest)])
+        off.append([int(x) for x in eng_off.resolve(txns, v, oldest)])
+        want.append([int(x) for x in ora.resolve(txns, v, oldest)])
+    return on, off, want
+
+
+@pytest.mark.parametrize("mode", ["fused_sort", "bsearch"])
+def test_heat_parity_step_both_search_modes(mode):
+    eng_on = JaxConflictEngine(CFG, ladder=LADDER, history_search=mode,
+                               heat_buckets=HEAT_B)
+    eng_off = JaxConflictEngine(CFG, ladder=LADDER, history_search=mode,
+                                heat_buckets=0)
+    on, off, want = drive_pair(eng_on, eng_off)
+    assert on == off == want
+    # the aggregate actually populated (not a vacuous parity)
+    assert eng_on.heat.batches > 0
+    assert eng_on.heat.verdict_totals["committed"] > 0
+    assert eng_on.heat.verdict_totals["conflicts"] > 0
+    assert eng_on.heat.occupancy > 0
+    assert eng_on.heat.gc_reclaimed_total > 0, "gc lane never exercised"
+    # the device-counted verdict lanes agree with the host-side status
+    # decode exactly (two independent paths to the same split)
+    assert eng_on.heat.verdict_totals == eng_on.perf.verdicts
+    assert eng_off.heat is None
+
+
+def test_heat_parity_loop_dispatch():
+    eng_on = DeviceLoopEngine(CFG, ladder=LADDER, heat_buckets=HEAT_B)
+    eng_off = JaxConflictEngine(CFG, ladder=LADDER, heat_buckets=0)
+    on, off, want = drive_pair(eng_on, eng_off, seed=902)
+    eng_on.drain_loop()
+    assert on == off == want
+    assert eng_on.loop_stats["blocking_syncs"] == 0
+    assert eng_on.heat.batches > 0
+    # attribution populated: some aborted txn names its witness
+    assert eng_on.heat.verdict_totals["conflicts"] > 0
+    assert len(eng_on.heat.attribution) > 0
+
+
+def test_heat_parity_subsharded():
+    shards = __import__("foundationdb_tpu.core.keyshard",
+                        fromlist=["KeyShardMap"]).KeyShardMap([b"ht/00080"])
+    eng_on = SubshardedConflictEngine(CFG, shards, heat_buckets=HEAT_B)
+    eng_off = SubshardedConflictEngine(CFG, shards, heat_buckets=0)
+    on, off, want = drive_pair(eng_on, eng_off, seed=903)
+    assert on == off == want
+    # shard axes fold through ONE merge per chunk: verdict totals must
+    # equal the true per-transaction counts (committed/conflicts/too_old
+    # are replicated across shards — counting them per shard would
+    # inflate n_shards-fold), and the capacity gauge is the summed
+    # per-shard tables
+    total_txns = sum(len(b) for b in on)
+    assert sum(eng_on.heat.verdict_totals.values()) == total_txns
+    assert eng_on.heat.batches >= len(BOUNDARY_SIZES * 2)
+    assert eng_on.heat.capacity == 2 * CFG.capacity
+    assert eng_on.heat.occupancy > 0
+
+
+def test_no_steady_state_recompiles_with_heat():
+    """The bucket-ladder compile guard (tests/test_bucket_ladder.py
+    pattern) with heat baked into every program: a warmed heat-on engine
+    serving mixed-size traffic never hits the JAX compiler again."""
+    from foundationdb_tpu.tools.floor_bench import _CompileCounter
+
+    eng = JaxConflictEngine(CFG, ladder=LADDER, heat_buckets=HEAT_B).warmup()
+    rng = random.Random(5002)
+    v = 0
+
+    def drive_round():
+        nonlocal v
+        for n in BOUNDARY_SIZES:
+            v += rng.randrange(60, 240)
+            eng.resolve(point_txns(rng, n, v), v, max(0, v - 1200))
+
+    drive_round()                       # absorb one-time lazy host costs
+    compiles_warm = eng.perf.compiles
+    counter = _CompileCounter()
+    try:
+        for _ in range(2):
+            drive_round()
+    finally:
+        seen = counter.close()
+    assert seen == 0, f"steady-state JAX compiles with heat on: {seen}"
+    assert eng.perf.compiles == compiles_warm
+    assert eng.heat.batches > 0
+
+
+# -- host aggregator unit semantics (core/heatmap.py) ------------------------
+
+def synth_heat(keys, reads, writes, conflicts, occupancy=100,
+               counts=(5, 2, 0, 0), key_words=4):
+    b = len(keys)
+    bounds = keypack.pack_keys(keys, key_words)
+    hist = np.stack([np.asarray(reads), np.asarray(writes),
+                     np.asarray(conflicts)], axis=1).astype(np.int32)
+    return {
+        "bounds": bounds,
+        "hist": hist,
+        "counts": np.asarray(counts, np.int32),
+        "occupancy": np.asarray(occupancy, np.int32),
+        "wit_ver": np.full((4,), -(2 ** 30), np.int32),
+        "wit_bucket": np.full((4,), -1, np.int32),
+    }
+
+
+def test_aggregator_decay_and_merge():
+    agg = KeyRangeHeatAggregator(key_words=4, capacity=1000, buckets=2,
+                                 decay=0.5)
+    keys = [b"a", b"m"]
+    agg.merge(synth_heat(keys, [10, 0], [8, 0], [4, 0]))
+    agg.merge(synth_heat(keys, [0, 10], [0, 8], [0, 4]))
+    hot = {r["begin"]: r for r in agg.hot_ranges()}
+    # first batch decayed once: a's writes 8*0.5 = 4; m's fresh 8
+    assert hot["m"]["writes"] == 8.0
+    assert hot["a"]["writes"] == 4.0
+    assert agg.occupancy == 100
+    assert agg.verdict_totals == {"committed": 10, "conflicts": 4, "too_old": 0}
+    agg.reset_weights()
+    assert agg.hot_ranges() == []
+    assert agg.verdict_totals["committed"] == 10   # totals survive
+
+
+def test_aggregator_split_points_and_concentration():
+    agg = KeyRangeHeatAggregator(key_words=4, capacity=1000, buckets=8,
+                                 decay=1.0)
+    keys = [b"k%02d" % i for i in range(8)]
+    even = [10] * 8
+    agg.merge(synth_heat(keys, even, even, [0] * 8))
+    flat = agg.concentration()
+    splits = agg.split_points(4)
+    assert len(splits) == 3
+    bal = agg.split_balance(4, splits)
+    assert len(bal) == 4 and abs(sum(bal) - 1.0) < 1e-9
+    assert max(bal) - min(bal) < 1e-9          # even load splits evenly
+    # skewed: all the load in one range must raise concentration
+    agg2 = KeyRangeHeatAggregator(key_words=4, capacity=1000, buckets=8,
+                                  decay=1.0)
+    skew = [100, 1, 1, 1, 1, 1, 1, 1]
+    agg2.merge(synth_heat(keys, skew, skew, [0] * 8))
+    assert agg2.concentration() > flat
+    assert agg2.hot_ranges(top_n=1)[0]["begin"] == "k00"
+
+
+def test_aggregator_attribution_sampling():
+    agg = KeyRangeHeatAggregator(key_words=4, capacity=64, buckets=2,
+                                 decay=1.0)
+    heat = synth_heat([b"a", b"m"], [1, 1], [1, 1], [1, 0])
+    heat["wit_ver"] = np.asarray([50, -(2 ** 30), 70, -(2 ** 30)], np.int32)
+    heat["wit_bucket"] = np.asarray([0, -1, 1, -1], np.int32)
+    agg.merge(heat, base=1000, version=1234)
+    samples = list(agg.attribution)
+    assert len(samples) == 2
+    assert samples[0]["witness_version"] == 1050      # base-relative + base
+    assert samples[0]["range_begin"] == "a"
+    assert samples[1]["range_begin"] == "m"
+    assert all(s["version"] == 1234 for s in samples)
+
+
+# -- disabled path -----------------------------------------------------------
+
+def test_heat_disabled_emits_nothing():
+    import jax
+
+    eng = JaxConflictEngine(CFG, heat_buckets=0)
+    assert eng.heat is None
+    assert eng.heat_snapshot() is None
+    out_shapes = jax.eval_shape(
+        lambda st, b: ck.resolve_step(eng.cfg, st, b),
+        ck.state_struct(eng.cfg), ck.batch_struct(eng.cfg))
+    assert "heat" not in out_shapes[1]
+    _hist, edges, _wpos = jax.eval_shape(
+        lambda st, b: ck.local_phases(eng.cfg, st, b),
+        ck.state_struct(eng.cfg), ck.batch_struct(eng.cfg))
+    assert not any(k.startswith("heat_") for k in edges)
+
+
+# -- status / telemetry / CLI fragments --------------------------------------
+
+def test_engine_perf_verdict_counters():
+    eng = JaxConflictEngine(CFG, heat_buckets=0)
+    rng = random.Random(7)
+    v = 1_000
+    total = 0
+    for _ in range(4):
+        v += 300
+        txns = point_txns(rng, 12, v)
+        total += len(txns)
+        eng.resolve(txns, v, 0)
+    verd = eng.perf.verdicts
+    assert sum(verd.values()) == total
+    assert set(verd) <= {"committed", "conflicts", "too_old"}
+    assert verd == eng.perf.as_dict()["verdicts"]
+    # and the hub exports them as engine.*.verdicts.* series
+    telemetry.hub().sync()
+    names = [n for n in telemetry.hub().tdmetrics.metrics
+             if ".verdicts." in n]
+    assert names, "verdict split not synced to the hub"
+
+
+def test_heat_snapshot_and_hub_series():
+    eng = JaxConflictEngine(CFG, heat_buckets=HEAT_B)
+    rng = random.Random(8)
+    v = 1_000
+    for _ in range(3):
+        v += 300
+        eng.resolve(point_txns(rng, 16, v), v, 0)
+    snap = eng.heat_snapshot(top_n=4)
+    for key in ("batches", "occupancy", "occupancy_frac", "gc_reclaimed",
+                "verdicts", "concentration", "hot_ranges", "split_points",
+                "split_balance"):
+        assert key in snap, key
+    brief = eng.heat_snapshot(brief=True)
+    assert set(brief) == {"conflicts", "occupancy_frac", "concentration",
+                          "top_range", "top_share"}
+    telemetry.hub().sync()
+    series = [n for n in telemetry.hub().tdmetrics.metrics
+              if n.startswith("heat.")]
+    assert any(n.endswith(".occupancy") for n in series)
+    assert any(n.endswith(".concentration_x1000") for n in series)
+    text = telemetry.hub().prometheus_text()
+    assert "# TYPE fdbtpu_heat gauge" in text
+
+
+def test_cli_heat_renders_campaign_report(tmp_path):
+    from foundationdb_tpu.tools.cli import Cli
+
+    eng = JaxConflictEngine(CFG, heat_buckets=HEAT_B)
+    rng = random.Random(9)
+    v = 1_000
+    for _ in range(3):
+        v += 300
+        eng.resolve(point_txns(rng, 24, v), v, 0)
+    report = {"campaigns": [{"cfg_seed": 5, "engine_mode": "jax",
+                             "heat": eng.heat_snapshot()}]}
+    p = tmp_path / "report.json"
+    p.write_text(json.dumps(report))
+    out = io.StringIO()
+    cli = Cli.__new__(Cli)
+    cli.out = out
+    cli.do_heat([str(p)])
+    text = out.getvalue()
+    assert "seed 5 [jax]" in text
+    assert "occupancy" in text and "split points" in text
+    assert "hot ranges" in text
+
+
+def test_cli_heat_live_sim_cluster():
+    """The acceptance path end to end: a live sim cluster with a heat-on
+    device engine — engine_health -> ratekeeper poll -> CC status doc
+    (qos.resolver_telemetry.heat) -> `cli heat` renders hot ranges,
+    occupancy headroom and split points."""
+    from foundationdb_tpu.server.cluster import (
+        DynamicClusterConfig, build_dynamic_cluster)
+    from foundationdb_tpu.tools.cli import Cli
+
+    tiny = ck.KernelConfig(key_words=4, capacity=1024, max_txns=32,
+                           max_reads=32, max_writes=32)
+    c = build_dynamic_cluster(seed=181, cfg=DynamicClusterConfig(
+        engine_factory=lambda: JaxConflictEngine(tiny, heat_buckets=8)))
+    out = io.StringIO()
+    cli = Cli(c, out=out)
+    c.sim.run(until=5.0)
+    for i in range(8):
+        cli.run_command(f"set hk{i % 3} v{i}")
+    c.sim.run(until=c.sim.sched.time + 3.0)   # ratekeeper poll cadence
+    out.seek(0)
+    out.truncate(0)
+    cli.run_command("heat")
+    text = out.getvalue()
+    assert "occupancy" in text, text
+    assert "hot ranges" in text, text
+    assert "split points" in text or "concentration" in text, text
+    out.seek(0)
+    out.truncate(0)
+    cli.run_command("heat json")
+    doc = json.loads(out.getvalue())
+    frag = next(v for v in doc.values() if v)
+    assert frag["batches"] > 0 and "hot_ranges" in frag
+
+
+def test_flight_recorder_carries_heat():
+    """ResilientEngine records the heat/occupancy brief next to the
+    abort-set digest (docs/observability.md) and the validation workload
+    contract: the fields are sane."""
+    from foundationdb_tpu.core import buggify
+    from foundationdb_tpu.fault import ResilienceConfig, ResilientEngine
+    from foundationdb_tpu.sim.loop import set_scheduler
+    from foundationdb_tpu.sim.simulator import Simulator
+
+    sim = Simulator(77)
+    buggify.disable()
+    try:
+        dev = JaxConflictEngine(CFG, heat_buckets=HEAT_B)
+        eng = ResilientEngine(dev, ResilienceConfig(
+            dispatch_timeout=5.0, retry_budget=1, retry_backoff=0.01,
+            probe_rate=0.0, probation_batches=1, failover_min_batches=1))
+        rng = random.Random(10)
+        v = 1_000
+
+        async def go():
+            nonlocal v
+            for _ in range(3):
+                v += 300
+                await eng.resolve(point_txns(rng, 8, v), v, 0)
+
+        sim.sched.run_until(sim.sched.spawn(go()), until=1000)
+        recs = eng.flight.dump()
+        assert recs and all("heat" in r for r in recs)
+        h = recs[-1]["heat"]
+        assert 0.0 <= h["occupancy_frac"] <= 1.0
+        assert h["conflicts"] >= 0
+        # the supervisor pass-through serves the same brief
+        assert eng.heat_snapshot(brief=True)["occupancy_frac"] == \
+            pytest.approx(dev.heat.occupancy_frac(), abs=1e-4)
+    finally:
+        buggify.disable()
+        set_scheduler(None)
