@@ -46,6 +46,14 @@ heat-smoke:
 trace-smoke:
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.trace_smoke
 
+# Static invariant check (docs/static_analysis.md, ~2s, pure AST — never
+# imports jax): determinism, host-sync discipline, donation safety,
+# recompile hazards, knob/doc drift, span registry. Non-zero on any
+# non-baselined finding or stale baseline entry; the same run rides tier-1
+# as tests/test_lint.py::test_repo_clean.
+lint:
+	python -m foundationdb_tpu.tools.lint
+
 # Wall-clock chaos (docs/real_cluster.md): seeded nemesis campaigns against
 # the REAL transport under jax AND device_loop engine modes — every SLO
 # machine-asserted (p99 outside injected-fault windows <= the budget-knob
@@ -63,4 +71,4 @@ chaos-real:
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.cli \
 		chaos-status chaos_real_report.json
 
-.PHONY: check bench bench-smoke telemetry-smoke heat-smoke trace-smoke chaos chaos-real
+.PHONY: check bench bench-smoke telemetry-smoke heat-smoke trace-smoke chaos chaos-real lint

@@ -64,7 +64,13 @@ def _make_server_knobs() -> Knobs:
     k.init("commit_transaction_batch_interval", 0.0005, lambda r: r.random01() * 0.005)
     k.init("commit_transaction_batch_count_max", 32768, lambda r: r.random_int(1, 100))
     k.init("commit_transaction_batch_bytes_max", 8 << 20)
-    k.init("resolver_state_memory_limit", 1 << 20)
+    #: bound on a resolver's conflict-history state footprint (reference:
+    #: RESOLVER_STATE_MEMORY_LIMIT). Ours is the device interval table —
+    #: capacity H x K key words plus versions is a few MB at the default
+    #: shape — so the bound is sized with headroom above that; the
+    #: resolver reports `state_bytes` and a `state_memory_pressure` flag
+    #: in engine_health (server/resolver.py) when the footprint exceeds it
+    k.init("resolver_state_memory_limit", 64 << 20)
     k.init("grv_batch_interval", 0.0005, lambda r: r.random01() * 0.005)
     # Ratekeeper (reference: fdbserver/Knobs.cpp ratekeeper section)
     k.init("ratekeeper_update_interval", 0.25)
@@ -282,6 +288,11 @@ def _make_flow_knobs() -> Knobs:
     #: jitter half-width as a fraction of the backoff (0.5 = x[0.5, 1.5)),
     #: so a fleet of clients never reconnects in lockstep
     k.init("real_reconnect_backoff_jitter", 0.5)
+    #: bound on the whole-cluster boot probe (real/cluster.py: every
+    #: spawned node must accept a connection within this) — was a
+    #: hardcoded `time.time() + 60`; promoted alongside the
+    #: real_rpc_timeout_s family so slow CI boxes tune it by name
+    k.init("real_cluster_boot_timeout_s", 60.0)
     return k
 
 

@@ -77,7 +77,7 @@ class TDMetricCollection:
     def __init__(self, now=None):
         import time as _time
 
-        self.now = now or _time.monotonic
+        self.now = now or _time.monotonic  # fdbtpu-lint: allow[determinism] wall-mode default only; the sim passes its virtual clock as `now`
         self.metrics: Dict[str, _BaseMetric] = {}
 
     def int64(self, name: str) -> Int64Metric:

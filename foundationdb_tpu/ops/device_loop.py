@@ -385,6 +385,9 @@ class DeviceLoopEngine(JaxConflictEngine):
                     self.loop_stats["blocking_syncs"] += 1
             self._finish(self._ring.popleft())
 
+    # fdbtpu-lint: drain-point — only reached once ticket.ready() (or the
+    # deadline fallback, which loop_stats charges as a blocking sync): the
+    # asarray below copies a COMPLETED buffer, it never parks in the device
     def _finish(self, ticket: _LoopTicket) -> None:
         t_dec = time.perf_counter()
         commit = np.asarray(ticket.commit_dev)[:ticket.n_chunks]

@@ -244,13 +244,17 @@ def main(argv=None) -> int:
                     os.path.dirname(os.path.abspath(__file__)))),
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
 
-        # wait for every node to accept connections (the cluster join path)
+        # wait for every node to accept connections (the cluster join path);
+        # deadline knob-driven like the rest of the real_rpc_timeout_s
+        # family, and on the monotonic clock — a wall-clock step (NTP, VM
+        # resume) must not expire the probe early
         from ..core import buggify
+        from ..core.knobs import FLOW_KNOBS
 
-        deadline = time.time() + 60
+        deadline = time.monotonic() + FLOW_KNOBS.real_cluster_boot_timeout_s
         for port in ports:
             while True:
-                if time.time() > deadline:
+                if time.monotonic() > deadline:
                     raise TimeoutError(f"node on port {port} never came up")
                 if buggify.buggify():
                     # slow joiner: the probe itself lags, so nodes come up

@@ -17,6 +17,7 @@ from typing import Dict, Optional
 
 from ..core import buggify, error
 from ..core import telemetry
+from ..core.knobs import SERVER_KNOBS
 from ..core.stats import CounterCollection
 from ..core.trace import g_spans, span_event, span_now
 from ..core.types import (
@@ -64,6 +65,21 @@ def gained_ranges(old_splits: tuple, new_splits: tuple, i: int) -> list:
     if ne > oe:
         out.append((max(nb, oe), ne))
     return [(b, e) for b, e in out if b < e]
+
+
+def _engine_state_bytes(engine) -> Optional[int]:
+    """Footprint of the engine's resolved-history state, in bytes — the
+    device interval table for kernel engines (a dict of arrays), reached
+    through a ResilientEngine's wrapped device when supervised.  None when
+    the engine keeps no array state (the serial oracle)."""
+    dev = getattr(engine, "device", engine)
+    st = getattr(dev, "state", None)
+    if not isinstance(st, dict):
+        return None
+    try:
+        return int(sum(int(getattr(v, "nbytes", 0)) for v in st.values()))
+    except (TypeError, ValueError):
+        return None
 
 
 class Resolver:
@@ -140,6 +156,15 @@ class Resolver:
         if fn is not None:
             out.update(fn())
         out["resolve_errors"] = self.stats.counter("resolve_errors").value
+        # state-memory accounting (reference: RESOLVER_STATE_MEMORY_LIMIT):
+        # the footprint of the conflict-history state, and a pressure flag
+        # when it exceeds the knob — a throttle/alert signal surfaced
+        # through the same ratekeeper -> status-doc path as health
+        sb = _engine_state_bytes(self.engine)
+        if sb is not None:
+            out["state_bytes"] = sb
+            out["state_memory_pressure"] = (
+                sb > SERVER_KNOBS.resolver_state_memory_limit)
         if self._service is not None and self._service.batcher is not None:
             out["target_batch_txns"] = self._service.target_batch_txns()
         # Unified telemetry fragment (docs/observability.md): engine perf

@@ -53,6 +53,11 @@ commands:
                        fetch live span rings over RPC (trace.spans token),
                        reconstruct per-commit waterfalls, optionally write
                        Chrome trace JSON (docs/observability.md)
+  lint [ARGS...]       run fdbtpu-lint, the static invariant checker:
+                       determinism, host-sync discipline, donation safety,
+                       recompile hazards, knob/doc drift, span registry
+                       (docs/static_analysis.md; args pass through, e.g.
+                       `lint --json` or `lint --rules knob-drift`)
   help                 this text
   exit                 quit
 Keys/values are text; prefix with 0x for hex bytes."""
@@ -323,6 +328,20 @@ class Cli:
         for line in chaos_status_lines():
             self._print(line)
 
+    def do_lint(self, args: List[str]) -> int:
+        """Static invariant check (docs/static_analysis.md): run the
+        fdbtpu-lint checkers over the repo — cluster-less, pure AST (never
+        imports jax), args pass straight through to the lint CLI.  Returns
+        the lint exit status so one-shot `cli lint` fails CI exactly like
+        `python -m foundationdb_tpu.tools.lint` does."""
+        from .lint import CHECKERS
+        from .lint.core import main as lint_main
+
+        rc = lint_main(CHECKERS, argv=list(args), out=self.out)
+        if rc:
+            self._print("lint: FINDINGS (see above)")
+        return rc
+
     def do_trace(self, args: List[str]) -> None:
         """Distributed-trace workflows (docs/observability.md "Distributed
         tracing"): validate+summarize an exported Chrome trace JSON, or
@@ -576,6 +595,14 @@ class Cli:
 
 def main(argv=None) -> int:
     import argparse
+
+    raw = list(sys.argv[1:] if argv is None else argv)
+    if raw and raw[0].replace("-", "_") == "lint":
+        # before argparse: lint owns its own flags (--json, --rules, ...)
+        # and never needs a cluster
+        cli = Cli.__new__(Cli)
+        cli.out = sys.stdout
+        return cli.do_lint(raw[1:])
 
     ap = argparse.ArgumentParser(description="cli over a simulated cluster")
     ap.add_argument("--seed", type=int, default=0)

@@ -1,0 +1,43 @@
+"""fdbtpu-lint: AST-based invariant checker (docs/static_analysis.md).
+
+Six checkers over a shared file-cache/policy core, each front-running a
+dynamic assertion the campaigns otherwise only catch one seed at a time:
+
+===============  ========================================================
+rule             front-runs
+===============  ========================================================
+determinism      seed-replay parity (bit-identical journal replay)
+host-sync        blocking_syncs == 0 + pack/dispatch overlap
+donation         drain-before-host-touch on the donated interval table
+recompile        zero steady-state compiles (EnginePerf.compiles pin)
+knob-drift       --knob override surface + documented capacity model
+span-registry    telescoping latency sum identity (max_sum_err SLO)
+===============  ========================================================
+
+    python -m foundationdb_tpu.tools.lint [--json] [--rules a,b] [paths]
+"""
+from .core import (DEFAULT_POLICY, Checker, FileCtx, Finding, LintResult,
+                   RulePolicy, load_baseline, main, run_lint, write_baseline)
+from .determinism import DeterminismChecker
+from .donation import DonationChecker
+from .host_sync import HostSyncChecker
+from .knob_drift import KnobDriftChecker
+from .recompile import RecompileChecker
+from .span_registry import SpanRegistryChecker
+
+#: the pluggable registry: construct once, shared by __main__, the cli
+#: subcommand and the tests.  Adding a rule = one module + one row here.
+CHECKERS = (
+    DeterminismChecker(),
+    HostSyncChecker(),
+    DonationChecker(),
+    RecompileChecker(),
+    KnobDriftChecker(),
+    SpanRegistryChecker(),
+)
+
+__all__ = [
+    "CHECKERS", "Checker", "DEFAULT_POLICY", "FileCtx", "Finding",
+    "LintResult", "RulePolicy", "load_baseline", "main", "run_lint",
+    "write_baseline",
+]

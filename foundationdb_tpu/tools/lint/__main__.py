@@ -1,0 +1,8 @@
+"""`python -m foundationdb_tpu.tools.lint` — run the invariant checkers."""
+import sys
+
+from . import CHECKERS
+from .core import main
+
+if __name__ == "__main__":
+    sys.exit(main(CHECKERS))

@@ -1,0 +1,130 @@
+"""Rule `donation`: reads of donated buffers between dispatch and drain.
+
+Front-runs: the drain-before-host-touch contract on the donated interval
+table (ops/device_loop.py `drain_loop()`; `donate_state_kwargs`).  A
+dispatched program OWNS its donated input — XLA may already have reused
+the buffer — so a host read of the donated name between the dispatch and
+the matching drain races buffer reuse.  The seed round learned this the
+hard way (glibc double free on CPU with donated deserialized-cache
+programs); dynamically it only surfaces as memory corruption on specific
+backends, which is exactly why it wants a static check.
+
+Within each function body, statements are walked in source order
+(nested ``def``s are their own scope, not part of the flow):
+
+- a call whose callee name contains ``dispatch`` / ``enqueue`` or is a
+  compiled-program handle (``prog``) ARMS the check — reads inside the
+  trigger statement itself (the dispatch's own arguments, including the
+  canonical ``self.state, out = prog(self.state, ...)`` re-binding) are
+  the sanctioned hand-off;
+- while armed, a LOAD of a donated name (``state`` by policy) flags;
+- a call to ``force`` / ``drain_loop`` / ``_drain_through`` / ``clear``
+  disarms (the engine-side barrier ran).
+
+Heuristic scope: branches are walked linearly, so a drain inside an
+``if`` arm disarms the fall-through too — the rule aims at the straight-
+line dispatch bodies the engines actually use (fixture-proven in
+tests/test_lint.py).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator, List, Optional
+
+from .core import Checker, FileCtx, Finding, RulePolicy
+
+
+def _last_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _linear_stmts(body: List[ast.stmt]) -> Iterator[ast.stmt]:
+    """Statements in source order, recursing into compound bodies but NOT
+    into nested function/class scopes."""
+    for s in body:
+        yield s
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            continue
+        for attr in ("body", "orelse", "finalbody"):
+            sub = getattr(s, attr, None)
+            if sub:
+                yield from _linear_stmts(sub)
+        for h in getattr(s, "handlers", ()) or ():
+            yield from _linear_stmts(h.body)
+
+
+class DonationChecker(Checker):
+    rule = "donation"
+    description = "donated-buffer reads between dispatch/enqueue and drain"
+    fronts = "drain-before-host-touch on the donated interval table"
+
+    def check(self, ctx: FileCtx, policy: RulePolicy) -> Iterable[Finding]:
+        opts = policy.options
+        donated = tuple(opts.get("donated", ("state",)))
+        triggers = tuple(opts.get("triggers", ("dispatch", "enqueue", "prog")))
+        drains = tuple(opts.get("drains",
+                                ("force", "drain_loop", "_drain_through",
+                                 "clear")))
+        out: List[Finding] = []
+
+        def stmt_nodes(s: ast.stmt) -> List[ast.AST]:
+            """All nodes of a statement, excluding nested def/class bodies."""
+            nodes: List[ast.AST] = []
+            stack: List[ast.AST] = [s]
+            while stack:
+                n = stack.pop()
+                nodes.append(n)
+                for ch in ast.iter_child_nodes(n):
+                    if isinstance(ch, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                       ast.ClassDef, ast.Lambda)):
+                        continue
+                    stack.append(ch)
+            return nodes
+
+        def classify(s: ast.stmt):
+            is_trigger = is_drain = False
+            reads: List[ast.AST] = []
+            for n in stmt_nodes(s):
+                if isinstance(n, ast.Call):
+                    name = _last_name(n.func)
+                    if name is not None:
+                        if name in drains:
+                            is_drain = True
+                        if name == "prog" and "prog" in triggers:
+                            is_trigger = True
+                        elif any(t in name for t in triggers if t != "prog"):
+                            is_trigger = True
+                if isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Load) \
+                        and n.attr in donated:
+                    reads.append(n)
+                elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                        and n.id in donated:
+                    reads.append(n)
+            return is_trigger, is_drain, reads
+
+        for fn in ctx.functions:
+            armed_at: Optional[int] = None
+            for s in _linear_stmts(fn.body):
+                is_trigger, is_drain, reads = classify(s)
+                if is_drain:
+                    armed_at = None
+                    continue
+                if armed_at is not None and reads:
+                    r = reads[0]
+                    nm = r.attr if isinstance(r, ast.Attribute) else r.id
+                    out.append(Finding(
+                        self.rule, ctx.rel, s.lineno,
+                        f"read of donated buffer `{nm}` after the dispatch "
+                        f"on line {armed_at} with no intervening drain — "
+                        "the dispatched program owns the donated input and "
+                        "XLA may have reused the buffer; call drain_loop()/"
+                        "force() first (docs/static_analysis.md#donation)"))
+                    armed_at = None   # one finding per window is actionable
+                if is_trigger:
+                    armed_at = s.lineno
+        return out

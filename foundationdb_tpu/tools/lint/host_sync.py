@@ -1,0 +1,97 @@
+"""Rule `host-sync`: implicit device→host syncs in the dispatch path.
+
+Front-runs: the ``blocking_syncs == 0`` SLO (ops/device_loop.py
+``loop_stats``, asserted by the chaos campaigns and `make bench-smoke`)
+and the async-dispatch overlap the pipeline's throughput depends on — one
+stray ``np.asarray(x_dev)`` in a dispatch-path function forces the host
+to park inside a device sync, re-serializing the pack/dispatch overlap
+that the latency attribution prices.
+
+Flags, inside dispatch-path modules (``ops/``, ``pipeline/`` by policy),
+outside drain points:
+
+- ``.block_until_ready()`` on anything;
+- ``.item()`` on anything (scalar readback is always a sync);
+- ``np.asarray(x)`` / ``np.array(x)`` / ``float(x)`` / ``bool(x)`` where
+  ``x`` terminates in a device-resident name per the codebase convention
+  (``*_dev`` / ``*_device`` — ops/device_loop.py tickets).
+
+A *drain point* is where syncing is the contract: a function named in the
+policy's sanctioned set (``force`` / ``drain_loop`` / ``_drain_through``)
+or one annotated ``# fdbtpu-lint: drain-point <why>`` on (or directly
+above) its ``def`` line.  Enclosing drain points cover nested helpers.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from .core import Checker, FileCtx, Finding, RulePolicy
+
+#: qualified numpy converters that force a device value to host
+_NUMPY_SYNCS = ("numpy.asarray", "numpy.array")
+
+
+def _terminal_name(e: ast.AST) -> Optional[str]:
+    """The identifier an expression 'ends in': `ticket.commit_dev` ->
+    commit_dev, `out_dev[:n]` -> out_dev, `x` -> x."""
+    while isinstance(e, (ast.Subscript, ast.Starred)):
+        e = e.value
+    if isinstance(e, ast.Attribute):
+        return e.attr
+    if isinstance(e, ast.Name):
+        return e.id
+    return None
+
+
+class HostSyncChecker(Checker):
+    rule = "host-sync"
+    description = "implicit device->host syncs outside drain points"
+    fronts = "blocking_syncs == 0 (loop_stats SLO) + pack/dispatch overlap"
+
+    def check(self, ctx: FileCtx, policy: RulePolicy) -> Iterable[Finding]:
+        opts = policy.options
+        drain_names = tuple(opts.get("drain_names",
+                                     ("force", "drain_loop", "_drain_through")))
+        suffixes = tuple(opts.get("device_suffixes", ("_dev", "_device")))
+        out: List[Finding] = []
+
+        def in_drain(node: ast.AST) -> bool:
+            return any(ctx.is_drain_function(fn, drain_names)
+                       for fn in ctx.enclosing_funcs(node))
+
+        def device_ish(e: ast.AST) -> bool:
+            name = _terminal_name(e)
+            return name is not None and name.endswith(suffixes)
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            hit: Optional[str] = None
+            if isinstance(f, ast.Attribute) and f.attr == "block_until_ready":
+                hit = ".block_until_ready() is an explicit blocking sync"
+            elif isinstance(f, ast.Attribute) and f.attr == "item" \
+                    and not node.args:
+                hit = ".item() forces a scalar device readback"
+            elif isinstance(f, ast.Name) and f.id in ("float", "bool") \
+                    and len(node.args) == 1 and device_ish(node.args[0]):
+                hit = (f"{f.id}() of a device value "
+                       f"(`{_terminal_name(node.args[0])}`) blocks on the "
+                       "device")
+            else:
+                q = ctx.qual_of(f)
+                if q in _NUMPY_SYNCS and node.args \
+                        and device_ish(node.args[0]):
+                    hit = (f"np.{f.attr}() of a device value "
+                           f"(`{_terminal_name(node.args[0])}`) blocks on "
+                           "the device")
+            if hit is None or in_drain(node):
+                continue
+            out.append(Finding(
+                self.rule, ctx.rel, node.lineno,
+                f"{hit} in a dispatch-path module outside a drain point — "
+                "move it behind force()/drain_loop(), or annotate the "
+                "function `# fdbtpu-lint: drain-point <why>` if syncing is "
+                "its contract (docs/static_analysis.md#host-sync)"))
+        return out

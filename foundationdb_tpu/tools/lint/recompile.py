@@ -1,0 +1,100 @@
+"""Rule `recompile`: Python scalars / data-dependent shapes into jits.
+
+Front-runs: the zero-steady-state-compile guarantee (`EnginePerf.compiles`
+pinned post-warmup by tests/test_bucket_ladder.py and `make bench-smoke`'s
+jax-monitoring counter).  A bare Python scalar traced into a jitted entry
+point specializes the program per VALUE, and a data-dependent slice
+specializes it per SHAPE — each new batch size then pays a full XLA
+compile in the serving path, precisely what the bucket ladder
+(`KernelConfig.bucket`) exists to prevent.
+
+Flags, inside dispatch-path modules (``ops/``, ``pipeline/`` by policy),
+at calls of compiled-program handles (local names in the policy's
+``entries`` set — the codebase idiom is ``prog = self._program(...);
+prog(state, ...)``) or of a ``jax.jit(...)`` result invoked directly:
+
+- an argument containing a bare ``len(...)`` (a per-batch Python scalar:
+  one compile per distinct value);
+- an argument that is a slice with a non-constant bound
+  (``buf[:n]`` — one compile per distinct shape).
+
+Routing the value through an array wrapper (``np.int32(c)``,
+``jnp.asarray(...)``) or the bucket ladder's fixed shapes is the fix —
+wrapped subtrees are pruned, so ``prog(state, np.int32(len(xs)))`` is
+clean.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from .core import Checker, FileCtx, Finding, RulePolicy
+
+
+def _last_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+class RecompileChecker(Checker):
+    rule = "recompile"
+    description = "unbucketed Python scalars / dynamic shapes into jitted entries"
+    fronts = "zero steady-state compiles (EnginePerf.compiles post-warmup)"
+
+    def check(self, ctx: FileCtx, policy: RulePolicy) -> Iterable[Finding]:
+        opts = policy.options
+        entries = tuple(opts.get("entries", ("prog", "program", "compiled")))
+        wrappers = tuple(opts.get("wrappers",
+                                  ("int32", "int64", "float32", "asarray",
+                                   "array", "full", "zeros",
+                                   "ShapeDtypeStruct")))
+        out: List[Finding] = []
+
+        def is_entry_call(call: ast.Call) -> bool:
+            f = call.func
+            if isinstance(f, ast.Name) and f.id in entries:
+                return True
+            # jax.jit(fn)(args...): calling the jit result directly
+            if isinstance(f, ast.Call) and ctx.qual_of(f.func) == "jax.jit":
+                return True
+            return False
+
+        def hazards(e: ast.AST) -> Iterable[ast.AST]:
+            """Hazard nodes in an argument expression, pruning wrapped
+            subtrees (an array wrapper makes the scalar a traced value)."""
+            if isinstance(e, ast.Call):
+                name = _last_name(e.func)
+                if name in wrappers:
+                    return
+                if isinstance(e.func, ast.Name) and e.func.id == "len":
+                    yield e
+                    return
+            if isinstance(e, ast.Subscript):
+                sl = e.slice
+                if isinstance(sl, ast.Slice) and any(
+                        b is not None and not isinstance(b, ast.Constant)
+                        for b in (sl.lower, sl.upper)):
+                    yield e
+            for ch in ast.iter_child_nodes(e):
+                yield from hazards(ch)
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not is_entry_call(node):
+                continue
+            args = list(node.args) + [k.value for k in node.keywords]
+            for a in args:
+                for h in hazards(a):
+                    what = ("bare `len(...)` (one compile per distinct "
+                            "value)" if isinstance(h, ast.Call)
+                            else "data-dependent slice (one compile per "
+                                 "distinct shape)")
+                    out.append(Finding(
+                        self.rule, ctx.rel, h.lineno,
+                        f"{what} flows into a jitted entry point — route "
+                        "through the KernelConfig.bucket ladder or wrap as "
+                        "a traced array scalar (np.int32(...)) "
+                        "(docs/static_analysis.md#recompile)"))
+        return out

@@ -1,0 +1,108 @@
+"""Rule `span-registry`: segment names at Span sites must be registered.
+
+Front-runs: the telescoping sum identity of the latency attribution
+(pipeline/latency_harness.py ``ATTRIBUTION_SEGMENTS``: named segments sum
+EXACTLY to client-observed latency, machine-asserted by
+tests/test_trace_spans.py and the chaos campaigns' ``max_sum_err``).  A
+new ``span_event("resolver.<seg>", ...)`` whose segment is not in the
+registry silently lands in the ``resolve_overhead`` residual — the
+identity still "holds" numerically while the attribution quietly stops
+naming where the time went.
+
+Flags: span sites (``span`` / ``span_event`` / ``Span`` calls) whose name
+argument is a string constant (conditional expressions check both arms)
+with a policy prefix (``resolver.`` / ``engine.`` / ``pipeline.``) whose
+final dotted component is not in ``ATTRIBUTION_SEGMENTS``.  The registry
+is read from the latency harness by AST — the linter never imports the
+package (no jax).  Dynamically-built names (f-strings, concatenation)
+are outside the rule; give such sites an unprefixed process name or a
+constant.
+"""
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from .core import Checker, FileCtx, Finding, RulePolicy
+
+
+def _parse_registry(path: Path, name: str) -> Optional[Tuple[str, ...]]:
+    """The ATTRIBUTION_SEGMENTS tuple, by AST (no package import)."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    try:
+                        val = ast.literal_eval(node.value)
+                    except (ValueError, SyntaxError):
+                        return None
+                    if isinstance(val, (tuple, list)):
+                        return tuple(str(v) for v in val)
+    return None
+
+
+def _const_strings(e: ast.AST) -> Iterable[str]:
+    """String constants an expression can evaluate to: plain constants and
+    both arms of conditional expressions.  Dynamic names yield nothing."""
+    if isinstance(e, ast.Constant) and isinstance(e.value, str):
+        yield e.value
+    elif isinstance(e, ast.IfExp):
+        yield from _const_strings(e.body)
+        yield from _const_strings(e.orelse)
+
+
+class SpanRegistryChecker(Checker):
+    rule = "span-registry"
+    description = "Span segment names outside ATTRIBUTION_SEGMENTS"
+    fronts = "telescoping latency sum identity (max_sum_err SLO)"
+    repo_level = True
+
+    def check_repo(self, root: Path, ctxs: Sequence[FileCtx],
+                   policy: RulePolicy) -> Iterable[Finding]:
+        opts = policy.options
+        reg_path = root / opts.get(
+            "registry_file", "foundationdb_tpu/pipeline/latency_harness.py")
+        if not reg_path.exists():
+            return []        # fixture tree without the harness
+        registry = _parse_registry(
+            reg_path, opts.get("registry_name", "ATTRIBUTION_SEGMENTS"))
+        if registry is None:
+            return [Finding(
+                self.rule,
+                reg_path.relative_to(root).as_posix(), 1,
+                "ATTRIBUTION_SEGMENTS is no longer a literal tuple — the "
+                "span-registry rule cannot read it "
+                "(docs/static_analysis.md#span-registry)")]
+        segs = set(registry)
+        prefixes = tuple(opts.get("prefixes",
+                                  ("resolver.", "engine.", "pipeline.")))
+        span_calls = set(opts.get("span_calls",
+                                  ("span", "span_event", "Span", "subspan")))
+        out: List[Finding] = []
+        for ctx in ctxs:
+            if not policy.applies(ctx.rel):
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                f = node.func
+                fname = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else None)
+                if fname not in span_calls:
+                    continue
+                for s in _const_strings(node.args[0]):
+                    if not s.startswith(prefixes) or "." not in s:
+                        continue
+                    seg = s.rsplit(".", 1)[1]
+                    if seg not in segs:
+                        out.append(Finding(
+                            self.rule, ctx.rel, node.lineno,
+                            f"span segment `{s}` is not in "
+                            "ATTRIBUTION_SEGMENTS — its time lands in the "
+                            "resolve_overhead residual and the attribution "
+                            "silently stops naming it; register the segment "
+                            "in pipeline/latency_harness.py "
+                            "(docs/static_analysis.md#span-registry)"))
+        return out
