@@ -46,6 +46,24 @@ heat-smoke:
 trace-smoke:
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.trace_smoke
 
+# Performance-observatory smoke (docs/observability.md "Performance
+# observatory", ~30s CPU): the compile & memory ledger populates on
+# warmup with analysis fields, sampled device timing stays observational
+# (abort parity on/off, blocking_syncs == 0, zero post-warmup compiles
+# with sampling baked in) and lands within sanity bounds of the
+# loop-floor figure, and bench_history parses every committed
+# BENCH_r*.json with the regression gate green.
+perf-smoke:
+	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.perf_smoke
+
+# Bench-artifact trend gate (docs/observability.md "Performance
+# observatory"): per-section trend tables over the committed BENCH_r*.json
+# series with noise-aware verdicts — >10% regressions on headline metrics
+# against the previous SAME-PLATFORM artifact fail, naming the section
+# and metric. Cluster-less; `cli bench-history` is the same run.
+bench-history:
+	python -m foundationdb_tpu.tools.bench_history
+
 # Static invariant check (docs/static_analysis.md, ~2s, pure AST — never
 # imports jax): determinism, host-sync discipline, donation safety,
 # recompile hazards, knob/doc drift, span registry. Non-zero on any
@@ -71,4 +89,4 @@ chaos-real:
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.cli \
 		chaos-status chaos_real_report.json
 
-.PHONY: check bench bench-smoke telemetry-smoke heat-smoke trace-smoke chaos chaos-real lint
+.PHONY: check bench bench-smoke telemetry-smoke heat-smoke trace-smoke chaos chaos-real lint perf-smoke bench-history

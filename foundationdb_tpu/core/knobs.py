@@ -214,6 +214,22 @@ def _make_server_knobs() -> Knobs:
     #: shards the aggregator proposes equal-load split points for — the
     #: measured input to multi-chip key-range sharding (ROADMAP item 1)
     k.init("resolver_heat_split_shards", 8)
+    # Performance observatory (docs/observability.md "Performance
+    # observatory"). Deliberately no BUGGIFY randomizers: both layers are
+    # observational (the ledger reads analysis off already-compiled
+    # artifacts; sampling is counter-based and draws no rng), and a
+    # randomizer draw would shift every sim's rng stream.
+    #: per-compile records the engine's PerfLedger ring retains
+    #: (core/perfledger.py: build duration + cost_analysis flops/bytes +
+    #: memory_analysis peak HBM per (bucket, search mode, dispatch mode))
+    k.init("resolver_perf_ledger_size", 128)
+    #: fraction of dispatches that record a measured enqueue->ready
+    #: device interval on the already-non-blocking drain paths (step
+    #: force, fused scans, device-loop poll) — 1/rate rounds to a
+    #: deterministic 1-in-N cadence, no rng; 0 disables. Abort sets are
+    #: bit-identical on/off (tests/test_perf_ledger.py); engines take a
+    #: `device_time_sample_rate=` constructor override.
+    k.init("resolver_device_time_sample_rate", 0.0625)
     # Wall-clock chaos (real/chaos.py; docs/real_cluster.md). Defaults for
     # the seeded NetworkNemesis' background fault mix — a campaign's
     # ChaosConfig reads these so `--knob`-style overrides steer injection

@@ -1,0 +1,174 @@
+"""Compile & memory ledger: every device-program build, priced.
+
+The serving path's compile story was scattered until now: `EnginePerf`
+counted HOW MANY programs an engine built (`compiles`, the
+zero-steady-state-compile guard) and `warmup_ms` said what the whole
+warmup cost, but nothing recorded what each compile WAS — which (bucket,
+history-search mode, dispatch mode) shape, how long the build took, and
+what the compiled artifact costs to run: XLA's own `cost_analysis()`
+flops/bytes-accessed estimate and `memory_analysis()` peak-memory
+breakdown (argument + output + temp + alias bytes — the HBM the program
+pins while it runs). Those numbers are the before/after evidence the
+EngineSpec refactor and the PAM-style history table (ROADMAP items 2-3)
+need, and the per-compile durations are exactly the rewarm bill the
+chaos campaigns price at 3x budget on every ResilientEngine swap-back.
+
+`PerfLedger` is a bounded ring of per-compile records plus running
+totals, registered with the telemetry hub like every other source
+(`perf.<label>.*` series -> the `fdbtpu_perf` Prometheus family), riding
+engine_health -> ratekeeper -> CC status doc -> `tools/cli.py perf`,
+which joins it with the PR 11 `state_bytes` pressure gauge into one
+memory view. Recording draws no rng and costs two dict updates — the
+analysis is read off the ALREADY-compiled artifact, never triggering a
+compile itself — so the layer is observational by construction.
+
+Sampled device timing lives next door (ops/host_engine.py): the
+`resolver_device_time_sample_rate` knob makes every Nth dispatch stamp
+its enqueue time and record the enqueue->ready wall interval when its
+results land on the ALREADY-non-blocking drain paths (step force, fused
+scans, the device loop's `poll()`); `sample_every_from_rate` converts
+the knob's fraction into that deterministic 1-in-N cadence (counter
+based — no rng draw, so enabling sampling can never shift a simulation's
+random stream, and abort sets are bit-identical either way).
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+#: ledger-ring size fallback when the knob registry is unavailable
+DEFAULT_LEDGER_SIZE = 128
+
+#: the per-record fields every ledger row carries (tests pin the schema;
+#: analysis fields may be None when the backend exposes no analysis —
+#: e.g. jit-warm mesh programs, where the build is not an AOT artifact)
+RECORD_FIELDS = ("engine", "bucket", "n_chunks", "search_mode",
+                 "dispatch_mode", "kind", "duration_ms", "flops",
+                 "bytes_accessed", "peak_bytes", "generated_code_bytes")
+
+
+def ledger_size_from_knobs() -> int:
+    from .knobs import SERVER_KNOBS
+
+    try:
+        return int(getattr(SERVER_KNOBS, "resolver_perf_ledger_size"))
+    except (AttributeError, TypeError, ValueError):
+        return DEFAULT_LEDGER_SIZE
+
+
+def sample_every_from_rate(rate: Optional[float]) -> int:
+    """The `resolver_device_time_sample_rate` knob (or a constructor
+    override) as a deterministic 1-in-N dispatch cadence: 0 disables
+    (returns 0), otherwise every `round(1/rate)`-th dispatch is sampled
+    (1.0 -> every dispatch). Counter-based on purpose — a rng draw here
+    would shift every simulation's random stream for a knob that only
+    reads clocks."""
+    if rate is None:
+        from .knobs import SERVER_KNOBS
+
+        rate = float(getattr(SERVER_KNOBS, "resolver_device_time_sample_rate",
+                             0.0) or 0.0)
+    rate = float(rate)
+    if rate <= 0.0:
+        return 0
+    return max(1, round(1.0 / min(rate, 1.0)))
+
+
+def analyze_compiled(compiled: Any) -> Dict[str, Optional[int]]:
+    """Cost/memory analysis off an already-compiled jax artifact:
+    `cost_analysis()` flops + bytes accessed, `memory_analysis()` peak
+    device bytes (argument + output + temp + alias — what the program
+    pins in HBM while it runs) and generated-code size. Every field is
+    None when the handle is not an AOT artifact (jit-warm mesh programs)
+    or the backend withholds the analysis; reading the analysis never
+    compiles anything."""
+    out: Dict[str, Optional[int]] = {"flops": None, "bytes_accessed": None,
+                                     "peak_bytes": None,
+                                     "generated_code_bytes": None}
+    try:
+        ca = compiled.cost_analysis()
+        d = ca[0] if isinstance(ca, (list, tuple)) else ca
+        if d:
+            if d.get("flops") is not None:
+                out["flops"] = int(d["flops"])
+            if d.get("bytes accessed") is not None:
+                out["bytes_accessed"] = int(d["bytes accessed"])
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        peak = 0
+        for f in ("argument_size_in_bytes", "output_size_in_bytes",
+                  "temp_size_in_bytes", "alias_size_in_bytes"):
+            peak += int(getattr(ma, f, 0) or 0)
+        out["peak_bytes"] = peak
+        out["generated_code_bytes"] = int(
+            getattr(ma, "generated_code_size_in_bytes", 0) or 0)
+    except Exception:
+        pass
+    return out
+
+
+class PerfLedger:
+    """Bounded ring of per-compile records + running totals for one
+    engine (registered per engine like EnginePerf, so a process hosting
+    several engines keeps their compile bills apart)."""
+
+    def __init__(self, size: Optional[int] = None):
+        self.records: deque = deque(maxlen=size if size is not None
+                                    else ledger_size_from_knobs())
+        #: compile counts / total build ms split by kind ("warmup" =
+        #: inside warmup()/ensure_warm, "steady" = a serving-path build —
+        #: the compile-stall the AOT ladder exists to prevent)
+        self.compiles: Dict[str, int] = {}
+        self.compile_ms: Dict[str, float] = {}
+        #: max peak_bytes over every analyzed record — the engine's
+        #: largest single-program HBM pin
+        self.peak_bytes = 0
+        self.flops_total = 0
+        self.bytes_accessed_total = 0
+
+    def record_compile(self, *, engine: str, bucket: int, n_chunks: int,
+                       search_mode: str, dispatch_mode: str, kind: str,
+                       duration_ms: float,
+                       compiled: Any = None,
+                       analysis: Optional[Dict[str, Optional[int]]] = None
+                       ) -> dict:
+        """File one program build. `compiled` (preferred) is analyzed in
+        place; `analysis` lets callers pass a precomputed dict."""
+        if analysis is None:
+            analysis = (analyze_compiled(compiled) if compiled is not None
+                        else {"flops": None, "bytes_accessed": None,
+                              "peak_bytes": None,
+                              "generated_code_bytes": None})
+        rec = {"engine": engine, "bucket": int(bucket),
+               "n_chunks": int(n_chunks), "search_mode": search_mode,
+               "dispatch_mode": dispatch_mode, "kind": kind,
+               "duration_ms": round(float(duration_ms), 3), **analysis}
+        self.records.append(rec)
+        self.compiles[kind] = self.compiles.get(kind, 0) + 1
+        self.compile_ms[kind] = (self.compile_ms.get(kind, 0.0)
+                                 + float(duration_ms))
+        if analysis.get("peak_bytes"):
+            self.peak_bytes = max(self.peak_bytes, analysis["peak_bytes"])
+        if analysis.get("flops"):
+            self.flops_total += analysis["flops"]
+        if analysis.get("bytes_accessed"):
+            self.bytes_accessed_total += analysis["bytes_accessed"]
+        return rec
+
+    def rows(self) -> List[dict]:
+        return list(self.records)
+
+    def snapshot(self, max_rows: int = 16) -> dict:
+        """The status-document fragment (engine_health -> ratekeeper ->
+        CC status doc -> `cli perf`): totals plus the newest rows."""
+        return {
+            "compiles": dict(sorted(self.compiles.items())),
+            "compile_ms": {k: round(v, 1)
+                           for k, v in sorted(self.compile_ms.items())},
+            "peak_bytes": self.peak_bytes,
+            "flops_total": self.flops_total,
+            "bytes_accessed_total": self.bytes_accessed_total,
+            "rows": list(self.records)[-max_rows:],
+        }

@@ -63,6 +63,9 @@ class TelemetryHub:
         #: label -> weakref to KeyRangeHeatAggregator (core/heatmap.py —
         #: keyspace heat, occupancy headroom, split planning)
         self._heat: Dict[str, "weakref.ref"] = {}
+        #: label -> weakref to PerfLedger (core/perfledger.py — compile &
+        #: memory ledger: build durations, flops/bytes, peak HBM)
+        self._perf_ledgers: Dict[str, "weakref.ref"] = {}
         self._seq = 0
         #: bounded ring of recent nemesis/chaos events (real/chaos.py,
         #: real/nemesis.py) — rendered by `tools/cli.py chaos-status`
@@ -94,6 +97,15 @@ class TelemetryHub:
         sync-accounting counters, synced as `loop.<label>.*` series."""
         label = self._label("loop", name)
         self._loops[label] = weakref.ref(engine)
+        return label
+
+    def register_perf_ledger(self, ledger, name: str = "perf") -> str:
+        """An engine's compile & memory ledger (core/perfledger.py):
+        warmup/steady compile counts and durations, cost-analysis
+        flops/bytes and peak compiled-program HBM, synced as
+        `perf.<label>.*` series (the `fdbtpu_perf` Prometheus family)."""
+        label = self._label("perf", name)
+        self._perf_ledgers[label] = weakref.ref(ledger)
         return label
 
     def register_heat(self, aggregator, name: str = "heat") -> str:
@@ -174,6 +186,16 @@ class TelemetryHub:
             # previously only visible per batch in status_of
             for kind, n in getattr(perf, "verdicts", {}).items():
                 td.int64(f"engine.{label}.verdicts.{kind}").set(n)
+            # sampled measured device timing (docs/observability.md
+            # "Performance observatory"): mean per-chunk enqueue->ready
+            # microseconds and sample counts per bucket
+            if getattr(perf, "device_time", None):
+                for b, ms in perf.device_time_ms_by_bucket().items():
+                    td.int64(f"engine.{label}.device_time_us.{b}").set(
+                        int(ms * 1000))
+                for b, d in perf.device_time.items():
+                    td.int64(f"engine.{label}.device_time_samples.{b}").set(
+                        int(d["samples"]))
         for label, b in self._live(self._batchers):
             # EWMAs are floats; the Int64 series stores microseconds so the
             # persisted change history stays integral. Keys are per
@@ -205,6 +227,20 @@ class TelemetryHub:
             td.int64(f"loop.{label}.ring_depth").set(eng.ring_depth())
             td.int64(f"loop.{label}.slots_in_flight").set(
                 eng.slots_in_flight())
+        for label, led in self._live(self._perf_ledgers):
+            # compile & memory ledger (core/perfledger.py): warmup/steady
+            # compile counts + total build time, the cost-analysis
+            # totals, and the largest single-program HBM pin — the
+            # `fdbtpu_perf` exposition family
+            for kind in ("warmup", "steady"):
+                td.int64(f"perf.{label}.compiles_{kind}").set(
+                    led.compiles.get(kind, 0))
+                td.int64(f"perf.{label}.compile_us_{kind}").set(
+                    int(led.compile_ms.get(kind, 0.0) * 1000))
+            td.int64(f"perf.{label}.peak_hbm_bytes").set(led.peak_bytes)
+            td.int64(f"perf.{label}.flops_total").set(led.flops_total)
+            td.int64(f"perf.{label}.bytes_accessed_total").set(
+                led.bytes_accessed_total)
         for label, agg in self._live(self._heat):
             # keyspace heat & occupancy (core/heatmap.py): contention
             # concentration, table headroom and GC pressure as integer
@@ -237,6 +273,8 @@ class TelemetryHub:
                       for label, eng in self._live(self._loops)},
             "heat": {label: agg.snapshot()
                      for label, agg in self._live(self._heat)},
+            "perf_ledgers": {label: led.snapshot()
+                             for label, led in self._live(self._perf_ledgers)},
         }
 
     #: per-family HELP strings for the exposition (families are the first
@@ -253,6 +291,9 @@ class TelemetryHub:
                 "(ops/device_loop.py; blocking_syncs must be 0)",
         "heat": "keyspace heat & history-occupancy gauges "
                 "(core/heatmap.py; fractions are x1000 fixed-point)",
+        "perf": "compile & memory ledger gauges (core/perfledger.py: "
+                "warmup/steady compile counts and microseconds, "
+                "cost-analysis totals, peak compiled-program HBM bytes)",
         "chaos": "injected nemesis fault events (real/chaos.py)",
         "demo": "demo KV per-op counters (real/demo_server.py)",
     }

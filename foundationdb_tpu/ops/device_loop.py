@@ -136,7 +136,7 @@ class _LoopTicket:
 
     __slots__ = ("commit_dev", "too_dev", "ov_dev", "heat_dev", "heat_base",
                  "heat_version", "n_txns", "n_chunks", "slot", "status",
-                 "overflow", "done")
+                 "overflow", "done", "sample")
 
     def __init__(self, commit_dev, too_dev, ov_dev, n_txns: int,
                  n_chunks: int, slot: "_LoopSlot", heat_dev=None,
@@ -155,6 +155,9 @@ class _LoopTicket:
         self.status: Optional[np.ndarray] = None
         self.overflow = False
         self.done = False
+        #: sampled device timing (t0_wall, t0_span, version) or None —
+        #: stamped at enqueue, recorded when _finish sees the results
+        self.sample = None
 
     def ready(self) -> bool:
         """Non-blocking: have this slot's abort bitmaps (and heat planes,
@@ -227,6 +230,7 @@ class DeviceLoopEngine(JaxConflictEngine):
                  arena: bool = True,
                  history_search: Optional[str] = None,
                  heat_buckets: Optional[int] = None,
+                 device_time_sample_rate: Optional[float] = None,
                  queue_slots: int = 4,
                  queue_depth: int = 2,
                  drain_deadline_s: float = 5.0):
@@ -247,11 +251,16 @@ class DeviceLoopEngine(JaxConflictEngine):
                            #: what bench.py injects as the sim service's
                            #: queue_enqueue_ms / result_drain_ms
                            "enqueue_ms": 0.0, "decode_ms": 0.0}
+        #: armed by _dispatch_sampled for the ticket the next
+        #: _dispatch_unit creates (sampled device timing: readiness is
+        #: discovered in poll()/_finish, so the ticket carries the stamp)
+        self._sample_pending = None
         super().__init__(loop_kernel_config(cfg),
                          initial_version=initial_version, ladder=ladder,
                          scan_sizes=(), arena=arena,
                          history_search=history_search,
-                         heat_buckets=heat_buckets)
+                         heat_buckets=heat_buckets,
+                         device_time_sample_rate=device_time_sample_rate)
         # the loop's queue/ring gauges flow into the unified telemetry hub
         # (docs/observability.md): `loop.<label>.*` series alongside the
         # EnginePerf counters the base class registered above
@@ -288,9 +297,10 @@ class DeviceLoopEngine(JaxConflictEngine):
         key = (bucket.max_txns, -1)
         prog = self._programs.get(key)
         if prog is None:
-            prog = self._make_program(bucket, self.queue_slots)
+            # _build_and_record times the build and files it in the
+            # compile & memory ledger exactly like the step engines
+            prog = self._build_and_record(bucket, self.queue_slots)
             self._programs[key] = prog
-            self.perf.compiles += 1
         return prog
 
     def _make_program(self, bucket: KernelConfig, n_chunks: int):
@@ -327,6 +337,8 @@ class DeviceLoopEngine(JaxConflictEngine):
                              out["overflow"], bucket.max_txns, C, slot,
                              heat_dev=out.get("heat"), heat_base=self.base,
                              heat_version=self._heat_version)
+        ticket.sample = self._sample_pending
+        self._sample_pending = None
         slot.ticket = ticket
         self._ring.append(ticket)
         self.loop_stats["units"] += 1
@@ -339,6 +351,21 @@ class DeviceLoopEngine(JaxConflictEngine):
             return ticket.status, ticket.overflow
 
         return force
+
+    def _dispatch_sampled(self, bucket: KernelConfig, per_chunks):
+        """Loop-mode sampled device timing: the enqueue stamp rides the
+        TICKET and is recorded in _finish — when the non-blocking drain
+        actually sees the results — not at force() time, which in steady
+        state runs long after the results landed in the ring."""
+        from ..core.trace import g_spans, span_now
+
+        self._sample_pending = (time.perf_counter(),
+                                span_now() if g_spans.enabled else 0.0,
+                                self._heat_version)
+        try:
+            return self._dispatch_unit(bucket, per_chunks)
+        finally:
+            self._sample_pending = None
 
     def _acquire_slot(self, bucket: KernelConfig) -> _LoopSlot:
         slot = self._pool.acquire(bucket)
@@ -404,6 +431,15 @@ class DeviceLoopEngine(JaxConflictEngine):
                 version=ticket.heat_version, base=ticket.heat_base,
                 layout="c")
         self.loop_stats["decode_ms"] += (time.perf_counter() - t_dec) * 1e3
+        if ticket.sample is not None:
+            # sampled enqueue->ready interval: the results were ALREADY
+            # ready when this drain decoded them, so the clock reads add
+            # no sync — the loop's zero-blocking-sync contract holds with
+            # sampling enabled (tests/test_perf_ledger.py pins it)
+            t0_wall, t0_span, version = ticket.sample
+            ticket.sample = None
+            self._record_device_sample(ticket.n_txns, ticket.n_chunks,
+                                       t0_wall, t0_span, version)
         ticket.done = True
         if ticket.slot.ticket is ticket:
             ticket.slot.ticket = None

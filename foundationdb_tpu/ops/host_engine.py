@@ -477,6 +477,10 @@ class EnginePerf:
     #: status_of and never aggregated anywhere (docs/observability.md
     #: "Keyspace heat & occupancy")
     verdicts: Dict[str, int] = field(default_factory=dict)
+    #: sampled enqueue->ready device timing per bucket T (the
+    #: `resolver_device_time_sample_rate` knob; docs/observability.md
+    #: "Performance observatory"): {T: {samples, chunks, ms_total}}
+    device_time: Dict[int, Dict[str, float]] = field(default_factory=dict)
     warmup_ms: float = 0.0
     warmed: bool = False
     #: flight recorder (docs/observability.md): a bounded ring of recent
@@ -499,6 +503,28 @@ class EnginePerf:
     def record_dispatch_mode(self, mode: str, chunks: int) -> None:
         self.dispatch_mode_hits[mode] = (
             self.dispatch_mode_hits.get(mode, 0) + chunks)
+
+    def record_device_time(self, bucket: int, ms: float,
+                           chunks: int = 1) -> None:
+        """Fold one SAMPLED dispatch unit's measured enqueue->ready wall
+        interval into the per-bucket accumulators (docs/observability.md
+        "Performance observatory"). The interval covers `chunks` fused
+        chunks, so the per-chunk mean is what compares against injected
+        per-bucket device times; it is an upper bound on device time —
+        exact when the host was waiting on the unit, inflated by host
+        slack when results sat ready in a ring before the drain looked."""
+        d = self.device_time.setdefault(
+            bucket, {"samples": 0, "chunks": 0, "ms_total": 0.0})
+        d["samples"] += 1
+        d["chunks"] += chunks
+        d["ms_total"] += float(ms)
+
+    def device_time_ms_by_bucket(self) -> Dict[int, float]:
+        """Mean measured per-CHUNK device ms per bucket over every
+        sample — the measured figure `latency_attribution` reports
+        alongside the sim's injected per-bucket times."""
+        return {b: round(d["ms_total"] / d["chunks"], 4)
+                for b, d in self.device_time.items() if d["chunks"]}
 
     def record_verdicts(self, status) -> None:
         """Fold one batch's final statuses (any int iterable / np array of
@@ -525,6 +551,11 @@ class EnginePerf:
             "search_mode_hits": dict(sorted(self.search_mode_hits.items())),
             "dispatch_mode_hits": dict(sorted(self.dispatch_mode_hits.items())),
             "verdicts": dict(sorted(self.verdicts.items())),
+            "device_time_ms": {str(b): v for b, v in
+                               sorted(self.device_time_ms_by_bucket().items())},
+            "device_time_samples": {
+                str(b): d["samples"]
+                for b, d in sorted(self.device_time.items())},
             "warmup_ms": round(self.warmup_ms, 1),
             "warmed": self.warmed,
             "recent_dispatches": len(self.recent),
@@ -574,7 +605,8 @@ class RoutedConflictEngineBase:
                  scan_sizes: Sequence[int] = (2, 4, 8),
                  arena: bool = True,
                  history_search: Optional[str] = None,
-                 heat_buckets: Optional[int] = None):
+                 heat_buckets: Optional[int] = None,
+                 device_time_sample_rate: Optional[float] = None):
         # Subclasses seed their device state (incl. any initial version, as a
         # base-relative offset) via _reset_device_state.
         cfg = self._resolve_history_search(cfg, history_search)
@@ -610,6 +642,19 @@ class RoutedConflictEngineBase:
             bucket_hits={b.max_txns: 0 for b in self.buckets},
             search_modes={b.max_txns: ck.resolved_history_search(b)
                           for b in self.buckets})
+        # compile & memory ledger (core/perfledger.py): every program
+        # build recorded with duration + cost/memory analysis; "warmup"
+        # vs "steady" classified by the flag warmup() holds
+        from ..core import perfledger
+
+        self.perf_ledger = perfledger.PerfLedger()
+        self._warming = False
+        # sampled enqueue->ready device timing (docs/observability.md
+        # "Performance observatory"): deterministic 1-in-N dispatch
+        # cadence, no rng; 0 = off
+        self._sample_every = perfledger.sample_every_from_rate(
+            device_time_sample_rate)
+        self._dispatch_seq = 0
         self.arena: Optional[HostPackArena] = HostPackArena() if arena else None
         # keyspace-heat aggregator (core/heatmap.py): merges the device's
         # per-batch heat aggregates; None when the layer is off — the
@@ -625,6 +670,7 @@ class RoutedConflictEngineBase:
         from ..core import telemetry
 
         telemetry.hub().register_engine_perf(self.perf, name=self.name)
+        telemetry.hub().register_perf_ledger(self.perf_ledger, name=self.name)
         if self.heat is not None:
             telemetry.hub().register_heat(self.heat, name=self.name)
 
@@ -755,9 +801,27 @@ class RoutedConflictEngineBase:
         key = (bucket.max_txns, n_chunks)
         prog = self._programs.get(key)
         if prog is None:
-            prog = self._make_program(bucket, n_chunks)
+            prog = self._build_and_record(bucket, n_chunks)
             self._programs[key] = prog
-            self.perf.compiles += 1
+        return prog
+
+    def _build_and_record(self, bucket: KernelConfig, n_chunks: int):
+        """Build one program, bump the compile counter, and file the
+        build in the compile & memory ledger (core/perfledger.py):
+        duration plus the compiled artifact's cost/memory analysis, keyed
+        (bucket, search mode, dispatch mode), classified warmup vs
+        steady by the flag warmup() holds."""
+        t0 = time.perf_counter()
+        prog = self._make_program(bucket, n_chunks)
+        self.perf.compiles += 1
+        self.perf_ledger.record_compile(
+            engine=self.name, bucket=bucket.max_txns, n_chunks=n_chunks,
+            search_mode=self.perf.search_modes.get(
+                bucket.max_txns, ck.resolved_history_search(bucket)),
+            dispatch_mode=self.dispatch_mode,
+            kind="warmup" if self._warming else "steady",
+            duration_ms=(time.perf_counter() - t0) * 1e3,
+            compiled=prog)
         return prog
 
     def _make_program(self, bucket: KernelConfig, n_chunks: int):
@@ -775,10 +839,14 @@ class RoutedConflictEngineBase:
         path can dispatch, so steady state never hits a compile stall.
         Idempotent; returns self for chaining."""
         t0 = time.perf_counter()
-        for b in (buckets if buckets is not None else self.buckets):
-            for c in (1,) + tuple(scan_sizes if scan_sizes is not None
-                                  else self._scan_sizes):
-                self._warm_program(b, c, self._program(b, c))
+        self._warming = True
+        try:
+            for b in (buckets if buckets is not None else self.buckets):
+                for c in (1,) + tuple(scan_sizes if scan_sizes is not None
+                                      else self._scan_sizes):
+                    self._warm_program(b, c, self._program(b, c))
+        finally:
+            self._warming = False
         self.perf.warmup_ms += (time.perf_counter() - t0) * 1e3
         self.perf.warmed = True
         return self
@@ -830,6 +898,69 @@ class RoutedConflictEngineBase:
         status = np.stack([np.asarray(s) for s, _ in results])
         overflow = any(bool(o) for _, o in results)
         return lambda: (status, overflow)
+
+    # -- sampled device timing (docs/observability.md "Performance
+    # -- observatory") -------------------------------------------------------
+    def _sample_next_dispatch(self) -> bool:
+        """Deterministic 1-in-N sampling decision for the next dispatch
+        unit (counter-based — no rng, so sampling can never perturb a
+        seeded simulation or the abort stream)."""
+        if not self._sample_every:
+            return False
+        self._dispatch_seq += 1
+        return self._dispatch_seq % self._sample_every == 0
+
+    def _sampled_unit(self, bucket: KernelConfig,
+                      per_chunks: List[List[Dict[str, np.ndarray]]]):
+        """_dispatch_unit, with the sampled fraction of units timed
+        enqueue->ready. The measurement rides the EXISTING drain paths —
+        a step unit's force() already blocks on its outputs, a loop
+        ticket's readiness is already probed non-blockingly — so sampling
+        adds two clock reads and no device sync anywhere."""
+        if not self._sample_next_dispatch():
+            return self._dispatch_unit(bucket, per_chunks)
+        return self._dispatch_sampled(bucket, per_chunks)
+
+    def _dispatch_sampled(self, bucket: KernelConfig,
+                          per_chunks: List[List[Dict[str, np.ndarray]]]):
+        """Step-family implementation: stamp the enqueue, record when the
+        unit's force() returns (its outputs just landed). The loop engine
+        overrides this to stamp the ticket instead — its results become
+        ready in poll()/_finish, long before force() may be called."""
+        from ..core.trace import g_spans, span_now
+
+        version = self._heat_version
+        t0_span = span_now() if g_spans.enabled else 0.0
+        t0 = time.perf_counter()
+        unit = self._dispatch_unit(bucket, per_chunks)
+        chunks = len(per_chunks)
+
+        def force() -> Tuple[np.ndarray, bool]:
+            out = unit()
+            self._record_device_sample(bucket.max_txns, chunks, t0, t0_span,
+                                       version)
+            return out
+
+        return force
+
+    def _record_device_sample(self, bucket_txns: int, chunks: int,
+                              t0_wall: float, t0_span: float,
+                              version) -> None:
+        ms = (time.perf_counter() - t0_wall) * 1e3
+        self.perf.record_device_time(bucket_txns, ms, chunks=chunks)
+        from ..core.trace import g_spans, span_event, span_now
+
+        if g_spans.enabled:
+            # the measured device interval as its own span: the Chrome
+            # export renders `track="device"` spans on a separate device
+            # track next to the host spans (tools/trace_export.py); the
+            # segment is registered in ATTRIBUTION_SEGMENTS as an OVERLAY
+            # — it overlaps device_dispatch/device_resident, so the
+            # attribution excludes it from the partition sum
+            span_event("engine.device_time", version, t0_span, span_now(),
+                       device_ms=round(ms, 4), bucket=bucket_txns,
+                       chunks=chunks, track="device",
+                       parent="resolver.queue_wait")
 
     def _run_detect(self, per_shard: List[Dict[str, np.ndarray]]):
         """Phases 1-2; returns an opaque device context for _run_fix/_run_apply."""
@@ -1187,7 +1318,7 @@ class RoutedConflictEngineBase:
             self.perf.record_dispatch_mode(self.dispatch_mode, len(run))
             for c in self._split_run(len(run)):
                 sub, run = run[:c], run[c:]
-                unit = self._dispatch_unit(bucket, [ch[0] for ch in sub])
+                unit = self._sampled_unit(bucket, [ch[0] for ch in sub])
                 self.perf.scan_dispatches[c] = (
                     self.perf.scan_dispatches.get(c, 0) + 1)
                 rec = self.perf.record_dispatch(
@@ -1468,10 +1599,12 @@ class SubshardedConflictEngine(RoutedConflictEngineBase):
                  scan_sizes: Sequence[int] = (2, 4, 8),
                  arena: bool = True,
                  history_search: Optional[str] = None,
-                 heat_buckets: Optional[int] = None):
+                 heat_buckets: Optional[int] = None,
+                 device_time_sample_rate: Optional[float] = None):
         super().__init__(cfg, shards, ladder=ladder, scan_sizes=scan_sizes,
                          arena=arena, history_search=history_search,
-                         heat_buckets=heat_buckets)
+                         heat_buckets=heat_buckets,
+                         device_time_sample_rate=device_time_sample_rate)
         cfg = self.cfg   # base resolved the history-search mode into it
         self._reset_device_state(initial_version)
         self.tier_map = VersionIntervalMap(initial_version)
@@ -1566,11 +1699,13 @@ class JaxConflictEngine(RoutedConflictEngineBase):
                  scan_sizes: Sequence[int] = (2, 4, 8),
                  arena: bool = True,
                  history_search: Optional[str] = None,
-                 heat_buckets: Optional[int] = None):
+                 heat_buckets: Optional[int] = None,
+                 device_time_sample_rate: Optional[float] = None):
         super().__init__(cfg, KeyShardMap([]), ladder=ladder,
                          scan_sizes=scan_sizes, arena=arena,
                          history_search=history_search,
-                         heat_buckets=heat_buckets)
+                         heat_buckets=heat_buckets,
+                         device_time_sample_rate=device_time_sample_rate)
         cfg = self.cfg   # base resolved the history-search mode into it
         self.state = ck.initial_state(cfg, version_rel=initial_version)
         self.tier_map = VersionIntervalMap(initial_version)

@@ -205,6 +205,7 @@ class ShardedConflictEngine(RoutedConflictEngineBase):
         arena: bool = True,
         history_search=None,
         heat_buckets=None,
+        device_time_sample_rate=None,
     ):
         if mesh is None:
             devs = jax.devices()
@@ -214,7 +215,8 @@ class ShardedConflictEngine(RoutedConflictEngineBase):
         super().__init__(cfg, shards or KeyShardMap.uniform(n_devices),
                          ladder=ladder, scan_sizes=scan_sizes, arena=arena,
                          history_search=history_search,
-                         heat_buckets=heat_buckets)
+                         heat_buckets=heat_buckets,
+                         device_time_sample_rate=device_time_sample_rate)
         cfg = self.cfg   # base resolved the history-search mode into it
         assert self.n_shards == n_devices
         self.mesh = mesh

@@ -146,7 +146,19 @@ ATTRIBUTION_SEGMENTS = (
     "meta_drain",        # proxy phase 3.5: metadata stream drain
     "log_push",          # proxy phase 4: tlog push (+ logging order wait)
     "reply_net",         # phase 5 reply delivery back to the client
+    "device_time",       # OVERLAY: sampled measured enqueue->ready device
+                         # interval (ops/host_engine.py, the
+                         # resolver_device_time_sample_rate knob) — its
+                         # own Chrome device track; overlaps
+                         # device_dispatch/device_resident, so the
+                         # partition sum excludes it (OVERLAY_SEGMENTS)
 )
+
+#: segments that are measured OVERLAYS of the partition, not members of
+#: it: they ride the attribution tables and the Chrome export but are
+#: excluded from the telescoping sum — including them would double-count
+#: the device interval they overlap and break the sum identity.
+OVERLAY_SEGMENTS = ("device_time",)
 
 
 def _attribute(records, by_trace) -> Optional[dict]:
@@ -198,6 +210,9 @@ def _attribute(records, by_trace) -> Optional[dict]:
             "log_push": tr["proxy.log_push"],
         }
         seg["reply_net"] = lat - sum(seg.values())
+        # overlay segments join AFTER the partition closed over reply_net:
+        # they are reported, never summed (OVERLAY_SEGMENTS)
+        seg["device_time"] = tr.get("engine.device_time", 0.0)
         rows.append((lat, seg))
     if not rows:
         return None
@@ -210,7 +225,14 @@ def _attribute(records, by_trace) -> Optional[dict]:
         segs = {k: sum(s[k] for _, s in sel) / len(sel) * 1e3
                 for k in ATTRIBUTION_SEGMENTS}
         client = sum(l for l, _ in sel) / len(sel) * 1e3
-        total = sum(segs.values())
+        total = sum(v for k, v in segs.items()
+                    if k not in OVERLAY_SEGMENTS)
+        for k in OVERLAY_SEGMENTS:
+            # an overlay nobody measured is not a 0ms measurement — the
+            # sim harness injects device time and emits no engine spans,
+            # so a structural 0.0 row would read as a (wrong) figure
+            if not segs.get(k):
+                segs.pop(k, None)
         return {
             "client_ms": round(client, 4),
             "segments_ms": {k: round(v, 4) for k, v in segs.items()},
@@ -227,7 +249,9 @@ def _attribute(records, by_trace) -> Optional[dict]:
             "client_ms": round(sum(l for l, _ in rows) / len(rows) * 1e3, 4),
             "segments_ms": {
                 k: round(sum(s[k] for _, s in rows) / len(rows) * 1e3, 4)
-                for k in ATTRIBUTION_SEGMENTS},
+                for k in ATTRIBUTION_SEGMENTS
+                if k not in OVERLAY_SEGMENTS
+                or any(s[k] for _, s in rows)},
         },
     }
 

@@ -178,6 +178,21 @@ class Resolver:
             perf = getattr(getattr(self.engine, "device", None), "perf", None)
         if perf is not None:
             tel["engine_perf"] = perf.as_dict()
+        # compile & memory ledger (core/perfledger.py): per-compile
+        # durations + flops/bytes/peak-HBM ride the same poll, joined by
+        # `tools/cli.py perf` with the state-memory gauge below into one
+        # memory view
+        ledger = getattr(self.engine, "perf_ledger", None)
+        if ledger is None:
+            ledger = getattr(getattr(self.engine, "device", None),
+                             "perf_ledger", None)
+        if ledger is not None:
+            tel["perf_ledger"] = ledger.snapshot()
+        if sb is not None:
+            # mirrored into the telemetry fragment so `cli perf` renders
+            # the whole memory story from one status-doc subtree
+            tel["state_bytes"] = sb
+            tel["state_memory_pressure"] = out["state_memory_pressure"]
         if self._service is not None and self._service.batcher is not None:
             tel["batcher"] = self._service.batcher.as_dict()
         flight = getattr(self.engine, "flight", None)

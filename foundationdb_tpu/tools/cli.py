@@ -38,6 +38,15 @@ commands:
                        abort-cause split, budget-batcher EWMAs
                        (docs/observability.md)
   telemetry read PROCESS METRIC   read a persisted \\xff/metrics/ series
+  perf [json]          performance observatory: compile & memory ledger
+                       (warmup/steady compile counts + durations, flops,
+                       peak compiled-program HBM), the state-memory
+                       gauge, and sampled measured device timing — one
+                       memory/compile view per resolver
+                       (docs/observability.md "Performance observatory")
+  bench-history [ARGS...]  BENCH_r*.json trend tables + the noise-aware
+                       regression gate (cluster-less; args pass through,
+                       e.g. `bench-history --json`)
   heat [json|FILE.json]  keyspace heat & history occupancy: top hot
                        ranges, occupancy headroom, suggested equal-load
                        shard split points — live from the cluster's
@@ -172,8 +181,26 @@ class Cli:
             if perf:
                 hits = ", ".join(f"{k}:{v}" for k, v in
                                  sorted(perf.get("bucket_hits", {}).items()))
-                self._print(f"    engine   - compiles {perf.get('compiles')}, "
-                            f"warmed {perf.get('warmed')}, bucket hits {{{hits}}}")
+                scans = ", ".join(f"{k}:{v}" for k, v in
+                                  sorted(perf.get("scan_dispatches",
+                                                  {}).items()))
+                # warmup_ms + the compile/scan counters (collected since
+                # PR 3) on the same line as the bucket histogram, so one
+                # glance says what was compiled, when, and what it served
+                self._print(f"    engine   - compiles {perf.get('compiles')} "
+                            f"(warmup {perf.get('warmup_ms', 0):.0f}ms, "
+                            f"warmed {perf.get('warmed')}), "
+                            f"bucket hits {{{hits}}}, scans {{{scans}}}")
+                dtm = perf.get("device_time_ms") or {}
+                if dtm:
+                    # bucket keys are stringified ints: sort numerically
+                    # or 128 renders before 64
+                    sampled = ", ".join(
+                        f"{k}:{v}ms" for k, v in
+                        sorted(dtm.items(), key=lambda kv: int(kv[0])))
+                    ns = sum((perf.get("device_time_samples") or {}).values())
+                    self._print(f"    devtime  - sampled {{{sampled}}} "
+                                f"({ns} samples)")
                 modes = perf.get("search_mode_hits") or {}
                 if modes:
                     picks = ", ".join(f"{k}:{v}" for k, v in
@@ -202,6 +229,94 @@ class Cli:
             if "flight_recorder_entries" in frag:
                 self._print(f"    flightrec- {frag['flight_recorder_entries']} "
                             "recent dispatch records")
+
+    @staticmethod
+    def _mib(n) -> str:
+        return f"{n / (1 << 20):.1f} MiB"
+
+    def do_perf(self, args: List[str]) -> None:
+        """Performance observatory (docs/observability.md "Performance
+        observatory"): the compile & memory ledger, the PR 11
+        state-memory gauge and the sampled measured device timing,
+        joined into one per-resolver view off the status document."""
+        from ..core.knobs import SERVER_KNOBS
+
+        doc = self._drive(self.db.get_status())
+        if doc is None:
+            self._print("status unavailable (no cluster controller reachable)")
+            return
+        tel = (doc.get("qos") or {}).get("resolver_telemetry") or {}
+        if args and args[0] == "json":
+            self._print(json.dumps(
+                {addr: {"perf_ledger": frag.get("perf_ledger"),
+                        "state_bytes": frag.get("state_bytes"),
+                        "state_memory_pressure":
+                            frag.get("state_memory_pressure"),
+                        "device_time_ms": (frag.get("engine_perf") or {})
+                            .get("device_time_ms")}
+                 for addr, frag in tel.items()},
+                indent=2, sort_keys=True))
+            return
+        rendered = 0
+        limit = int(SERVER_KNOBS.resolver_state_memory_limit)
+        for addr in sorted(tel):
+            frag = tel.get(addr) or {}
+            ledger = frag.get("perf_ledger")
+            sb = frag.get("state_bytes")
+            if ledger is None and sb is None:
+                continue
+            rendered += 1
+            self._print(f"  resolver {addr}:")
+            if sb is not None:
+                pressure = ("PRESSURE"
+                            if frag.get("state_memory_pressure") else "ok")
+                line = (f"    memory   - state {self._mib(sb)} / "
+                        f"limit {self._mib(limit)} ({pressure})")
+                if ledger and ledger.get("peak_bytes"):
+                    line += (f", peak compiled-program HBM "
+                             f"{self._mib(ledger['peak_bytes'])}")
+                self._print(line)
+            if ledger:
+                comp = ledger.get("compiles") or {}
+                ms = ledger.get("compile_ms") or {}
+                self._print(
+                    f"    compiles - warmup {comp.get('warmup', 0)} "
+                    f"({ms.get('warmup', 0):.0f}ms), "
+                    f"steady {comp.get('steady', 0)} "
+                    f"({ms.get('steady', 0):.0f}ms), "
+                    f"flops {ledger.get('flops_total', 0):.3g}, "
+                    f"bytes {ledger.get('bytes_accessed_total', 0):.3g}")
+                for r in (ledger.get("rows") or [])[-8:]:
+                    peak = (self._mib(r["peak_bytes"])
+                            if r.get("peak_bytes") else "n/a")
+                    self._print(
+                        f"      [{r.get('kind'):>6}] T={r.get('bucket')} "
+                        f"x{r.get('n_chunks')} {r.get('search_mode')}/"
+                        f"{r.get('dispatch_mode')} "
+                        f"{r.get('duration_ms', 0):.0f}ms "
+                        f"peak {peak}")
+            dtm = (frag.get("engine_perf") or {}).get("device_time_ms") or {}
+            if dtm:
+                sampled = ", ".join(
+                    f"{k}:{v}ms" for k, v in
+                    sorted(dtm.items(), key=lambda kv: int(kv[0])))
+                self._print(f"    devtime  - sampled per-bucket {{{sampled}}}")
+        if not rendered:
+            self._print("no perf-observatory telemetry yet (oracle engines, "
+                        "or the cluster is still seeding)")
+
+    def do_bench_history(self, args: List[str]) -> int:
+        """BENCH_r*.json trend tables + regression gate (cluster-less;
+        docs/observability.md "Performance observatory"). Args pass
+        through to tools/bench_history.py, and the gate's exit status is
+        returned so one-shot `cli bench-history` fails CI exactly like
+        `make bench-history`."""
+        from . import bench_history
+
+        rc = bench_history.main(argv=list(args), out=self.out)
+        if rc:
+            self._print("bench-history: GATE FAILURES (see above)")
+        return rc
 
     def _render_heat(self, label: str, heat: dict) -> None:
         """One engine's keyspace-heat snapshot (core/heatmap.py layout)."""
@@ -603,6 +718,12 @@ def main(argv=None) -> int:
         cli = Cli.__new__(Cli)
         cli.out = sys.stdout
         return cli.do_lint(raw[1:])
+    if raw and raw[0].replace("-", "_") == "bench_history":
+        # same pre-argparse pass-through: the trend gate owns its flags
+        # (--json, --threshold, --dir) and reads artifacts, not a cluster
+        cli = Cli.__new__(Cli)
+        cli.out = sys.stdout
+        return cli.do_bench_history(raw[1:])
 
     ap = argparse.ArgumentParser(description="cli over a simulated cluster")
     ap.add_argument("--seed", type=int, default=0)

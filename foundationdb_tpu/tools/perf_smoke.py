@@ -1,0 +1,146 @@
+"""`make perf-smoke`: CPU-backend performance-observatory check, ~30s,
+so the observatory's wiring breaks loudly in CI rather than only at the
+next recorded bench (docs/observability.md "Performance observatory").
+
+Asserts, at toy sizes:
+
+  * **compile & memory ledger** — warmup populates the ring with one
+    record per built program, every record carries the (bucket, search
+    mode, dispatch mode, kind) key and a duration, and the CPU backend's
+    cost/memory analysis lands (flops + peak bytes non-null);
+  * **sampling is observational** — abort sets are bit-identical with
+    device-time sampling off vs at 100%, the loop engine's
+    `blocking_syncs` stays 0 with sampling enabled, and the steady-state
+    drive triggers ZERO compiles on the real jax-monitoring counter with
+    sampling baked in;
+  * **sampled timing sanity** — the sampled enqueue→ready per-batch ms
+    lands within a (generous, shared-CI-box) factor of the loop_floor
+    host-time figure measured in the same process over the same stream:
+    the two are different quantities (device interval vs host wall), but
+    an order-of-magnitude disagreement means a stamp is on the wrong
+    side of a drain;
+  * **trend gate** — tools/bench_history.py parses every committed
+    BENCH_r*.json and the regression gate is green.
+
+Prints one JSON line; any failed check exits non-zero.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main() -> int:
+    import numpy as np
+
+    from foundationdb_tpu.core import perfledger
+    from foundationdb_tpu.ops import conflict_kernel as ck
+    from foundationdb_tpu.ops.device_loop import DeviceLoopEngine
+    from foundationdb_tpu.ops.host_engine import JaxConflictEngine
+    from foundationdb_tpu.tools.floor_bench import (_CompileCounter,
+                                                    run_loop_floor)
+    from foundationdb_tpu.tools.ladder_bench import make_point_txns
+
+    failures = []
+    cfg = ck.KernelConfig(key_words=4, capacity=2048, max_txns=128,
+                          max_point_reads=256, max_point_writes=256,
+                          max_reads=32, max_writes=32)
+
+    # -- ledger populated on warmup, schema + analysis fields ---------------
+    eng = JaxConflictEngine(cfg, ladder=[32, 64], scan_sizes=(2,),
+                            device_time_sample_rate=1.0).warmup()
+    rows = eng.perf_ledger.rows()
+    if len(rows) != eng.perf.compiles:
+        failures.append(f"ledger rows {len(rows)} != compiles "
+                        f"{eng.perf.compiles}")
+    for r in rows:
+        missing = [f for f in perfledger.RECORD_FIELDS if f not in r]
+        if missing:
+            failures.append(f"ledger record missing fields {missing}")
+            break
+        if r["kind"] != "warmup":
+            failures.append(f"warmup build recorded as {r['kind']!r}")
+            break
+    if rows and (rows[0]["flops"] is None or not rows[0]["peak_bytes"]):
+        failures.append("CPU cost/memory analysis missing from ledger "
+                        f"(flops={rows[0]['flops']}, "
+                        f"peak={rows[0]['peak_bytes']})")
+
+    # -- sampling observational: on/off abort parity, zero compiles ---------
+    off = JaxConflictEngine(cfg, ladder=[32, 64], scan_sizes=(2,),
+                            device_time_sample_rate=0.0).warmup()
+    loop_on = DeviceLoopEngine(cfg, ladder=[32, 64],
+                               device_time_sample_rate=1.0).warmup()
+    rng = np.random.default_rng(13)
+    counter = _CompileCounter()
+    version = 2_000
+    parity = True
+    for _ in range(2):
+        for n in (16, 31, 32, 33, 64, 65, 128, 250):
+            txns = make_point_txns(n, 256, rng, version)
+            version += max(64, n)
+            new_oldest = max(0, version - 100_000)
+            got = [int(x) for x in eng.resolve(txns, version, new_oldest)]
+            want = [int(x) for x in off.resolve(txns, version, new_oldest)]
+            lgot = [int(x) for x in loop_on.resolve(txns, version, new_oldest)]
+            if got != want or lgot != want:
+                parity = False
+    loop_on.drain_loop()
+    steady = counter.close()
+    if not parity:
+        failures.append("sampling on/off abort-set parity failed")
+    if steady is None:
+        failures.append("jax compile counter unavailable")
+    elif steady:
+        failures.append(f"{steady} post-warmup compiles with sampling on")
+    if loop_on.loop_stats["blocking_syncs"]:
+        failures.append(
+            f"{loop_on.loop_stats['blocking_syncs']} blocking syncs with "
+            "sampling enabled (want 0)")
+    sampled = eng.perf.device_time_ms_by_bucket()
+    loop_sampled = loop_on.perf.device_time_ms_by_bucket()
+    if not sampled or not loop_sampled:
+        failures.append("100% sampling produced no device-time samples "
+                        f"(step={sampled}, loop={loop_sampled})")
+
+    # -- sampled timing within sanity bounds of the loop_floor figure -------
+    floor = run_loop_floor(cfg, n_batches=8, pool=256)
+    top = cfg.max_txns
+    sample_ms = loop_sampled.get(top) or max(loop_sampled.values(), default=0)
+    step_ms = floor["step_host_ms_per_batch"]
+    if sample_ms and step_ms:
+        ratio = sample_ms / step_ms
+        if not (0.02 <= ratio <= 50.0):
+            failures.append(
+                f"sampled device ms {sample_ms:.3f} implausible vs "
+                f"loop_floor step host ms {step_ms:.3f} (ratio {ratio:.2f})")
+    if floor["loop_stats"]["blocking_syncs"]:
+        failures.append("loop_floor drive hit blocking syncs")
+
+    # -- the trend gate parses + passes on the committed series -------------
+    from foundationdb_tpu.tools import bench_history
+
+    try:
+        series = bench_history.load_series(bench_history.find_repo_root())
+        trends = bench_history.build_trends(series)
+        if not series:
+            failures.append("no BENCH_r*.json artifacts found")
+        elif not trends["ok"]:
+            failures.append(f"bench_history gate red: {trends['failures']}")
+    except Exception as e:  # noqa: BLE001 — the smoke must name the break
+        failures.append(f"bench_history failed: {type(e).__name__}: {e}")
+        trends = None
+
+    out = {"metric": "perf_smoke", "ok": not failures, "failures": failures,
+           "ledger_rows": len(rows),
+           "steady_state_compiles": steady,
+           "sampled_step_ms": sampled, "sampled_loop_ms": loop_sampled,
+           "loop_floor_step_host_ms": floor["step_host_ms_per_batch"],
+           "loop_floor_loop_host_ms": floor["loop_host_ms_per_batch"],
+           "artifacts": len(series) if trends else 0}
+    print(json.dumps(out))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,12 +1,25 @@
-"""Regenerate README.md's measured-performance block from the latest BENCH JSON.
+"""Regenerate README.md's measured-performance block from the BENCH series.
 
 The README's headline numbers drifted from the recorded bench artifacts
 (round 5 claimed 6.9M extrapolated / 1.17 compute ratio vs the recorded
 6.30M / 1.379) because the bullets were hand-edited. This script makes the
 block generated: the text between the `<!-- BENCH:begin -->` /
-`<!-- BENCH:end -->` markers is rewritten from the newest `BENCH_r*.json`
-(or an explicit `--bench PATH`), so the prose can never disagree with the
-artifact again.
+`<!-- BENCH:end -->` markers is rewritten from the committed
+`BENCH_r*.json` series, so the prose can never disagree with the
+artifacts again.
+
+Since round 6 the series spans PLATFORMS: r01–r05 were recorded on the
+tunneled TPU, r06 on the CPU backend (the chip tunnel is gone from the
+recording box). Each bullet therefore renders from the **newest artifact
+that records its section**, with chip-measured bullets (the single-chip
+headline, weak scaling, the serial latency curve) pinned to the newest
+ACCELERATOR artifact — a CPU-profile artifact contributes the sections
+the chip artifact never recorded (loop floor, chaos serving, heat,
+compile/memory ledger) without overwriting chip numbers with CPU ones.
+Every bullet carries its source round (and `· cpu` when applicable), and
+bullets grow **trend arrows** against the previous artifact of the SAME
+platform (tools/bench_history.py owns the comparison rules; cross-
+platform deltas are never rendered as trends).
 
     python -m foundationdb_tpu.tools.readme_perf            # rewrite README
     python -m foundationdb_tpu.tools.readme_perf --check    # exit 1 on drift
@@ -18,6 +31,9 @@ import json
 import re
 import sys
 from pathlib import Path
+from typing import List, Optional, Tuple
+
+from . import bench_history as bh
 
 BEGIN = "<!-- BENCH:begin -->"
 END = "<!-- BENCH:end -->"
@@ -31,19 +47,13 @@ def find_repo_root() -> Path:
     raise SystemExit("repo root (README.md + bench.py) not found")
 
 
-def latest_bench(root: Path) -> Path:
+def load_artifacts(root: Path) -> List[Tuple[str, dict]]:
+    """(name, parsed) for every committed BENCH_r*.json, oldest first."""
     benches = sorted(root.glob("BENCH_r*.json"),
                      key=lambda p: int(re.search(r"r(\d+)", p.stem).group(1)))
     if not benches:
         raise SystemExit("no BENCH_r*.json found")
-    return benches[-1]
-
-
-def load_parsed(path: Path) -> dict:
-    d = json.loads(path.read_text())
-    # driver artifacts wrap the bench's JSON line under "parsed"; a raw
-    # `python bench.py` capture is the metrics object itself
-    return d.get("parsed", d)
+    return [(p.name, bh.load_parsed(p)) for p in benches]
 
 
 def fmt_m(x: float) -> str:
@@ -51,19 +61,91 @@ def fmt_m(x: float) -> str:
     return f"{x / 1e6:.2f}M"
 
 
-def render(m: dict, source: str) -> str:
-    chip = fmt_m(m["value"])
+class _Series:
+    """Source selection + trend arrows over the artifact list."""
+
+    def __init__(self, artifacts: List[Tuple[str, dict]]):
+        self.artifacts = artifacts
+        self.platforms = [bh.platform_of(p) for _, p in artifacts]
+        self.rounds = [
+            (m.group(0) if (m := re.search(r"r(\d+)", name)) else name)
+            for name, _ in artifacts]
+
+    def newest(self, pred, chip_pinned: bool = False) -> Optional[int]:
+        """Index of the newest artifact satisfying `pred`; chip-pinned
+        bullets prefer the newest non-CPU artifact so a CPU-profile
+        round never overwrites chip-measured numbers."""
+        idxs = [i for i, (_, p) in enumerate(self.artifacts) if pred(p)]
+        if not idxs:
+            return None
+        if chip_pinned:
+            accel = [i for i in idxs if self.platforms[i] != "cpu"]
+            if accel:
+                return accel[-1]
+        return idxs[-1]
+
+    def tag(self, i: int) -> str:
+        """Per-bullet source annotation: '*(r05)*' / '*(r06 · cpu)*'."""
+        plat = self.platforms[i]
+        suffix = " · cpu" if plat == "cpu" else ""
+        return f" *({self.rounds[i]}{suffix})*"
+
+    def arrow(self, i: int, section: str, path: str,
+              higher_is_better: bool = True) -> str:
+        """' — ↑ +4.2% vs r04' against the previous SAME-platform
+        artifact recording the metric; '' when there is none (first
+        artifact on its platform) or the value is flat to 2 decimals."""
+        cur = bh.extract_path(self.artifacts[i][1], section, path)
+        if cur is None:
+            return ""
+        prev_i = next(
+            (j for j in reversed(range(i))
+             if self.platforms[j] == self.platforms[i]
+             and bh.extract_path(self.artifacts[j][1], section, path)
+             is not None),
+            None)
+        if prev_i is None:
+            return ""
+        prev = bh.extract_path(self.artifacts[prev_i][1], section, path)
+        change = bh.pct_change(prev, cur)
+        if change is None:
+            return ""
+        better = change > 0 if higher_is_better else change < 0
+        if abs(change) < 0.005:
+            glyph = "→"
+        else:
+            glyph = "↑" if better else "↓"
+        return (f" — {glyph} {change * 100:+.1f}% "
+                f"vs {self.rounds[prev_i]}")
+
+
+def render(artifacts: List[Tuple[str, dict]]) -> str:
+    s = _Series(artifacts)
+    sources = ", ".join(f"{name} [{plat}]"
+                        for (name, _), plat in zip(artifacts, s.platforms))
     lines = [
         BEGIN,
-        f"<!-- generated by tools/readme_perf.py from {source}; edit there -->",
-        f"- single chip: **{chip} resolved txn/s** sustained "
-        f"({m['device_ms_per_batch']:.2f} ms / {m['batch_txns']}-txn batch "
-        f"device time), ~{m['vs_native_cpu']:.1f}× the C++ engine on one CPU "
-        "core",
+        f"<!-- generated by tools/readme_perf.py from {sources}; "
+        "edit there -->",
     ]
-    ws = m.get("sharded_tpu_weak_scale")
-    mesh = m.get("sharded_cpu_mesh")
-    if ws and mesh:
+
+    i = s.newest(lambda m: m.get("value") is not None, chip_pinned=True)
+    if i is not None:
+        m = artifacts[i][1]
+        chip = fmt_m(m["value"])
+        lines += [
+            f"- single chip: **{chip} resolved txn/s** sustained "
+            f"({m['device_ms_per_batch']:.2f} ms / {m['batch_txns']}-txn "
+            "batch device time), "
+            f"~{m['vs_native_cpu']:.1f}× the C++ engine on one CPU core"
+            + s.arrow(i, "", "value") + s.tag(i),
+        ]
+
+    i = s.newest(lambda m: m.get("sharded_tpu_weak_scale")
+                 and m.get("sharded_cpu_mesh"), chip_pinned=True)
+    if i is not None:
+        m = artifacts[i][1]
+        ws, mesh = m["sharded_tpu_weak_scale"], m["sharded_cpu_mesh"]
         lines += [
             "- **8-shard weak scaling** (the BASELINE config): per-shard "
             f"program measured at **{ws['per_shard_ms']:.2f} ms per "
@@ -72,11 +154,16 @@ def render(m: dict, source: str) -> str:
             "extrapolated v5e-8** with ICI psum verdict combine; the "
             "CPU-mesh total-compute ratio is "
             f"**{mesh['total_compute_ratio']:.2f}** (sharding is a measured "
-            "total-compute win)",
+            "total-compute win)"
+            + s.arrow(i, "sharded_tpu_weak_scale",
+                      "v5e8_extrapolated_txns_per_sec") + s.tag(i),
         ]
-    curve = m.get("latency_curve") or {}
-    pp = curve.get("production_point")
-    if pp:
+
+    i = s.newest(lambda m: (m.get("latency_curve") or {})
+                 .get("production_point"), chip_pinned=True)
+    if i is not None:
+        curve = artifacts[i][1]["latency_curve"]
+        pp = curve["production_point"]
         pts = curve.get("points", [])
         span = (f"{pts[0]['batch_txns']}→{pts[-1]['batch_txns']}"
                 if pts else "")
@@ -84,10 +171,15 @@ def render(m: dict, source: str) -> str:
             f"- a latency-vs-batch-size curve ({span}) whose serial "
             f"production point is {pp['batch_txns']}-txn batches at "
             f"{pp['total_ms']:.2f} ms = "
-            f"**{fmt_m(pp['txns_per_sec'])} txn/s** one batch at a time",
+            f"**{fmt_m(pp['txns_per_sec'])} txn/s** one batch at a time"
+            + s.arrow(i, "latency_curve", "production_point.txns_per_sec")
+            + s.tag(i),
         ]
-    bl = m.get("bucket_ladder") or {}
-    if bl.get("device_ms_by_bucket"):
+
+    i = s.newest(lambda m: (m.get("bucket_ladder") or {})
+                 .get("device_ms_by_bucket"))
+    if i is not None:
+        bl = artifacts[i][1]["bucket_ladder"]
         buckets = ", ".join(bl["device_ms_by_bucket"])
         lines += [
             f"- **bucket ladder** (`docs/perf.md`): shapes {{{buckets}}} "
@@ -95,23 +187,34 @@ def render(m: dict, source: str) -> str:
             f"{bl['compiles_warmup']} programs warmed in "
             f"{bl['warmup_ms'] / 1e3:.1f} s, "
             f"**{bl['steady_state_compiles']} steady-state compiles** "
-            "serving mixed-size traffic",
+            "serving mixed-size traffic" + s.tag(i),
         ]
-    hf = m.get("history_floor") or {}
-    hf_pts = [p for p in hf.get("points", [])
-              if p.get("occupancy_frac", 0) >= 0.5 and p.get("bsearch_speedup")]
-    if hf_pts:
-        p = hf_pts[0]
+
+    def _hf_point(m):
+        hf = m.get("history_floor") or {}
+        pts = [p for p in hf.get("points", [])
+               if p.get("occupancy_frac", 0) >= 0.5
+               and p.get("bsearch_speedup")]
+        return pts[0] if pts else None
+
+    i = s.newest(lambda m: _hf_point(m) is not None)
+    if i is not None:
+        hf = artifacts[i][1]["history_floor"]
+        p = _hf_point(artifacts[i][1])
         lines += [
             "- **history search floor** (`docs/perf.md`): at "
             f"{hf['batch_txns']}-txn batches and "
             f"{p['occupancy_frac'] * 100:.0f}% table occupancy, batch-only "
             f"sort + binary search runs **{p['bsearch_ms']:.2f} ms** vs "
             f"{p['fused_sort_ms']:.2f} ms for the fused table re-sort "
-            f"(**{p['bsearch_speedup']:.1f}×**), bit-identical abort sets",
+            f"(**{p['bsearch_speedup']:.1f}×**), bit-identical abort sets"
+            + s.tag(i),
         ]
-    lf = m.get("loop_floor") or {}
-    if lf.get("loop_speedup") and lf.get("parity_ok"):
+
+    i = s.newest(lambda m: (m.get("loop_floor") or {}).get("loop_speedup")
+                 and (m.get("loop_floor") or {}).get("parity_ok"))
+    if i is not None:
+        lf = artifacts[i][1]["loop_floor"]
         syncs = (lf.get("loop_stats") or {}).get("blocking_syncs", 0)
         lines += [
             "- **device-resident loop** (`docs/perf.md`): at the "
@@ -120,11 +223,14 @@ def render(m: dict, source: str) -> str:
             f"**{lf['loop_host_ms_per_batch']:.2f} ms** host time vs "
             f"{lf['step_host_ms_per_batch']:.2f} ms step dispatch "
             f"(**{lf['loop_speedup']:.1f}×**), {syncs} blocking host syncs, "
-            "bit-identical abort sets",
+            "bit-identical abort sets" + s.tag(i),
         ]
-    ul = m.get("latency_under_load") or {}
-    up = ul.get("production_point")
-    if up:
+
+    i = s.newest(lambda m: (m.get("latency_under_load") or {})
+                 .get("production_point"))
+    if i is not None:
+        ul = artifacts[i][1]["latency_under_load"]
+        up = ul["production_point"]
         lines += [
             "- **pipelined resolver under open-loop load** "
             f"(`pipeline/`, depth {up['depth']}): "
@@ -133,36 +239,53 @@ def render(m: dict, source: str) -> str:
             f"p99 {up['p99_ms']:.2f} ms inside the "
             f"{ul['budget_p99_ms']} ms budget"
             + (f" — **{ul['vs_serial_512_curve']:.1f}×** the serial "
-               "production point" if "vs_serial_512_curve" in ul else ""),
+               "production point" if "vs_serial_512_curve" in ul else "")
+            + s.arrow(i, "latency_under_load",
+                      "production_point.sustained_txns_per_sec") + s.tag(i),
         ]
-    sc = m.get("served_under_chaos") or {}
-    rows = sc.get("sweep") or []
-    if rows:
-        # renders once a BENCH with served_under_chaos is recorded
+
+    def _chaos_ok(m):
+        sc = m.get("served_under_chaos") or {}
+        rows = sc.get("sweep") or []
+        return (any(r.get("admission") for r in rows)
+                and any(not r.get("admission") for r in rows))
+
+    i = s.newest(_chaos_ok)
+    if i is not None:
+        sc = artifacts[i][1]["served_under_chaos"]
+        rows = sc["sweep"]
         adm = [r for r in rows if r.get("admission")]
         unc = [r for r in rows if not r.get("admission")]
         users = sc.get("users_served_per_chip") or {}
-        if adm and unc:
-            worst_adm = max(r["p99_ms"] for r in adm)
-            best_unc = min(r["p99_ms"] for r in unc)
-            skews = ", ".join(str(r["s"]) for r in adm)
-            lines += [
-                "- **served under chaos** (`docs/real_cluster.md`): "
-                f"wall-clock Zipf sweep (s ∈ {{{skews}}}) through the real "
-                "transport with the network nemesis active — per-tenant "
-                f"admission holds p99 ≤ {worst_adm:.0f} ms (budget "
-                f"{sc['budget_ms']:.0f} ms) while uncontrolled runs blow to "
-                f"≥ {best_unc:.0f} ms; "
-                f"**{users.get('no_nemesis', 0)} users/chip** at "
-                f"{sc['txns_per_user_per_sec']} txn/s/user "
-                f"({users.get('under_nemesis', 0)} under nemesis)",
-            ]
-    ch = m.get("conflict_heat") or {}
-    sweep_rows = [r for r in ch.get("sweep") or [] if "concentration" in r]
-    split = ch.get("split") or {}
-    overhead = ch.get("overhead") or {}
-    if sweep_rows and ch.get("parity_ok") and overhead.get("ok"):
-        # renders once a BENCH with conflict_heat is recorded
+        worst_adm = max(r["p99_ms"] for r in adm)
+        best_unc = min(r["p99_ms"] for r in unc)
+        skews = ", ".join(str(r["s"]) for r in adm)
+        lines += [
+            "- **served under chaos** (`docs/real_cluster.md`): "
+            f"wall-clock Zipf sweep (s ∈ {{{skews}}}) through the real "
+            "transport with the network nemesis active — per-tenant "
+            f"admission holds p99 ≤ {worst_adm:.0f} ms (budget "
+            f"{sc['budget_ms']:.0f} ms) while uncontrolled runs blow to "
+            f"≥ {best_unc:.0f} ms; "
+            f"**{users.get('no_nemesis', 0)} users/chip** at "
+            f"{sc['txns_per_user_per_sec']} txn/s/user "
+            f"({users.get('under_nemesis', 0)} under nemesis)"
+            + s.arrow(i, "served_under_chaos",
+                      "users_served_per_chip.no_nemesis") + s.tag(i),
+        ]
+
+    def _heat_ok(m):
+        ch = m.get("conflict_heat") or {}
+        return (any("concentration" in r for r in ch.get("sweep") or [])
+                and ch.get("parity_ok")
+                and (ch.get("overhead") or {}).get("ok"))
+
+    i = s.newest(_heat_ok)
+    if i is not None:
+        ch = artifacts[i][1]["conflict_heat"]
+        sweep_rows = [r for r in ch["sweep"] if "concentration" in r]
+        split = ch.get("split") or {}
+        overhead = ch.get("overhead") or {}
         conc = ", ".join(f"s={r['s']}: {r['concentration']:.3f}"
                          for r in sweep_rows)
         lines += [
@@ -172,11 +295,37 @@ def render(m: dict, source: str) -> str:
             f"balance measured load across {split.get('shards', 8)} shards "
             f"within {split.get('max_dev_frac', 0) * 100:.0f}% at s=0.9, "
             f"with {overhead.get('overhead_pct', 0):.1f}% device-time "
-            "overhead and bit-identical abort sets",
+            "overhead and bit-identical abort sets" + s.tag(i),
         ]
-    att = m.get("latency_attribution") or {}
-    p99 = att.get("p99") or {}
-    if p99.get("segments_ms"):
+
+    i = s.newest(lambda m: (m.get("compile_memory") or {}).get("engines"))
+    if i is not None:
+        cm = artifacts[i][1]["compile_memory"]
+        step = (cm["engines"].get("step") or {})
+        ledger = step.get("ledger") or {}
+        comp = ledger.get("compiles") or {}
+        ms = ledger.get("compile_ms") or {}
+        ssc = cm.get("steady_state_compiles")
+        steady_text = (f"**{ssc} steady-state compiles**"
+                       if ssc is not None else
+                       "steady-state compiles unmonitored")
+        lines += [
+            "- **compile & memory ledger** (`docs/observability.md`): "
+            f"every program build priced — {comp.get('warmup', 0)} warmup "
+            f"compiles in {ms.get('warmup', 0) / 1e3:.1f} s for the step "
+            "ladder, "
+            f"{steady_text} "
+            "with 100% device-time sampling enabled, peak compiled-program "
+            f"footprint {cm.get('peak_hbm_bytes', 0) / (1 << 20):.0f} MiB "
+            f"next to a {step.get('state_bytes', 0) / (1 << 20):.1f} MiB "
+            "interval table" + s.tag(i),
+        ]
+
+    i = s.newest(lambda m: ((m.get("latency_attribution") or {})
+                            .get("p99") or {}).get("segments_ms"))
+    if i is not None:
+        att = artifacts[i][1]["latency_attribution"]
+        p99 = att["p99"]
         segs = p99["segments_ms"]
         # the phases an operator steers by, largest first
         named = sorted(
@@ -188,8 +337,9 @@ def render(m: dict, source: str) -> str:
             f"p99 commit ({p99['client_ms']:.2f} ms) decomposes into named "
             f"span segments summing to "
             f"{p99.get('sum_over_client', 1.0) * 100:.0f}% of the "
-            f"client-observed figure — {detail}",
+            f"client-observed figure — {detail}" + s.tag(i),
         ]
+
     lines.append(END)
     return "\n".join(lines)
 
@@ -197,32 +347,38 @@ def render(m: dict, source: str) -> str:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--bench", type=Path, default=None,
-                    help="BENCH JSON (default: newest BENCH_r*.json)")
+                    help="render from ONE artifact instead of the merged "
+                         "BENCH_r*.json series")
     ap.add_argument("--readme", type=Path, default=None)
     ap.add_argument("--check", action="store_true",
-                    help="exit 1 if README disagrees with the artifact")
+                    help="exit 1 if README disagrees with the artifacts")
     args = ap.parse_args(argv)
 
     root = find_repo_root()
-    bench = args.bench or latest_bench(root)
+    if args.bench is not None:
+        artifacts = [(args.bench.name, bh.load_parsed(args.bench))]
+        source = args.bench.name
+    else:
+        artifacts = load_artifacts(root)
+        source = artifacts[-1][0]
     readme = args.readme or root / "README.md"
     text = readme.read_text()
     if BEGIN not in text or END not in text:
         raise SystemExit(f"README is missing the {BEGIN} … {END} markers")
-    block = render(load_parsed(bench), bench.name)
+    block = render(artifacts)
     pattern = re.compile(re.escape(BEGIN) + ".*?" + re.escape(END), re.DOTALL)
     new_text = pattern.sub(lambda _m: block, text, count=1)
     if args.check:
         if new_text != text:
-            print(f"README perf block is stale vs {bench.name}")
+            print(f"README perf block is stale vs {source}")
             return 1
-        print(f"README perf block matches {bench.name}")
+        print(f"README perf block matches {source}")
         return 0
     if new_text != text:
         readme.write_text(new_text)
-        print(f"README perf block regenerated from {bench.name}")
+        print(f"README perf block regenerated from {source}")
     else:
-        print(f"README perf block already matches {bench.name}")
+        print(f"README perf block already matches {source}")
     return 0
 
 

@@ -227,11 +227,19 @@ def chrome_trace(spans: Sequence[Dict[str, Any]],
     for s in spans:
         args = {k: v for k, v in s.items()
                 if k not in ("Name", "Begin", "End", "Proc")}
+        proc = s.get("Proc") or "proc"
+        if s.get("track") == "device":
+            # sampled measured device intervals (ops/host_engine.py's
+            # `engine.device_time` spans) render on their own device
+            # track next to the process's host spans, so the enqueue->
+            # ready interval reads against the host-side segments it
+            # overlaps (docs/observability.md "Performance observatory")
+            proc = f"{proc} [device]"
         events.append({
             "name": s["Name"], "cat": "span", "ph": "X",
             "ts": round((s["Begin"] - base) * 1e6, 1),
             "dur": round(max(s["End"] - s["Begin"], 0.0) * 1e6, 1),
-            "pid": pid(s.get("Proc") or "proc"),
+            "pid": pid(proc),
             "tid": _tid_of(s.get("Trace")),
             "args": args,
         })

@@ -306,6 +306,31 @@ def test_span_registry_quiet_on_registered_segments(tmp_path):
     assert [f for f in res.new if f.rule == "span-registry"] == []
 
 
+def test_span_registry_polices_device_track_segment(tmp_path):
+    """The PR 12 device-track span (`engine.device_time`, the sampled
+    measured device interval): emitting it WITHOUT registering the
+    segment is a finding — overlay or not, the registry is the contract
+    — and registering it (the shipped state, where OVERLAY_SEGMENTS
+    additionally excludes it from the partition sum) is quiet."""
+    _write(tmp_path, "foundationdb_tpu/pipeline/latency_harness.py",
+           SEGMENTS_FIXTURE)
+    _write(tmp_path, "foundationdb_tpu/ops/engine.py", (
+        "def f(span_event, v):\n"
+        "    span_event('engine.device_time', v, 0, 1, track='device')\n"
+    ))
+    res = _lint(tmp_path)
+    spans = [f for f in res.new if f.rule == "span-registry"]
+    assert len(spans) == 1 and "engine.device_time" in spans[0].message
+
+    registered = SEGMENTS_FIXTURE.replace(
+        "    'force',\n", "    'force',\n    'device_time',\n")
+    registered += "OVERLAY_SEGMENTS = ('device_time',)\n"
+    _write(tmp_path, "foundationdb_tpu/pipeline/latency_harness.py",
+           registered)
+    res = _lint(tmp_path)
+    assert [f for f in res.new if f.rule == "span-registry"] == []
+
+
 # -- framework mechanics ------------------------------------------------------
 
 def test_suppression_with_reason_is_honoured_and_reported(tmp_path):

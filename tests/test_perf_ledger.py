@@ -1,0 +1,382 @@
+"""Performance observatory (docs/observability.md "Performance
+observatory"): the compile & memory ledger, sampled measured device
+timing, and the bench-artifact trend gate.
+
+Contracts pinned here:
+
+  * every program build lands ONE ledger record with the full schema
+    (bucket, search/dispatch mode, kind, duration) and — on the CPU
+    backend, which exposes XLA's analysis — non-null flops/peak bytes;
+    warmup vs steady classification follows the warmup() flag;
+  * device-time sampling is OBSERVATIONAL: abort sets bit-identical with
+    sampling off vs 100% across step, fused-scan and loop dispatch,
+    `blocking_syncs == 0` with sampling enabled, zero post-warmup
+    compiles on the real jax-monitoring counter with sampling baked in;
+  * the `device_time` span segment is registered as an OVERLAY: it rides
+    the attribution tables and its own Chrome device track without
+    entering the telescoping partition sum;
+  * `bench_history` fails a synthetic >10% same-platform headline
+    regression (naming section + metric), treats a platform change as a
+    baseline reset, tolerates noisy non-headline metrics, and passes on
+    the real committed BENCH_r*.json series.
+"""
+import io
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.core import perfledger, telemetry
+from foundationdb_tpu.ops import conflict_kernel as ck
+from foundationdb_tpu.ops.device_loop import DeviceLoopEngine
+from foundationdb_tpu.ops.host_engine import JaxConflictEngine
+from foundationdb_tpu.tools.floor_bench import _CompileCounter
+from foundationdb_tpu.tools.ladder_bench import make_point_txns
+
+CFG = ck.KernelConfig(key_words=4, capacity=1024, max_txns=64,
+                      max_point_reads=128, max_point_writes=128,
+                      max_reads=16, max_writes=16)
+
+
+# -- compile & memory ledger --------------------------------------------------
+
+def test_ledger_schema_and_warmup_classification():
+    eng = JaxConflictEngine(CFG, ladder=[32], scan_sizes=(2,),
+                            device_time_sample_rate=0.0).warmup()
+    rows = eng.perf_ledger.rows()
+    assert len(rows) == eng.perf.compiles == 4   # {32, 64} x {1, 2} chunks
+    for r in rows:
+        for f in perfledger.RECORD_FIELDS:
+            assert f in r, (f, r)
+        assert r["kind"] == "warmup"
+        assert r["duration_ms"] > 0
+        assert r["engine"] == "jax"
+        assert r["dispatch_mode"] == "step"
+        assert r["bucket"] in (32, 64)
+        assert r["search_mode"] in ("fused_sort", "bsearch")
+    # CPU exposes the full analysis; the ledger must carry it
+    assert all(r["flops"] and r["peak_bytes"] for r in rows), rows
+    snap = eng.perf_ledger.snapshot()
+    assert snap["compiles"] == {"warmup": 4}
+    assert snap["compile_ms"]["warmup"] > 0
+    assert snap["peak_bytes"] == max(r["peak_bytes"] for r in rows)
+
+
+def test_ledger_classifies_unwarmed_build_as_steady():
+    eng = JaxConflictEngine(CFG, ladder=[32], scan_sizes=(),
+                            device_time_sample_rate=0.0)
+    rng = np.random.default_rng(3)
+    eng.resolve(make_point_txns(16, 64, rng, 1000), 1000, 0)
+    kinds = {r["kind"] for r in eng.perf_ledger.rows()}
+    assert kinds == {"steady"}
+    assert eng.perf_ledger.snapshot()["compiles"].get("steady", 0) >= 1
+
+
+def test_loop_engine_ledger_one_body_per_bucket():
+    eng = DeviceLoopEngine(CFG, ladder=[32],
+                           device_time_sample_rate=0.0).warmup()
+    rows = eng.perf_ledger.rows()
+    assert len(rows) == len(eng.buckets) == eng.perf.compiles
+    assert all(r["dispatch_mode"] == "loop" and r["kind"] == "warmup"
+               for r in rows)
+
+
+def test_sample_every_from_rate():
+    assert perfledger.sample_every_from_rate(0.0) == 0
+    assert perfledger.sample_every_from_rate(1.0) == 1
+    assert perfledger.sample_every_from_rate(0.25) == 4
+    assert perfledger.sample_every_from_rate(2.0) == 1   # clamped
+    # None reads the knob default (0.0625 -> every 16th dispatch)
+    assert perfledger.sample_every_from_rate(None) == 16
+
+
+# -- sampled device timing: observational across dispatch modes ---------------
+
+def test_sampling_on_off_abort_parity_and_zero_syncs():
+    sampled = JaxConflictEngine(CFG, ladder=[32], scan_sizes=(2,),
+                                device_time_sample_rate=1.0).warmup()
+    plain = JaxConflictEngine(CFG, ladder=[32], scan_sizes=(2,),
+                              device_time_sample_rate=0.0).warmup()
+    loop = DeviceLoopEngine(CFG, ladder=[32],
+                            device_time_sample_rate=1.0).warmup()
+    rng = np.random.default_rng(11)
+    counter = _CompileCounter()
+    version = 1_000
+    for _ in range(2):
+        # straddles the 32-bucket boundary and forces multi-chunk plans
+        # (fused scans on the step engine, multi-fill slots on the loop)
+        for n in (8, 31, 32, 33, 64, 120):
+            txns = make_point_txns(n, 128, rng, version)
+            version += max(64, n)
+            new_oldest = max(0, version - 50_000)
+            got = [int(x) for x in sampled.resolve(txns, version, new_oldest)]
+            want = [int(x) for x in plain.resolve(txns, version, new_oldest)]
+            lgot = [int(x) for x in loop.resolve(txns, version, new_oldest)]
+            assert got == want == lgot, (n, version)
+    loop.drain_loop()
+    steady = counter.close()
+    assert steady == 0, f"{steady} post-warmup compiles with sampling on"
+    assert loop.loop_stats["blocking_syncs"] == 0
+    # 100% sampling: every dispatch unit recorded an interval
+    assert sampled.perf.device_time_ms_by_bucket()
+    assert loop.perf.device_time_ms_by_bucket()
+    assert sum(d["samples"] for d in sampled.perf.device_time.values()) > 0
+    # the unsampled engine recorded nothing (disabled path allocates
+    # no accumulators)
+    assert plain.perf.device_time == {}
+    d = sampled.perf.as_dict()
+    assert d["device_time_ms"] and d["device_time_samples"]
+
+
+def test_sampling_default_knob_cadence_counts_dispatches():
+    eng = JaxConflictEngine(CFG, ladder=[32], scan_sizes=())
+    assert eng._sample_every == 16   # the knob default, 0.0625
+    # deterministic counter: exactly every 16th decision samples
+    hits = [eng._sample_next_dispatch() for _ in range(32)]
+    assert sum(hits) == 2 and hits[15] and hits[31]
+
+
+# -- the device_time overlay segment ------------------------------------------
+
+def test_device_time_overlay_registered_and_excluded_from_sum():
+    from foundationdb_tpu.pipeline.latency_harness import (
+        ATTRIBUTION_SEGMENTS, OVERLAY_SEGMENTS, _attribute)
+
+    assert "device_time" in ATTRIBUTION_SEGMENTS
+    assert "device_time" in OVERLAY_SEGMENTS
+    base = {"proxy.commit_batch.t0": 0.001, "proxy.get_version": 0.001,
+            "proxy.resolve_rpc": 0.004, "proxy.meta_drain": 0.001,
+            "proxy.log_push": 0.001, "resolver.queue_wait": 0.001,
+            "resolver.host_pack": 0.001, "resolver.pipeline_wait": 0.0,
+            "resolver.device_dispatch": 0.001,
+            # the measured overlay: overlaps device_dispatch
+            "engine.device_time": 0.0009}
+    att = _attribute([(0.0, 0.010, True, 7)], {7: base})
+    segs = att["p50"]["segments_ms"]
+    assert segs["device_time"] == pytest.approx(0.9, rel=0.01)
+    # the partition sum EXCLUDES the overlay: identity stays exact
+    assert att["p50"]["sum_ms"] == pytest.approx(10.0, abs=0.05)
+    assert att["p50"]["sum_over_client"] == pytest.approx(1.0, abs=0.01)
+
+
+def test_chrome_export_renders_device_track():
+    from foundationdb_tpu.tools.trace_export import (chrome_trace,
+                                                     validate_chrome_trace)
+
+    spans = [
+        {"Name": "client.commit", "Begin": 1.0, "End": 1.01,
+         "Trace": 42, "Proc": "client"},
+        {"Name": "engine.device_time", "Begin": 1.002, "End": 1.006,
+         "Trace": 42, "Proc": "resolver", "track": "device",
+         "device_ms": 4.0, "bucket": 64, "chunks": 1},
+        {"Name": "engine.force", "Begin": 1.001, "End": 1.007,
+         "Trace": 42, "Proc": "resolver"},
+    ]
+    doc = chrome_trace(spans)
+    assert validate_chrome_trace(doc) == 3
+    names = {ev["args"]["name"] for ev in doc["traceEvents"]
+             if ev.get("ph") == "M"}
+    # the sampled interval gets its own device track next to the host
+    # spans of the same process
+    assert "resolver [device]" in names and "resolver" in names
+    dev_pid = next(ev["pid"] for ev in doc["traceEvents"]
+                   if ev.get("ph") == "M"
+                   and ev["args"]["name"] == "resolver [device]")
+    host_pid = next(ev["pid"] for ev in doc["traceEvents"]
+                    if ev.get("ph") == "M"
+                    and ev["args"]["name"] == "resolver")
+    dev_events = [ev for ev in doc["traceEvents"]
+                  if ev.get("ph") == "X" and ev["pid"] == dev_pid]
+    assert [ev["name"] for ev in dev_events] == ["engine.device_time"]
+    assert host_pid != dev_pid
+
+
+# -- telemetry hub + exposition -----------------------------------------------
+
+def test_perf_family_in_prometheus_exposition():
+    telemetry.reset()
+    hub = telemetry.hub()
+    led = perfledger.PerfLedger()
+    led.record_compile(engine="jax", bucket=64, n_chunks=1,
+                       search_mode="bsearch", dispatch_mode="step",
+                       kind="warmup", duration_ms=12.5,
+                       analysis={"flops": 1000, "bytes_accessed": 2000,
+                                 "peak_bytes": 4096,
+                                 "generated_code_bytes": 0})
+    # hostile label: the escape rules must hold for the new family too
+    hub.register_perf_ledger(led, name='we"ird\\x\ny')
+    text = hub.prometheus_text()
+    assert "# HELP fdbtpu_perf " in text and "# TYPE fdbtpu_perf gauge" in text
+    import re
+
+    sample_re = re.compile(
+        r'^fdbtpu_[a-zA-Z_][a-zA-Z0-9_]*'
+        r'(\{series="(\\.|[^"\\\n])*"\})? -?\d+(\.\d+)?$')
+    perf_lines = [ln for ln in text.splitlines()
+                  if ln.startswith("fdbtpu_perf")]
+    assert perf_lines
+    for ln in perf_lines:
+        assert sample_re.match(ln), ln
+    assert any("compiles_warmup" in ln and ln.endswith(" 1")
+               for ln in perf_lines), perf_lines
+    assert any("peak_hbm_bytes" in ln and ln.endswith(" 4096")
+               for ln in perf_lines), perf_lines
+    telemetry.reset()
+
+
+def test_cli_perf_live_sim_cluster():
+    """The acceptance path end to end: engine_health -> ratekeeper ->
+    CC status doc (qos.resolver_telemetry.perf_ledger + state_bytes) ->
+    `cli perf` renders the joined memory/compile view."""
+    from foundationdb_tpu.server.cluster import (DynamicClusterConfig,
+                                                 build_dynamic_cluster)
+    from foundationdb_tpu.tools.cli import Cli
+
+    tiny = ck.KernelConfig(key_words=4, capacity=1024, max_txns=32,
+                           max_reads=32, max_writes=32)
+    c = build_dynamic_cluster(seed=191, cfg=DynamicClusterConfig(
+        engine_factory=lambda: JaxConflictEngine(tiny)))
+    out = io.StringIO()
+    cli = Cli(c, out=out)
+    c.sim.run(until=5.0)
+    for i in range(6):
+        cli.run_command(f"set pk{i % 3} v{i}")
+    c.sim.run(until=c.sim.sched.time + 3.0)   # ratekeeper poll cadence
+    out.seek(0)
+    out.truncate(0)
+    cli.run_command("perf")
+    text = out.getvalue()
+    assert "compiles - warmup" in text, text
+    assert "memory   - state" in text, text
+    out.seek(0)
+    out.truncate(0)
+    cli.run_command("perf json")
+    doc = json.loads(out.getvalue())
+    frag = next(iter(doc.values()))
+    assert frag["perf_ledger"]["compiles"], frag
+    assert frag["state_bytes"] > 0
+
+
+# -- bench_history: the trend gate --------------------------------------------
+
+def _art(tmp_path: Path, n: int, **m):
+    m.setdefault("metric", "resolved_txns_per_sec_per_chip")
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps(m))
+
+
+def test_bench_history_fails_induced_headline_regression(tmp_path):
+    from foundationdb_tpu.tools import bench_history as bh
+
+    _art(tmp_path, 1, value=1_000_000.0, device="TPU v5 lite0")
+    _art(tmp_path, 2, value=880_000.0, device="TPU v5 lite0")   # -12%
+    trends = bh.build_trends(bh.load_series(tmp_path))
+    assert not trends["ok"]
+    assert any("value" in f and "regressed" in f and "12" in f
+               for f in trends["failures"]), trends["failures"]
+    out = io.StringIO()
+    assert bh.main(["--dir", str(tmp_path)], out=out) == 1
+    assert "GATE FAILURES" in out.getvalue()
+
+
+def test_bench_history_platform_change_resets_baseline(tmp_path):
+    from foundationdb_tpu.tools import bench_history as bh
+
+    _art(tmp_path, 1, value=1_000_000.0, device="TPU v5 lite0")
+    _art(tmp_path, 2, value=90_000.0, device="TFRT_CPU_0")   # 11x "drop"
+    trends = bh.build_trends(bh.load_series(tmp_path))
+    assert trends["ok"], trends["failures"]
+    row = next(r for r in trends["metrics"] if r["metric"] == "value")
+    assert row["verdict"] == "platform-change"
+    assert row["platform"] == "cpu" and row["baseline_round"] is None
+    # a later CPU artifact compares against the CPU baseline, and a
+    # same-platform regression there DOES gate
+    _art(tmp_path, 3, value=70_000.0, device="TFRT_CPU_0")   # -22% vs r02
+    trends = bh.build_trends(bh.load_series(tmp_path))
+    assert not trends["ok"]
+    row = next(r for r in trends["metrics"] if r["metric"] == "value")
+    assert row["verdict"] == "regressed" and row["baseline_round"] == 2
+
+
+def test_bench_history_noise_and_improvement_verdicts(tmp_path):
+    from foundationdb_tpu.tools import bench_history as bh
+
+    _art(tmp_path, 1, value=1_000_000.0, host_pack_ms_per_batch=1.0,
+         device="TPU v5 lite0")
+    # headline -5% = inside threshold; host pack +20% = inside its 25%
+    # noise band (wall timings on a shared box are not regressions)
+    _art(tmp_path, 2, value=950_000.0, host_pack_ms_per_batch=1.2,
+         device="TPU v5 lite0")
+    trends = bh.build_trends(bh.load_series(tmp_path))
+    assert trends["ok"], trends["failures"]
+    by = {r["metric"]: r for r in trends["metrics"]}
+    assert by["value"]["verdict"] == "ok"
+    assert by["host_pack_ms_per_batch"]["verdict"] == "ok"
+    # a genuine improvement is named as one
+    _art(tmp_path, 3, value=1_400_000.0, device="TPU v5 lite0")
+    trends = bh.build_trends(bh.load_series(tmp_path))
+    assert next(r for r in trends["metrics"]
+                if r["metric"] == "value")["verdict"] == "improved"
+
+
+def test_bench_history_zero_baseline_movement_is_signal(tmp_path):
+    """A zero-pinned metric (steady-state compiles) moving off zero has
+    no meaningful percentage — the verdict must still name the
+    regression (change_frac None, never inf into tables/JSON)."""
+    from foundationdb_tpu.tools import bench_history as bh
+
+    _art(tmp_path, 1, value=1e6,
+         bucket_ladder={"steady_state_compiles": 0}, device="TPU v5 lite0")
+    _art(tmp_path, 2, value=1e6,
+         bucket_ladder={"steady_state_compiles": 1}, device="TPU v5 lite0")
+    trends = bh.build_trends(bh.load_series(tmp_path))
+    row = next(r for r in trends["metrics"]
+               if r["metric"] == "steady_state_compiles")
+    assert row["verdict"] == "regressed" and row["change_frac"] is None
+    assert trends["ok"]   # informational metric: named, not gating
+    json.dumps(trends)    # strict-JSON clean (no Infinity tokens)
+
+
+def test_bench_history_headline_gone_missing_fails(tmp_path):
+    """bench.py's sections are exception-guarded — a broken run just
+    omits the section — so the gate must also fail when the NEWEST
+    artifact stops recording a headline figure its platform used to
+    record (and must NOT fire across a platform change)."""
+    from foundationdb_tpu.tools import bench_history as bh
+
+    _art(tmp_path, 1, value=1_000_000.0, device="TPU v5 lite0")
+    _art(tmp_path, 2, device="TPU v5 lite0")   # value vanished, same plat
+    trends = bh.build_trends(bh.load_series(tmp_path))
+    assert not trends["ok"]
+    assert any("went missing" in f and "value" in f
+               for f in trends["failures"]), trends["failures"]
+    # across a platform change the absence is a reset, not a failure
+    (tmp_path / "BENCH_r02.json").unlink()
+    _art(tmp_path, 2, device="TFRT_CPU_0")
+    trends = bh.build_trends(bh.load_series(tmp_path))
+    assert trends["ok"], trends["failures"]
+
+
+def test_bench_history_real_committed_series_passes():
+    from foundationdb_tpu.tools import bench_history as bh
+
+    root = bh.find_repo_root()
+    series = bh.load_series(root)
+    assert len(series) >= 5
+    trends = bh.build_trends(series)
+    assert trends["ok"], trends["failures"]
+    # every artifact parsed into the headline row
+    row = next(r for r in trends["metrics"] if r["metric"] == "value")
+    assert all(v is not None for v in row["values"])
+
+
+def test_readme_perf_renders_merged_series_with_sources():
+    from foundationdb_tpu.tools import readme_perf as rp
+
+    root = rp.find_repo_root()
+    artifacts = rp.load_artifacts(root)
+    block = rp.render(artifacts)
+    assert block.startswith(rp.BEGIN) and block.endswith(rp.END)
+    # the chip headline renders from an accelerator artifact, tagged
+    assert "single chip" in block
+    assert "*(r0" in block
