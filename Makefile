@@ -56,6 +56,17 @@ trace-smoke:
 perf-smoke:
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.perf_smoke
 
+# Cluster-watchdog smoke (docs/observability.md "Watchdog, burn rates &
+# incidents", ~30s, solo-CPU safe — pure host-side, no jax): a synthetic
+# telemetry replay on a virtual clock drives every rule class
+# (threshold, staleness, anomaly band, multi-window burn rate) through
+# pending -> firing -> resolved, the burn-rate arithmetic is checked
+# against a hand computation, same-seed replays produce bit-equal
+# incident timelines, and the `fdbtpu_alerts` exposition passes the
+# strict PR 8 line parser.
+watch-smoke:
+	python -m foundationdb_tpu.tools.watch_smoke
+
 # Bench-artifact trend gate (docs/observability.md "Performance
 # observatory"): per-section trend tables over the committed BENCH_r*.json
 # series with noise-aware verdicts — >10% regressions on headline metrics
@@ -83,10 +94,12 @@ lint:
 # renders one). Solo-CPU: do not overlap with tier-1.
 chaos-real:
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.real.nemesis \
-		--seeds 2 --engine-modes jax,device_loop --sweep \
+		--seeds 2 --engine-modes jax,device_loop --sweep --watchdog \
 		--trace-dir chaos_real_traces \
 		--json chaos_real_report.json
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.cli \
 		chaos-status chaos_real_report.json
+	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.cli \
+		incidents chaos_real_report.json
 
-.PHONY: check bench bench-smoke telemetry-smoke heat-smoke trace-smoke chaos chaos-real lint perf-smoke bench-history
+.PHONY: check bench bench-smoke telemetry-smoke heat-smoke trace-smoke chaos chaos-real lint perf-smoke bench-history watch-smoke

@@ -230,6 +230,48 @@ def _make_server_knobs() -> Knobs:
     #: bit-identical on/off (tests/test_perf_ledger.py); engines take a
     #: `device_time_sample_rate=` constructor override.
     k.init("resolver_device_time_sample_rate", 0.0625)
+    # Cluster watchdog (core/watchdog.py; docs/observability.md
+    # "Watchdog, burn rates & incidents"). Deliberately no BUGGIFY
+    # randomizers: evaluation is observational (host-side reads only,
+    # no rng), and a randomizer draw would shift every sim's rng stream.
+    #: master switch: off = `hub().sync()` pays one attribute check and
+    #: allocates nothing (the NULL_SPAN-style regression guard); on = a
+    #: default-ruleset watchdog attaches at hub construction and every
+    #: sync evaluates the rules
+    k.init("watchdog_enabled", False)
+    #: bounded ring of alert lifecycle transitions the watchdog retains
+    k.init("watchdog_alert_ring", 256)
+    #: a rule's condition must hold this long before pending -> firing
+    #: (discipline rules like blocking_syncs override to 0: a blocking
+    #: sync is a fact, not a rate)
+    k.init("watchdog_hold_s", 0.1)
+    #: a firing rule's condition must stay clear this long to resolve
+    k.init("watchdog_clear_s", 0.5)
+    #: burn-rate fast/slow trailing windows — BOTH must burn above the
+    #: threshold to fire (fast = detection latency, slow = flap guard)
+    k.init("watchdog_burn_fast_s", 0.5)
+    k.init("watchdog_burn_slow_s", 2.0)
+    #: burn-rate multiplier over the error budget that fires (1.0 =
+    #: budget spent exactly at the sustainable rate)
+    k.init("watchdog_burn_threshold", 2.0)
+    #: p99-vs-budget SLO error budget: allowed fraction of acks over the
+    #: latency budget (0.01 = the p99 contract)
+    k.init("watchdog_slo_bad_frac", 0.01)
+    #: abort-fraction error budget (conflicts / resolved) — optimistic
+    #: concurrency makes SOME aborts normal; a burn over this is hot-key
+    #: collapse (the Zipf sweep measured 16%->43% with skew)
+    k.init("watchdog_abort_budget_frac", 0.25)
+    #: tenant throttle-rate error budget (rejected / offered)
+    k.init("watchdog_throttle_budget_frac", 0.2)
+    #: EWMA z-score band width for anomaly rules (heat concentration)
+    k.init("watchdog_z_threshold", 3.5)
+    #: a must-advance series (commit SLI total) frozen longer than this
+    #: under evaluation is a stall
+    k.init("watchdog_staleness_s", 1.5)
+    #: admission fraction while a burn-rate alert is firing — the
+    #: ratekeeper consumes the firing signal as a rate clamp alongside
+    #: resolver_degraded (server/ratekeeper.py)
+    k.init("watchdog_burn_tps_fraction", 0.5)
     # Wall-clock chaos (real/chaos.py; docs/real_cluster.md). Defaults for
     # the seeded NetworkNemesis' background fault mix — a campaign's
     # ChaosConfig reads these so `--knob`-style overrides steer injection

@@ -37,6 +37,23 @@ HEALTH_STATE_INDEX = {"healthy": 0, "suspect": 1, "failed": 2,
                       "probation": 3, "quarantined": 4}
 
 
+def _engine_state_bytes(engine) -> Optional[int]:
+    """Footprint of an engine's resolved-history state, in bytes — the
+    device interval table for kernel engines (a dict of arrays), reached
+    through a ResilientEngine's wrapped device when supervised. None when
+    the engine keeps no array state (the serial oracle).
+    server/resolver.py uses the same helper for its engine_health
+    fragment."""
+    dev = getattr(engine, "device", engine)
+    st = getattr(dev, "state", None)
+    if not isinstance(st, dict):
+        return None
+    try:
+        return int(sum(int(getattr(v, "nbytes", 0)) for v in st.values()))
+    except (TypeError, ValueError):
+        return None
+
+
 class TelemetryHub:
     """Per-process registry of serving-path telemetry sources.
 
@@ -66,10 +83,21 @@ class TelemetryHub:
         #: label -> weakref to PerfLedger (core/perfledger.py — compile &
         #: memory ledger: build durations, flops/bytes, peak HBM)
         self._perf_ledgers: Dict[str, "weakref.ref"] = {}
+        #: label -> weakref to TenantAdmission (server/ratekeeper.py —
+        #: admitted/rejected totals feed the throttle burn-rate rule)
+        self._admissions: Dict[str, "weakref.ref"] = {}
         self._seq = 0
         #: bounded ring of recent nemesis/chaos events (real/chaos.py,
         #: real/nemesis.py) — rendered by `tools/cli.py chaos-status`
         self.chaos_events: deque = deque(maxlen=256)
+        #: the cluster watchdog (core/watchdog.py): None (default) = the
+        #: disabled path — sync() pays ONE attribute check and allocates
+        #: nothing. The `watchdog_enabled` knob auto-attaches a
+        #: default-ruleset engine at hub construction; campaigns attach
+        #: their own via attach_watchdog().
+        from .watchdog import watchdog_from_knobs
+
+        self._watchdog = watchdog_from_knobs()
 
     # -- registration --------------------------------------------------------
     def _label(self, kind: str, name: str) -> str:
@@ -107,6 +135,25 @@ class TelemetryHub:
         label = self._label("perf", name)
         self._perf_ledgers[label] = weakref.ref(ledger)
         return label
+
+    def register_admission(self, admission, name: str = "admission") -> str:
+        """A per-tenant admission controller (server/ratekeeper.py
+        TenantAdmission): admitted/rejected totals synced as
+        `admission.<label>.*` series — the good/bad pair the watchdog's
+        tenant_throttle_burn rule consumes."""
+        label = self._label("admission", name)
+        self._admissions[label] = weakref.ref(admission)
+        return label
+
+    # -- the cluster watchdog (core/watchdog.py) -----------------------------
+    @property
+    def watchdog(self):
+        return self._watchdog
+
+    def attach_watchdog(self, wd) -> None:
+        """Install (or replace) the process watchdog; None detaches. The
+        attached engine evaluates on every sync()."""
+        self._watchdog = wd
 
     def register_heat(self, aggregator, name: str = "heat") -> str:
         """An engine's keyspace-heat aggregator (core/heatmap.py): hot-range
@@ -213,6 +260,27 @@ class TelemetryHub:
                         "swap_backs", "probes", "probe_mismatches",
                         "oracle_batches"):
                 td.int64(f"resolver.{label}.{key}").set(st.get(key, 0))
+            # state-memory accounting (reference: RESOLVER_STATE_MEMORY_
+            # LIMIT): the supervised device table's footprint vs the knob,
+            # as a series so the watchdog's state_memory_pressure rule
+            # evaluates it live (server/resolver.py mirrors the same
+            # figures into engine_health for the status doc)
+            sb = _engine_state_bytes(eng)
+            if sb is not None:
+                from .knobs import SERVER_KNOBS
+
+                td.int64(f"resolver.{label}.state_bytes").set(sb)
+                td.int64(f"resolver.{label}.state_memory_pressure").set(
+                    1 if sb > int(SERVER_KNOBS.resolver_state_memory_limit)
+                    else 0)
+        for label, adm in self._live(self._admissions):
+            # per-tenant admission totals (server/ratekeeper.py): the
+            # offered split into admitted vs shed — the watchdog's
+            # tenant_throttle_burn good/bad pair
+            td.int64(f"admission.{label}.admitted").set(
+                sum(adm.admitted.values()))
+            td.int64(f"admission.{label}.rejected").set(
+                sum(adm.rejected.values()))
         for label, eng in self._live(self._loops):
             # device-loop eyes (ops/device_loop.py): the double buffer's
             # slot occupancy, the result ring's depth, and every
@@ -259,6 +327,13 @@ class TelemetryHub:
                 int(b["concentration"] * 1000))
             td.int64(f"heat.{label}.top_range_share_x1000").set(
                 int(b["top_share"] * 1000))
+        # cluster watchdog (core/watchdog.py): evaluate the rule set over
+        # the series refreshed above. The disabled path is this one
+        # attribute check — no call, no allocation (the <5 µs/call
+        # regression guard in tests/test_watchdog.py)
+        wd = self._watchdog
+        if wd is not None:
+            wd.evaluate(self)
 
     def snapshot(self) -> dict:
         """Live values for status documents (no TDMetric round trip)."""
@@ -275,6 +350,10 @@ class TelemetryHub:
                      for label, agg in self._live(self._heat)},
             "perf_ledgers": {label: led.snapshot()
                              for label, led in self._live(self._perf_ledgers)},
+            "admission": {label: adm.as_dict()
+                          for label, adm in self._live(self._admissions)},
+            "watchdog": (self._watchdog.snapshot()
+                         if self._watchdog is not None else None),
         }
 
     #: per-family HELP strings for the exposition (families are the first
@@ -296,6 +375,13 @@ class TelemetryHub:
                 "cost-analysis totals, peak compiled-program HBM bytes)",
         "chaos": "injected nemesis fault events (real/chaos.py)",
         "demo": "demo KV per-op counters (real/demo_server.py)",
+        "alerts": "cluster-watchdog alert states (core/watchdog.py: 0 ok, "
+                  "1 pending, 2 firing; `alerts.firing` counts the live "
+                  "firing set — the ALERTS-style family)",
+        "sli": "commit SLO indicator counters (core/watchdog.py "
+               "record_commit_sli: acks within/over the latency budget)",
+        "admission": "per-tenant admission totals (server/ratekeeper.py "
+                     "TenantAdmission: admitted vs shed)",
     }
 
     @staticmethod

@@ -89,8 +89,15 @@ def _small_kernel_cfg():
                         max_writes=256, max_txns=64)
 
 
-def make_chaos_engine(engine_mode: str):
-    """(inner, injector, supervised) for a campaign engine stack."""
+def make_chaos_engine(engine_mode: str,
+                      dispatch_timeout_s: Optional[float] = None):
+    """(inner, injector, supervised) for a campaign engine stack.
+    `dispatch_timeout_s` overrides the supervisor's per-dispatch
+    watchdog: a co-resident CI box stalls the event loop tens to
+    hundreds of ms, and a no-fault control campaign (the watchdog
+    false-positive guard) must not read such a stall as a device
+    fault — operators tune resolver_dispatch_timeout per deployment
+    the same way."""
     from ..fault.inject import FaultInjectingEngine, FaultRates
     from ..fault.resilient import ResilienceConfig, ResilientEngine
 
@@ -108,9 +115,12 @@ def make_chaos_engine(engine_mode: str):
         inner, rates=FaultRates(exception=0, hang=0, slow=0, flip=0, outage=0))
     supervised = ResilientEngine(
         injector,
-        ResilienceConfig(dispatch_timeout=0.25, retry_budget=1,
-                         retry_backoff=0.02, probe_rate=0.05,
-                         probation_batches=2, failover_min_batches=2),
+        ResilienceConfig(
+            dispatch_timeout=(0.25 if dispatch_timeout_s is None
+                              else float(dispatch_timeout_s)),
+            retry_budget=1,
+            retry_backoff=0.02, probe_rate=0.05,
+            probation_batches=2, failover_min_batches=2),
         record_journal=True)
     return inner, injector, supervised
 
@@ -126,13 +136,15 @@ class ChaosCommitServer:
                  admission_burst_s: Optional[float] = None,
                  batch_interval_s: float = 0.004, max_batch: int = 48,
                  service_floor_s: float = 0.0,
-                 transport_degraded_fn=None, port: int = 0):
+                 transport_degraded_fn=None, port: int = 0,
+                 dispatch_timeout_s: Optional[float] = None):
         from ..server.ratekeeper import TenantAdmission
         from .runtime import make_dispatcher
 
         self.sched = sched
         self.engine_mode = engine_mode
-        self.inner, self.injector, self.engine = make_chaos_engine(engine_mode)
+        self.inner, self.injector, self.engine = make_chaos_engine(
+            engine_mode, dispatch_timeout_s=dispatch_timeout_s)
         self.proc = RealProcess(port=port)
         self.proc.dispatcher = make_dispatcher(sched)
         self.proc.register(COMMIT_TOKEN, self._commit)
@@ -161,6 +173,9 @@ class ChaosCommitServer:
         self.admission_tps = admission_tps
         if self.admission is not None:
             self.admission.set_rate(admission_tps)
+            # the throttle burn-rate rule's good/bad pair (core/watchdog):
+            # admitted vs shed totals as `admission.*` hub series
+            telemetry.hub().register_admission(self.admission, "admission")
         #: transport-health probe (RealNetClient.transport_degraded on a
         #: wall node with outbound links): collapses the batch cap exactly
         #: like engine degradation — the same hook ResolverPipeline takes
@@ -286,8 +301,21 @@ class ChaosCommitServer:
         from ..sim.loop import TaskPriority, delay, now
 
         committed = int(TransactionCommitResult.COMMITTED)
+        hub = telemetry.hub()
+        # watchdog heartbeat (core/watchdog.py): the batcher is the
+        # campaign's live pulse, so alerts fire DURING the run, not at
+        # the autopsy — but a full hub.sync() re-renders every
+        # registered series, and the fastest burn window is 0.5 s, so
+        # evaluating every ~64 ms loses nothing while keeping that host
+        # work off the 4 ms measured batch cadence. One attribute check
+        # per tick when the watchdog is off — the disabled path is free.
+        wd_stride = max(1, round(0.064 / max(self.batch_interval_s, 1e-4)))
+        ticks = 0
         while self._running:
             await delay(self.batch_interval_s, TaskPriority.PROXY_COMMIT_BATCHER)
+            ticks += 1
+            if hub.watchdog is not None and ticks % wd_stride == 0:
+                hub.sync()
             if not self._pending:
                 continue
             self._refresh_admission()
@@ -380,6 +408,20 @@ class NemesisConfig:
     #: first connects, first batches and cold engine paths are warmup,
     #: not steady-state serving
     warmup_frac: float = 0.15
+    #: cluster watchdog (core/watchdog.py): None = the watchdog_enabled
+    #: knob decides; True/False force-attach/detach for this campaign.
+    #: With it on, the report gains `alerts` + `incidents` and
+    #: `assert_slos` additionally requires every firing incident to be
+    #: EXPLAINED (overlap an injected fault window or name a breach)
+    watchdog: Optional[bool] = None
+    #: extra AlertRule instances appended to the default ruleset (tests
+    #: induce an unexplained incident through this)
+    watchdog_extra_rules: Optional[list] = None
+    #: supervisor per-dispatch watchdog override (None = the campaign
+    #: default, 0.25 s). Control campaigns on co-resident CI boxes
+    #: widen it so an event-loop stall can't masquerade as a device
+    #: fault (see make_chaos_engine)
+    dispatch_timeout_s: Optional[float] = None
 
     #: budget multiplier for CPU-emulated device modes: a real chip-
     #: adjacent resolver serves a batch in well under a millisecond, but
@@ -451,6 +493,13 @@ class CampaignReport:
     suffered: Dict[str, Dict[str, int]] = field(default_factory=dict)
     transport: Dict[str, int] = field(default_factory=dict)
     attribution: Optional[dict] = None
+    #: watchdog alert lifecycle states at campaign end (core/watchdog.py)
+    alerts: Optional[list] = None
+    #: machine-correlated incident timeline: firing alerts grouped and
+    #: matched against injected fault windows, health transitions and the
+    #: trace root cause — `cli incidents REPORT.json` renders it and
+    #: assert_slos requires every entry explained
+    incidents: Optional[list] = None
     #: tail-sampled waterfall population (tools/trace_export.trace_summary)
     traces: Optional[dict] = None
     #: dominant segment of the worst retained trace — what an SLO-breach
@@ -625,10 +674,34 @@ async def _device_chaos(cfg: NemesisConfig, server: ChaosCommitServer) \
 async def _campaign(cfg: NemesisConfig) -> CampaignReport:
     import gc
 
+    from ..core import buggify
     from ..sim.loop import set_scheduler
     from .runtime import RealScheduler
 
+    # a sim that ran earlier in this process (pytest co-residency) may
+    # have left BUGGIFY enabled; the wall-clock campaign is a MEASURED
+    # run — leaked sim fault injection (e.g. the ResilientEngine
+    # dispatch-boundary site) would fail over healthy engines and charge
+    # phantom incidents/latency to the system under test
+    buggify_rng = buggify._rng
+    buggify_was = buggify.is_enabled()
+    buggify.disable()
     telemetry.reset()
+    # cluster watchdog (core/watchdog.py): cfg override wins, else the
+    # watchdog_enabled knob (telemetry.reset() already auto-attached a
+    # default-ruleset engine when the knob is on). Campaign-attached
+    # engines get the default catalog plus any test-induced extras.
+    wd = None
+    use_watchdog = (cfg.watchdog if cfg.watchdog is not None
+                    else telemetry.hub().watchdog is not None)
+    if use_watchdog:
+        from ..core import watchdog as watchdog_mod
+
+        wd = watchdog_mod.Watchdog(
+            list(watchdog_mod.default_rules())
+            + list(cfg.watchdog_extra_rules or []))
+    telemetry.hub().attach_watchdog(wd)
+    wd_budget_ms = cfg.resolved_budget_ms()
     # Defer cyclic GC for the measured window: at ~100 rps of RPC frames,
     # futures and span records, a gen-2 collection stalls the event loop
     # 20-50 ms on a CI box — latency that belongs to CPython, not the
@@ -655,7 +728,8 @@ async def _campaign(cfg: NemesisConfig) -> CampaignReport:
         admission_burst_s=cfg.admission_burst_s,
         batch_interval_s=cfg.resolved_batch_interval_s(),
         max_batch=cfg.max_batch,
-        service_floor_s=cfg.service_floor_s)
+        service_floor_s=cfg.service_floor_s,
+        dispatch_timeout_s=cfg.dispatch_timeout_s)
     nemesis = NetworkNemesis(cfg.seed, cfg.chaos)
     transports: Dict[str, ChaosTransport] = {}
     versions: Dict[str, int] = {}
@@ -706,6 +780,7 @@ async def _campaign(cfg: NemesisConfig) -> CampaignReport:
                                    parent="client.commit")
                 tok = push_trace_context(ctx)
                 t_sub = span_now()
+            t_wd = time.monotonic() if wd is not None else 0.0
             try:
                 v = await transports[spec.name].request(
                     f"client-{spec.name}", commit_ep,
@@ -716,6 +791,14 @@ async def _campaign(cfg: NemesisConfig) -> CampaignReport:
                     span_event("client.commit", ctx.trace_id, t_sub,
                                span_now(), err=e.name, tenant=spec.name,
                                Proc=f"client-{spec.name}")
+                if wd is not None and e.name in ("not_committed",
+                                                 "transaction_too_old"):
+                    # a verdict-bearing ack: it counts against the p99
+                    # SLO exactly like the harness's ack population
+                    # (throttles/transport failures burn other budgets)
+                    watchdog_mod.record_commit_sli(
+                        telemetry.hub(),
+                        (time.monotonic() - t_wd) * 1e3, wd_budget_ms)
                 if e.name == "transaction_too_old":
                     asyncio.ensure_future(refresh_version(spec.name))
                 raise
@@ -726,6 +809,10 @@ async def _campaign(cfg: NemesisConfig) -> CampaignReport:
                 span_event("client.commit", ctx.trace_id, t_sub, span_now(),
                            version=int(v), tenant=spec.name,
                            Proc=f"client-{spec.name}")
+            if wd is not None:
+                watchdog_mod.record_commit_sli(
+                    telemetry.hub(), (time.monotonic() - t_wd) * 1e3,
+                    wd_budget_ms)
             versions[spec.name] = max(versions[spec.name], int(v))
             return int(v)
 
@@ -781,6 +868,17 @@ async def _campaign(cfg: NemesisConfig) -> CampaignReport:
             # cold-start grace (see NemesisConfig.warmup_frac)
             windows.append((rep.t_start,
                             rep.t_start + cfg.duration_s * cfg.warmup_frac))
+        # kinded window records: the nemesis' own (partition/stall/...)
+        # plus the composed device/process arcs and the warmup grace —
+        # shared by the Chrome trace export AND watchdog incident
+        # correlation, so both views name the same injected faults
+        window_dicts = list(nemesis.windows)
+        window_dicts += [{"kind": "device_incident", "t0": a, "t1": b}
+                         for a, b in incident_windows]
+        if cfg.warmup_frac > 0:
+            window_dicts.append({
+                "kind": "warmup", "t0": rep.t_start,
+                "t1": rep.t_start + cfg.duration_s * cfg.warmup_frac})
         acks = rep.ack_records()
         report.windows = windows
         report.counts = rep.counts()
@@ -834,13 +932,6 @@ async def _campaign(cfg: NemesisConfig) -> CampaignReport:
             report.traces = trace_export.trace_summary(waterfalls, retained)
             report.slo_root_cause = trace_export.root_cause(retained)
             if cfg.trace_export:
-                window_dicts = list(nemesis.windows)
-                window_dicts += [{"kind": "device_incident", "t0": a, "t1": b}
-                                 for a, b in incident_windows]
-                if cfg.warmup_frac > 0:
-                    window_dicts.append({
-                        "kind": "warmup", "t0": rep.t_start,
-                        "t1": rep.t_start + cfg.duration_s * cfg.warmup_frac})
                 doc = trace_export.chrome_trace(
                     trace_export.spans_for_traces(spans, retained),
                     window_dicts)
@@ -849,7 +940,22 @@ async def _campaign(cfg: NemesisConfig) -> CampaignReport:
                 with open(cfg.trace_export, "w") as f:
                     json.dump(doc, f, default=str)
                 report.trace_file = cfg.trace_export
+        if wd is not None:
+            # final evaluation tick, then machine-correlate: every firing
+            # incident must overlap an injected fault window, carry the
+            # health transitions it spans, and name the dominant latency
+            # segment of the worst retained trace — "slo_p99_burn firing ·
+            # overlaps partition window · dominant=server_resolve"
+            telemetry.hub().sync()
+            breached = ("p99_budget"
+                        if report.p99_outside_ms > wd_budget_ms else None)
+            wd.correlate(window_dicts, root_cause=report.slo_root_cause,
+                         breached_slo=breached)
+            report.alerts = wd.alerts_snapshot()
+            report.incidents = [i.as_dict() for i in wd.incidents]
     finally:
+        if buggify_was and buggify_rng is not None:
+            buggify.enable(buggify_rng)
         if gc_was_enabled:
             gc.enable()
             gc.collect()
@@ -904,6 +1010,18 @@ def assert_slos(report: CampaignReport, cfg: NemesisConfig,
     if cfg.partitions > 0:
         assert report.chaos_counts.get("partition", 0) >= 1, \
             f"no partition was injected: {ctx}"
+    if report.incidents is not None:
+        # every firing incident must be EXPLAINED: it overlaps an
+        # injected fault window or names a measured breach. An alert
+        # with neither is the watchdog crying wolf — or a real
+        # regression the campaign didn't inject; both fail the run,
+        # alert name first so the log reads like a page.
+        for inc in report.incidents:
+            lead = (inc.get("alerts") or [{"name": "incident"}])[0]["name"]
+            assert inc.get("explained"), \
+                (f"{lead}: firing incident #{inc.get('id')} "
+                 f"({inc.get('summary')}) is not explained by any "
+                 f"injected fault window or named breach: {ctx}")
     if cfg.collect_spans:
         assert report.attribution is not None, \
             f"span attribution empty (spans not collected?): {ctx}"
@@ -1067,6 +1185,13 @@ def main(argv=None) -> int:
     ap.add_argument("--serve", type=int, default=None, metavar="PORT",
                     help="run a traced commit server solo on PORT "
                          "(the trace-smoke child process) and never return")
+    ap.add_argument("--watchdog", action="store_true",
+                    help="attach the cluster watchdog (core/watchdog.py): "
+                         "live burn-rate/anomaly alerts during the "
+                         "campaign, incident timelines in the report "
+                         "(`cli incidents REPORT.json`), and assert_slos "
+                         "additionally requires every firing incident "
+                         "explained by an injected fault window")
     args = ap.parse_args(argv)
     if args.serve is not None:
         try:
@@ -1104,7 +1229,8 @@ def main(argv=None) -> int:
             cfg = NemesisConfig(seed=seed, engine_mode=mode,
                                 duration_s=duration,
                                 budget_ms=args.budget_ms,
-                                trace_export=trace_path)
+                                trace_export=trace_path,
+                                watchdog=True if args.watchdog else None)
             print(f"campaign: engine={mode} seed={seed} ...", flush=True)
             rep = run_campaign(cfg)
             reports.append(rep.as_dict())
@@ -1124,7 +1250,9 @@ def main(argv=None) -> int:
                       f"n={rep.n_outside}) parity={rep.parity_checked} "
                       f"failovers={rep.engine_stats.get('failovers')} "
                       f"swap_backs={rep.engine_stats.get('swap_backs')} "
-                      f"child_restarts={rep.child_restarts}", flush=True)
+                      f"child_restarts={rep.child_restarts}"
+                      + (f" incidents={len(rep.incidents)} (all explained)"
+                         if rep.incidents is not None else ""), flush=True)
             except AssertionError as e:
                 failures += 1
                 print(f"  SLO FAILED: {e}", file=sys.stderr, flush=True)

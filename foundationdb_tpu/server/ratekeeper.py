@@ -168,6 +168,12 @@ class Ratekeeper:
         self.lag_stale: bool = True
         self.worst_tlog_bytes: int = 0
         self.resolver_degraded: bool = False
+        #: True while any resolver reports a FIRING burn-rate alert from
+        #: its cluster watchdog (core/watchdog.py): the SLO error budget
+        #: is being spent faster than sustainable, so admission slows
+        #: before the breach lands — the same consume-point an online
+        #: resharding controller will drive from (ROADMAP item 4)
+        self.burn_alert_firing: bool = False
         #: resolver address -> last reported engine health state
         self.resolver_health: Dict[str, str] = {}
         #: resolver address -> last reported telemetry fragment (engine
@@ -310,12 +316,23 @@ class Ratekeeper:
                 frac = (target_t - self.worst_tlog_bytes) / spring_t
                 tps_tlog = max(1.0, max_tps * frac)
         tps_resolver = max_tps
+        tps_watchdog = max_tps
         if resolver_infos is not None:
             self.resolver_degraded = any(h.get("degraded") for h in resolver_infos)
             if self.resolver_degraded:
                 tps_resolver = max(
                     1.0, max_tps * SERVER_KNOBS.resolver_degraded_tps_fraction)
-        return min(tps_lag, tps_bytes, tps_tlog, tps_resolver)
+            # watchdog burn-rate clamp (core/watchdog.py): a firing
+            # multi-window burn alert means the SLO budget is being spent
+            # at an unsustainable rate RIGHT NOW — shed load while the
+            # budget still has headroom, exactly like the degraded signal
+            # but driven by measured SLO spend instead of engine health
+            self.burn_alert_firing = any(h.get("burn_alert_firing")
+                                         for h in resolver_infos)
+            if self.burn_alert_firing:
+                tps_watchdog = max(
+                    1.0, max_tps * SERVER_KNOBS.watchdog_burn_tps_fraction)
+        return min(tps_lag, tps_bytes, tps_tlog, tps_resolver, tps_watchdog)
 
     async def get_rate_info(self, req: GetRateInfoRequest) -> GetRateInfoReply:
         from ..core import buggify

@@ -67,19 +67,10 @@ def gained_ranges(old_splits: tuple, new_splits: tuple, i: int) -> list:
     return [(b, e) for b, e in out if b < e]
 
 
-def _engine_state_bytes(engine) -> Optional[int]:
-    """Footprint of the engine's resolved-history state, in bytes — the
-    device interval table for kernel engines (a dict of arrays), reached
-    through a ResilientEngine's wrapped device when supervised.  None when
-    the engine keeps no array state (the serial oracle)."""
-    dev = getattr(engine, "device", engine)
-    st = getattr(dev, "state", None)
-    if not isinstance(st, dict):
-        return None
-    try:
-        return int(sum(int(getattr(v, "nbytes", 0)) for v in st.values()))
-    except (TypeError, ValueError):
-        return None
+#: shared with the telemetry hub's health sync, which exports the same
+#: figures as `resolver.<label>.state_bytes`/`state_memory_pressure`
+#: series for the watchdog's pressure rule (core/telemetry.py)
+_engine_state_bytes = telemetry._engine_state_bytes
 
 
 class Resolver:
@@ -198,6 +189,16 @@ class Resolver:
         flight = getattr(self.engine, "flight", None)
         if flight is not None:
             tel["flight_recorder_entries"] = len(flight)
+        # cluster watchdog (core/watchdog.py): evaluate-on-sync, then ride
+        # the health poll -> ratekeeper -> master status -> CC status doc
+        # -> `tools/cli.py alerts|incidents`. The firing burn-rate bit is
+        # top-level like `degraded`: the ratekeeper consumes it as a rate
+        # clamp without digging through the telemetry fragment.
+        wd = telemetry.hub().watchdog
+        if wd is not None:
+            telemetry.hub().sync()
+            tel["watchdog"] = wd.snapshot()
+            out["burn_alert_firing"] = tel["watchdog"]["burn_firing"]
         # keyspace heat & occupancy (core/heatmap.py): hot ranges, table
         # headroom and suggested split points ride the same poll ->
         # ratekeeper -> CC status doc -> `tools/cli.py heat`

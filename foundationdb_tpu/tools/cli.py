@@ -53,6 +53,17 @@ commands:
                        status doc, or from a campaign report JSON
                        (real/nemesis.py --json) / bench artifact with a
                        conflict_heat section (docs/observability.md)
+  alerts [json|FILE.json]  cluster-watchdog alert states (core/watchdog.py):
+                       rule catalog, pending/firing/resolved lifecycle and
+                       burn-rate values — live from the cluster status doc,
+                       or cluster-less from a campaign report JSON
+                       (docs/observability.md "Watchdog, burn rates &
+                       incidents"; per-alert runbook in docs/operations.md)
+  incidents [json|FILE.json]  machine-correlated incident timelines:
+                       firing alerts grouped and matched against injected
+                       fault windows, resolver health transitions and the
+                       trace root cause ("p99 burn firing · overlaps
+                       partition window · dominant=server_resolve")
   chaos-status [FILE]  nemesis event counts from this process's telemetry
                        hub, or from a campaign report JSON written by
                        `python -m foundationdb_tpu.real.nemesis --json`
@@ -408,6 +419,135 @@ class Cli:
             self._print("no keyspace heat yet (oracle engines, "
                         "resolver_heat_buckets=0, or no traffic)")
 
+    # -- cluster watchdog (docs/observability.md "Watchdog, burn rates &
+    # incidents"; per-alert runbook table in docs/operations.md) ------------
+    def _render_alerts(self, label: str, snap: dict) -> None:
+        """One watchdog snapshot's alert table (core/watchdog.py)."""
+        evals = snap.get("evaluations")
+        self._print(f"  {label}: "
+                    + (f"{evals} evaluations, " if evals is not None else "")
+                    + f"{len(snap.get('firing') or [])} firing"
+                    + (" [BURN ALERT — ratekeeper clamping]"
+                       if snap.get("burn_firing") else ""))
+        alerts = snap.get("alerts") or []
+        if not alerts:
+            self._print("    no alert states tracked yet (no matching "
+                        "series under the rules)")
+        for a in sorted(alerts, key=lambda a: (a["state"] == "ok",
+                                               a["name"], a["series"])):
+            mark = {"firing": "!!", "pending": " ~"}.get(a["state"], "  ")
+            self._print(f"    {mark} {a['name']:<24} {a['state']:<8} "
+                        f"{a['series']:<36} v={a['value']} "
+                        f"fired x{a.get('fired_count', 0)}  {a['detail']}")
+
+    def _render_incidents(self, label: str, incidents: list,
+                          alerts_hint: Optional[list] = None) -> None:
+        """One campaign's / watchdog's incident timeline."""
+        if not incidents:
+            self._print(f"  {label}: no incidents"
+                        + ("" if alerts_hint is None else
+                           f" ({len(alerts_hint)} alert states all quiet)"))
+            return
+        self._print(f"  {label}: {len(incidents)} incident(s)")
+        for inc in incidents:
+            t1 = inc.get("t1")
+            span = (f"{inc.get('t0', 0):.2f}s .. "
+                    + (f"{t1:.2f}s" if t1 is not None else "OPEN"))
+            verdict = ("EXPLAINED" if inc.get("explained")
+                       else "UNEXPLAINED")
+            self._print(f"    #{inc.get('id')} [{span}] {verdict}"
+                        + (f" — {inc.get('explanation')}"
+                           if inc.get("explanation") else ""))
+            self._print(f"       {inc.get('summary')}")
+            for a in inc.get("alerts") or []:
+                self._print(f"       alert {a.get('name')} "
+                            f"({a.get('kind')}) {a.get('series')} "
+                            f"v={a.get('value')}  {a.get('detail')}")
+            for h in inc.get("health") or []:
+                self._print(f"       health t={h.get('t'):.2f}s "
+                            f"{h.get('label')} -> {h.get('state')}")
+            rc = inc.get("root_cause")
+            if rc:
+                self._print(f"       root cause: dominant="
+                            f"{rc.get('dominant_segment')} "
+                            f"({rc.get('dominant_ms')} ms of "
+                            f"{rc.get('client_ms')} ms, trace "
+                            f"{rc.get('rid')})")
+
+    def _watchdog_fragments(self, args: List[str]):
+        """(label, watchdog snapshot-or-campaign dict) rows from a report
+        file (cluster-less) or the live cluster status document."""
+        if args and args[0].endswith(".json"):
+            with open(args[0]) as f:
+                doc = json.load(f)
+            return [(f"seed {rep.get('cfg_seed')} [{rep.get('engine_mode')}]",
+                     rep)
+                    for rep in doc.get("campaigns", [])], True
+        doc = self._drive(self.db.get_status())
+        if doc is None:
+            self._print("status unavailable (no cluster controller reachable)")
+            return None, False
+        tel = (doc.get("qos") or {}).get("resolver_telemetry") or {}
+        return [(f"resolver {addr}", frag.get("watchdog"))
+                for addr, frag in sorted(tel.items())
+                if frag.get("watchdog") is not None], False
+
+    def do_alerts(self, args: List[str]) -> None:
+        """Watchdog alert states, live (status doc watchdog fragment) or
+        cluster-less over a campaign report JSON (real/nemesis.py --json
+        --watchdog)."""
+        rows, from_file = self._watchdog_fragments(args)
+        if rows is None:
+            return
+        if args and args[0] == "json":
+            self._print(json.dumps(
+                {label: {"alerts": (frag or {}).get("alerts"),
+                         "firing": (frag or {}).get("firing")}
+                 for label, frag in rows},
+                indent=2, sort_keys=True, default=str))
+            return
+        rendered = 0
+        for label, frag in rows:
+            if from_file:
+                alerts = frag.get("alerts")
+                if alerts is None:
+                    continue
+                snap = {"alerts": alerts,
+                        "firing": [a for a in alerts
+                                   if a.get("state") == "firing"]}
+                self._render_alerts(label, snap)
+            else:
+                self._render_alerts(label, frag)
+            rendered += 1
+        if not rendered:
+            self._print("no watchdog telemetry (watchdog_enabled off, or "
+                        "campaigns run without --watchdog)")
+
+    def do_incidents(self, args: List[str]) -> None:
+        """Machine-correlated incident timelines, live or cluster-less
+        over a campaign report JSON — what `make chaos-real` renders
+        after its campaigns."""
+        rows, from_file = self._watchdog_fragments(args)
+        if rows is None:
+            return
+        if args and args[0] == "json":
+            self._print(json.dumps(
+                {label: (frag.get("incidents") if frag else None)
+                 for label, frag in rows},
+                indent=2, sort_keys=True, default=str))
+            return
+        rendered = 0
+        for label, frag in rows:
+            incidents = (frag or {}).get("incidents")
+            if incidents is None:
+                continue
+            self._render_incidents(label, incidents,
+                                   alerts_hint=(frag or {}).get("alerts"))
+            rendered += 1
+        if not rendered:
+            self._print("no incident telemetry (watchdog_enabled off, or "
+                        "campaigns run without --watchdog)")
+
     def do_chaos_status(self, args: List[str]) -> None:
         """Nemesis activity (docs/real_cluster.md): chaos.* counters + the
         recent event ring from the telemetry hub — the live view after an
@@ -733,16 +873,21 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     cmd0 = args.command[0].replace("-", "_") if args.command else ""
     if cmd0 in ("chaos_status", "trace") or (
-            cmd0 == "heat" and len(args.command) > 1
+            cmd0 in ("heat", "alerts", "incidents") and len(args.command) > 1
             and args.command[1].endswith(".json")):
         # no cluster needed: renders the hub / a report, trace or heat
-        # artifact file / a live span-ring fetch over RPC
+        # artifact file / a live span-ring fetch over RPC / campaign
+        # alert+incident timelines
         cli = Cli.__new__(Cli)
         cli.out = sys.stdout
         if cmd0 == "chaos_status":
             cli.do_chaos_status(args.command[1:])
         elif cmd0 == "heat":
             cli.do_heat(args.command[1:])
+        elif cmd0 == "alerts":
+            cli.do_alerts(args.command[1:])
+        elif cmd0 == "incidents":
+            cli.do_incidents(args.command[1:])
         else:
             cli.do_trace(args.command[1:])
         return 0

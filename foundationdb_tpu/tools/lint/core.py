@@ -315,7 +315,8 @@ DEFAULT_POLICY: Dict[str, RulePolicy] = {
         }),
     "knob-drift": RulePolicy(
         options={
-            "families": ("resolver_", "real_", "chaos_", "trace_"),
+            "families": ("resolver_", "real_", "chaos_", "trace_",
+                         "watchdog_"),
             "knobs_file": "foundationdb_tpu/core/knobs.py",
             "docs_dir": "docs",
             # extra reference roots scanned for knob usage beyond the

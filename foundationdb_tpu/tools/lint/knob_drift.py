@@ -1,8 +1,8 @@
 """Rule `knob-drift`: three-way knob / code / docs reconciliation.
 
 Front-runs: the operator contract.  Every ``resolver_*`` / ``real_*`` /
-``chaos_*`` / ``trace_*`` knob is a tuning surface the docs advertise and
-campaigns override by name — a knob defined but never referenced is dead
+``chaos_*`` / ``trace_*`` / ``watchdog_*`` knob is a tuning surface the
+docs advertise and campaigns override by name — a knob defined but never referenced is dead
 weight, a knob without a doc row is an invisible tuning surface, a doc
 row for a deleted knob teaches operators a ``--knob`` override that
 raises ``KeyError``, and a drifted documented default misprices every
@@ -91,7 +91,8 @@ class KnobDriftChecker(Checker):
                    policy: RulePolicy) -> Iterable[Finding]:
         opts = policy.options
         families = tuple(opts.get("families",
-                                  ("resolver_", "real_", "chaos_", "trace_")))
+                                  ("resolver_", "real_", "chaos_", "trace_",
+                                   "watchdog_")))
         knobs_rel = opts.get("knobs_file", "foundationdb_tpu/core/knobs.py")
         knobs_path = root / knobs_rel
         docs_dir = root / opts.get("docs_dir", "docs")

@@ -1,0 +1,598 @@
+"""Cluster watchdog (core/watchdog.py; docs/observability.md "Watchdog,
+burn rates & incidents").
+
+Covers: rule lifecycles (pending -> firing -> resolved with hold/clear
+semantics) per rule class on a virtual clock; burn-rate arithmetic
+against a hand computation + the multi-window flap guard; incident
+grouping, correlation and explainability (an unexplained incident fails
+`assert_slos` with the alert name leading); same-seed determinism of
+incident timelines; the disabled-path allocation/cost guard (the
+NULL_SPAN pattern); observational safety with real engines (bit-identical
+abort sets, zero post-warmup compiles, blocking_syncs == 0 with the
+watchdog evaluating between batches); the ratekeeper burn clamp; and the
+tier-1 campaign acceptance — a fault seed produces >= 1 incident
+machine-correlated to its injected window with the dominant latency
+segment named, while a no-fault control campaign produces zero firing
+incidents (the false-positive guard)."""
+import io
+import json
+import time
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.core import telemetry
+from foundationdb_tpu.core.knobs import SERVER_KNOBS
+from foundationdb_tpu.core.watchdog import (
+    AnomalyRule,
+    BurnRateRule,
+    StalenessRule,
+    ThresholdRule,
+    Watchdog,
+    default_rules,
+    record_commit_sli,
+    watchdog_allocations,
+)
+
+
+def _wd(rules, t):
+    """A watchdog on a settable virtual clock (t is a 1-element list)."""
+    return Watchdog(rules, now_fn=lambda: t[0])
+
+
+def _hub():
+    h = telemetry.TelemetryHub()
+    h.attach_watchdog(None)   # ours regardless of the knob
+    return h
+
+
+def _states(wd):
+    return {(n, s): a["state"] for (n, s), a in
+            (((al["name"], al["series"]), al)
+             for al in wd.alerts_snapshot())}
+
+
+# -- lifecycles ---------------------------------------------------------------
+
+def test_threshold_lifecycle_hold_and_clear():
+    t = [0.0]
+    hub = _hub()
+    wd = _wd([ThresholdRule("q_depth", "loop.*.ring_depth", 5, ">",
+                            hold_s=0.2, clear_s=0.4)], t)
+    hub.attach_watchdog(wd)
+    m = hub.tdmetrics.int64("loop.eng.ring_depth")
+
+    def tick(dt, v):
+        t[0] += dt
+        m.set(v)
+        hub.sync()
+
+    tick(0.1, 3)
+    assert wd.alerts_snapshot()[0]["state"] == "ok"
+    tick(0.1, 9)                 # active -> pending
+    assert wd.alerts_snapshot()[0]["state"] == "pending"
+    tick(0.1, 9)                 # held 0.1 < 0.2: still pending
+    assert wd.alerts_snapshot()[0]["state"] == "pending"
+    tick(0.15, 9)                # held 0.25 >= 0.2 -> firing
+    assert wd.alerts_snapshot()[0]["state"] == "firing"
+    assert wd.firing()[0]["name"] == "q_depth"
+    tick(0.1, 2)                 # clear starts
+    assert wd.alerts_snapshot()[0]["state"] == "firing"
+    tick(0.2, 9)                 # re-activates mid-clear: clear resets
+    tick(0.1, 2)
+    tick(0.3, 2)
+    assert wd.alerts_snapshot()[0]["state"] == "firing"  # only 0.3 clear
+    tick(0.2, 2)                 # 0.5 >= 0.4 -> resolved
+    assert wd.alerts_snapshot()[0]["state"] == "ok"
+    states = [e["state"] for e in wd.ring]
+    assert states.count("firing") == 1 and states[-1] == "resolved"
+
+
+def test_threshold_blip_shorter_than_hold_never_fires():
+    t = [0.0]
+    hub = _hub()
+    wd = _wd([ThresholdRule("blip", "x.*.v", 0, ">", hold_s=0.5,
+                            clear_s=0.1)], t)
+    hub.attach_watchdog(wd)
+    m = hub.tdmetrics.int64("x.a.v")
+    for i in range(20):
+        t[0] += 0.1
+        m.set(1 if i % 4 == 0 else 0)   # 0.1s blips, 0.5s hold
+        hub.sync()
+    assert not [e for e in wd.ring if e["state"] == "firing"]
+    assert wd.incidents == []
+
+
+def test_staleness_arms_fires_and_resolves():
+    t = [0.0]
+    hub = _hub()
+    wd = _wd([StalenessRule("stall", "sli.*.total", max_age_s=1.0,
+                            hold_s=0.0, clear_s=0.0)], t)
+    hub.attach_watchdog(wd)
+    m = hub.tdmetrics.int64("sli.commit.total")
+    for i in range(1, 11):      # advancing: never stale
+        t[0] = i * 0.2
+        m.set(i)
+        hub.sync()
+    assert wd.firing() == []
+    for i in range(11, 18):     # frozen for 1.4s > 1.0s
+        t[0] = i * 0.2
+        hub.sync()
+    assert [a["name"] for a in wd.firing()] == ["stall"]
+    t[0] += 0.2
+    m.set(99)                   # flow resumes
+    hub.sync()
+    hub.sync()
+    assert wd.firing() == []
+
+
+def test_anomaly_band_fires_on_shift_and_reconverges():
+    t = [0.0]
+    hub = _hub()
+    wd = _wd([AnomalyRule("shift", "heat.*.concentration_x1000",
+                          z_threshold=3.5, hold_s=0.0, clear_s=0.1)], t)
+    hub.attach_watchdog(wd)
+    m = hub.tdmetrics.int64("heat.eng.concentration_x1000")
+    for i in range(1, 30):                 # stable band
+        t[0] = i * 0.1
+        m.set(100 + (i % 3))
+        hub.sync()
+    assert wd.firing() == []
+    fired = False
+    for i in range(30, 80):                # step shift
+        t[0] = i * 0.1
+        m.set(700)
+        hub.sync()
+        fired = fired or bool(wd.firing())
+    assert fired, "level shift never fired the anomaly band"
+    # the clamped band walked to the new level and the alert resolved
+    assert wd.firing() == []
+    assert [e for e in wd.ring if e["state"] == "resolved"]
+
+
+# -- burn-rate math -----------------------------------------------------------
+
+def test_burn_rate_matches_hand_computation_exactly():
+    from foundationdb_tpu.core.watchdog import _SeriesView
+
+    rule = BurnRateRule("b", "sli.*.good", "sli.*.bad", budget_frac=0.05,
+                        fast_s=1.0, slow_s=4.0, threshold=2.0)
+    td = telemetry.TelemetryHub().tdmetrics
+    good = bad = 0
+    t = 0.0
+    for i in range(1, 41):                  # 0.1s ticks over 4s
+        t = i * 0.1
+        good += 9
+        bad += 1                            # 10% bad at a 5% budget
+        td.int64("sli.commit.good").set(good)
+        td.int64("sli.commit.bad").set(bad)
+        list(rule.conditions(t, _SeriesView(td.metrics)))
+    burn_fast, ev_fast = rule.window_burn(("commit",), 1.0, t)
+    burn_slow, ev_slow = rule.window_burn(("commit",), 4.0, t)
+    # hand: every window sees the same 10% bad fraction -> 0.1/0.05 = 2.0
+    assert burn_fast == pytest.approx(2.0)
+    assert burn_slow == pytest.approx(2.0)
+    # fast window: baseline is the newest sample at/before t-1.0 (t=3.0,
+    # 300 events recorded) -> delta = 400 - 300 = 100 events
+    assert ev_fast == pytest.approx(100)
+    # slow window: wider than the recorded history, so the baseline is
+    # the EARLIEST observation (t=0.1, 10 events) -> 390, not 400 —
+    # pre-history is never fabricated as zero
+    assert ev_slow == pytest.approx(good + bad - 10)
+
+
+def test_burn_multiwindow_blip_does_not_fire():
+    """A short bad spike burns the fast window but not the slow one —
+    the pair must NOT fire (the flap guard), while a sustained burn
+    fires both."""
+    t = [0.0]
+    hub = _hub()
+    rule = BurnRateRule("slo", "sli.*.good", "sli.*.bad",
+                        budget_frac=0.1, fast_s=0.5, slow_s=2.0,
+                        threshold=2.0, hold_s=0.0, clear_s=0.1)
+    wd = _wd([rule], t)
+    hub.attach_watchdog(wd)
+    td = hub.tdmetrics
+    good = bad = 0
+
+    def tick(n_good, n_bad):
+        t[0] += 0.05
+        nonlocal good, bad
+        good += n_good
+        bad += n_bad
+        td.int64("sli.c.good").set(good)
+        td.int64("sli.c.bad").set(bad)
+        hub.sync()
+
+    for _ in range(60):
+        tick(5, 0)              # 3s healthy history
+    for _ in range(4):
+        tick(1, 4)              # 0.2s blip at 80% bad: fast window burns
+                                # (~3.2x budget) but the slow one holds
+    assert wd.firing() == [], "blip fired despite a cold slow window"
+    for _ in range(40):
+        tick(1, 4)              # sustained 2s burn: both windows
+    assert [a["name"] for a in wd.firing()] == ["slo"]
+
+
+# -- incidents, correlation, explainability -----------------------------------
+
+def test_incident_groups_correlates_and_explains():
+    t = [0.0]
+    hub = _hub()
+    wd = _wd([ThresholdRule("engine_unhealthy", "resolver.*.state", 1,
+                            ">=", hold_s=0.0, clear_s=0.2)], t)
+    hub.attach_watchdog(wd)
+    m = hub.tdmetrics.int64("resolver.r1.state")
+    for i in range(1, 5):
+        t[0] = i * 0.1
+        m.value = 0 if i < 3 else 2        # healthy, then failed at 0.3
+        m._record(m.value)
+        hub.sync()
+    t[0] = 0.6
+    m.value = 3                            # probation
+    m._record(m.value)
+    hub.sync()
+    t[0] = 0.8
+    m.value = 0
+    m._record(m.value)
+    hub.sync()
+    t[0] = 1.2
+    hub.sync()                             # clear elapses -> resolved
+    assert len(wd.incidents) == 1
+    inc = wd.incidents[0]
+    assert inc.t1 is not None
+    root = {"dominant_segment": "server_resolve", "dominant_ms": 9.1,
+            "client_ms": 12.0, "rid": "r1.1"}
+    wd.correlate([{"kind": "device_fault", "t0": 0.25, "t1": 0.9}],
+                 root_cause=root)
+    d = inc.as_dict()
+    assert d["explained"] and d["windows"][0]["kind"] == "device_fault"
+    # the summary reads like the issue's example: alert · window ·
+    # dominant segment · worst health state
+    assert "engine_unhealthy firing" in d["summary"]
+    assert "overlaps device_fault window" in d["summary"]
+    assert "dominant=server_resolve" in d["summary"]
+    assert "state=probation" in d["summary"]
+    assert {h["state"] for h in d["health"]} >= {"failed", "probation"}
+
+
+def test_unexplained_incident_and_breach_naming():
+    t = [0.0]
+    hub = _hub()
+    wd = _wd([BurnRateRule("slo_p99_burn", "sli.*.good", "sli.*.bad",
+                           budget_frac=0.01, fast_s=0.2, slow_s=0.5,
+                           threshold=2.0, min_events=4, hold_s=0.0),
+              ThresholdRule("tripwire", "x.*.v", 0, ">", hold_s=0.0)], t)
+    hub.attach_watchdog(wd)
+    td = hub.tdmetrics
+    good = bad = 0
+    for i in range(1, 30):
+        t[0] = i * 0.1
+        good += 3
+        bad += 2
+        td.int64("sli.c.good").set(good)
+        td.int64("sli.c.bad").set(bad)
+        td.int64("x.a.v").set(1)
+        hub.sync()
+    assert {a["name"] for a in wd.firing()} == {"slo_p99_burn", "tripwire"}
+    # no windows, no breach named: unexplained
+    wd.correlate([])
+    assert all(not i.explained for i in wd.incidents)
+    # a named breach explains ONLY incidents carrying a burn alert; this
+    # incident has one, so it reads as the breach's alert
+    wd.correlate([], breached_slo="p99_budget")
+    assert wd.incidents[0].explained
+    assert "names the p99_budget breach" in wd.incidents[0].explanation
+
+
+def test_alert_ring_bounded_by_knob():
+    t = [0.0]
+    hub = _hub()
+    old = SERVER_KNOBS.watchdog_alert_ring
+    SERVER_KNOBS.set_knob("watchdog_alert_ring", "16")
+    try:
+        wd = _wd([ThresholdRule("flap", "x.*.v", 0, ">", hold_s=0.0,
+                                clear_s=0.0)], t)
+        hub.attach_watchdog(wd)
+        m = hub.tdmetrics.int64("x.a.v")
+        for i in range(1, 200):
+            t[0] = i * 0.1
+            m.set(i % 2)
+            hub.sync()
+        assert len(wd.ring) == 16
+    finally:
+        SERVER_KNOBS.set_knob("watchdog_alert_ring", str(old))
+
+
+# -- determinism --------------------------------------------------------------
+
+def test_same_seed_synthetic_replay_identical_timelines():
+    """Two runs of the same seeded replay produce bit-equal incident
+    timelines (names, windows, root causes) — the fdbtpu-lint
+    determinism contract, dynamically."""
+    from foundationdb_tpu.tools.watch_smoke import synthetic_replay
+
+    _h1, wd1, _w1 = synthetic_replay(seed=13)
+    _h2, wd2, _w2 = synthetic_replay(seed=13)
+    assert wd1.timeline() == wd2.timeline()
+    assert ([i.as_dict() for i in wd1.incidents]
+            == [i.as_dict() for i in wd2.incidents])
+
+
+# -- the disabled path (the NULL_SPAN pattern) --------------------------------
+
+def test_disabled_watchdog_sync_allocates_nothing_and_stays_cheap():
+    hub = _hub()                      # watchdog None
+    hub.tdmetrics.int64("engine.e.compiles").set(3)
+    hub.sync()                        # series created, steady state
+    before = watchdog_allocations[0]
+    n = 2000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        hub.sync()
+    per_call_us = (time.perf_counter() - t0) / n * 1e6
+    assert watchdog_allocations[0] == before, \
+        "watchdog-off sync() allocated watchdog state"
+    assert hub.watchdog is None
+    # the watchdog-off tail is one attribute check; the whole empty-hub
+    # sync stays well under the telemetry-smoke span budget's order
+    assert per_call_us < 50.0, f"sync() costs {per_call_us:.1f}us/call"
+
+
+# -- observational safety with real engines -----------------------------------
+
+def test_watchdog_on_abort_parity_zero_compiles_zero_blocking_syncs():
+    """The acceptance bit: watchdog-on runs keep abort sets
+    bit-identical across step AND loop dispatch with zero post-warmup
+    compiles and blocking_syncs == 0 — evaluation reads host-side
+    counters only and can never touch a verdict."""
+    from foundationdb_tpu.ops import conflict_kernel as ck
+    from foundationdb_tpu.ops.device_loop import DeviceLoopEngine
+    from foundationdb_tpu.ops.host_engine import JaxConflictEngine
+    from foundationdb_tpu.tools.floor_bench import _CompileCounter
+    from foundationdb_tpu.tools.ladder_bench import make_point_txns
+
+    cfg = ck.KernelConfig(key_words=4, capacity=1024, max_txns=64,
+                          max_point_reads=128, max_point_writes=128,
+                          max_reads=16, max_writes=16)
+    telemetry.reset()
+    hub = telemetry.hub()
+    hub.attach_watchdog(Watchdog(default_rules()))
+    watched = JaxConflictEngine(cfg, ladder=[32], scan_sizes=(2,)).warmup()
+    loop = DeviceLoopEngine(cfg, ladder=[32]).warmup()
+    plain = JaxConflictEngine(cfg, ladder=[32], scan_sizes=(2,)).warmup()
+    rng = np.random.default_rng(7)
+    counter = _CompileCounter()
+    version = 1_000
+    evals_before = hub.watchdog.evaluations
+    for n in (8, 31, 32, 33, 64, 120):
+        txns = make_point_txns(n, 128, rng, version)
+        version += max(64, n)
+        new_oldest = max(0, version - 50_000)
+        got = [int(x) for x in watched.resolve(txns, version, new_oldest)]
+        lgot = [int(x) for x in loop.resolve(txns, version, new_oldest)]
+        want = [int(x) for x in plain.resolve(txns, version, new_oldest)]
+        assert got == want == lgot, (n, version)
+        hub.sync()                     # evaluate between every batch
+    loop.drain_loop()
+    assert counter.close() == 0, "watchdog sync caused steady compiles"
+    assert loop.loop_stats["blocking_syncs"] == 0
+    assert hub.watchdog.evaluations > evals_before
+    # the engines' series were actually under evaluation: the abort burn
+    # rule tracks the verdict counters (keyed by the engine label) and
+    # the steady-compile rule tracks the perf ledger
+    names = {a["name"] for a in hub.watchdog.alerts_snapshot()}
+    assert {"abort_frac_burn", "steady_state_compiles"} <= names
+    telemetry.reset()
+
+
+# -- ratekeeper clamp ---------------------------------------------------------
+
+def test_ratekeeper_clamps_on_firing_burn_alert():
+    from foundationdb_tpu.server.ratekeeper import Ratekeeper
+
+    rk = Ratekeeper(net=None, src_addr="rk", storage_tags=[],
+                    committed_version_fn=lambda: 0)
+    max_tps = float(SERVER_KNOBS.max_transactions_per_second)
+    tps = rk._update_rate([], None, [{"degraded": False,
+                                      "burn_alert_firing": False}])
+    assert tps == max_tps
+    tps = rk._update_rate([], None, [{"degraded": False,
+                                      "burn_alert_firing": True}])
+    assert rk.burn_alert_firing
+    assert tps == pytest.approx(
+        max_tps * SERVER_KNOBS.watchdog_burn_tps_fraction)
+    # composes with the degraded clamp: min wins
+    tps = rk._update_rate([], None, [{"degraded": True,
+                                      "burn_alert_firing": True}])
+    assert tps == pytest.approx(max_tps * min(
+        SERVER_KNOBS.watchdog_burn_tps_fraction,
+        SERVER_KNOBS.resolver_degraded_tps_fraction))
+
+
+# -- SLI recording ------------------------------------------------------------
+
+def test_record_commit_sli_good_bad_split():
+    hub = _hub()
+    for ms in (1.0, 2.0, 300.0):
+        record_commit_sli(hub, ms, budget_ms=250.0)
+    td = hub.tdmetrics
+    assert td.int64("sli.commit.total").value == 3
+    assert td.int64("sli.commit.good").value == 2
+    assert td.int64("sli.commit.bad").value == 1
+
+
+# -- exposition + cli ---------------------------------------------------------
+
+def test_alerts_exposition_strict_parse():
+    from foundationdb_tpu.tools.watch_smoke import (strict_parse_prometheus,
+                                                    synthetic_replay)
+
+    hub, wd, _ = synthetic_replay(seed=3)
+    text = hub.prometheus_text()
+    assert strict_parse_prometheus(text) > 0
+    assert "# TYPE fdbtpu_alerts gauge" in text
+    assert 'fdbtpu_alerts{series="firing"}' in text
+    assert "fdbtpu_sli" in text and "fdbtpu_admission" in text
+
+
+def _report_file(tmp_path, incidents, alerts=None):
+    rep = {"cfg_seed": 5, "engine_mode": "oracle",
+           "incidents": incidents, "alerts": alerts or []}
+    p = tmp_path / "report.json"
+    p.write_text(json.dumps({"campaigns": [rep]}))
+    return str(p)
+
+
+def test_cli_incidents_and_alerts_cluster_less(tmp_path):
+    from foundationdb_tpu.tools.cli import Cli
+
+    inc = {"id": 1, "t0": 1.0, "t1": 2.0, "explained": True,
+           "explanation": "overlaps injected partition",
+           "summary": "slo_p99_burn firing · overlaps partition window "
+                      "· dominant=server_resolve",
+           "alerts": [{"name": "slo_p99_burn", "kind": "burn",
+                       "series": "commit", "value": 9.0, "detail": "x"}],
+           "windows": [{"kind": "partition", "t0": 0.9, "t1": 2.1}],
+           "health": [{"t": 1.1, "label": "r1", "state": "probation"}],
+           "root_cause": {"dominant_segment": "server_resolve",
+                          "dominant_ms": 9.0, "client_ms": 12.0,
+                          "rid": "r.1"}}
+    alerts = [{"name": "slo_p99_burn", "series": "commit",
+               "state": "firing", "value": 9.0, "detail": "burn",
+               "fired_count": 1}]
+    path = _report_file(tmp_path, [inc], alerts)
+    out = io.StringIO()
+    cli = Cli.__new__(Cli)
+    cli.out = out
+    cli.do_incidents([path])
+    text = out.getvalue()
+    assert "EXPLAINED" in text and "overlaps injected partition" in text
+    assert "dominant=server_resolve" in text
+    assert "probation" in text
+    out.seek(0)
+    out.truncate(0)
+    cli.do_alerts([path])
+    text = out.getvalue()
+    assert "slo_p99_burn" in text and "firing" in text
+
+    # empty-incident report renders the quiet path, not a crash
+    out.seek(0)
+    out.truncate(0)
+    cli.do_incidents([_report_file(tmp_path, [])])
+    assert "no incidents" in out.getvalue()
+
+
+def test_cli_alerts_live_sim_cluster():
+    """engine_health -> ratekeeper -> CC status doc -> `cli alerts`
+    renders the watchdog fragment from a live (simulated) cluster with
+    the watchdog_enabled knob on, evaluating on the virtual clock."""
+    from foundationdb_tpu.server.cluster import (DynamicClusterConfig,
+                                                 build_dynamic_cluster)
+    from foundationdb_tpu.tools.cli import Cli
+
+    SERVER_KNOBS.set_knob("watchdog_enabled", "true")
+    try:
+        c = build_dynamic_cluster(seed=23, cfg=DynamicClusterConfig())
+        out = io.StringIO()
+        cli = Cli(c, out=out)
+        c.sim.run(until=5.0)
+        for i in range(4):
+            cli.run_command(f"set wk{i} v{i}")
+        c.sim.run(until=c.sim.sched.time + 3.0)   # ratekeeper poll cadence
+        out.seek(0)
+        out.truncate(0)
+        cli.run_command("alerts")
+        text = out.getvalue()
+        assert "evaluations" in text and "firing" in text, text
+        out.seek(0)
+        out.truncate(0)
+        cli.run_command("incidents")
+        # BUGGIFY fires device faults at the engine boundary in every
+        # sim, so a suspect arc (and thus a live incident) may or may
+        # not have happened by now — both renders are valid; live
+        # incidents carry no injected windows to correlate against
+        assert ("no incidents" in out.getvalue()
+                or "incident(s)" in out.getvalue())
+    finally:
+        SERVER_KNOBS.set_knob("watchdog_enabled", "false")
+        telemetry.reset()
+
+
+# -- the campaign acceptance --------------------------------------------------
+
+def _campaign_cfg(**kw):
+    from foundationdb_tpu.real.nemesis import NemesisConfig
+
+    kw.setdefault("budget_ms", 250.0)   # the tier-1 co-residency budget
+    kw.setdefault("engine_mode", "oracle")
+    kw.setdefault("watchdog", True)
+    return NemesisConfig(seed=kw.pop("seed", 11), **kw)
+
+
+@pytest.mark.timeout(120)
+def test_campaign_fault_seed_produces_explained_incident():
+    """Tier-1 acceptance: the chaos seed's injected device-fault window
+    produces >= 1 incident machine-correlated to it, with the dominant
+    latency segment named; assert_slos (which now also checks
+    explainability) passes."""
+    from foundationdb_tpu.real.nemesis import assert_slos, run_campaign
+
+    cfg = _campaign_cfg(duration_s=3.5)
+    rep = run_campaign(cfg)
+    assert_slos(rep, cfg)
+    assert rep.incidents, "fault campaign produced no incidents"
+    correlated = [i for i in rep.incidents if i["windows"]]
+    assert correlated, f"no incident overlapped a fault window: {rep.incidents}"
+    inc = correlated[0]
+    assert inc["explained"]
+    assert {w["kind"] for w in inc["windows"]} & \
+        {"device_incident", "partition"}
+    assert inc["root_cause"]["dominant_segment"] in \
+        inc["root_cause"]["segments_ms"]
+    assert f"dominant={inc['root_cause']['dominant_segment']}" \
+        in inc["summary"]
+    # the forced failover arc is in the incident's health timeline
+    assert any(h["state"] in ("failed", "suspect", "probation")
+               for h in inc["health"])
+    # alert states rode the report for `cli alerts REPORT.json`
+    assert any(a["fired_count"] > 0 for a in rep.alerts)
+
+
+@pytest.mark.timeout(90)
+def test_campaign_no_fault_control_zero_incidents():
+    """The false-positive guard: a control campaign with no injected
+    faults fires nothing."""
+    from foundationdb_tpu.real.nemesis import assert_slos, run_campaign
+
+    # widened dispatch watchdog: a co-resident CI stall must not read
+    # as a device fault in the NO-fault control (make_chaos_engine)
+    cfg = _campaign_cfg(seed=29, duration_s=2.5, partitions=0,
+                        device_faults=False, kill_child=False,
+                        dispatch_timeout_s=2.0)
+    rep = run_campaign(cfg)
+    assert rep.incidents == [], \
+        f"control campaign fired incidents: {rep.incidents}"
+    assert_slos(rep, cfg)
+
+
+@pytest.mark.timeout(90)
+def test_campaign_induced_unexplained_incident_fails_slos():
+    """An alert with no overlapping injected window fails assert_slos
+    with the alert name LEADING the message."""
+    from foundationdb_tpu.real.nemesis import assert_slos, run_campaign
+
+    tripwire = ThresholdRule("induced_tripwire", "sli.*.total", 0, ">",
+                             hold_s=0.0)
+    cfg = _campaign_cfg(seed=31, duration_s=2.5, partitions=0,
+                        device_faults=False, kill_child=False,
+                        warmup_frac=0.0,   # no window may explain it
+                        dispatch_timeout_s=2.0,
+                        watchdog_extra_rules=[tripwire])
+    rep = run_campaign(cfg)
+    assert rep.incidents and not rep.incidents[0]["explained"]
+    with pytest.raises(AssertionError) as ei:
+        assert_slos(rep, cfg)
+    assert str(ei.value).startswith("induced_tripwire"), \
+        str(ei.value)[:120]
