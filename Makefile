@@ -75,6 +75,30 @@ watch-smoke:
 bench-history:
 	python -m foundationdb_tpu.tools.bench_history
 
+# Online-resharding smoke (docs/elasticity.md, ~45s, solo-CPU safe — one
+# process, no sockets, do not overlap with tier-1): synthetic drift
+# against REAL jax engines drives one split AND one merge end-to-end
+# through the live handoff protocol, asserts every blackout under
+# reshard_blackout_budget_ms (controller clocks AND reshard.blackout
+# trace segments), zero post-warmup compiles on untouched shards,
+# bit-identical shard-journal oracle replay (handoff batches included),
+# and a strict parse of the fdbtpu_reshard Prometheus family.
+reshard-smoke:
+	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.reshard_smoke
+
+# Diurnal drift campaigns (docs/elasticity.md): the live-elasticity SLO
+# gate — 2 seeds x {jax, device_loop} wall-clock campaigns where the hot
+# range DRIFTS across the keyspace while the heat-driven controller
+# splits/merges resolver shards on the live cluster. assert_slos
+# additionally requires >= 2 executed reshards per campaign with every
+# per-range blackout inside budget. Solo-CPU: do not overlap with tier-1.
+chaos-drift:
+	JAX_PLATFORMS=cpu python -m foundationdb_tpu.real.nemesis \
+		--drift --seeds 2 --engine-modes jax,device_loop --watchdog \
+		--json chaos_drift_report.json
+	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.cli \
+		shards chaos_drift_report.json
+
 # Static invariant check (docs/static_analysis.md, ~2s, pure AST — never
 # imports jax): determinism, host-sync discipline, donation safety,
 # recompile hazards, knob/doc drift, span registry. Non-zero on any
@@ -102,4 +126,4 @@ chaos-real:
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.cli \
 		incidents chaos_real_report.json
 
-.PHONY: check bench bench-smoke telemetry-smoke heat-smoke trace-smoke chaos chaos-real lint perf-smoke bench-history watch-smoke
+.PHONY: check bench bench-smoke telemetry-smoke heat-smoke trace-smoke chaos chaos-real chaos-drift reshard-smoke lint perf-smoke bench-history watch-smoke

@@ -42,16 +42,9 @@ LANE_READS, LANE_WRITES, LANE_CONFLICTS = 0, 1, 2
 C_COMMITTED, C_CONFLICTS, C_TOO_OLD, C_RECLAIMED = 0, 1, 2, 3
 
 
-def _fmt_key(key: bytes) -> str:
-    """Render a boundary key for humans/JSON: printable ASCII as text,
-    anything else as 0x-hex (the `tools/cli.py` convention)."""
-    try:
-        s = key.decode()
-        if s.isascii() and s.isprintable():
-            return s
-    except UnicodeDecodeError:
-        pass
-    return "0x" + key.hex()
+# the one boundary-key renderer (printable ASCII as text, else 0x-hex),
+# shared with the shard map's report dicts
+from .keyshard import _fmt_key  # noqa: E402  (re-export, existing users)
 
 
 def _unpack_key(row: np.ndarray, key_words: int) -> bytes:
@@ -107,6 +100,12 @@ class KeyRangeHeatAggregator:
         #: recent first-witness abort attributions: which prior write
         #: (version) killed a transaction, and in which key range
         self.attribution: deque = deque(maxlen=self.MAX_ATTRIBUTION)
+        #: last ADOPTED split points (split-point hysteresis: a fresh
+        #: equal-load derivation replaces these only when it improves the
+        #: measured imbalance by at least the hysteresis knob — two
+        #: adjacent scrapes of a stationary stream must not flap the
+        #: resharding controller by one bucket)
+        self._last_splits: Optional[List[bytes]] = None
 
     # -- merging -------------------------------------------------------------
     def merge(self, heat: Dict[str, np.ndarray], base: int = 0,
@@ -171,6 +170,68 @@ class KeyRangeHeatAggregator:
                     })
         self._prune()
 
+    def observe_batch(self, transactions, verdicts,
+                      version: Optional[int] = None) -> None:
+        """Host-fed merge path: fold ONE resolved batch's conflict ranges
+        directly into the decayed map, keyed by each range's begin key.
+
+        The device path (`merge`/`merge_shards`) rides the resolve step's
+        packed aggregate and its table-sampled bucket grid; this path
+        serves engines without the device layer (the CPU oracle, an
+        elastic group of supervised engines — server/reshard.py) from the
+        transactions the host already holds. Same read model either way:
+        hot_ranges / concentration / split_points answer identically, the
+        grid is just the observed range-begin keys instead of sampled
+        table boundaries. Reads land in the reads lane; committed writes
+        in the writes lane; a conflicted transaction's read begins in the
+        conflicts lane (where the contention actually bit)."""
+        from .types import TransactionCommitResult
+
+        self.batches += 1
+        committed = int(TransactionCommitResult.COMMITTED)
+        too_old = int(TransactionCommitResult.TOO_OLD)
+        if self.decay < 1.0 and self._w:
+            for w in self._w.values():
+                w *= self.decay
+
+        def lane(key: bytes, ln: int, amount: float = 1.0) -> None:
+            w = self._w.get(key)
+            if w is None:
+                w = self._w[key] = np.zeros((3,), np.float64)
+            w[ln] += amount
+
+        samples = 0
+        for t, txn in enumerate(transactions):
+            v = int(verdicts[t])
+            if v == committed:
+                self.verdict_totals["committed"] += 1
+            elif v == too_old:
+                self.verdict_totals["too_old"] += 1
+            else:
+                self.verdict_totals["conflicts"] += 1
+            for r in txn.read_conflict_ranges:
+                lane(r.begin, LANE_READS)
+                if v != committed and v != too_old:
+                    lane(r.begin, LANE_CONFLICTS)
+            if v == committed:
+                for r in txn.write_conflict_ranges:
+                    lane(r.begin, LANE_WRITES)
+            elif (v != too_old and version is not None and samples < 4
+                  and txn.read_conflict_ranges):
+                # sampled abort attribution, the host-fed analog of the
+                # device path's first-witness ring: the host doesn't know
+                # WHICH prior write convicted, but the aborted range and
+                # batch version still place the contention
+                samples += 1
+                self.attribution.append({
+                    "txn_index": t,
+                    "version": int(version),
+                    "witness_version": None,
+                    "range_begin": _fmt_key(
+                        txn.read_conflict_ranges[0].begin),
+                })
+        self._prune()
+
     def reset_weights(self) -> None:
         """Drop the accumulated range weights and attribution samples
         (verdict/occupancy totals stay). Useful after a warm-up phase:
@@ -180,6 +241,7 @@ class KeyRangeHeatAggregator:
         state on a stationary grid."""
         self._w.clear()
         self.attribution.clear()
+        self._last_splits = None
 
     def _prune(self) -> None:
         if len(self._w) <= self.MAX_RANGES:
@@ -260,7 +322,61 @@ class KeyRangeHeatAggregator:
             key = items[j][0]
             if not out or key > out[-1]:
                 out.append(key)
+        # Split-point hysteresis (the `resolver_heat_split_hysteresis`
+        # knob): the equal-load derivation above re-runs on the DECAYED
+        # weights every call, so two adjacent scrapes of a stationary
+        # stream can disagree by one bucket — enough to flap an online
+        # resharding controller between two near-equal plans. Keep the
+        # last adopted splits unless the fresh candidate improves the
+        # measured per-shard imbalance by at least the knob.
+        last = self._last_splits
+        if (last is not None and last != out
+                and len(last) == len(out)):
+            imb_last = self._imbalance(self.split_balance(shards, last))
+            imb_new = self._imbalance(self.split_balance(shards, out))
+            if imb_last - imb_new < self._split_hysteresis():
+                return list(last)
+        self._last_splits = list(out)
         return out
+
+    def split_key_within(self, begin: bytes,
+                         end: Optional[bytes]) -> Optional[bytes]:
+        """The measured equal-load midpoint key STRICTLY inside span
+        [begin, end) — where an online split of that span should cut
+        (server/reshard.py). None when the span's load sits in a single
+        retained bucket (nothing to split on)."""
+        items = [(k, w) for k, w in self._sorted_items()
+                 if k >= begin and (end is None or k < end)]
+        if len(items) < 2:
+            return None
+        loads = [float(w[LANE_WRITES] + w[LANE_CONFLICTS]) for _k, w in items]
+        total = sum(loads)
+        if total <= 0:
+            return None
+        acc = 0.0
+        for i, (k, _w) in enumerate(items):
+            acc += loads[i]
+            if acc >= total / 2 and i + 1 < len(items):
+                key = items[i + 1][0]
+                if key > begin and (end is None or key < end):
+                    return key
+                return None
+        return None
+
+    @staticmethod
+    def _imbalance(fracs: Sequence[float]) -> float:
+        """Worst per-shard deviation from the equal-load ideal."""
+        if not fracs:
+            return 0.0
+        ideal = 1.0 / len(fracs)
+        return max(abs(f - ideal) for f in fracs)
+
+    @staticmethod
+    def _split_hysteresis() -> float:
+        from .knobs import SERVER_KNOBS
+
+        return float(getattr(SERVER_KNOBS,
+                             "resolver_heat_split_hysteresis", 0.05))
 
     def split_balance(self, shards: Optional[int] = None,
                       splits: Optional[Sequence[bytes]] = None) -> List[float]:

@@ -15,6 +15,18 @@ from . import wire
 from .types import Key
 
 
+def _fmt_key(key: bytes) -> str:
+    """Render a boundary key for humans/JSON: printable ASCII as text,
+    anything else as 0x-hex (the `tools/cli.py` convention)."""
+    try:
+        s = key.decode()
+        if s.isascii() and s.isprintable():
+            return s
+    except UnicodeDecodeError:
+        pass
+    return "0x" + key.hex()
+
+
 class KeyShardMap:
     """Static partition of the keyspace into S contiguous spans.
 
@@ -66,6 +78,84 @@ class KeyShardMap:
         return out
 
 
+class EpochedKeyShardMap:
+    """Versioned shard map: a monotone sequence of (epoch, flip_version,
+    KeyShardMap) entries, atomically flipped at a chosen commit version.
+
+    The online-resharding analog of the proxy's `_routing_flips` chain
+    (server/proxy.py): every consumer routes a batch by the newest epoch
+    whose flip_version is <= the batch's commit version, so proxies and
+    resolvers that agree on commit versions agree on routing — a
+    transaction resolves under exactly ONE epoch (the one its batch
+    version selects), never both sides of a flip. Epochs fully below the
+    GC horizon are pruned (`gc`); the newest epoch at or below the
+    horizon is always kept (it still routes the horizon itself).
+
+    Jax-free and wire-serializable like KeyShardMap: the whole epoch
+    chain rides status documents and role-handoff RPCs."""
+
+    def __init__(self, initial: KeyShardMap, flip_version: int = 0,
+                 epoch: int = 0):
+        #: ascending (epoch, flip_version, map)
+        self.epochs: List[Tuple[int, int, KeyShardMap]] = \
+            [(int(epoch), int(flip_version), initial)]
+
+    @property
+    def epoch(self) -> int:
+        return self.epochs[-1][0]
+
+    @property
+    def flip_version(self) -> int:
+        return self.epochs[-1][1]
+
+    def current(self) -> KeyShardMap:
+        return self.epochs[-1][2]
+
+    def map_for_version(self, version: int) -> KeyShardMap:
+        """The map that resolves `version`: newest epoch at or below it
+        (versions below the first retained flip route by that first
+        epoch — its predecessors were GC'd because nothing below the
+        horizon may resolve any more)."""
+        return self.entry_for_version(version)[2]
+
+    def entry_for_version(self, version: int) -> Tuple[int, int, KeyShardMap]:
+        for e in reversed(self.epochs):
+            if version >= e[1]:
+                return e
+        return self.epochs[0]
+
+    def flip(self, new_map: KeyShardMap, flip_version: int) -> int:
+        """Install `new_map` for every version >= flip_version; returns
+        the new epoch id. Flips are strictly ordered — a flip at or below
+        the newest one would make routing ambiguous for the overlap."""
+        assert flip_version > self.flip_version, \
+            f"flip at v{flip_version} not above newest v{self.flip_version}"
+        e = self.epoch + 1
+        self.epochs.append((e, int(flip_version), new_map))
+        return e
+
+    def gc(self, oldest_version: int) -> None:
+        """Drop epochs no version >= oldest_version can route by."""
+        while len(self.epochs) > 1 and self.epochs[1][1] <= oldest_version:
+            self.epochs.pop(0)
+
+    def as_dict(self) -> dict:
+        # keys render through _fmt_key: this dict rides campaign-report
+        # JSON (`cli shards REPORT.json`), where raw bytes would land as
+        # repr strings via json default=str
+        return {
+            "epoch": self.epoch,
+            "flip_version": self.flip_version,
+            "n_shards": self.current().n_shards,
+            "splits": [_fmt_key(k) for k in self.current().begins[1:]],
+            "history": [
+                {"epoch": e, "flip_version": fv,
+                 "splits": [_fmt_key(k) for k in m.begins[1:]]}
+                for e, fv, m in self.epochs
+            ],
+        }
+
+
 # wire codec: a shard map is fully described by its split keys (real-mode
 # role interfaces carry it inside ProxyConfig / Initialize* requests)
 wire.register_adapter(
@@ -73,3 +163,19 @@ wire.register_adapter(
     to_state=lambda m: list(m.begins[1:]),
     from_state=lambda splits: KeyShardMap(splits),
 )
+
+# the epoch chain serializes as its (epoch, flip_version, splits) rows
+wire.register_adapter(
+    EpochedKeyShardMap, "EpochedKeyShardMap",
+    to_state=lambda em: [(e, fv, list(m.begins[1:]))
+                         for e, fv, m in em.epochs],
+    from_state=lambda rows: _epoched_from_state(rows),
+)
+
+
+def _epoched_from_state(rows) -> EpochedKeyShardMap:
+    e0, fv0, splits0 = rows[0]
+    em = EpochedKeyShardMap(KeyShardMap(list(splits0)), fv0, e0)
+    em.epochs = [(int(e), int(fv), KeyShardMap(list(s)))
+                 for e, fv, s in rows]
+    return em

@@ -214,6 +214,42 @@ def _make_server_knobs() -> Knobs:
     #: shards the aggregator proposes equal-load split points for — the
     #: measured input to multi-chip key-range sharding (ROADMAP item 1)
     k.init("resolver_heat_split_shards", 8)
+    #: split-point hysteresis: a freshly derived equal-load split set
+    #: replaces the last adopted one only when it improves the measured
+    #: worst per-shard imbalance by at least this fraction — two adjacent
+    #: scrapes of a stationary stream must not flap the resharding
+    #: controller by one bucket (core/heatmap.py split_points)
+    k.init("resolver_heat_split_hysteresis", 0.05)
+    # Live elasticity: heat-driven online resolver resharding
+    # (server/reshard.py; docs/elasticity.md). Deliberately no BUGGIFY
+    # randomizers: the drift campaign stresses the controller directly,
+    # and these only matter in wall-clock mode where buggify is off.
+    #: admission fraction while a reshard is in flight — the ratekeeper
+    #: clamps the published rate alongside watchdog_burn_tps_fraction
+    #: until the handoff completes (server/ratekeeper.py)
+    k.init("reshard_tps_fraction", 0.5)
+    #: per-range blackout budget: the freeze -> cutover interval of one
+    #: range handoff (the only window the moving range cannot serve) must
+    #: stay under this, machine-asserted per executed reshard via the
+    #: reshard.blackout trace segments (docs/elasticity.md "Blackout SLO")
+    k.init("reshard_blackout_budget_ms", 250.0)
+    #: controller evaluation cadence (heat scrape -> plan decision)
+    k.init("reshard_eval_interval_s", 0.5)
+    #: minimum wall-clock spacing between executed reshards — composes
+    #: with the split-point hysteresis to keep the control loop stable
+    k.init("reshard_min_interval_s", 1.0)
+    #: split trigger: hottest shard's measured write+conflict load share
+    #: above this plans a split of that shard at the heat-suggested key
+    k.init("reshard_split_share", 0.55)
+    #: merge trigger: an adjacent shard pair whose combined share is
+    #: below this folds into one engine (frees capacity for hot splits)
+    k.init("reshard_merge_share", 0.25)
+    #: upper bound on live resolver shards the controller may create
+    k.init("reshard_max_shards", 4)
+    #: a reshard in flight longer than this is STALLED — the watchdog's
+    #: ReshardStalledRule fires and the incident names the frozen range
+    #: and the donor engine's health state (core/watchdog.py)
+    k.init("reshard_stall_s", 3.0)
     # Performance observatory (docs/observability.md "Performance
     # observatory"). Deliberately no BUGGIFY randomizers: both layers are
     # observational (the ledger reads analysis off already-compiled

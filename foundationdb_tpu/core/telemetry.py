@@ -86,6 +86,10 @@ class TelemetryHub:
         #: label -> weakref to TenantAdmission (server/ratekeeper.py —
         #: admitted/rejected totals feed the throttle burn-rate rule)
         self._admissions: Dict[str, "weakref.ref"] = {}
+        #: label -> weakref to ReshardController (server/reshard.py —
+        #: executed/stalled/blackout gauges feed the `fdbtpu_reshard`
+        #: family and the watchdog's reshard rules)
+        self._reshards: Dict[str, "weakref.ref"] = {}
         self._seq = 0
         #: bounded ring of recent nemesis/chaos events (real/chaos.py,
         #: real/nemesis.py) — rendered by `tools/cli.py chaos-status`
@@ -144,6 +148,23 @@ class TelemetryHub:
         label = self._label("admission", name)
         self._admissions[label] = weakref.ref(admission)
         return label
+
+    def register_reshard(self, controller, name: str = "reshard") -> str:
+        """An online-resharding controller (server/reshard.py): executed
+        and stalled counts, in-flight age and blackout accounting synced
+        as `reshard.<label>.*` series — the `fdbtpu_reshard` exposition
+        family, and the series the watchdog's ReshardStalledRule and
+        blackout-overrun rule evaluate."""
+        label = self._label("reshard", name)
+        self._reshards[label] = weakref.ref(controller)
+        return label
+
+    def reshard_source(self, label: str):
+        """The live controller registered under `label` (None if
+        collected) — the stalled-reshard rule reads its range/donor
+        detail through this to compose a speakable incident line."""
+        ref = self._reshards.get(label)
+        return ref() if ref is not None else None
 
     # -- the cluster watchdog (core/watchdog.py) -----------------------------
     @property
@@ -273,6 +294,24 @@ class TelemetryHub:
                 td.int64(f"resolver.{label}.state_memory_pressure").set(
                     1 if sb > int(SERVER_KNOBS.resolver_state_memory_limit)
                     else 0)
+        for label, rc in self._live(self._reshards):
+            # online-resharding gauges (server/reshard.py): epoch + shard
+            # count for the live map, executed/stalled op counts, the
+            # worst observed blackout vs budget, and the in-flight age
+            # the ReshardStalledRule evaluates
+            td.int64(f"reshard.{label}.epoch").set(rc.group.emap.epoch)
+            td.int64(f"reshard.{label}.shards").set(
+                len(rc.group.active_sids()))
+            td.int64(f"reshard.{label}.executed").set(rc.executed)
+            td.int64(f"reshard.{label}.stalled").set(rc.stalled)
+            td.int64(f"reshard.{label}.in_flight").set(
+                1 if rc.in_flight() else 0)
+            td.int64(f"reshard.{label}.in_flight_age_us").set(
+                int(rc.in_flight_age_s() * 1e6))
+            td.int64(f"reshard.{label}.blackout_us_max").set(
+                int(rc.blackout_ms_max * 1000))
+            td.int64(f"reshard.{label}.blackout_over_budget").set(
+                rc.blackout_over_budget)
         for label, adm in self._live(self._admissions):
             # per-tenant admission totals (server/ratekeeper.py): the
             # offered split into admitted vs shed — the watchdog's
@@ -352,6 +391,8 @@ class TelemetryHub:
                              for label, led in self._live(self._perf_ledgers)},
             "admission": {label: adm.as_dict()
                           for label, adm in self._live(self._admissions)},
+            "reshard": {label: rc.snapshot()
+                        for label, rc in self._live(self._reshards)},
             "watchdog": (self._watchdog.snapshot()
                          if self._watchdog is not None else None),
         }
@@ -382,6 +423,9 @@ class TelemetryHub:
                "record_commit_sli: acks within/over the latency budget)",
         "admission": "per-tenant admission totals (server/ratekeeper.py "
                      "TenantAdmission: admitted vs shed)",
+        "reshard": "online-resharding controller gauges "
+                   "(server/reshard.py: live epoch/shard count, executed/"
+                   "stalled ops, in-flight age, blackout vs budget)",
     }
 
     @staticmethod

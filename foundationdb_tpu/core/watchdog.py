@@ -78,10 +78,14 @@ def _pattern_re(pattern: str) -> "re.Pattern":
 class _SeriesView:
     """One evaluation tick's read model over the hub's TDMetric series:
     current values plus a per-rule match cache invalidated when the
-    series population grows (it only grows — metrics are never deleted)."""
+    series population grows (it only grows — metrics are never deleted).
+    `hub` (when the evaluator passes it) lets a rule read a registered
+    source's richer detail — e.g. the stalled-reshard rule naming the
+    frozen range — without growing the series surface."""
 
-    def __init__(self, metrics: Dict[str, Any]):
+    def __init__(self, metrics: Dict[str, Any], hub: Any = None):
         self.metrics = metrics
+        self.hub = hub
 
     def value(self, name: str) -> Optional[float]:
         m = self.metrics.get(name)
@@ -381,6 +385,49 @@ class BurnRateRule(AlertRule):
                 "bad": self.bad_pattern, "budget_frac": self.budget_frac}
 
 
+class ReshardStalledRule(AlertRule):
+    """An online reshard has been in flight longer than the
+    `reshard_stall_s` knob (server/reshard.py publishes
+    `reshard.<label>.in_flight_age_us`; a completed or abandoned op
+    resets it to 0, clearing the alert). The detail reads like a page:
+    "reshard of [k1,k2) frozen · donor r1 state=probation" — composed
+    from the live controller through the hub registry, so the incident
+    names the range and the donor engine's health, not a bare gauge.
+    Fires immediately (hold 0): a stalled handoff is a fact, not a
+    rate."""
+
+    kind = "reshard"
+
+    def __init__(self, name: str = "reshard_stalled",
+                 pattern: str = "reshard.*.in_flight_age_us", **kw):
+        kw.setdefault("hold_s", 0.0)
+        super().__init__(name, **kw)
+        self.pattern = pattern
+        self._rx = _pattern_re(pattern)
+
+    def conditions(self, t, view):
+        from .knobs import SERVER_KNOBS
+
+        stall_us = float(SERVER_KNOBS.reshard_stall_s) * 1e6
+        for series, caps in self._matches(view, self.pattern, self._rx):
+            v = view.value(series)
+            if v is None:
+                continue
+            active = v > stall_us
+            detail = (f"in flight {v / 1e6:.2f}s "
+                      f"(stall after {stall_us / 1e6:g}s)")
+            if active and view.hub is not None and caps:
+                rc = view.hub.reshard_source(caps[0])
+                if rc is not None:
+                    live = rc.in_flight_detail()
+                    if live:
+                        detail = f"{live} · {detail}"
+            yield (series, active, round(v / 1e6, 3), detail)
+
+    def describe(self):
+        return {**super().describe(), "pattern": self.pattern}
+
+
 class _AlertState:
     """Lifecycle state of one (rule, series) pair."""
 
@@ -488,6 +535,14 @@ def default_rules() -> List[AlertRule]:
         # -- anomaly bands ------------------------------------------------
         AnomalyRule("heat_concentration_shift",
                     "heat.*.concentration_x1000"),
+        # -- online resharding (server/reshard.py) ------------------------
+        ReshardStalledRule("reshard_stalled"),
+        # blackout burn: an executed reshard whose freeze -> cutover
+        # interval exceeded reshard_blackout_budget_ms — a fact the
+        # moment the counter moves, like the discipline rules
+        ThresholdRule("reshard_blackout",
+                      "reshard.*.blackout_over_budget", 0, ">",
+                      hold_s=0.0),
         # -- staleness/absence -------------------------------------------
         StalenessRule("commit_flow_stalled", "sli.*.total",
                       max_age_s=float(k.watchdog_staleness_s)),
@@ -602,7 +657,7 @@ class Watchdog:
         open/close the incident envelope. Called from sync()."""
         t = self.now_fn()
         self.evaluations += 1
-        view = _SeriesView(hub.tdmetrics.metrics)
+        view = _SeriesView(hub.tdmetrics.metrics, hub)
         self._track_health(t, view)
         for rule in self.rules:
             for series, active, value, detail in rule.conditions(t, view):
@@ -690,9 +745,19 @@ class Watchdog:
         measures (the incident then IS the breach's alert, not noise).
         Anything else is an unexplained incident — `assert_slos` fails
         the campaign on it, alert name first."""
+        from .knobs import SERVER_KNOBS
+
         end_default = self.now_fn()
+        burn_look_back = float(SERVER_KNOBS.watchdog_burn_slow_s)
         for inc in self.incidents:
             lo, hi = inc.t0 - margin_s, (inc.t1 or end_default) + margin_s
+            if any(a.get("kind") == "burn" for a in inc.alerts.values()):
+                # a burn alert's firing evidence is its trailing slow
+                # window: bad events inside [t0 - slow_s, t0] lit it, so
+                # a fault window anywhere in that span explains the
+                # incident even when the alert fired after the window
+                # closed (burn trails the cause by construction)
+                lo -= burn_look_back
             inc.windows = [w for w in windows
                            if float(w.get("t0", 0)) <= hi
                            and float(w.get("t1", 0)) >= lo]

@@ -1,0 +1,151 @@
+"""Range history handoff: move a key range's committed write history
+between supervised engines without losing a verdict.
+
+The donor side of an online reshard (server/reshard.py) must hand the
+recipient everything that can still decide a verdict for the moving
+range. The ResilientEngine's shadow (fault/resilient.py) is exactly that
+window: one (version, committed write ranges, new_oldest) entry per
+resolved batch, trimmed to version >= the GC horizon — the same
+sufficiency argument that makes failover rebuilds bit-identical (any
+read passing the too-old gate has snapshot >= oldest, so writes below
+the horizon can never conflict) makes a RANGE-CLIPPED slice of the
+shadow sufficient for the moving range.
+
+Transfer happens in two stages, the classic live-migration shape:
+
+  * pre-copy (unfrozen): the slice as of a version watermark is
+    COALESCED to the effective interval map (key -> last write version,
+    restricted to the range — a hot range overwrites the same keys over
+    and over, so the coalesced form is bounded by distinct keys, not by
+    history length) and replayed into the recipient as synthetic
+    write-only transactions, one batch per distinct version in ascending
+    order. The donor keeps serving; writes landing after the watermark
+    are the next round's delta.
+  * delta (frozen): once the range is frozen the few entries above the
+    final watermark replay raw — this is the only part inside the
+    blackout, which is what keeps the per-range unavailability under
+    `reshard_blackout_budget_ms`.
+
+Replaying through the recipient's ResilientEngine (not its raw device)
+is the point: the synthetic batches land in the recipient's OWN shadow
+and journal, so a later failover, probe or re-warm of the recipient
+rebuilds WITH the adopted history, and the campaign's clean-oracle
+journal replay covers the handoff batches like any others. Write-only
+transactions commit unconditionally (no reads -> no conflicts, no
+too-old), so adoption can never flip a verdict.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..core.types import CommitTransaction, Key, KeyRange, Version
+from ..ops.oracle import VersionIntervalMap
+
+#: (version, ((begin, end), ...)) — one replayable write-history batch
+HistoryBatch = Tuple[Version, Tuple[Tuple[Key, Key], ...]]
+
+
+def clip_range(b: Key, e: Key, begin: Key,
+               end: Optional[Key]) -> Optional[Tuple[Key, Key]]:
+    """Concrete [b, e) intersected with the shard span [begin, end);
+    None when empty. A `None` span end means +inf (the last span)."""
+    cb = max(b, begin)
+    ce = e if end is None else min(e, end)
+    return (cb, ce) if cb < ce else None
+
+
+def shadow_slice(engine, begin: Key, end: Optional[Key],
+                 min_version: Version = 0) -> List[HistoryBatch]:
+    """The donor ResilientEngine's shadow entries above `min_version`,
+    clipped to [begin, end); empty clips drop. Entries come back in
+    shadow (= resolution) order."""
+    out: List[HistoryBatch] = []
+    for version, writes, _new_oldest in getattr(engine, "_shadow", ()):
+        if version <= min_version:
+            continue
+        clipped = []
+        for b, e in writes:
+            c = clip_range(b, e, begin, end)
+            if c is not None:
+                clipped.append(c)
+        if clipped:
+            out.append((version, tuple(clipped)))
+    return out
+
+
+def coalesce(entries: Sequence[HistoryBatch],
+             begin: Key, end: Optional[Key]) -> List[HistoryBatch]:
+    """Entries -> the EFFECTIVE interval map restricted to [begin, end),
+    re-expressed as one write-only batch per distinct surviving version,
+    ascending. Observable-state equivalent to replaying every entry:
+    later writes overwrite earlier ones key-by-key exactly as the
+    interval map records, and sub-horizon residue was already trimmed
+    from the shadow. A hot range that overwrote the same keys thousands
+    of times coalesces to a handful of intervals — this is what keeps
+    pre-copy (and with it the frozen delta) small."""
+    if not entries:
+        return []
+    m = VersionIntervalMap(0)
+    for version, writes in entries:
+        for b, e in writes:
+            if e is None:
+                e = b"\xff\xff\xff\xff\xff\xff"
+            m.write(b, e, version)
+    by_version: Dict[Version, List[Tuple[Key, Key]]] = {}
+    keys, vers = m.keys, m.vers
+    for i, v in enumerate(vers):
+        if v <= 0:
+            continue
+        b = keys[i]
+        e = keys[i + 1] if i + 1 < len(keys) else b"\xff\xff\xff\xff\xff\xff"
+        rows = by_version.setdefault(v, [])
+        # merge adjacency within one version: the map splits intervals at
+        # every historical boundary; re-fusing keeps batches minimal
+        if rows and rows[-1][1] == b:
+            rows[-1] = (rows[-1][0], e)
+        else:
+            rows.append((b, e))
+    return [(v, tuple(by_version[v])) for v in sorted(by_version)]
+
+
+async def replay_slice(recipient, entries: Sequence[HistoryBatch]) -> int:
+    """Adopt `entries` into the recipient supervised engine: one
+    synthetic write-only transaction per batch, resolved at the entry's
+    own version (write versions must be preserved exactly — quantizing
+    them upward would manufacture conflicts for snapshots in between).
+    new_oldest rides as 0 so adoption never advances the recipient's
+    too-old gate. Returns the number of batches replayed."""
+    n = 0
+    for version, writes in entries:
+        txn = CommitTransaction(
+            read_snapshot=version,
+            write_conflict_ranges=[KeyRange(b, e) for b, e in writes])
+        r = recipient.resolve([txn], version, 0)
+        if hasattr(r, "__await__"):
+            await r
+        n += 1
+    return n
+
+
+def last_shadow_version(engine) -> Version:
+    """The donor's newest shadow version — the pre-copy watermark."""
+    shadow = getattr(engine, "_shadow", None)
+    if not shadow:
+        return 0
+    return max(entry[0] for entry in shadow)
+
+
+def migrate_ewmas(src_batcher, dst_batcher) -> int:
+    """Carry a donor batcher's observed per-(bucket, search-mode,
+    dispatch-mode) latency EWMAs onto the recipient so the moved range's
+    batch sizing starts from the donor's measurements instead of
+    re-learning from cold (pipeline/resolver_pipeline.BudgetBatcher).
+    Keys the recipient has already observed win. Returns entries copied."""
+    if src_batcher is None or dst_batcher is None:
+        return 0
+    copied = 0
+    for key, ms in src_batcher.ewma_ms.items():
+        if key not in dst_batcher.ewma_ms:
+            dst_batcher.ewma_ms[key] = float(ms)
+            copied += 1
+    return copied

@@ -125,6 +125,29 @@ def make_chaos_engine(engine_mode: str,
     return inner, injector, supervised
 
 
+class _GroupInjector:
+    """The device-fault control surface of an elastic group: setting
+    `rates` fans the FaultRates to EVERY slot's injector (current slots
+    at set time — a fault window opens and closes around the same
+    population), so a campaign's forced device-fault window targets the
+    shards actually serving, not just whichever engine happened to be
+    slot 0 before reshards moved the traffic."""
+
+    def __init__(self, group):
+        self._group = group
+        self._rates = None
+
+    @property
+    def rates(self):
+        return self._rates
+
+    @rates.setter
+    def rates(self, value) -> None:
+        self._rates = value
+        for slot in self._group.slots.values():
+            slot.injector.rates = value
+
+
 class ChaosCommitServer:
     """The wall-clock resolver node the campaign aims traffic at: commit
     RPCs batch on the cooperative scheduler and resolve in strict version
@@ -137,14 +160,39 @@ class ChaosCommitServer:
                  batch_interval_s: float = 0.004, max_batch: int = 48,
                  service_floor_s: float = 0.0,
                  transport_degraded_fn=None, port: int = 0,
-                 dispatch_timeout_s: Optional[float] = None):
+                 dispatch_timeout_s: Optional[float] = None,
+                 elastic: bool = False, reshard: bool = False,
+                 reshard_spares: int = 2):
         from ..server.ratekeeper import TenantAdmission
         from .runtime import make_dispatcher
 
         self.sched = sched
         self.engine_mode = engine_mode
-        self.inner, self.injector, self.engine = make_chaos_engine(
-            engine_mode, dispatch_timeout_s=dispatch_timeout_s)
+        self._elastic = elastic
+        self._reshard_spares = reshard_spares
+        self.reshard_ctl = None
+        if elastic:
+            # the elastic resolution tier (server/reshard.py): a live
+            # group of supervised engines behind an epoched shard map,
+            # each built through the SAME make_chaos_engine stack —
+            # optionally with the heat-driven resharding controller on
+            from ..pipeline.resolver_pipeline import BudgetBatcher
+            from ..server.reshard import (ElasticResolverGroup,
+                                          ReshardController)
+
+            ladder = sorted({max(8, max_batch // 8), max_batch})
+            group = ElasticResolverGroup(
+                lambda: make_chaos_engine(
+                    engine_mode, dispatch_timeout_s=dispatch_timeout_s),
+                make_batcher=lambda: BudgetBatcher(ladder))
+            self.inner, self.engine = group, group
+            self.injector = _GroupInjector(group)
+            if reshard:
+                self.reshard_ctl = ReshardController(
+                    group, on_complete=self._on_reshard_complete)
+        else:
+            self.inner, self.injector, self.engine = make_chaos_engine(
+                engine_mode, dispatch_timeout_s=dispatch_timeout_s)
         self.proc = RealProcess(port=port)
         self.proc.dispatcher = make_dispatcher(sched)
         self.proc.register(COMMIT_TOKEN, self._commit)
@@ -209,19 +257,37 @@ class ChaosCommitServer:
         self._batcher_task = self.sched.spawn(
             self._batcher(), TaskPriority.PROXY_COMMIT_BATCHER,
             name="chaosBatcher")
+        if self.reshard_ctl is not None:
+            self.reshard_ctl.start(self.sched)
 
     async def stop(self) -> None:
         self._running = False
+        if self.reshard_ctl is not None:
+            self.reshard_ctl.stop()
         if self._batcher_task is not None:
             self._batcher_task.cancel()
         await self.proc.stop()
 
     def warmup(self) -> None:
         """AOT-compile the ladder for device-backed modes so the campaign
-        never charges first-compile stalls to the SLO window."""
+        never charges first-compile stalls to the SLO window; an elastic
+        group additionally pre-warms standby recipient engines so a
+        reshard never compiles on the serving path."""
         fn = getattr(self.engine, "warmup", None)
         if fn is not None and self.engine_mode != "oracle":
             fn()
+        if self._elastic:
+            self.engine.prewarm_spares(self._reshard_spares)
+
+    def _on_reshard_complete(self, op) -> None:
+        """Mid-flight adaptation after a cutover: per-tenant admission
+        weights rebalance from the post-reshard heat fractions, so the
+        published rate's split tracks where the load actually moved
+        (server/reshard.py rebalance_admission)."""
+        from ..server.reshard import rebalance_admission
+
+        if self.admission is not None:
+            rebalance_admission(self.admission, self.engine.heat)
 
     # -- handlers (run on the cooperative scheduler via the dispatcher) ------
     async def _commit(self, body):
@@ -281,6 +347,8 @@ class ChaosCommitServer:
                           if self.admission is not None else None),
             "shed_expired": self.proc.shed_expired,
         }
+        if self.reshard_ctl is not None:
+            out["reshard"] = self.reshard_ctl.snapshot()
         loop_stats = getattr(self.inner, "loop_stats", None)
         if loop_stats is not None:
             out["loop_stats"] = dict(loop_stats)
@@ -295,6 +363,11 @@ class ChaosCommitServer:
             return
         frac = (float(SERVER_KNOBS.resolver_degraded_tps_fraction)
                 if self.degraded else 1.0)
+        if self._elastic and self.engine.reshard_in_flight:
+            # reshard clamp (server/ratekeeper.py's tps_reshard, applied
+            # at the campaign's admission point): handoff work and the
+            # frozen range's queueing must not race full-rate admission
+            frac = min(frac, float(SERVER_KNOBS.reshard_tps_fraction))
         self.admission.set_rate(self.admission_tps * frac)
 
     async def _batcher(self) -> None:
@@ -422,6 +495,17 @@ class NemesisConfig:
     #: widen it so an event-loop stall can't masquerade as a device
     #: fault (see make_chaos_engine)
     dispatch_timeout_s: Optional[float] = None
+    #: elastic resolution tier (server/reshard.py): the commit server
+    #: resolves through an ElasticResolverGroup of supervised engines
+    #: behind an epoched shard map instead of one engine
+    elastic: bool = False
+    #: heat-driven online resharding controller active (implies elastic)
+    reshard: bool = False
+    #: pre-warmed standby recipient engines (reshards never compile on
+    #: the serving path while a spare is available)
+    reshard_spares: int = 2
+    #: assert_slos floor on executed reshards (the drift campaign's >= 2)
+    min_reshards: int = 0
 
     #: budget multiplier for CPU-emulated device modes: a real chip-
     #: adjacent resolver serves a batch in well under a millisecond, but
@@ -509,6 +593,16 @@ class CampaignReport:
     trace_file: Optional[str] = None
     depth_collapses: int = 0
     shed_expired: int = 0
+    #: online-resharding controller snapshot (server/reshard.py): epoch
+    #: chain, executed/stalled ops with per-op blackouts — `cli shards
+    #: REPORT.json` renders it
+    reshard: Optional[dict] = None
+    #: per-executed-reshard blackout durations as measured by the
+    #: reshard.blackout trace segments (the PR 8 span verification of the
+    #: blackout SLO, independent of the controller's own clocks)
+    reshard_span_blackouts_ms: Optional[list] = None
+    #: post-reshard per-tenant admission weights (rebalance_admission)
+    admission_weights: Optional[dict] = None
     wall_s: float = 0.0
 
     def as_dict(self) -> dict:
@@ -729,7 +823,9 @@ async def _campaign(cfg: NemesisConfig) -> CampaignReport:
         batch_interval_s=cfg.resolved_batch_interval_s(),
         max_batch=cfg.max_batch,
         service_floor_s=cfg.service_floor_s,
-        dispatch_timeout_s=cfg.dispatch_timeout_s)
+        dispatch_timeout_s=cfg.dispatch_timeout_s,
+        elastic=cfg.elastic or cfg.reshard, reshard=cfg.reshard,
+        reshard_spares=cfg.reshard_spares)
     nemesis = NetworkNemesis(cfg.seed, cfg.chaos)
     transports: Dict[str, ChaosTransport] = {}
     versions: Dict[str, int] = {}
@@ -864,6 +960,20 @@ async def _campaign(cfg: NemesisConfig) -> CampaignReport:
         # caught by a window is excluded without blanket padding
         windows = nemesis.fault_windows()
         windows += incident_windows
+        #: reshard blackouts are PLANNED maintenance windows with their
+        #: own SLO (per-range blackout <= reshard_blackout_budget_ms,
+        #: asserted separately): acks caught inside one are excluded from
+        #: the p99 like injected faults, and the watchdog correlates
+        #: incidents against them under their own window kind. The
+        #: `reshard_arc` records (whole plan -> cutover handoff) are
+        #: correlation-only — the service keeps serving through the arc,
+        #: so its latency stays IN the p99 population; only the blackout
+        #: and any inline-warm window are excluded
+        reshard_windows: List[dict] = (list(server.reshard_ctl.windows)
+                                       if server.reshard_ctl is not None
+                                       else [])
+        windows += [(w["t0"], w["t1"]) for w in reshard_windows
+                    if w["kind"] != "reshard_arc"]
         if cfg.warmup_frac > 0:
             # cold-start grace (see NemesisConfig.warmup_frac)
             windows.append((rep.t_start,
@@ -875,6 +985,7 @@ async def _campaign(cfg: NemesisConfig) -> CampaignReport:
         window_dicts = list(nemesis.windows)
         window_dicts += [{"kind": "device_incident", "t0": a, "t1": b}
                          for a, b in incident_windows]
+        window_dicts += reshard_windows
         if cfg.warmup_frac > 0:
             window_dicts.append({
                 "kind": "warmup", "t0": rep.t_start,
@@ -890,8 +1001,15 @@ async def _campaign(cfg: NemesisConfig) -> CampaignReport:
         report.p99_overall_ms = percentile_ms(
             sorted(l * 1e3 for _t, l, _ok, _v in acks), 0.99)
         report.engine_stats = dict(server.engine.stats)
-        report.parity_checked, report.parity_mismatches = \
-            replay_journal_parity(server.engine.journal)
+        parity_fn = getattr(server.engine, "parity_check", None)
+        if parity_fn is not None:
+            # elastic group: every shard engine's journal — handoff
+            # adoption batches included — replays through its own clean
+            # oracle (server/reshard.py parity_check)
+            report.parity_checked, report.parity_mismatches = parity_fn()
+        else:
+            report.parity_checked, report.parity_mismatches = \
+                replay_journal_parity(server.engine.journal)
         heat_fn = getattr(server.engine, "heat_snapshot", None)
         if heat_fn is not None:
             report.heat = heat_fn()
@@ -902,6 +1020,10 @@ async def _campaign(cfg: NemesisConfig) -> CampaignReport:
             report.loop_stats = dict(loop_stats)
         report.admission = (server.admission.as_dict()
                             if server.admission is not None else None)
+        if server.reshard_ctl is not None:
+            report.reshard = server.reshard_ctl.snapshot()
+            if server.admission is not None:
+                report.admission_weights = dict(server.admission.weights)
         report.chaos_counts = telemetry.hub().chaos_counts()
         report.suffered = {name: dict(tr.suffered)
                            for name, tr in transports.items()}
@@ -927,6 +1049,13 @@ async def _campaign(cfg: NemesisConfig) -> CampaignReport:
             # trace-event JSON with the nemesis fault windows on the
             # same timeline
             spans = list(g_spans.spans)
+            if server.reshard_ctl is not None:
+                # the span-verified blackout SLO: every executed reshard
+                # emitted one reshard.blackout segment carrying its
+                # measured freeze -> cutover duration
+                report.reshard_span_blackouts_ms = [
+                    rec.get("blackout_ms") for rec in spans
+                    if rec.get("Name") == "reshard.blackout"]
             waterfalls = trace_export.build_waterfalls(spans)
             retained = trace_export.tail_sample(waterfalls)
             report.traces = trace_export.trace_summary(waterfalls, retained)
@@ -1010,6 +1139,31 @@ def assert_slos(report: CampaignReport, cfg: NemesisConfig,
     if cfg.partitions > 0:
         assert report.chaos_counts.get("partition", 0) >= 1, \
             f"no partition was injected: {ctx}"
+    if cfg.reshard:
+        # resharding SLOs (docs/elasticity.md "Blackout SLO"): enough
+        # reshards actually EXECUTED on the live cluster, none stalled,
+        # and every per-range blackout within budget — by the controller's
+        # own clocks AND by the independent reshard.blackout trace segments
+        rs = report.reshard or {}
+        bo_budget = float(SERVER_KNOBS.reshard_blackout_budget_ms)
+        assert rs.get("executed", 0) >= cfg.min_reshards, \
+            (f"only {rs.get('executed', 0)} reshards executed "
+             f"(need >= {cfg.min_reshards}): {ctx}")
+        assert rs.get("stalled", 0) == 0, \
+            f"{rs.get('stalled')} reshard(s) stalled: {ctx}"
+        for op in rs.get("ops", []):
+            if op.get("state") == "done":
+                assert op["blackout_ms"] <= bo_budget, \
+                    (f"reshard #{op['id']} ({op['kind']}) blackout "
+                     f"{op['blackout_ms']:.1f} ms exceeds budget "
+                     f"{bo_budget} ms: {ctx}")
+        if cfg.collect_spans:
+            bos = report.reshard_span_blackouts_ms or []
+            assert len(bos) >= rs.get("executed", 0), \
+                (f"{len(bos)} reshard.blackout trace segments for "
+                 f"{rs.get('executed')} executed reshards: {ctx}")
+            assert all(b is not None and b <= bo_budget for b in bos), \
+                f"span-measured blackout over budget {bo_budget} ms: {ctx}"
     if report.incidents is not None:
         # every firing incident must be EXPLAINED: it overlaps an
         # injected fault window or names a measured breach. An alert
@@ -1037,6 +1191,40 @@ def assert_slos(report: CampaignReport, cfg: NemesisConfig,
         assert tr.get("max_sum_err_ms", 0.0) <= 0.05, \
             (f"waterfall segments do not sum to client latency "
              f"(max err {tr.get('max_sum_err_ms')} ms): {ctx}")
+
+
+# -- the diurnal drift campaign (online resharding under moving load) ---------
+
+def drift_config(seed: int, engine_mode: str = "oracle",
+                 duration_s: Optional[float] = None,
+                 **kw) -> NemesisConfig:
+    """The live-elasticity campaign (ROADMAP item 4, docs/elasticity.md):
+    an open-loop Zipf fleet whose hot range DRIFTS across the keyspace
+    over the run, served by the elastic resolver group with the
+    heat-driven resharding controller active, composed with background
+    NetworkNemesis faults. assert_slos then additionally requires >= 2
+    reshards executed on the live cluster with every per-range blackout
+    inside `reshard_blackout_budget_ms` (span-verified), on top of the
+    standard p99/parity/incident contract."""
+    if duration_s is None:
+        duration_s = 6.0 if engine_mode == "oracle" else 10.0
+    scale = 1.0 if engine_mode == "oracle" else 0.4
+    n_keys = 512
+    tenants = [
+        # the drifting hot tenant: its Zipf head sweeps most of the pool
+        # over the campaign, so the load concentration MOVES through the
+        # key-sorted space and a static partition goes stale
+        TenantSpec("drift", target_tps=55 * scale, s=1.2, n_keys=n_keys,
+                   drift_keys_per_s=n_keys * 0.6 / duration_s),
+        TenantSpec("warm", target_tps=25 * scale, s=0.9, n_keys=512),
+        TenantSpec("bg", target_tps=20 * scale, s=0.0, n_keys=1024),
+    ]
+    kw.setdefault("watchdog", True)
+    return NemesisConfig(
+        seed=seed, engine_mode=engine_mode, duration_s=duration_s,
+        tenants=tenants, elastic=True, reshard=True, min_reshards=2,
+        partitions=1, partition_s=0.4,
+        device_faults=False, kill_child=False, **kw)
 
 
 # -- the bench capacity model -------------------------------------------------
@@ -1169,7 +1357,9 @@ def main(argv=None) -> int:
     ap.add_argument("--base-seed", type=int, default=11)
     ap.add_argument("--engine-modes", default="jax,device_loop",
                     help="comma list of oracle|jax|device_loop")
-    ap.add_argument("--duration", type=float, default=4.0)
+    ap.add_argument("--duration", type=float, default=None,
+                    help="campaign seconds (default 4.0; --drift defaults "
+                         "6.0 oracle / 10.0 device-backed)")
     ap.add_argument("--budget-ms", type=float, default=None,
                     help="explicit p99 budget; default is the knob product "
                          "resolver_p99_budget_ms x real_chaos_budget_factor "
@@ -1185,6 +1375,14 @@ def main(argv=None) -> int:
     ap.add_argument("--serve", type=int, default=None, metavar="PORT",
                     help="run a traced commit server solo on PORT "
                          "(the trace-smoke child process) and never return")
+    ap.add_argument("--drift", action="store_true",
+                    help="run the diurnal drift campaign instead of the "
+                         "fault campaign: elastic resolver group + "
+                         "heat-driven online resharding under a drifting "
+                         "Zipf fleet; assert_slos additionally requires "
+                         ">= 2 executed reshards with every blackout "
+                         "inside reshard_blackout_budget_ms "
+                         "(docs/elasticity.md)")
     ap.add_argument("--watchdog", action="store_true",
                     help="attach the cluster watchdog (core/watchdog.py): "
                          "live burn-rate/anomaly alerts during the "
@@ -1220,18 +1418,28 @@ def main(argv=None) -> int:
         # device-backed modes run longer: their fault windows (rewarm is
         # ~10 ms per shadow batch on CPU) eat more of the run, and the SLO
         # needs enough outside-window samples for a meaningful p99
-        duration = args.duration if mode == "oracle" else max(args.duration, 8.0)
+        base_duration = 4.0 if args.duration is None else args.duration
+        duration = (base_duration if mode == "oracle"
+                    else max(base_duration, 8.0))
         for i in range(args.seeds):
             seed = args.base_seed + i
             trace_path = (os.path.join(args.trace_dir,
                                        f"trace_{mode}_s{seed}.json")
                           if args.trace_dir else None)
-            cfg = NemesisConfig(seed=seed, engine_mode=mode,
-                                duration_s=duration,
-                                budget_ms=args.budget_ms,
-                                trace_export=trace_path,
-                                watchdog=True if args.watchdog else None)
-            print(f"campaign: engine={mode} seed={seed} ...", flush=True)
+            if args.drift:
+                cfg = drift_config(seed, engine_mode=mode,
+                                   duration_s=args.duration,
+                                   budget_ms=args.budget_ms,
+                                   trace_export=trace_path,
+                                   watchdog=True if args.watchdog else None)
+            else:
+                cfg = NemesisConfig(seed=seed, engine_mode=mode,
+                                    duration_s=duration,
+                                    budget_ms=args.budget_ms,
+                                    trace_export=trace_path,
+                                    watchdog=True if args.watchdog else None)
+            print(f"campaign: engine={mode} seed={seed}"
+                  + (" [drift]" if args.drift else "") + " ...", flush=True)
             rep = run_campaign(cfg)
             reports.append(rep.as_dict())
             if rep.trace_file:
@@ -1245,12 +1453,17 @@ def main(argv=None) -> int:
                       f"{tr.get('n_waterfalls')} waterfalls)", flush=True)
             try:
                 assert_slos(rep, cfg)
+                rs = rep.reshard or {}
                 print(f"  OK  p99_outside={rep.p99_outside_ms:.3f}ms "
                       f"(budget {cfg.resolved_budget_ms()}ms, "
                       f"n={rep.n_outside}) parity={rep.parity_checked} "
                       f"failovers={rep.engine_stats.get('failovers')} "
                       f"swap_backs={rep.engine_stats.get('swap_backs')} "
                       f"child_restarts={rep.child_restarts}"
+                      + (f" reshards={rs.get('executed')} "
+                         f"(blackout_max={rs.get('blackout_ms_max')}ms, "
+                         f"epoch={rs.get('epoch')})"
+                         if rep.reshard is not None else "")
                       + (f" incidents={len(rep.incidents)} (all explained)"
                          if rep.incidents is not None else ""), flush=True)
             except AssertionError as e:

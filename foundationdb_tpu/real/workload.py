@@ -52,16 +52,27 @@ def zipf_cdf(n_keys: int, s: float) -> List[float]:
 
 class ZipfKeySampler:
     """Seeded rank-Zipf sampler: rank 0 is the hottest key. Inverse-CDF
-    via bisect — O(log n) per draw, no numpy in the hot path."""
+    via bisect — O(log n) per draw, no numpy in the hot path.
 
-    def __init__(self, n_keys: int, s: float, rng: DeterministicRandom):
+    `drift` rotates the rank->key mapping over time: at elapsed time t
+    the hottest RANK lands on key index `int(drift * t) % n_keys`, so the
+    hot range sweeps the tenant's keyspace — the diurnal-shift model the
+    drift campaign (real/nemesis.py) reshards under. drift=0 keeps the
+    classic static mapping."""
+
+    def __init__(self, n_keys: int, s: float, rng: DeterministicRandom,
+                 drift: float = 0.0):
         self.n_keys = n_keys
         self.s = s
         self.rng = rng
+        self.drift = float(drift)
         self._cdf = zipf_cdf(n_keys, s)
 
-    def sample(self) -> int:
-        return bisect.bisect_left(self._cdf, self.rng.random01())
+    def sample(self, t_rel: float = 0.0) -> int:
+        rank = bisect.bisect_left(self._cdf, self.rng.random01())
+        if self.drift:
+            rank = (rank + int(self.drift * t_rel)) % self.n_keys
+        return rank
 
 
 @dataclass
@@ -76,6 +87,10 @@ class TenantSpec:
     reads_per_txn: int = 2
     writes_per_txn: int = 2
     key_prefix: bytes = b""
+    #: hot-range drift in key indices per second (0 = stationary): the
+    #: Zipf head sweeps the pool at this speed, so load concentration
+    #: MOVES through the keyspace over the campaign
+    drift_keys_per_s: float = 0.0
 
     def prefix(self) -> bytes:
         return self.key_prefix or self.name.encode()
@@ -162,9 +177,10 @@ class WorkloadFleet:
 
         rep = self.report
         pfx = spec.prefix()
-        reads = [b"%s/%06d" % (pfx, sampler.sample())
+        t_rel = time.monotonic() - (rep.t_start or self._phase_start)
+        reads = [b"%s/%06d" % (pfx, sampler.sample(t_rel))
                  for _ in range(spec.reads_per_txn)]
-        writes = [b"%s/%06d" % (pfx, sampler.sample())
+        writes = [b"%s/%06d" % (pfx, sampler.sample(t_rel))
                   for _ in range(spec.writes_per_txn)]
         t0 = time.monotonic()
         ok, version, err = False, None, None
@@ -185,7 +201,8 @@ class WorkloadFleet:
     async def _tenant_stream(self, spec: TenantSpec,
                              rng: DeterministicRandom) -> None:
         sampler = ZipfKeySampler(spec.n_keys, spec.s,
-                                 DeterministicRandom(rng.random_int(0, 2**31 - 1)))
+                                 DeterministicRandom(rng.random_int(0, 2**31 - 1)),
+                                 drift=spec.drift_keys_per_s)
         lam = max(spec.target_tps, 1e-3)
         t_end = self._phase_start + self.duration_s
         tasks: set = set()

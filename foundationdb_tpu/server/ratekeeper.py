@@ -171,9 +171,16 @@ class Ratekeeper:
         #: True while any resolver reports a FIRING burn-rate alert from
         #: its cluster watchdog (core/watchdog.py): the SLO error budget
         #: is being spent faster than sustainable, so admission slows
-        #: before the breach lands — the same consume-point an online
-        #: resharding controller will drive from (ROADMAP item 4)
+        #: before the breach lands — the same consume-point the online
+        #: resharding controller drives from (server/reshard.py)
         self.burn_alert_firing: bool = False
+        #: True while any resolver reports an online reshard in flight
+        #: (server/reshard.py ReshardController via engine health): the
+        #: handoff is spending host/device time on pre-copy + delta
+        #: transfer, and the frozen range briefly queues its batches —
+        #: clamp admission by `reshard_tps_fraction` until cutover so the
+        #: recovery work stays bounded, exactly like the degraded clamp
+        self.reshard_in_flight: bool = False
         #: resolver address -> last reported engine health state
         self.resolver_health: Dict[str, str] = {}
         #: resolver address -> last reported telemetry fragment (engine
@@ -317,6 +324,7 @@ class Ratekeeper:
                 tps_tlog = max(1.0, max_tps * frac)
         tps_resolver = max_tps
         tps_watchdog = max_tps
+        tps_reshard = max_tps
         if resolver_infos is not None:
             self.resolver_degraded = any(h.get("degraded") for h in resolver_infos)
             if self.resolver_degraded:
@@ -332,7 +340,18 @@ class Ratekeeper:
             if self.burn_alert_firing:
                 tps_watchdog = max(
                     1.0, max_tps * SERVER_KNOBS.watchdog_burn_tps_fraction)
-        return min(tps_lag, tps_bytes, tps_tlog, tps_resolver, tps_watchdog)
+            # reshard clamp (server/reshard.py): while a range handoff is
+            # in flight the published rate scales by reshard_tps_fraction
+            # — pre-copy/delta transfer work and the frozen range's brief
+            # queueing must not compete with full-rate admission; the
+            # clamp lifts on the same poll that reports the cutover
+            self.reshard_in_flight = any(h.get("reshard_in_flight")
+                                         for h in resolver_infos)
+            if self.reshard_in_flight:
+                tps_reshard = max(
+                    1.0, max_tps * SERVER_KNOBS.reshard_tps_fraction)
+        return min(tps_lag, tps_bytes, tps_tlog, tps_resolver, tps_watchdog,
+                   tps_reshard)
 
     async def get_rate_info(self, req: GetRateInfoRequest) -> GetRateInfoReply:
         from ..core import buggify

@@ -711,7 +711,65 @@ class Cli:
             self._print(f"  {_fmt(k)} -> {_fmt(v)}")
         self._print(f"{len(rows)} row(s)")
 
+    def _render_reshard(self, label: str, rs: dict) -> None:
+        """One campaign's online-resharding record (server/reshard.py
+        ReshardController.snapshot layout)."""
+        sm = rs.get("shard_map") or {}
+        self._print(f"  {label}: epoch {sm.get('epoch', rs.get('epoch'))}, "
+                    f"{sm.get('n_shards', '?')} shard(s), "
+                    f"{rs.get('executed', 0)} reshard(s) executed, "
+                    f"{rs.get('stalled', 0)} stalled")
+        splits = sm.get("splits") or []
+        begins = ["''"] + [repr(s) for s in splits]
+        for i, b in enumerate(begins):
+            e = begins[i + 1] if i + 1 < len(begins) else "+inf"
+            self._print(f"    shard {i}: [{b} .. {e})")
+        hist = sm.get("history") or []
+        if len(hist) > 1:
+            self._print("    epoch history:")
+            for h in hist:
+                self._print(f"      epoch {h.get('epoch')} @ v"
+                            f"{h.get('flip_version')}: "
+                            f"{len(h.get('splits') or []) + 1} shard(s)")
+        ops = rs.get("ops") or []
+        if ops:
+            self._print(f"    blackout budget {rs.get('blackout_budget_ms')}"
+                        f" ms, worst {rs.get('blackout_ms_max')} ms, "
+                        f"{rs.get('blackout_over_budget', 0)} over")
+            for op in ops:
+                end = op.get("end") if op.get("end") is not None else "+inf"
+                self._print(
+                    f"    #{op.get('id')} {op.get('kind'):<5} "
+                    f"[{op.get('begin')!r} .. {end!r}) {op.get('state'):<8}"
+                    f" blackout={op.get('blackout_ms', 0):.2f}ms"
+                    f" precopy={op.get('precopied')} delta={op.get('delta')}"
+                    + (" (prewarmed)" if op.get("prewarmed") else "")
+                    + (f" ERR {op.get('error')}" if op.get("error") else ""))
+        inflight = rs.get("in_flight")
+        if inflight:
+            self._print(f"    IN FLIGHT: #{inflight.get('id')} "
+                        f"{inflight.get('kind')} state="
+                        f"{inflight.get('state')}")
+
     def do_shards(self, args: List[str]) -> None:
+        """Resolver epoch/shard map + executed reshards from a campaign
+        report JSON (cluster-less, like `heat`), or the storage shard map
+        of the live simulated cluster."""
+        if args and args[0].endswith(".json"):
+            with open(args[0]) as f:
+                doc = json.load(f)
+            rendered = 0
+            for rep in doc.get("campaigns", []):
+                rs = rep.get("reshard")
+                if rs:
+                    self._render_reshard(
+                        f"seed {rep.get('cfg_seed')} "
+                        f"[{rep.get('engine_mode')}]", rs)
+                    rendered += 1
+            if not rendered:
+                self._print(f"no reshard records in {args[0]} (campaigns "
+                            "run without --drift / reshard=True?)")
+            return
         from ..server import system_keys
 
         async def go(tr):
@@ -873,7 +931,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     cmd0 = args.command[0].replace("-", "_") if args.command else ""
     if cmd0 in ("chaos_status", "trace") or (
-            cmd0 in ("heat", "alerts", "incidents") and len(args.command) > 1
+            cmd0 in ("heat", "alerts", "incidents", "shards")
+            and len(args.command) > 1
             and args.command[1].endswith(".json")):
         # no cluster needed: renders the hub / a report, trace or heat
         # artifact file / a live span-ring fetch over RPC / campaign
@@ -888,6 +947,8 @@ def main(argv=None) -> int:
             cli.do_alerts(args.command[1:])
         elif cmd0 == "incidents":
             cli.do_incidents(args.command[1:])
+        elif cmd0 == "shards":
+            cli.do_shards(args.command[1:])
         else:
             cli.do_trace(args.command[1:])
         return 0

@@ -316,7 +316,7 @@ DEFAULT_POLICY: Dict[str, RulePolicy] = {
     "knob-drift": RulePolicy(
         options={
             "families": ("resolver_", "real_", "chaos_", "trace_",
-                         "watchdog_"),
+                         "watchdog_", "reshard_"),
             "knobs_file": "foundationdb_tpu/core/knobs.py",
             "docs_dir": "docs",
             # extra reference roots scanned for knob usage beyond the
@@ -331,6 +331,13 @@ DEFAULT_POLICY: Dict[str, RulePolicy] = {
             "prefixes": ("resolver.", "engine.", "pipeline."),
             "registry_file": "foundationdb_tpu/pipeline/latency_harness.py",
             "registry_name": "ATTRIBUTION_SEGMENTS",
+            # additional prefix -> own registry: reshard.* protocol-arc
+            # segments live on their own timeline (not in the commit
+            # waterfall's telescoping sum), so they register separately
+            "extra_registries": (
+                ("reshard.", "foundationdb_tpu/server/reshard.py",
+                 "RESHARD_SEGMENTS"),
+            ),
             "span_calls": ("span", "span_event", "Span", "subspan"),
         }),
 }

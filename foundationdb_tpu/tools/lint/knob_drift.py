@@ -141,10 +141,27 @@ class KnobDriftChecker(Checker):
                 referenced.add(name)
 
         # -- documented: doc-table rows -------------------------------------
+        # Only rows inside a KNOB table count — a table whose header's
+        # first cell reads `knob`. Other tables legitimately lead with
+        # family-prefixed names that are NOT knobs (the operations.md
+        # alert runbook names watchdog rules like `reshard_stalled`);
+        # counting those would both fabricate doc rows for undefined
+        # knobs and mask genuinely undocumented ones.
         doc_rows: Dict[str, List[Tuple[str, int, str]]] = {}
         for md in sorted(docs_dir.glob("*.md")) if docs_dir.exists() else []:
             rel = md.relative_to(root).as_posix()
+            in_knob_table = False
             for i, line in enumerate(md.read_text().splitlines(), 1):
+                if not line.lstrip().startswith("|"):
+                    in_knob_table = False
+                    continue
+                cells = [c.strip().strip("`").lower()
+                         for c in line.strip().strip("|").split("|")]
+                if cells and cells[0] == "knob":
+                    in_knob_table = True
+                    continue
+                if not in_knob_table:
+                    continue
                 m = _ROW_RE.match(line)
                 if m and m.group(1).startswith(families):
                     doc_rows.setdefault(m.group(1), []).append(

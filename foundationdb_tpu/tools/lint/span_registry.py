@@ -78,6 +78,26 @@ class SpanRegistryChecker(Checker):
         segs = set(registry)
         prefixes = tuple(opts.get("prefixes",
                                   ("resolver.", "engine.", "pipeline.")))
+        #: policed prefix -> (segment set, registry name, registry file):
+        #: the commit-waterfall prefixes share ATTRIBUTION_SEGMENTS; extra
+        #: registries (reshard.* protocol arcs) bring their own tuple
+        registries: List[Tuple[Tuple[str, ...], set, str, str]] = [
+            (prefixes, segs, opts.get("registry_name",
+                                      "ATTRIBUTION_SEGMENTS"),
+             reg_path.relative_to(root).as_posix()),
+        ]
+        for pfx, rel_file, name in opts.get("extra_registries", ()):
+            p = root / rel_file
+            if not p.exists():
+                continue
+            extra = _parse_registry(p, name)
+            if extra is None:
+                return [Finding(
+                    self.rule, rel_file, 1,
+                    f"{name} is no longer a literal tuple — the "
+                    "span-registry rule cannot read it "
+                    "(docs/static_analysis.md#span-registry)")]
+            registries.append(((pfx,), set(extra), name, rel_file))
         span_calls = set(opts.get("span_calls",
                                   ("span", "span_event", "Span", "subspan")))
         out: List[Finding] = []
@@ -93,16 +113,20 @@ class SpanRegistryChecker(Checker):
                 if fname not in span_calls:
                     continue
                 for s in _const_strings(node.args[0]):
-                    if not s.startswith(prefixes) or "." not in s:
+                    if "." not in s:
                         continue
-                    seg = s.rsplit(".", 1)[1]
-                    if seg not in segs:
-                        out.append(Finding(
-                            self.rule, ctx.rel, node.lineno,
-                            f"span segment `{s}` is not in "
-                            "ATTRIBUTION_SEGMENTS — its time lands in the "
-                            "resolve_overhead residual and the attribution "
-                            "silently stops naming it; register the segment "
-                            "in pipeline/latency_harness.py "
-                            "(docs/static_analysis.md#span-registry)"))
+                    for pfxs, reg_segs, reg_name, reg_rel in registries:
+                        if not s.startswith(pfxs):
+                            continue
+                        seg = s.rsplit(".", 1)[1]
+                        if seg not in reg_segs:
+                            out.append(Finding(
+                                self.rule, ctx.rel, node.lineno,
+                                f"span segment `{s}` is not in "
+                                f"{reg_name} — its time lands in an "
+                                "unnamed residual and the attribution "
+                                "silently stops naming it; register the "
+                                f"segment in {reg_rel} "
+                                "(docs/static_analysis.md#span-registry)"))
+                        break
         return out

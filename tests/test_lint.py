@@ -262,11 +262,45 @@ def test_knob_drift_alias_and_string_references_count(tmp_path):
         "y = 'resolver_via_string'\n"                # set_knob-style override
     ))
     _write(tmp_path, "docs/x.md", (
+        "| knob | default | meaning |\n"
+        "|---|---|---|\n"
         "| `resolver_via_alias` | 1 | row |\n"
         "| `resolver_via_string` | 2 | row |\n"
     ))
     res = _lint(tmp_path)
     assert [f for f in res.new if f.rule == "knob-drift"] == []
+
+
+def test_knob_drift_ignores_non_knob_tables(tmp_path):
+    """A table that is NOT a knob table (header's first cell isn't
+    `knob`) can lead with family-prefixed names — the operations.md alert
+    runbook documents watchdog rules like `resolver_stalled` — without
+    the checker treating them as knob doc rows (neither as documentation
+    for a defined knob nor as rows for undefined ones)."""
+    _write(tmp_path, "foundationdb_tpu/core/knobs.py", (
+        "class K:\n"
+        "    def init(self, *a):\n"
+        "        pass\n"
+        "k = K()\n"
+        "k.init('resolver_real_knob', 1)\n"
+    ))
+    _write(tmp_path, "foundationdb_tpu/server/uses.py", (
+        "from ..core.knobs import SERVER_KNOBS\n"
+        "a = SERVER_KNOBS.resolver_real_knob\n"
+    ))
+    _write(tmp_path, "docs/x.md", (
+        "| alert | meaning |\n"
+        "|---|---|\n"
+        "| `resolver_stalled` | an alert name, not a knob |\n"
+        "| `resolver_real_knob` | runbook row, still not knob docs |\n"
+        "\n"
+        "| knob | default | meaning |\n"
+        "|---|---|---|\n"
+        "| `resolver_real_knob` | 1 | the actual doc row |\n"
+    ))
+    res = _lint(tmp_path)
+    msgs = [f.message for f in res.new if f.rule == "knob-drift"]
+    assert msgs == [], msgs
 
 
 SEGMENTS_FIXTURE = (

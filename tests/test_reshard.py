@@ -1,0 +1,785 @@
+"""Live elasticity: heat-driven online resolver resharding (ISSUE 14;
+server/reshard.py, fault/handoff.py, core/keyshard.EpochedKeyShardMap,
+docs/elasticity.md).
+
+Covers: the epoched shard map (atomic flip routing, GC, wire round-trip);
+split-point hysteresis (a stationary Zipf stream must not flap the
+controller across 50 scrapes); elastic-group resolution parity against a
+single serial oracle (single-shard fast path AND the cross-shard
+two-phase path); epoch-flip correctness (straddling batches resolve
+under their submission epoch; a no-trigger elastic group is verdict-
+bit-identical to a plain supervised engine; duplicate in-flight versions
+across a handoff resolve once); the live split/merge handoff end to end
+with blackout accounting, EWMA migration and admission rebalancing; the
+ratekeeper reshard clamp (mirroring the burn clamp); the watchdog's
+ReshardStalledRule naming the frozen range and donor health; and the
+tier-1 drift-campaign seed (>= 2 reshards executed on the live wall-clock
+cluster, every blackout in budget, journal parity, incidents explained)
+with the 2-seed x {jax, device_loop} matrix `slow`-marked for
+`make chaos-drift` class runs (solo-CPU: never overlap tier-1)."""
+import io
+import json
+import random
+
+import pytest
+
+from foundationdb_tpu.core import buggify, telemetry, wire
+from foundationdb_tpu.core.heatmap import KeyRangeHeatAggregator
+from foundationdb_tpu.core.keyshard import EpochedKeyShardMap, KeyShardMap
+from foundationdb_tpu.core.knobs import SERVER_KNOBS
+from foundationdb_tpu.core.rng import DeterministicRandom
+from foundationdb_tpu.core.trace import g_trace
+from foundationdb_tpu.core.types import (
+    CommitTransaction,
+    KeyRange,
+    TransactionCommitResult,
+)
+from foundationdb_tpu.fault import handoff
+from foundationdb_tpu.fault.inject import FaultInjectingEngine, FaultRates
+from foundationdb_tpu.fault.resilient import ResilienceConfig, ResilientEngine
+from foundationdb_tpu.ops.oracle import OracleConflictEngine
+from foundationdb_tpu.server.reshard import (
+    ElasticResolverGroup,
+    ReshardController,
+    rebalance_admission,
+)
+from foundationdb_tpu.sim.loop import set_scheduler
+from foundationdb_tpu.sim.simulator import Simulator
+
+CFG = ResilienceConfig(dispatch_timeout=0.5, retry_budget=2,
+                       retry_backoff=0.02, probe_rate=0.0,
+                       probation_batches=2, failover_min_batches=2)
+
+
+@pytest.fixture
+def sim():
+    s = Simulator(17)
+    buggify.disable()
+    g_trace.clear()
+    telemetry.reset()
+    yield s
+    buggify.disable()
+    set_scheduler(None)
+    telemetry.reset()
+
+
+def oracle_factory():
+    inner = OracleConflictEngine()
+    injector = FaultInjectingEngine(
+        inner, rates=FaultRates(exception=0, hang=0, slow=0, flip=0,
+                                outage=0))
+    return inner, injector, ResilientEngine(injector, CFG,
+                                            record_journal=True)
+
+
+def drive(sim, coro):
+    return sim.sched.run_until(sim.sched.spawn(coro), until=100000)
+
+
+def batch_stream(seed, n, pool=60, prefix=b"k", span_frac=0.2):
+    """Deterministic batches mixing point ranges with WIDE ranges (which
+    straddle shard splits and exercise the two-phase path)."""
+    rng = random.Random(seed)
+    v = 0
+    out = []
+    for _ in range(n):
+        v += rng.randrange(20, 100)
+        txns = []
+        for _ in range(rng.randrange(1, 6)):
+            t = CommitTransaction(
+                read_snapshot=max(0, v - rng.randrange(1, 300)))
+            for _ in range(rng.randrange(1, 3)):
+                a = rng.randrange(pool)
+                if rng.random() < span_frac:
+                    b = min(pool, a + rng.randrange(2, pool // 2))
+                    t.read_conflict_ranges.append(KeyRange(
+                        b"%s/%03d" % (prefix, a), b"%s/%03d" % (prefix, b)))
+                else:
+                    k = b"%s/%03d" % (prefix, a)
+                    t.read_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            for _ in range(rng.randrange(0, 3)):
+                a = rng.randrange(pool)
+                if rng.random() < span_frac:
+                    b = min(pool, a + rng.randrange(2, pool // 4))
+                    t.write_conflict_ranges.append(KeyRange(
+                        b"%s/%03d" % (prefix, a), b"%s/%03d" % (prefix, b)))
+                else:
+                    k = b"%s/%03d" % (prefix, a)
+                    t.write_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            txns.append(t)
+        out.append((txns, v, max(0, v - 1500)))
+    return out
+
+
+# -- the epoched shard map ----------------------------------------------------
+
+def test_epoched_map_flip_routing_and_gc():
+    em = EpochedKeyShardMap(KeyShardMap([]))
+    assert em.epoch == 0 and em.current().n_shards == 1
+    e1 = em.flip(KeyShardMap([b"m"]), 500)
+    e2 = em.flip(KeyShardMap([b"g", b"m"]), 900)
+    assert (e1, e2) == (1, 2)
+    # routing is a pure function of the batch version: below the first
+    # flip -> epoch 0, at/above a flip -> that epoch, exactly
+    assert em.map_for_version(499).n_shards == 1
+    assert em.map_for_version(500).n_shards == 2
+    assert em.map_for_version(899).n_shards == 2
+    assert em.map_for_version(900).n_shards == 3
+    assert em.entry_for_version(700)[0] == 1
+    # a flip at or below the newest flip version would make routing
+    # ambiguous for the overlap
+    with pytest.raises(AssertionError):
+        em.flip(KeyShardMap([b"z"]), 900)
+    # GC drops epochs no version >= horizon can route by, but always
+    # keeps the newest epoch at or below the horizon (it still routes
+    # the horizon itself)
+    em.gc(600)
+    assert [e for e, _fv, _m in em.epochs] == [1, 2]
+    assert em.map_for_version(600).n_shards == 2
+    em.gc(2000)
+    assert [e for e, _fv, _m in em.epochs] == [2]
+
+
+def test_epoched_map_wire_round_trip():
+    em = EpochedKeyShardMap(KeyShardMap([]))
+    em.flip(KeyShardMap([b"m"]), 500)
+    em.flip(KeyShardMap([b"g", b"m", b"t"]), 900)
+    back = wire.loads(wire.dumps(em))
+    assert [(e, fv, m.begins) for e, fv, m in back.epochs] == \
+        [(e, fv, m.begins) for e, fv, m in em.epochs]
+    assert back.epoch == em.epoch and back.flip_version == 900
+    assert back.as_dict() == em.as_dict()
+
+
+# -- split-point hysteresis (the satellite bugfix) ----------------------------
+
+def _zipf_feed(agg, rng, n_batches, pool=128, s=1.1, start_v=0):
+    """A stationary rank-Zipf write stream through observe_batch."""
+    from foundationdb_tpu.real.workload import ZipfKeySampler
+
+    sampler = ZipfKeySampler(pool, s, rng)
+    v = start_v
+    for _ in range(n_batches):
+        v += 100
+        txns = []
+        for _ in range(24):
+            k = b"z/%05d" % sampler.sample()
+            txns.append(CommitTransaction(
+                read_snapshot=v - 1,
+                write_conflict_ranges=[KeyRange(k, k + b"\x00")]))
+        agg.observe_batch(txns, [int(TransactionCommitResult.COMMITTED)] *
+                          len(txns), version=v)
+    return v
+
+
+def test_split_points_stable_across_50_syncs_of_stationary_stream():
+    """The regression the hysteresis knob exists for: a STATIONARY Zipf
+    stream scraped 50 times must yield ONE split-point set — the decayed
+    re-derivation may not flap the resharding controller by one bucket
+    between adjacent scrapes."""
+    agg = KeyRangeHeatAggregator(key_words=4, capacity=0, buckets=0,
+                                 decay=0.98)
+    rng = DeterministicRandom(71)
+    v = _zipf_feed(agg, rng, 40)          # warm the weights first
+    seen = set()
+    for _ in range(50):
+        v = _zipf_feed(agg, rng, 1, start_v=v)
+        seen.add(tuple(agg.split_points(4)))
+    assert len(seen) == 1, f"split points flapped across syncs: {seen}"
+
+
+def test_split_points_hysteresis_adopts_only_clear_improvement():
+    agg = KeyRangeHeatAggregator(key_words=4, capacity=0, buckets=0,
+                                 decay=1.0)
+    rng = DeterministicRandom(72)
+    _zipf_feed(agg, rng, 30)
+    first = agg.split_points(4)
+    assert first and agg._last_splits == first
+    # a tiny perturbation (one extra batch) must keep the adopted set
+    v = _zipf_feed(agg, DeterministicRandom(73), 1, start_v=10_000)
+    assert agg.split_points(4) == first
+    # moving ALL the load to a disjoint key family is a clear
+    # improvement: the fresh derivation replaces the stale set
+    agg.reset_weights()
+    _zipf_feed(agg, DeterministicRandom(74), 30, pool=64)
+    # reset_weights cleared the memory: fresh adoption, no comparison
+    second = agg.split_points(4)
+    assert second and second != first
+
+
+def test_split_key_within_span():
+    agg = KeyRangeHeatAggregator(key_words=4, capacity=0, buckets=0,
+                                 decay=1.0)
+    for i in range(16):
+        k = b"q/%03d" % i
+        agg.observe_batch(
+            [CommitTransaction(read_snapshot=1, write_conflict_ranges=[
+                KeyRange(k, k + b"\x00")])],
+            [int(TransactionCommitResult.COMMITTED)], version=10 + i)
+    k = agg.split_key_within(b"q/000", b"q/016")
+    assert k is not None and b"q/000" < k < b"q/016"
+    # a span whose load sits in one retained bucket has nothing to split
+    assert agg.split_key_within(b"q/003", b"q/004") is None
+
+
+# -- elastic group resolution parity ------------------------------------------
+
+def _manual_split(group, splits, sids_of):
+    """Install a multi-shard epoch by hand (no handoff: shard engines
+    start empty, which is only parity-safe from version 0)."""
+    m = KeyShardMap(splits)
+    e = group.emap.flip(m, 1)
+    group._assign[e] = sids_of
+    return e
+
+
+def test_elastic_group_two_shard_parity_vs_serial_oracle(sim):
+    """Verdicts from a 2-shard group — fast path AND the cross-shard
+    two-phase exchange — are bit-identical to ONE serial oracle over the
+    same stream."""
+    group = ElasticResolverGroup(oracle_factory)
+    extra = group.new_slot()
+    _manual_split(group, [b"k/030"], [group.slots[0].sid, extra.sid])
+    clean = OracleConflictEngine()
+    batches = batch_stream(5, 40)
+
+    async def go():
+        for txns, v, old in batches:
+            got = await group.resolve(txns, v, old)
+            want = clean.resolve(txns, v, old)
+            assert [int(x) for x in got] == [int(x) for x in want], (v,)
+    drive(sim, go())
+    # wide ranges actually exercised the cross-shard path
+    assert group.extra_stats["two_phase_batches"] > 0
+    assert group.extra_stats["fast_batches"] > 0
+    checked, mismatches = group.parity_check()
+    assert checked > 0 and mismatches == 0
+
+
+def test_elastic_group_three_shard_parity_vs_serial_oracle(sim):
+    group = ElasticResolverGroup(oracle_factory)
+    s1, s2 = group.new_slot(), group.new_slot()
+    _manual_split(group, [b"k/020", b"k/040"],
+                  [group.slots[0].sid, s1.sid, s2.sid])
+    clean = OracleConflictEngine()
+    batches = batch_stream(9, 40)
+
+    async def go():
+        for txns, v, old in batches:
+            got = await group.resolve(txns, v, old)
+            want = clean.resolve(txns, v, old)
+            assert [int(x) for x in got] == [int(x) for x in want], (v,)
+    drive(sim, go())
+    assert group.extra_stats["two_phase_batches"] > 0
+
+
+def test_elastic_no_trigger_bit_identical_to_plain_engine(sim):
+    """Resharding ON but never triggering changes nothing: the elastic
+    group's verdict stream and journal abort sets are bit-identical to a
+    plain supervised engine over the same stream."""
+    plain = oracle_factory()[2]
+    group = ElasticResolverGroup(oracle_factory)
+    ctl = ReshardController(group, min_heat_batches=10**9)   # never plans
+    batches = batch_stream(13, 30)
+    got_group, got_plain = [], []
+
+    async def go():
+        for txns, v, old in batches:
+            got_plain.append([int(x) for x in await plain.resolve(
+                txns, v, old)])
+            got_group.append([int(x) for x in await group.resolve(
+                txns, v, old)])
+            assert ctl.plan() is None
+    drive(sim, go())
+    assert got_group == got_plain
+    aborts = lambda eng: [
+        [int(x) for x in verdicts]
+        for _v, _t, _o, verdicts in eng.journal]
+    assert aborts(group.slots[0].engine) == aborts(plain)
+    assert ctl.executed == 0 and group.emap.epoch == 0
+
+
+def test_straddling_batches_resolve_under_submission_epoch(sim):
+    """Batches on both sides of a flip — including one below the flip
+    version resolved AFTER the flip installed — route by their own
+    version's epoch and stay oracle-bit-identical. The split range's
+    history moves via the real handoff slice (fault/handoff.py), so the
+    recipient convicts stale reads against pre-flip writes."""
+    group = ElasticResolverGroup(oracle_factory)
+    extra = group.new_slot()
+    clean = OracleConflictEngine()
+    pre = batch_stream(21, 10)
+    flip_v = pre[-1][1] + 10
+    post = [(t, v + flip_v, o) for t, v, o in batch_stream(22, 10)]
+    # the straddler touches only keys BELOW the split (in the real
+    # protocol the moving range [k/030, +inf) is frozen across the flip,
+    # so a pre-flip version can still write the non-moving range only)
+    straddler = batch_stream(23, 1, pool=25)[-1]
+
+    async def go():
+        for txns, v, old in pre:
+            got = await group.resolve(txns, v, old)
+            assert [int(x) for x in got] == \
+                [int(x) for x in clean.resolve(txns, v, old)]
+        # the handoff: the moving range's committed write history slides
+        # from the donor's shadow into the recipient, then the flip
+        entries = handoff.coalesce(
+            handoff.shadow_slice(group.slots[0].engine, b"k/030", None),
+            b"k/030", None)
+        assert entries, "no history to hand off"
+        await handoff.replay_slice(extra.engine, entries)
+        e = group.emap.flip(KeyShardMap([b"k/030"]), flip_v)
+        group._assign[e] = [group.slots[0].sid, extra.sid]
+        # the straddler was submitted pre-flip: its batch version selects
+        # the OLD epoch even though the new epoch is already installed
+        txns, v, old = straddler
+        assert v < flip_v
+        _e, _fv, m = group.emap.entry_for_version(v)
+        assert _e == 0 and m.n_shards == 1
+        got = await group.resolve(txns, v, old)
+        assert [int(x) for x in got] == \
+            [int(x) for x in clean.resolve(txns, v, old)]
+        for txns, v, old in post:
+            assert group.emap.entry_for_version(v)[0] == e
+            got = await group.resolve(txns, v, old)
+            assert [int(x) for x in got] == \
+                [int(x) for x in clean.resolve(txns, v, old)]
+    drive(sim, go())
+
+
+def test_duplicate_in_flight_versions_resolve_once(sim):
+    """Duplicate deliveries of a version — concurrent with the first
+    dispatch, after completion, and across a reshard — answer the SAME
+    verdicts without re-applying (one journal entry per version)."""
+    group = ElasticResolverGroup(oracle_factory)
+    batches = batch_stream(31, 12)
+
+    async def go():
+        txns, v, old = batches[0]
+        a = await group.resolve(txns, v, old)
+        b = await group.resolve(txns, v, old)
+        assert [int(x) for x in a] == [int(x) for x in b]
+        for txns2, v2, old2 in batches[1:]:
+            await group.resolve(txns2, v2, old2)
+        # concurrent duplicates share the in-flight future
+        txns3, v3, old3 = batch_stream(32, 1)[0]
+        v3 += batches[-1][1]
+        f1 = sim.sched.spawn(group.resolve(txns3, v3, old3))
+        f2 = sim.sched.spawn(group.resolve(txns3, v3, old3))
+        r1 = await f1
+        r2 = await f2
+        assert [int(x) for x in r1] == [int(x) for x in r2]
+        # replay after completion answers from the verdict cache
+        again = await group.resolve(txns, v, old)
+        assert [int(x) for x in again] == [int(x) for x in a]
+    drive(sim, go())
+    journal_versions = [v for v, _t, _o, _vd in group.slots[0].engine.journal]
+    assert len(journal_versions) == len(set(journal_versions)), \
+        "a duplicate delivery re-applied a version"
+
+
+# -- the live handoff (split + merge end to end) ------------------------------
+
+def _hot_batches(n, pool, hot_lo, hot_hi, seed, start_v=0, frac=0.85):
+    rng = random.Random(seed)
+    v = start_v
+    out = []
+    for _ in range(n):
+        v += 100
+        txns = []
+        for _ in range(24):
+            if rng.random() < frac:
+                a = rng.randrange(hot_lo, hot_hi)
+            else:
+                a = rng.randrange(pool)
+            k = b"k/%03d" % a
+            txns.append(CommitTransaction(
+                read_snapshot=max(0, v - rng.randrange(1, 200)),
+                read_conflict_ranges=[KeyRange(k, k + b"\x00")],
+                write_conflict_ranges=[KeyRange(k, k + b"\x00")]))
+        out.append((txns, v, max(0, v - 2000)))
+    return out
+
+
+def test_controller_split_then_merge_live_handoff(sim):
+    """The full arc on live oracle engines: hot load -> split plan ->
+    pre-copy/freeze/delta/flip handoff -> verdicts stay oracle-parity
+    through and after the cutover; load cools -> merge folds the pair;
+    blackouts recorded and within budget; donor EWMAs migrate."""
+    from foundationdb_tpu.pipeline.resolver_pipeline import BudgetBatcher
+
+    group = ElasticResolverGroup(
+        oracle_factory, make_batcher=lambda: BudgetBatcher([16, 48]))
+    group.prewarm_spares(1)
+    ctl = ReshardController(group, min_heat_batches=5)
+    ctl._last_done = -100.0        # sim time starts near 0: open the
+    #                                reshard_min_interval_s gate
+    clean = OracleConflictEngine()
+    pool = 96
+    phase1 = _hot_batches(30, pool, 60, 92, seed=41)
+    v0 = phase1[-1][1]
+
+    async def go():
+        for txns, v, old in phase1:
+            got = await group.resolve(txns, v, old)
+            assert [int(x) for x in got] == \
+                [int(x) for x in clean.resolve(txns, v, old)]
+        plan = ctl.plan()
+        assert plan is not None and plan["kind"] == "split", plan
+        op = await ctl.execute(plan)
+        assert op is not None and op.state == "done", op
+        assert op.prewarmed and op.flip_version == v0 + 1
+        assert group.emap.epoch == 1
+        assert op.blackout_ms <= float(SERVER_KNOBS.reshard_blackout_budget_ms)
+        assert op.precopied > 0
+        assert op.ewmas_migrated >= 0
+        # post-split batches (same + cross-shard) stay bit-identical
+        for txns, v, old in _hot_batches(20, pool, 0, pool, seed=42,
+                                         start_v=v0, frac=0.0):
+            got = await group.resolve(txns, v, old)
+            assert [int(x) for x in got] == \
+                [int(x) for x in clean.resolve(txns, v, old)]
+        # the split range's history actually moved: the recipient can
+        # convict a stale read against a pre-split write on its own
+        checked, mismatches = group.parity_check()
+        assert checked > 0 and mismatches == 0
+        # now the hot window cools: drive uniform cold load until the
+        # pair's combined share drops under the merge trigger
+        v = v0 + 20 * 100
+        for _ in range(60):
+            batches = _hot_batches(5, 40, 0, 8, seed=43, start_v=v)
+            for txns, bv, old in batches:
+                got = await group.resolve(txns, bv, old)
+                assert [int(x) for x in got] == \
+                    [int(x) for x in clean.resolve(txns, bv, old)]
+            v = batches[-1][1]
+            plan = ctl.plan()
+            if plan is not None and plan["kind"] == "merge":
+                break
+        # a merge may or may not trigger depending on decay; if planned,
+        # execute and re-verify parity through the second cutover
+        if plan is not None and plan["kind"] == "merge":
+            op2 = await ctl.execute(plan)
+            assert op2 is not None and op2.state == "done", op2
+            for txns, bv, old in _hot_batches(10, 40, 0, 40, seed=44,
+                                              start_v=v, frac=0.0):
+                got = await group.resolve(txns, bv, old)
+                assert [int(x) for x in got] == \
+                    [int(x) for x in clean.resolve(txns, bv, old)]
+    drive(sim, go())
+    assert ctl.executed >= 1 and ctl.stalled == 0
+    assert ctl.blackout_over_budget == 0
+    assert any(w["kind"] == "reshard" for w in ctl.windows)
+    assert any(w["kind"] == "reshard_arc" for w in ctl.windows)
+    checked, mismatches = group.parity_check()
+    assert checked > 0 and mismatches == 0
+
+
+# -- the handoff primitives ---------------------------------------------------
+
+def test_clip_range():
+    assert handoff.clip_range(b"a", b"m", b"c", b"t") == (b"c", b"m")
+    assert handoff.clip_range(b"a", b"c", b"c", b"t") is None
+    assert handoff.clip_range(b"x", b"z", b"c", None) == (b"x", b"z")
+    assert handoff.clip_range(b"a", b"b", b"c", None) is None
+
+
+def test_coalesce_preserves_effective_history(sim):
+    """Replaying the COALESCED slice yields the same verdicts as
+    replaying every raw entry: later writes overwrite earlier ones
+    exactly as the interval map records."""
+    rng = random.Random(55)
+    entries = []
+    v = 0
+    for _ in range(60):
+        v += rng.randrange(5, 40)
+        writes = []
+        for _ in range(rng.randrange(1, 4)):
+            a = rng.randrange(40)
+            b = a + rng.randrange(1, 6)
+            writes.append((b"h/%03d" % a, b"h/%03d" % b))
+        entries.append((v, tuple(writes)))
+    coalesced = handoff.coalesce(entries, b"h/", b"h/\xff")
+    assert len(coalesced) <= len(entries)
+
+    def replay(entry_list):
+        o = OracleConflictEngine()
+        for ver, writes in entry_list:
+            o.resolve([CommitTransaction(
+                read_snapshot=ver,
+                write_conflict_ranges=[KeyRange(b, e)
+                                       for b, e in writes])], ver, 0)
+        return o
+
+    raw, coal = replay(entries), replay(coalesced)
+    probes = []
+    prng = random.Random(56)
+    for _ in range(200):
+        a = prng.randrange(44)
+        k = b"h/%03d" % a
+        probes.append(CommitTransaction(
+            read_snapshot=prng.randrange(v + 1),
+            read_conflict_ranges=[KeyRange(k, k + b"\x00")]))
+    got_raw = raw.resolve(probes, v + 10, 0)
+    got_coal = coal.resolve(probes, v + 10, 0)
+    assert [int(x) for x in got_raw] == [int(x) for x in got_coal]
+
+
+def test_shadow_slice_clips_and_watermarks(sim):
+    eng = oracle_factory()[2]
+
+    async def go():
+        for txns, v, old in batch_stream(61, 15):
+            await eng.resolve(txns, v, old)
+    drive(sim, go())
+    full = handoff.shadow_slice(eng, b"", None)
+    assert full, "supervised engine recorded no shadow"
+    lo = handoff.shadow_slice(eng, b"k/020", b"k/040")
+    for _v, writes in lo:
+        for b, e in writes:
+            assert b >= b"k/020" and e <= b"k/040"
+    wm = handoff.last_shadow_version(eng)
+    # the watermark tracks the RAW shadow (write-less batches included,
+    # which the clipped slice drops), so it bounds every sliced version
+    assert wm >= max(v for v, _w in full)
+    assert wm == max(entry[0] for entry in eng._shadow)
+    assert handoff.shadow_slice(eng, b"", None, min_version=wm) == []
+
+
+def test_migrate_ewmas_recipient_keys_win():
+    from foundationdb_tpu.pipeline.resolver_pipeline import BudgetBatcher
+
+    src, dst = BudgetBatcher([16, 48]), BudgetBatcher([16, 48])
+    src.observe(16, 5.0)
+    src.observe(48, 9.0)
+    key16 = next(k for k in src.ewma_ms if k[0] == 16)
+    dst.observe(16, 2.0)
+    before = dst.ewma_ms[key16]
+    copied = handoff.migrate_ewmas(src, dst)
+    assert copied >= 1
+    assert dst.ewma_ms[key16] == before, "recipient's own observation lost"
+    key48 = next(k for k in src.ewma_ms if k[0] == 48)
+    assert dst.ewma_ms[key48] == src.ewma_ms[key48]
+    assert handoff.migrate_ewmas(None, dst) == 0
+
+
+def test_rebalance_admission_weights_follow_heat():
+    from foundationdb_tpu.server.ratekeeper import TenantAdmission
+
+    agg = KeyRangeHeatAggregator(key_words=4, capacity=0, buckets=0,
+                                 decay=1.0)
+    txns = []
+    for i in range(30):
+        k = b"hot/%05d" % i
+        txns.append(CommitTransaction(read_snapshot=1,
+                                      write_conflict_ranges=[
+                                          KeyRange(k, k + b"\x00")]))
+    for i in range(10):
+        k = b"cold/%05d" % i
+        txns.append(CommitTransaction(read_snapshot=1,
+                                      write_conflict_ranges=[
+                                          KeyRange(k, k + b"\x00")]))
+    agg.observe_batch(txns, [int(TransactionCommitResult.COMMITTED)] *
+                      len(txns), version=10)
+    adm = TenantAdmission()
+    adm.set_rate(100.0)
+    # a tenant the admission layer has seen but the decayed/pruned heat
+    # no longer retains must keep a floor share — and the weights are
+    # normalized to MEAN 1.0 so a tenant entirely absent from the table
+    # (default weight 1.0) cannot out-weigh every measured one
+    adm.admitted["idle"] = 3
+    weights = rebalance_admission(adm, agg)
+    assert weights["hot"] > weights["cold"] > weights["idle"] > 0
+    assert adm.weights == weights
+    assert sum(weights.values()) / len(weights) == pytest.approx(1.0)
+    assert weights["hot"] > 1.0 > weights["idle"]
+
+
+# -- the ratekeeper clamp (satellite: the dormant hook wired) -----------------
+
+def test_ratekeeper_clamps_while_reshard_in_flight():
+    """Mirrors the burn-clamp unit: a resolver reporting
+    reshard_in_flight scales the published rate by reshard_tps_fraction,
+    restores it on completion, and composes with the other clamps (min
+    wins)."""
+    from foundationdb_tpu.server.ratekeeper import Ratekeeper
+
+    rk = Ratekeeper(net=None, src_addr="rk", storage_tags=[],
+                    committed_version_fn=lambda: 0)
+    max_tps = float(SERVER_KNOBS.max_transactions_per_second)
+    tps = rk._update_rate([], None, [{"degraded": False,
+                                      "reshard_in_flight": False}])
+    assert tps == max_tps and not rk.reshard_in_flight
+    tps = rk._update_rate([], None, [{"degraded": False,
+                                      "reshard_in_flight": True}])
+    assert rk.reshard_in_flight
+    assert tps == pytest.approx(max_tps * SERVER_KNOBS.reshard_tps_fraction)
+    # restored on the poll that reports completion
+    tps = rk._update_rate([], None, [{"degraded": False,
+                                      "reshard_in_flight": False}])
+    assert tps == max_tps and not rk.reshard_in_flight
+    # composes with degraded + burn: min of the fractions wins
+    tps = rk._update_rate([], None, [{"degraded": True,
+                                      "burn_alert_firing": True,
+                                      "reshard_in_flight": True}])
+    assert tps == pytest.approx(max_tps * min(
+        SERVER_KNOBS.reshard_tps_fraction,
+        SERVER_KNOBS.watchdog_burn_tps_fraction,
+        SERVER_KNOBS.resolver_degraded_tps_fraction))
+
+
+# -- the watchdog rule --------------------------------------------------------
+
+def test_reshard_stalled_rule_fires_and_names_the_range(sim):
+    """Past `reshard_stall_s` the rule fires immediately (hold 0) and the
+    detail reads like a page: the frozen range + donor engine state,
+    composed from the live controller through the hub registry."""
+    from foundationdb_tpu.core.watchdog import ReshardStalledRule, Watchdog
+    from foundationdb_tpu.server.reshard import ReshardOp
+
+    t = [0.0]
+    hub = telemetry.hub()
+    group = ElasticResolverGroup(oracle_factory)
+    ctl = ReshardController(group, now_fn=lambda: t[0])
+    wd = Watchdog([ReshardStalledRule()], now_fn=lambda: t[0])
+    hub.attach_watchdog(wd)
+    hub.sync()
+    assert all(a["state"] == "ok" for a in wd.alerts_snapshot())
+    # a handoff wedges mid-precopy: in-flight age grows past the knob
+    ctl.current = ReshardOp(id=1, kind="split", begin="k/030", end=None,
+                            donor_sids=[group.slots[0].sid],
+                            state="precopy", t_start=0.0)
+    t[0] = float(SERVER_KNOBS.reshard_stall_s) + 1.0
+    hub.sync()
+    firing = [a for a in wd.alerts_snapshot()
+              if a["name"] == "reshard_stalled" and a["state"] == "firing"]
+    assert firing, wd.alerts_snapshot()
+    detail = firing[0]["detail"]
+    assert "reshard of [k/030,+inf) precopy" in detail, detail
+    assert "donor r0 state=healthy" in detail, detail
+    # the op completes: the gauge resets and the alert resolves
+    ctl.current = None
+    t[0] += float(SERVER_KNOBS.watchdog_clear_s) + 1.0
+    hub.sync()
+    t[0] += float(SERVER_KNOBS.watchdog_clear_s) + 1.0
+    hub.sync()
+    assert all(a["state"] != "firing" for a in wd.alerts_snapshot()
+               if a["name"] == "reshard_stalled")
+
+
+def test_reshard_telemetry_series_and_exposition(sim):
+    group = ElasticResolverGroup(oracle_factory)
+    ctl = ReshardController(group)
+    hub = telemetry.hub()
+    hub.sync()
+    metrics = hub.tdmetrics.metrics
+    series = [n for n in metrics if n.startswith("reshard.")]
+    assert any(n.endswith(".executed") for n in series), series
+    assert any(n.endswith(".in_flight_age_us") for n in series), series
+    text = hub.prometheus_text()
+    assert "# TYPE fdbtpu_reshard gauge" in text
+    assert ctl.snapshot()["epoch"] == 0
+
+
+# -- the CLI render -----------------------------------------------------------
+
+def test_cli_shards_renders_campaign_report(tmp_path, capsys):
+    from foundationdb_tpu.tools.cli import Cli
+
+    report = {"campaigns": [{
+        "cfg_seed": 11, "engine_mode": "jax",
+        "reshard": {
+            "executed": 2, "stalled": 0, "in_flight": None,
+            "blackout_ms_max": 5.49, "blackout_budget_ms": 250.0,
+            "blackout_over_budget": 0, "epoch": 2,
+            "shard_map": {"epoch": 2, "flip_version": 900, "n_shards": 3,
+                          "splits": ["k/030", "k/060"],
+                          "history": [
+                              {"epoch": 0, "flip_version": 0, "splits": []},
+                              {"epoch": 1, "flip_version": 500,
+                               "splits": ["k/030"]},
+                              {"epoch": 2, "flip_version": 900,
+                               "splits": ["k/030", "k/060"]}]},
+            "ops": [{"id": 1, "kind": "split", "begin": "k/030",
+                     "end": None, "state": "done", "blackout_ms": 5.49,
+                     "precopied": 15, "delta": 1, "prewarmed": True},
+                    {"id": 2, "kind": "split", "begin": "k/060",
+                     "end": None, "state": "done", "blackout_ms": 0.0,
+                     "precopied": 24, "delta": 0, "prewarmed": False}],
+        }}]}
+    path = tmp_path / "report.json"
+    path.write_text(json.dumps(report))
+    cli = Cli.__new__(Cli)
+    cli.out = io.StringIO()
+    cli.do_shards([str(path)])
+    out = cli.out.getvalue()
+    assert "epoch 2, 3 shard(s), 2 reshard(s) executed" in out
+    assert "epoch history:" in out and "epoch 1 @ v500" in out
+    assert "#1 split" in out and "(prewarmed)" in out
+    assert "blackout budget 250.0 ms" in out
+    # a report without reshard records says so instead of crashing
+    plain = tmp_path / "plain.json"
+    plain.write_text(json.dumps({"campaigns": [{"cfg_seed": 1}]}))
+    cli.out = io.StringIO()
+    cli.do_shards([str(plain)])
+    assert "no reshard records" in cli.out.getvalue()
+
+
+# -- the drift campaign (tier-1 acceptance + slow matrix) ---------------------
+
+def _drift_cfg(seed, engine_mode="oracle", **kw):
+    from foundationdb_tpu.real.nemesis import drift_config
+
+    kw.setdefault("budget_ms", 250.0)   # tier-1 co-residency budget
+    return drift_config(seed, engine_mode=engine_mode, **kw)
+
+
+@pytest.mark.timeout(180)
+def test_drift_campaign_fast_seed():
+    """Tier-1 acceptance: the diurnal drift campaign on the live
+    wall-clock cluster — the hot range sweeps the keyspace, the
+    controller executes >= 2 reshards, every blackout is inside
+    `reshard_blackout_budget_ms` (controller clocks AND span segments),
+    journals replay bit-identical through clean oracles per shard
+    (handoff batches included), and every firing incident is explained."""
+    from foundationdb_tpu.real.nemesis import assert_slos, run_campaign
+
+    cfg = _drift_cfg(11)
+    rep = run_campaign(cfg)
+    assert_slos(rep, cfg)
+    rs = rep.reshard
+    assert rs and rs["executed"] >= 2 and rs["stalled"] == 0
+    assert rs["epoch"] >= 2 and rs["blackout_over_budget"] == 0
+    kinds = {op["kind"] for op in rs["ops"] if op["state"] == "done"}
+    assert "split" in kinds, rs["ops"]
+    # every executed reshard flipped the epoch exactly once (epochs
+    # fully below the GC horizon are pruned from the history chain)
+    assert rs["shard_map"]["epoch"] == rs["executed"]
+    # the span-verified blackout SLO (PR 8 trace segments)
+    assert rep.reshard_span_blackouts_ms is not None
+    assert len(rep.reshard_span_blackouts_ms) >= rs["executed"]
+    # parity covered every shard engine's journal
+    assert rep.parity_checked > 0 and rep.parity_mismatches == 0
+    # admission rebalanced from the post-reshard heat fractions
+    assert rep.admission_weights and sum(
+        rep.admission_weights.values()) > 0
+    assert rep.chaos_counts.get("reshard_split", 0) >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(1200)
+@pytest.mark.parametrize("engine_mode", ["jax", "device_loop"])
+def test_drift_campaign_matrix(engine_mode):
+    """The `make chaos-drift` class gate: 2 seeds per device-backed
+    engine mode, blocking_syncs==0 in loop mode (asserted inside
+    assert_slos via the group's aggregated loop_stats)."""
+    from foundationdb_tpu.real.nemesis import assert_slos, run_campaign
+
+    for seed in (11, 12):
+        cfg = _drift_cfg(seed, engine_mode=engine_mode)
+        rep = run_campaign(cfg)
+        assert_slos(rep, cfg)
+        assert rep.reshard["executed"] >= 2
+        if engine_mode == "device_loop":
+            assert rep.loop_stats is not None
+            assert rep.loop_stats.get("blocking_syncs", 0) == 0
