@@ -95,15 +95,28 @@ reshard-smoke:
 chaos-drift:
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.real.nemesis \
 		--drift --seeds 2 --engine-modes jax,device_loop --watchdog \
+		--blackbox-dir chaos_drift_blackbox \
 		--json chaos_drift_report.json
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.cli \
 		shards chaos_drift_report.json
+	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.cli \
+		blackbox chaos_drift_report.json
+
+# Commit-forensics smoke (docs/observability.md "Black-box journal &
+# forensics", ~30s, solo-CPU safe — oracle engines, one process): a short
+# chaos campaign with the black-box journal on (elastic + reshard +
+# watchdog), then: explain the worst retained ack (>= 5 signal sources
+# joined), differential-replay the persisted window through the clean
+# serial oracle (verdict-bit-identical, across the epoch flip), and
+# strict-parse every frame against BLACKBOX_EVENT_REGISTRY.
+forensics-smoke:
+	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.forensics_smoke
 
 # Static invariant check (docs/static_analysis.md, ~2s, pure AST — never
 # imports jax): determinism, host-sync discipline, donation safety,
-# recompile hazards, knob/doc drift, span registry. Non-zero on any
-# non-baselined finding or stale baseline entry; the same run rides tier-1
-# as tests/test_lint.py::test_repo_clean.
+# recompile hazards, knob/doc drift, span + blackbox registries.
+# Non-zero on any non-baselined finding or stale baseline entry; the
+# same run rides tier-1 as tests/test_lint.py::test_repo_clean.
 lint:
 	python -m foundationdb_tpu.tools.lint
 
@@ -120,10 +133,13 @@ chaos-real:
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.real.nemesis \
 		--seeds 2 --engine-modes jax,device_loop --sweep --watchdog \
 		--trace-dir chaos_real_traces \
+		--blackbox-dir chaos_real_blackbox \
 		--json chaos_real_report.json
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.cli \
 		chaos-status chaos_real_report.json
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.cli \
 		incidents chaos_real_report.json
+	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.cli \
+		explain --slo chaos_real_report.json
 
-.PHONY: check bench bench-smoke telemetry-smoke heat-smoke trace-smoke chaos chaos-real chaos-drift reshard-smoke lint perf-smoke bench-history watch-smoke
+.PHONY: check bench bench-smoke telemetry-smoke heat-smoke trace-smoke chaos chaos-real chaos-drift reshard-smoke lint perf-smoke bench-history watch-smoke forensics-smoke

@@ -301,6 +301,7 @@ def main(argv=None):
     sharded = sharded_cpu_numbers()
     floor = history_floor_section()
     chaos_served = served_under_chaos_section()
+    while_resharding = served_while_resharding_section()
     heat = conflict_heat_section()
 
     print(json.dumps({
@@ -329,6 +330,7 @@ def main(argv=None):
         "latency_under_load": under_load,
         "latency_attribution": attribution,
         "served_under_chaos": chaos_served,
+        "served_while_resharding": while_resharding,
         "conflict_heat": heat,
         "compile_memory": compile_memory,
         "profile": PROFILE,
@@ -849,6 +851,24 @@ def served_under_chaos_section():
     except Exception as e:  # noqa: BLE001 — a socketless/odd environment
         #                     must not kill the chip bench (sibling
         #                     sections guard the same way)
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def served_while_resharding_section():
+    """The elastic capacity model (ROADMAP item 4 follow-up,
+    docs/elasticity.md): the served_under_chaos serving point driven
+    through the elastic resolver group under a DRIFTING Zipf hot spot,
+    once with the heat-driven reshard controller ACTIVE and once static —
+    users-served per chip WHILE ranges split/move live (admission
+    clamped during handoffs, blackouts pausing the frozen range) vs. the
+    static figure. Wall-clock + oracle engines, chip-independent like
+    its sibling section."""
+    try:
+        from foundationdb_tpu.real.nemesis import run_served_while_resharding
+
+        return run_served_while_resharding()
+    except Exception as e:  # noqa: BLE001 — a socketless/odd environment
+        #                     must not kill the chip bench
         return {"error": f"{type(e).__name__}: {e}"}
 
 

@@ -232,6 +232,14 @@ class KeyRangeHeatAggregator:
                 })
         self._prune()
 
+    def attribution_for(self, version: int) -> List[dict]:
+        """The retained first-witness attribution samples of ONE batch
+        version — what the black-box journal attaches to that batch's
+        record (core/blackbox.py) and `cli explain` leads its verdict
+        line with."""
+        return [dict(a) for a in self.attribution
+                if a.get("version") == version]
+
     def reset_weights(self) -> None:
         """Drop the accumulated range weights and attribution samples
         (verdict/occupancy totals stay). Useful after a warm-up phase:

@@ -266,6 +266,25 @@ def _make_server_knobs() -> Knobs:
     #: bit-identical on/off (tests/test_perf_ledger.py); engines take a
     #: `device_time_sample_rate=` constructor override.
     k.init("resolver_device_time_sample_rate", 0.0625)
+    # Black-box journal & forensics (core/blackbox.py;
+    # docs/observability.md "Black-box journal & forensics").
+    # Deliberately no BUGGIFY randomizers: recording is observational
+    # (abort sets bit-identical on/off) and draws no rng.
+    #: master switch: "" = off (producer sites pay one list-index check
+    #: and allocate nothing); "on" = journal into resolver_blackbox_dir;
+    #: any other value is itself the journal directory
+    k.init("resolver_blackbox", "")
+    #: journal directory when resolver_blackbox is "on"
+    k.init("resolver_blackbox_dir", "blackbox")
+    #: segment rotation threshold: a segment reaching this many bytes is
+    #: closed and a new one opened (append-only within a segment)
+    k.init("resolver_blackbox_segment_bytes", 1 << 20)
+    #: retained segments; the oldest is deleted past this (the journal's
+    #: retention window — size it like the MVCC window so a replayed
+    #: slice's too-old gate still covers the retained history)
+    k.init("resolver_blackbox_segments", 8)
+    #: in-memory ring of recent envelopes for live explain / summaries
+    k.init("resolver_blackbox_ring", 4096)
     # Cluster watchdog (core/watchdog.py; docs/observability.md
     # "Watchdog, burn rates & incidents"). Deliberately no BUGGIFY
     # randomizers: evaluation is observational (host-side reads only,

@@ -346,4 +346,7 @@ from . import wire as _wire
 
 _wire.register_record(Mutation)
 _wire.register_record(KeyRange)
+# whole transactions ride the black-box journal's batch records
+# (core/blackbox.py) — the differential-replay unit
+_wire.register_record(CommitTransaction)
 _wire.register_enum(MutationType)

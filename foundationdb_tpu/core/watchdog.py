@@ -47,6 +47,7 @@ import re
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from . import blackbox
 from .trace import span_now
 
 #: allocation counter for the disabled-path regression guard
@@ -462,6 +463,9 @@ class Incident:
         self.root_cause: Optional[Dict[str, Any]] = None
         self.explained = False
         self.explanation: Optional[str] = None
+        #: already sunk to the black-box journal (correlate() may run
+        #: more than once; the append-only journal must not duplicate)
+        self.journaled = False
 
     def summary(self) -> str:
         parts = [" ".join(f"{a['name']} firing"
@@ -577,6 +581,16 @@ class Watchdog:
         self._health_rx = _pattern_re("resolver.*.state")
         self._health_last: Dict[str, int] = {}
 
+    def _edge(self, entry: Dict[str, Any]) -> None:
+        """One alert lifecycle edge: into the bounded ring AND, when a
+        black-box journal is installed, onto disk — post-hoc forensics
+        (`cli explain`) joins these against the batch/fault timeline."""
+        self.ring.append(entry)
+        if blackbox.enabled():
+            blackbox.record_alert(entry["alert"], entry["series"],
+                                  entry["state"], entry["value"],
+                                  entry["detail"])
+
     # -- evaluation ----------------------------------------------------------
     def _track_health(self, t: float, view: _SeriesView) -> None:
         from .telemetry import HEALTH_STATE_INDEX
@@ -605,7 +619,7 @@ class Watchdog:
         if st.state == OK:
             if active:
                 st.state, st.since = PENDING, t
-                self.ring.append({"t": round(t, 4), "alert": rule.name,
+                self._edge({"t": round(t, 4), "alert": rule.name,
                                   "series": series, "state": "pending",
                                   "value": value, "detail": detail})
                 # hold 0 = fire on the same tick the condition appears
@@ -614,7 +628,7 @@ class Watchdog:
         elif st.state == PENDING:
             if not active:
                 st.state = OK
-                self.ring.append({"t": round(t, 4), "alert": rule.name,
+                self._edge({"t": round(t, 4), "alert": rule.name,
                                   "series": series, "state": "cleared",
                                   "value": value, "detail": detail})
             elif t - st.since >= rule.resolved_hold_s():
@@ -631,7 +645,7 @@ class Watchdog:
                     st.clear_since = t
                 if t - st.clear_since >= rule.resolved_clear_s():
                     st.state, st.clear_since = OK, None
-                    self.ring.append({"t": round(t, 4), "alert": rule.name,
+                    self._edge({"t": round(t, 4), "alert": rule.name,
                                       "series": series, "state": "resolved",
                                       "value": value, "detail": detail})
 
@@ -639,7 +653,7 @@ class Watchdog:
               st: _AlertState) -> None:
         st.state, st.t_firing, st.clear_since = FIRING, t, None
         st.fired_count += 1
-        self.ring.append({"t": round(t, 4), "alert": rule.name,
+        self._edge({"t": round(t, 4), "alert": rule.name,
                           "series": series, "state": "firing",
                           "value": st.value, "detail": st.detail})
         if self._open is None:
@@ -772,6 +786,14 @@ class Watchdog:
                     a["kind"] == "burn" for a in inc.alerts.values()):
                 inc.explained = True
                 inc.explanation = f"names the {breached_slo} breach"
+        if blackbox.enabled():
+            # correlated incidents onto the black-box journal, ONCE per
+            # incident even when correlate() runs again: the post-hoc
+            # explain joins them against batch/fault timelines
+            for inc in self.incidents:
+                if not inc.journaled:
+                    inc.journaled = True
+                    blackbox.record_incident(inc.as_dict())
         return self.incidents
 
 

@@ -48,7 +48,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Deque, List, Optional, Tuple
 
-from ..core import buggify, error, telemetry
+from ..core import blackbox, buggify, error, telemetry
 from ..core.knobs import SERVER_KNOBS
 from ..core.rng import DeterministicRandom
 from ..core.trace import Severity, TraceEvent, g_spans, span_event, span_now
@@ -283,6 +283,12 @@ class ResilientEngine:
                        severity=(Severity.WARN if state != HEALTHY
                                  else Severity.INFO)) \
                 .detail("From", self.state).detail("To", state).log()
+            if blackbox.enabled():
+                # the transition onto the durable black-box journal:
+                # `cli explain` renders the failover/swap-back arc a
+                # version's batch ran under, hours after the process died
+                blackbox.record_health(self._telemetry_label,
+                                       self.state, state)
             self.state = state
             # transition into the unified TDMetric registry: the change
             # history of this Int64 series IS the incident timeline
@@ -417,6 +423,8 @@ class ResilientEngine:
         self._failover = self._rebuild_oracle()
         self._failed_batches = 0
         self._set_state(FAILED)
+        if blackbox.enabled():
+            blackbox.record_flight("failover", now_v, self.flight.dump())
         TraceEvent("ResolverEngineFailover", severity=Severity.WARN) \
             .detail("Version", now_v).detail("ShadowEntries", len(self._shadow)) \
             .detail("FlightRecorder", self.flight.dump()) \
@@ -444,6 +452,8 @@ class ResilientEngine:
         device is never trusted again this incarnation."""
         self.stats["probe_mismatches"] += 1
         self._set_state(QUARANTINED)
+        if blackbox.enabled():
+            blackbox.record_flight("quarantine", now_v, self.flight.dump())
         # the flight recorder's last N dispatch records ride the SevError:
         # a post-mortem replays them (digests + journal) without having to
         # reconstruct the dispatch history from scattered logs

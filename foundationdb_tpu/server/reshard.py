@@ -37,7 +37,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
-from ..core import error, telemetry
+from ..core import blackbox, error, telemetry
 from ..core.heatmap import (
     LANE_CONFLICTS,
     LANE_WRITES,
@@ -394,6 +394,21 @@ class ElasticResolverGroup:
                     del self._assign[e]
             self.last_version = max(self.last_version, now_v)
             self.heat.observe_batch(transactions, verdicts, version=now_v)
+            if blackbox.enabled():
+                # the group is the resolution tier's top level here: ONE
+                # batch record per version (slot engines underneath never
+                # record), stamped with the epoch that routed it — the
+                # differential-replay unit of core/blackbox.py
+                shards_touched = sorted({s for sh in touched for s in sh})
+                blackbox.record_batch(
+                    transactions, now_v, new_oldest, verdicts,
+                    epoch=_e,
+                    shard=(shards_touched[0]
+                           if len(shards_touched) == 1 else -1),
+                    engine="elastic",
+                    served_by=("fast" if all(len(s) <= 1 for s in touched)
+                               else "two_phase"),
+                    witness=self.heat.attribution_for(now_v))
             return verdicts
         finally:
             busy, self._busy = self._busy, None
@@ -794,6 +809,8 @@ class ReshardController:
             if spans_on:
                 span_event("reshard.warm", rid, ts0, span_now(),
                            Proc="reshard", prewarmed=prewarmed)
+            if blackbox.enabled():
+                blackbox.record_reshard(op, "warm")
             # PRE-COPY: coalesced history while the donors keep serving
             op.state = "precopy"
             ts0 = span_now()
@@ -817,10 +834,14 @@ class ReshardController:
             if spans_on:
                 span_event("reshard.precopy", rid, ts0, span_now(),
                            Proc="reshard", batches=op.precopied)
+            if blackbox.enabled():
+                blackbox.record_reshard(op, "precopy")
             # FREEZE -> residual delta -> CUTOVER: the blackout
             op.state = "frozen"
             g.freeze([(b, e) for _sid, b, e in moving])
             op.t_freeze = self.now_fn()
+            if blackbox.enabled():
+                blackbox.record_reshard(op, "frozen")
             ts_freeze = span_now()
             await g.quiesce()
             delta = sorted(self._slice_all(moving, marks))
@@ -836,6 +857,13 @@ class ReshardController:
             g.unfreeze()
             op.t_cutover = self.now_fn()
             op.blackout_ms = (op.t_cutover - op.t_freeze) * 1e3
+            if blackbox.enabled():
+                # the epoch flip, with the new split keys: routing under
+                # any version is reconstructible from the journal alone
+                blackbox.record_reshard(
+                    op, "flip", epoch=op.epoch,
+                    flip_version=op.flip_version,
+                    splits=[_fmt_key(k) for k in new_map.begins[1:]])
             if spans_on:
                 span_event("reshard.cutover", op.flip_version, ts_cut,
                            span_now(), Proc="reshard", epoch=op.epoch)
@@ -874,6 +902,9 @@ class ReshardController:
             self._last_done = self.now_fn()
             self.current = None
             g.reshard_in_flight = False
+            if blackbox.enabled():
+                blackbox.record_reshard(op, "done", epoch=op.epoch,
+                                        flip_version=op.flip_version)
             if self.on_complete is not None:
                 self.on_complete(op)
             return op
@@ -882,6 +913,8 @@ class ReshardController:
             op.state = "stalled"
             op.error = f"{type(e).__name__}: {e}"
             self.stalled += 1
+            if blackbox.enabled():
+                blackbox.record_reshard(op, "stalled")
             g.unfreeze()
             # the recipient never went live (op.epoch is only set at the
             # flip): cool it for recycling instead of leaking the warmed

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
-from ..core import buggify, error
+from ..core import blackbox, buggify, error
 from ..core import telemetry
 from ..core.knobs import SERVER_KNOBS
 from ..core.stats import CounterCollection
@@ -329,7 +329,8 @@ class Resolver:
                     p.send_error(error.please_reboot(
                         f"resolve {req.version} cancelled"))
                 raise
-            reply = self._finish(req.version, verdicts, prepended, new_oldest)
+            reply = self._finish(req.version, verdicts, prepended,
+                                 new_oldest, transactions)
             self._inflight.pop(req.version, None)
             p.send(reply)
             return reply
@@ -371,7 +372,7 @@ class Resolver:
                         f"resolve {req.version} failed in pipeline: {e}") from e
             raise
         reply = self._finish(req.version, verdicts, prepended, new_oldest,
-                             advance_chain=False)
+                             transactions, advance_chain=False)
         self._inflight.pop(req.version, None)
         p.send(reply)
         return reply
@@ -397,10 +398,20 @@ class Resolver:
         return r
 
     def _finish(self, version: Version, verdicts, prepended: bool,
-                new_oldest: Version,
+                new_oldest: Version, transactions=None,
                 advance_chain: bool = True) -> ResolveTransactionBatchReply:
         from ..core.types import TransactionCommitResult
 
+        if transactions is not None and blackbox.enabled():
+            # durable black-box record of the batch AS RESOLVED (synthetic
+            # handoff writes included — differential replay re-resolves
+            # exactly what the engine saw; core/blackbox.py)
+            blackbox.record_batch(
+                transactions, version, new_oldest, verdicts,
+                shard=self.index,
+                engine=getattr(self.engine, "name",
+                               type(self.engine).__name__),
+                proc=self.proc.address)
         if prepended:
             verdicts = verdicts[1:]   # the synthetic is ours, not a txn
         reply = ResolveTransactionBatchReply(committed=[int(v) for v in verdicts])

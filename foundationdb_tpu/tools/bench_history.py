@@ -95,6 +95,12 @@ METRICS: Tuple[Metric, ...] = (
            higher_is_better=False, noise_frac=0.0),
     Metric("served_under_chaos", "users_served_per_chip.no_nemesis",
            "users served/chip"),
+    Metric("served_while_resharding",
+           "users_served_per_chip.while_resharding",
+           "users served/chip while resharding", noise_frac=0.25),
+    Metric("served_while_resharding", "resharding.blackout_ms_max",
+           "worst reshard blackout ms", higher_is_better=False,
+           noise_frac=0.5),
     Metric("conflict_heat", "overhead.overhead_pct", "heat overhead %",
            higher_is_better=False, noise_frac=0.5),
     Metric("compile_memory", "peak_hbm_bytes", "peak compiled-program HBM",

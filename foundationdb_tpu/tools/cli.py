@@ -67,6 +67,22 @@ commands:
   chaos-status [FILE]  nemesis event counts from this process's telemetry
                        hub, or from a campaign report JSON written by
                        `python -m foundationdb_tpu.real.nemesis --json`
+  explain VERSION SRC  commit forensics (docs/observability.md "Black-box
+                       journal & forensics"): reconstruct one batch
+                       version's full causal arc — admission, routing
+                       epoch, queue/dispatch spans, verdicts with the
+                       first-witness write and ITS committing batch,
+                       failover arcs, overlapping incidents and fault
+                       windows — from a black-box journal directory or a
+                       campaign report JSON that recorded one
+  explain --slo REPORT.json   explain the worst retained-ack SLO breach
+                       end-to-end (the report's slo_root_cause version)
+  blackbox SRC         black-box journal summary (events by kind, version
+                       range, epoch flips) for a journal dir / report
+  blackbox replay --window v1..v2 SRC
+                       differential replay: re-resolve the persisted
+                       window through the clean serial oracle and diff
+                       verdicts bit-for-bit (works across epoch flips)
   trace FILE.json      validate + summarize an exported Chrome trace
                        (a campaign's --trace-dir output)
   trace fetch ADDR [ADDR...] [OUT.json]
@@ -75,7 +91,8 @@ commands:
                        Chrome trace JSON (docs/observability.md)
   lint [ARGS...]       run fdbtpu-lint, the static invariant checker:
                        determinism, host-sync discipline, donation safety,
-                       recompile hazards, knob/doc drift, span registry
+                       recompile hazards, knob/doc drift, span registry,
+                       blackbox event registry
                        (docs/static_analysis.md; args pass through, e.g.
                        `lint --json` or `lint --rules knob-drift`)
   help                 this text
@@ -329,6 +346,45 @@ class Cli:
             self._print("bench-history: GATE FAILURES (see above)")
         return rc
 
+    # -- cluster-less report loading (one path for every subcommand that
+    # renders a campaign report JSON: heat, alerts, incidents, shards,
+    # chaos-status, explain, blackbox) --------------------------------------
+    def _report_campaigns(self, path: str):
+        """(doc, [(label, campaign dict)]) for a report file; a missing
+        or corrupt file prints ONE uniform error and returns (None, []).
+        Campaign labels follow the `seed N [mode]` convention
+        everywhere, and a field a given report never recorded (an old
+        report read by a newer CLI — e.g. `blackbox`) renders as the
+        caller's uniform "no X records" line, never a KeyError."""
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            self._print(f"cannot read {path}: {e}")
+            return None, []
+        rows = [(f"seed {rep.get('cfg_seed')} [{rep.get('engine_mode')}]",
+                 rep)
+                for rep in doc.get("campaigns", [])]
+        return doc, rows
+
+    def _render_campaign_field(self, path: str, fld: str, render,
+                               missing_hint: str):
+        """Render `render(label, value)` for every campaign carrying
+        `fld`; one uniform message when none do. Returns the loaded doc
+        (None on a load error)."""
+        doc, rows = self._report_campaigns(path)
+        if doc is None:
+            return None
+        rendered = 0
+        for label, rep in rows:
+            value = rep.get(fld)
+            if value:
+                render(label, value)
+                rendered += 1
+        if not rendered:
+            self._print(f"no {fld} records in {path} ({missing_hint})")
+        return doc
+
     def _render_heat(self, label: str, heat: dict) -> None:
         """One engine's keyspace-heat snapshot (core/heatmap.py layout)."""
         self._print(f"  {label}:")
@@ -378,15 +434,14 @@ class Cli:
         the cluster status doc's qos.resolver_telemetry fragment, or from
         a campaign report / bench JSON artifact."""
         if args and args[0].endswith(".json"):
-            with open(args[0]) as f:
-                doc = json.load(f)
+            doc, rows = self._report_campaigns(args[0])
+            if doc is None:
+                return
             rendered = 0
-            for rep in doc.get("campaigns", []):
+            for label, rep in rows:
                 heat = rep.get("heat")
                 if heat:
-                    self._render_heat(
-                        f"seed {rep.get('cfg_seed')} "
-                        f"[{rep.get('engine_mode')}]", heat)
+                    self._render_heat(label, heat)
                     rendered += 1
             ch = (doc.get("parsed", doc)).get("conflict_heat")
             if ch:
@@ -478,11 +533,8 @@ class Cli:
         """(label, watchdog snapshot-or-campaign dict) rows from a report
         file (cluster-less) or the live cluster status document."""
         if args and args[0].endswith(".json"):
-            with open(args[0]) as f:
-                doc = json.load(f)
-            return [(f"seed {rep.get('cfg_seed')} [{rep.get('engine_mode')}]",
-                     rep)
-                    for rep in doc.get("campaigns", [])], True
+            _doc, rows = self._report_campaigns(args[0])
+            return (rows if _doc is not None else None), True
         doc = self._drive(self.db.get_status())
         if doc is None:
             self._print("status unavailable (no cluster controller reachable)")
@@ -554,10 +606,11 @@ class Cli:
         in-process campaign — or the aggregated counts of a campaign
         report file (real/nemesis.py --json)."""
         if args:
-            with open(args[0]) as f:
-                doc = json.load(f)
+            doc, rows = self._report_campaigns(args[0])
+            if doc is None:
+                return
             totals: dict = {}
-            campaigns = doc.get("campaigns", [])
+            campaigns = [rep for _label, rep in rows]
             for rep in campaigns:
                 for kind, n in (rep.get("chaos_counts") or {}).items():
                     totals[kind] = totals.get(kind, 0) + n
@@ -582,6 +635,152 @@ class Cli:
 
         for line in chaos_status_lines():
             self._print(line)
+
+    # -- commit forensics (docs/observability.md "Black-box journal &
+    # forensics": core/blackbox.py + tools/forensics.py) --------------------
+    def _forensics_rows(self, src: str):
+        """[(label, events)] for a journal dir / report path, with the
+        uniform operator-speakable error on anything unresolvable."""
+        from . import forensics
+
+        try:
+            return forensics.load_source(src)
+        except forensics.ForensicsError as e:
+            self._print(str(e))
+            return None
+
+    def _explain_rows(self, rows, version: int) -> None:
+        from . import forensics
+
+        last_err = "no journal rows"
+        for label, events in rows:
+            try:
+                info = forensics.explain(events, version)
+            except forensics.ForensicsError as e:
+                last_err = str(e)
+                continue
+            if len(rows) > 1:
+                self._print(f"[{label}]")
+            for line in forensics.render_explain(info):
+                self._print(line)
+            return
+        self._print(last_err)
+
+    def do_explain(self, args: List[str]) -> None:
+        """Causal explain of one resolved batch version — admission,
+        routing epoch, span segments, verdict + first witness, failover
+        arc, incident/fault overlap — from a black-box journal dir or a
+        campaign report JSON (`explain --slo REPORT.json` explains the
+        worst retained-ack breach end to end)."""
+        if not args:
+            self._print("usage: explain VERSION DIR_OR_REPORT.json | "
+                        "explain --slo REPORT.json")
+            return
+        if args[0] == "--slo":
+            if len(args) < 2:
+                self._print("usage: explain --slo REPORT.json")
+                return
+            doc, rows = self._report_campaigns(args[1])
+            if doc is None:
+                return
+            best = None
+            for label, rep in rows:
+                rc = rep.get("slo_root_cause") or {}
+                bb = rep.get("blackbox") or {}
+                if rc.get("version") is None or not bb.get("dir"):
+                    continue
+                if best is None or (rc.get("client_ms") or 0) > best[2]:
+                    best = (label, rep, rc.get("client_ms") or 0)
+            if best is None:
+                self._print(
+                    f"no explainable SLO root cause in {args[1]} "
+                    "(campaigns without a blackbox journal, or no "
+                    "retained traces)")
+                return
+            label, rep, _ms = best
+            rc = rep["slo_root_cause"]
+            self._print(
+                f"worst retained ack: {label} trace {rc.get('rid')} "
+                f"v{rc.get('version')} {rc.get('client_ms')} ms "
+                f"dominant={rc.get('dominant_segment')}")
+            frows = self._forensics_rows(rep["blackbox"]["dir"])
+            if frows is not None:
+                self._explain_rows(frows, int(rc["version"]))
+            return
+        if len(args) < 2:
+            self._print("usage: explain VERSION DIR_OR_REPORT.json")
+            return
+        try:
+            version = int(str(args[0]).lstrip("v"))
+        except ValueError:
+            self._print("usage: explain VERSION DIR_OR_REPORT.json "
+                        "(VERSION is a commit version, e.g. v8600)")
+            return
+        rows = self._forensics_rows(args[1])
+        if rows is not None:
+            self._explain_rows(rows, version)
+
+    def do_blackbox(self, args: List[str]) -> None:
+        """Black-box journal workflows: `blackbox SRC` summarizes what a
+        journal holds; `blackbox replay --window v1..v2 SRC` slices the
+        journal, re-resolves the window through the clean serial oracle
+        and diffs verdicts bit-for-bit (differential replay — works on
+        any persisted window, including across a reshard epoch flip)."""
+        from . import forensics
+
+        if not args:
+            self._print("usage: blackbox SRC | "
+                        "blackbox replay --window v1..v2 SRC")
+            return
+        if args[0] == "replay":
+            rest = list(args[1:])
+            spec = None
+            if "--window" in rest:
+                i = rest.index("--window")
+                if i + 1 < len(rest):
+                    spec = rest[i + 1]
+                del rest[i:i + 2]
+            if spec is None or not rest:
+                self._print("usage: blackbox replay --window v1..v2 SRC")
+                return
+            try:
+                v1, v2 = forensics.parse_window(spec)
+            except (forensics.ForensicsError, ValueError) as e:
+                self._print(str(e))
+                return
+            rows = self._forensics_rows(rest[0])
+            if rows is None:
+                return
+            for label, events in rows:
+                try:
+                    r = forensics.diff_replay(events, v1, v2)
+                except forensics.ForensicsError as e:
+                    self._print(f"  {label}: {e}")
+                    continue
+                verdict = ("VERDICT-IDENTICAL" if r["mismatches"] == 0
+                           else f"{r['mismatches']} MISMATCHED BATCHES")
+                self._print(
+                    f"  {label}: replayed {r['window_batches']} batch(es)"
+                    f" in v{v1}..v{v2} (+{r['prefix_batches']} prefix) "
+                    f"through the clean serial oracle — {verdict}; "
+                    f"epochs {r['epochs']}, coverage "
+                    f"{'ok' if r['coverage_ok'] else 'PARTIAL (rotated)'}")
+                if r.get("duplicate_versions"):
+                    self._print(
+                        f"    WARNING: versions {r['duplicate_versions']} "
+                        "recorded more than once in one stream (appended "
+                        "runs in one directory?) — duplicates skipped, "
+                        "not double-applied")
+                for mm in r["mismatch_detail"]:
+                    self._print(f"    v{mm.get('version')}: got "
+                                f"{mm.get('got')} want {mm.get('want')}")
+            return
+        rows = self._forensics_rows(args[0])
+        if rows is None:
+            return
+        for label, events in rows:
+            for line in forensics.summarize(label, events):
+                self._print(line)
 
     def do_lint(self, args: List[str]) -> int:
         """Static invariant check (docs/static_analysis.md): run the
@@ -756,19 +955,9 @@ class Cli:
         report JSON (cluster-less, like `heat`), or the storage shard map
         of the live simulated cluster."""
         if args and args[0].endswith(".json"):
-            with open(args[0]) as f:
-                doc = json.load(f)
-            rendered = 0
-            for rep in doc.get("campaigns", []):
-                rs = rep.get("reshard")
-                if rs:
-                    self._render_reshard(
-                        f"seed {rep.get('cfg_seed')} "
-                        f"[{rep.get('engine_mode')}]", rs)
-                    rendered += 1
-            if not rendered:
-                self._print(f"no reshard records in {args[0]} (campaigns "
-                            "run without --drift / reshard=True?)")
+            self._render_campaign_field(
+                args[0], "reshard", self._render_reshard,
+                "campaigns run without --drift / reshard=True?")
             return
         from ..server import system_keys
 
@@ -922,6 +1111,16 @@ def main(argv=None) -> int:
         cli = Cli.__new__(Cli)
         cli.out = sys.stdout
         return cli.do_bench_history(raw[1:])
+    if raw and raw[0].replace("-", "_") in ("explain", "blackbox"):
+        # pre-argparse pass-through: forensics owns its own flags
+        # (--slo, --window) and reads journals/reports, never a cluster
+        cli = Cli.__new__(Cli)
+        cli.out = sys.stdout
+        if raw[0].replace("-", "_") == "explain":
+            cli.do_explain(raw[1:])
+        else:
+            cli.do_blackbox(raw[1:])
+        return 0
 
     ap = argparse.ArgumentParser(description="cli over a simulated cluster")
     ap.add_argument("--seed", type=int, default=0)

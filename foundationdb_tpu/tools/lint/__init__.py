@@ -1,21 +1,24 @@
 """fdbtpu-lint: AST-based invariant checker (docs/static_analysis.md).
 
-Six checkers over a shared file-cache/policy core, each front-running a
-dynamic assertion the campaigns otherwise only catch one seed at a time:
+Seven checkers over a shared file-cache/policy core, each front-running
+a dynamic assertion the campaigns otherwise only catch one seed at a
+time:
 
-===============  ========================================================
-rule             front-runs
-===============  ========================================================
-determinism      seed-replay parity (bit-identical journal replay)
-host-sync        blocking_syncs == 0 + pack/dispatch overlap
-donation         drain-before-host-touch on the donated interval table
-recompile        zero steady-state compiles (EnginePerf.compiles pin)
-knob-drift       --knob override surface + documented capacity model
-span-registry    telescoping latency sum identity (max_sum_err SLO)
-===============  ========================================================
+=================  ======================================================
+rule               front-runs
+=================  ======================================================
+determinism        seed-replay parity (bit-identical journal replay)
+host-sync          blocking_syncs == 0 + pack/dispatch overlap
+donation           drain-before-host-touch on the donated interval table
+recompile          zero steady-state compiles (EnginePerf.compiles pin)
+knob-drift         --knob override surface + documented capacity model
+span-registry      telescoping latency sum identity (max_sum_err SLO)
+blackbox-registry  closed black-box journal schema (strict_parse gate)
+=================  ======================================================
 
     python -m foundationdb_tpu.tools.lint [--json] [--rules a,b] [paths]
 """
+from .blackbox_registry import BlackboxRegistryChecker
 from .core import (DEFAULT_POLICY, Checker, FileCtx, Finding, LintResult,
                    RulePolicy, load_baseline, main, run_lint, write_baseline)
 from .determinism import DeterminismChecker
@@ -34,6 +37,7 @@ CHECKERS = (
     RecompileChecker(),
     KnobDriftChecker(),
     SpanRegistryChecker(),
+    BlackboxRegistryChecker(),
 )
 
 __all__ = [

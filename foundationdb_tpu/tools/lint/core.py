@@ -340,6 +340,18 @@ DEFAULT_POLICY: Dict[str, RulePolicy] = {
             ),
             "span_calls": ("span", "span_event", "Span", "subspan"),
         }),
+    "blackbox-registry": RulePolicy(
+        packages=("foundationdb_tpu",),
+        options={
+            "registry_file": "foundationdb_tpu/core/blackbox.py",
+            "registry_name": "BLACKBOX_EVENT_REGISTRY",
+            # the producer entry point anywhere; the journal's own
+            # `record` method only inside the registry file (the name is
+            # too generic to police tree-wide — FlightRecorder.record,
+            # TDMetric recorders)
+            "record_calls": ("record_event",),
+            "local_record_calls": ("record",),
+        }),
 }
 
 

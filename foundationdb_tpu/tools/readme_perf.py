@@ -274,6 +274,32 @@ def render(artifacts: List[Tuple[str, dict]]) -> str:
                       "users_served_per_chip.no_nemesis") + s.tag(i),
         ]
 
+    def _swr_ok(m):
+        swr = m.get("served_while_resharding") or {}
+        return (swr.get("static") and swr.get("resharding")
+                and swr.get("users_served_per_chip"))
+
+    i = s.newest(_swr_ok)
+    if i is not None:
+        swr = artifacts[i][1]["served_while_resharding"]
+        users = swr["users_served_per_chip"]
+        rs = swr["resharding"]
+        lines += [
+            "- **served while resharding** (`docs/elasticity.md`): the "
+            "same wall-clock serving point through the elastic resolver "
+            "group under a drifting hot spot — "
+            f"**{users.get('while_resharding', 0)} users/chip** with "
+            f"{rs.get('reshards_executed', 0)} live reshard(s) executed "
+            f"(worst blackout {rs.get('blackout_ms_max', 0):.1f} ms, "
+            f"{rs.get('final_shards')} shards at end, journal parity "
+            f"{rs.get('parity_checked', 0)}/"
+            f"{rs.get('parity_mismatches', 0)}mm) vs "
+            f"{users.get('static', 0)} static, p99 inside the "
+            f"{swr['budget_ms']:.0f} ms elastic budget"
+            + s.arrow(i, "served_while_resharding",
+                      "users_served_per_chip.while_resharding") + s.tag(i),
+        ]
+
     def _heat_ok(m):
         ch = m.get("conflict_heat") or {}
         return (any("concentration" in r for r in ch.get("sweep") or [])

@@ -205,12 +205,26 @@ def _tid_of(trace_id: Any) -> int:
     return zlib.crc32(str(trace_id).encode()) % 997 + 1
 
 
+def _window_track(kind: str) -> str:
+    """Which timeline pid a kinded window renders on: nemesis faults,
+    reshard handoff arcs and watchdog incidents each get their OWN track,
+    so one trace shows faults, incidents and reshards together."""
+    if kind.startswith("reshard"):
+        return "reshard"
+    if kind in ("incident", "alert"):
+        return "watchdog"
+    return "nemesis"
+
+
 def chrome_trace(spans: Sequence[Dict[str, Any]],
                  windows: Sequence[Dict[str, Any]] = ()) -> Dict[str, Any]:
-    """Render spans + injected-fault windows as a Chrome trace-event JSON
+    """Render spans + kinded windows as a Chrome trace-event JSON
     document (the `traceEvents` array format chrome://tracing/Perfetto
-    load). One pid per recording process ("Proc"); nemesis windows land on
-    their own `nemesis` pid so faults and commits share a timeline."""
+    load). One pid per recording process ("Proc"); windows land on their
+    own per-family pids — injected faults on `nemesis`, reshard
+    warm/blackout/arc windows on `reshard`, watchdog incident envelopes
+    on `watchdog` — so faults, incidents and reshards share one
+    timeline with the commits they disturbed."""
     events: List[Dict[str, Any]] = []
     pid_of: Dict[str, int] = {}
 
@@ -244,11 +258,12 @@ def chrome_trace(spans: Sequence[Dict[str, Any]],
             "args": args,
         })
     for w in windows:
+        kind = w.get("kind", "fault")
         events.append({
-            "name": w.get("kind", "fault"), "cat": "chaos", "ph": "X",
+            "name": kind, "cat": "chaos", "ph": "X",
             "ts": round((w["t0"] - base) * 1e6, 1),
             "dur": round(max(w.get("t1", w["t0"]) - w["t0"], 0.0) * 1e6, 1),
-            "pid": pid("nemesis"), "tid": 1,
+            "pid": pid(_window_track(kind)), "tid": 1,
             "args": {k: v for k, v in w.items()
                      if k not in ("kind", "t0", "t1")},
         })

@@ -1,0 +1,449 @@
+"""Black-box journal (ISSUE 15; core/blackbox.py, docs/observability.md
+"Black-box journal & forensics").
+
+Covers: the closed event registry (wire round-trip per record type);
+same-seed deterministic runs producing BYTE-IDENTICAL journals and
+identical `explain` output (the sim virtual clock is the journal clock);
+crash-tolerant partial-tail segment reads (truncated and torn frames);
+segment rotation + retention; the disabled-path zero-allocation guard on
+the hot dispatch path; differential replay of a persisted window
+spanning a reshard epoch flip (verdict-bit-identical to the clean serial
+oracle); and journal-on/journal-off abort-set bit-parity with zero
+post-warmup compiles on a real jax engine.
+"""
+import dataclasses
+import os
+
+import pytest
+
+from foundationdb_tpu.core import blackbox, buggify, telemetry, wire
+from foundationdb_tpu.core.trace import g_trace
+from foundationdb_tpu.core.types import (
+    CommitTransaction,
+    KeyRange,
+)
+from foundationdb_tpu.fault.inject import FaultInjectingEngine, FaultRates
+from foundationdb_tpu.fault.resilient import ResilienceConfig, ResilientEngine
+from foundationdb_tpu.ops.oracle import OracleConflictEngine
+from foundationdb_tpu.server.reshard import (
+    ElasticResolverGroup,
+    ReshardController,
+)
+from foundationdb_tpu.sim.loop import set_scheduler
+from foundationdb_tpu.sim.simulator import Simulator
+from foundationdb_tpu.tools import forensics
+
+CFG = ResilienceConfig(dispatch_timeout=0.5, retry_budget=2,
+                       retry_backoff=0.02, probe_rate=0.0,
+                       probation_batches=2, failover_min_batches=2)
+
+
+@pytest.fixture
+def sim():
+    s = Simulator(29)
+    buggify.disable()
+    g_trace.clear()
+    telemetry.reset()
+    blackbox.uninstall()
+    yield s
+    blackbox.uninstall()
+    buggify.disable()
+    set_scheduler(None)
+    telemetry.reset()
+
+
+def oracle_factory():
+    inner = OracleConflictEngine()
+    injector = FaultInjectingEngine(
+        inner, rates=FaultRates(exception=0, hang=0, slow=0, flip=0,
+                                outage=0))
+    return inner, injector, ResilientEngine(injector, CFG,
+                                            record_journal=True)
+
+
+def drive(sim, coro):
+    return sim.sched.run_until(sim.sched.spawn(coro), until=100000)
+
+
+def _hot_batches(n, pool, hot_lo, hot_hi, seed, start_v=0, frac=0.85):
+    """Deterministic point-write batches concentrated on [hot_lo, hot_hi)
+    of a `k/NNN` pool (the test_reshard load shape)."""
+    import random
+
+    rng = random.Random(seed)
+    v = start_v
+    out = []
+    for _ in range(n):
+        v += rng.randrange(40, 120)
+        txns = []
+        for _ in range(rng.randrange(2, 6)):
+            t = CommitTransaction(
+                read_snapshot=max(0, v - rng.randrange(1, 400)))
+            a = (rng.randrange(hot_lo, hot_hi) if rng.random() < frac
+                 else rng.randrange(pool))
+            k = b"k/%03d" % a
+            t.read_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            t.write_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            txns.append(t)
+        out.append((txns, v, max(0, v - 2000)))
+    return out
+
+
+def _journal_bytes(directory) -> bytes:
+    out = b""
+    for p in blackbox._segment_paths(str(directory)):
+        with open(p, "rb") as f:
+            out += f.read()
+    return out
+
+
+# -- the registry --------------------------------------------------------------
+
+def test_registry_records_wire_round_trip():
+    """Every registered event kind's record type encodes/decodes through
+    core/wire.py — the journal format is exactly these schemas."""
+    assert set(blackbox.BLACKBOX_EVENT_REGISTRY) == {
+        "batch", "span", "health", "flight", "alert", "incident",
+        "reshard", "admission", "heat", "fault_window"}
+    for kind, cls in blackbox.BLACKBOX_EVENT_REGISTRY.items():
+        rec = cls()
+        env = blackbox.BBEnvelope(seq=3, t=1.5, kind=kind, payload=rec)
+        back = wire.loads(wire.dumps(env))
+        assert back.kind == kind
+        assert type(back.payload) is cls
+        assert dataclasses.asdict(back.payload) == dataclasses.asdict(rec)
+    # batch payloads carry whole transactions (the differential-replay
+    # unit): round-trip one with ranges
+    txn = CommitTransaction(
+        read_snapshot=7,
+        read_conflict_ranges=[KeyRange(b"a", b"a\x00")],
+        write_conflict_ranges=[KeyRange(b"b", b"c")])
+    b = blackbox.BBBatch(version=100, new_oldest=5, txns=(txn,),
+                         verdicts=(0,))
+    back = wire.loads(wire.dumps(b))
+    assert back.txns[0].read_snapshot == 7
+    assert back.txns[0].write_conflict_ranges[0].end == b"c"
+
+
+# -- determinism ---------------------------------------------------------------
+
+def _run_reshard_campaign(tmpdir, seed: int):
+    """One deterministic elastic run with the journal on: hot load ->
+    split handoff -> post-flip load. Returns (journal bytes, explain
+    lines for the last batch, diff_replay over the flip window)."""
+    sim_ = Simulator(seed)
+    buggify.disable()
+    g_trace.clear()
+    telemetry.reset()
+    try:
+        j = blackbox.BlackboxJournal(str(tmpdir), segment_bytes=1 << 22)
+        blackbox.install(j)
+        group = ElasticResolverGroup(oracle_factory)
+        group.prewarm_spares(1)
+        ctl = ReshardController(group, min_heat_batches=5)
+        ctl._last_done = -100.0
+        phase1 = _hot_batches(25, 96, 60, 92, seed=41)
+        v0 = phase1[-1][1]
+
+        async def go():
+            for txns, v, old in phase1:
+                await group.resolve(txns, v, old)
+            plan = ctl.plan()
+            assert plan is not None and plan["kind"] == "split", plan
+            op = await ctl.execute(plan)
+            assert op is not None and op.state == "done", op
+            for txns, v, old in _hot_batches(15, 96, 0, 96, seed=42,
+                                             start_v=v0, frac=0.0):
+                await group.resolve(txns, v, old)
+
+        drive(sim_, go())
+        blackbox.uninstall()
+        events = blackbox.read_journal(str(tmpdir))
+        ix = forensics.JournalIndex(events)
+        last_v = ix.batches[-1].payload.version
+        lines = forensics.render_explain(forensics.explain(events, last_v))
+        flip_v = next(e.payload.flip_version
+                      for e in ix.by_kind["reshard"]
+                      if e.payload.phase == "flip")
+        lo = ix.batches[0].payload.version
+        replay = forensics.diff_replay(events, lo, last_v)
+        return _journal_bytes(tmpdir), lines, replay, flip_v, lo, last_v
+    finally:
+        blackbox.uninstall()
+        buggify.disable()
+        set_scheduler(None)
+        telemetry.reset()
+
+
+def test_same_seed_journals_byte_identical_and_explain_deterministic(
+        tmp_path):
+    """The determinism contract: same seed, same virtual clock -> the
+    on-disk journal is BYTE-identical and the rendered explain output is
+    equal, run to run."""
+    b1, lines1, replay1, _fv, _lo, _hi = _run_reshard_campaign(
+        tmp_path / "a", seed=29)
+    b2, lines2, replay2, _fv2, _lo2, _hi2 = _run_reshard_campaign(
+        tmp_path / "b", seed=29)
+    assert b1 == b2
+    assert len(b1) > 1000
+    assert lines1 == lines2
+    assert replay1 == replay2
+
+
+def test_differential_replay_across_epoch_flip(tmp_path):
+    """`cli blackbox replay` semantics: a window STRADDLING the reshard
+    epoch flip replays verdict-bit-identical through one clean serial
+    oracle (the retained prefix rebuilds its state first)."""
+    _b, _lines, replay, flip_v, lo, hi = _run_reshard_campaign(
+        tmp_path / "j", seed=31)
+    assert replay["mismatches"] == 0
+    assert replay["coverage_ok"] and replay["complete_journal"]
+    # now a strict sub-window that spans the flip
+    events = blackbox.read_journal(str(tmp_path / "j"))
+    ix = forensics.JournalIndex(events)
+    pre = [b for b in ix.batches if b.payload.version < flip_v]
+    post = [b for b in ix.batches if b.payload.version >= flip_v]
+    assert pre and post, (flip_v, lo, hi)
+    r = forensics.diff_replay(events, pre[-3].payload.version,
+                              post[min(3, len(post) - 1)].payload.version)
+    assert r["mismatches"] == 0, r
+    assert len(r["epochs"]) >= 2, r
+    assert r["prefix_batches"] > 0
+
+
+# -- segment mechanics ---------------------------------------------------------
+
+def test_partial_tail_segment_recovery(tmp_path):
+    """A crash mid-append leaves a truncated or torn tail frame; the
+    reader returns every complete prefix record and stops — never
+    raises, never returns garbage."""
+    d = tmp_path / "pt"
+    j = blackbox.BlackboxJournal(str(d), now_fn=lambda: 1.0)
+    blackbox.install(j)
+    for i in range(10):
+        blackbox.record_health(f"r.{i}", "healthy", "suspect")
+    blackbox.uninstall()
+    (path,) = blackbox._segment_paths(str(d))
+    whole = open(path, "rb").read()
+    assert len(blackbox.read_segment(path)) == 10
+    # truncated tail: chop the last frame mid-payload
+    with open(path, "wb") as f:
+        f.write(whole[:-7])
+    evs = blackbox.read_segment(path)
+    assert len(evs) == 9
+    assert [e.seq for e in evs] == list(range(9))
+    # torn tail: restore, then flip a byte inside the last payload (crc
+    # catches it)
+    with open(path, "wb") as f:
+        f.write(whole[:-3] + bytes([whole[-3] ^ 0xFF]) + whole[-2:])
+    evs = blackbox.read_segment(path)
+    assert len(evs) == 9
+    # a journal reopened on the damaged directory continues appending
+    # past the retained records
+    j2 = blackbox.BlackboxJournal(str(d), now_fn=lambda: 2.0)
+    j2.record("health", blackbox.BBHealth(label="r.x", prev="a",
+                                          state="b"))
+    j2.close()
+    evs = blackbox.read_journal(str(d))
+    assert evs[-1].payload.label == "r.x"
+    assert evs[-1].seq == 9
+
+
+def test_fresh_journal_truncates_previous_run(tmp_path):
+    """Campaign semantics: re-running into the same deterministic
+    directory must not append a second stream with colliding commit
+    versions — fresh=True truncates the retained segments first, while
+    the default reopen continues (a restarted long-lived resolver)."""
+    d = tmp_path / "reuse"
+    j1 = blackbox.BlackboxJournal(str(d), now_fn=lambda: 1.0)
+    j1.record("health", blackbox.BBHealth(label="run1", prev="a",
+                                          state="b"))
+    j1.close()
+    # default reopen: continues the stream
+    j2 = blackbox.BlackboxJournal(str(d), now_fn=lambda: 2.0)
+    j2.record("health", blackbox.BBHealth(label="run2", prev="a",
+                                          state="b"))
+    j2.close()
+    assert [e.payload.label for e in blackbox.read_journal(str(d))] == \
+        ["run1", "run2"]
+    # fresh: the previous stream is gone, seq restarts at 0
+    j3 = blackbox.BlackboxJournal(str(d), now_fn=lambda: 3.0, fresh=True)
+    j3.record("health", blackbox.BBHealth(label="run3", prev="a",
+                                          state="b"))
+    j3.close()
+    evs = blackbox.read_journal(str(d))
+    assert [e.payload.label for e in evs] == ["run3"]
+    assert evs[0].seq == 0
+
+
+def test_segment_rotation_and_retention(tmp_path):
+    d = tmp_path / "rot"
+    j = blackbox.BlackboxJournal(str(d), segment_bytes=600,
+                                 max_segments=3, now_fn=lambda: 0.0)
+    blackbox.install(j)
+    for i in range(60):
+        blackbox.record_health(f"resilient.{i:03d}", "healthy", "failed")
+    blackbox.uninstall()
+    paths = blackbox._segment_paths(str(d))
+    assert len(paths) <= 3
+    evs = blackbox.read_journal(str(d))
+    assert evs, "rotation must retain the newest segments"
+    # the newest record always survives; the oldest rotated away
+    assert evs[-1].payload.label == "resilient.059"
+    assert evs[0].seq > 0
+    # seq numbers stay contiguous across the retained segments
+    seqs = [e.seq for e in evs]
+    assert seqs == list(range(seqs[0], seqs[0] + len(seqs)))
+
+
+def test_failed_write_rotates_to_fresh_segment(tmp_path):
+    """A failed append may leave a torn frame mid-segment, and readers
+    stop at the first torn frame — the journal must rotate so LATER
+    records stay readable instead of appending after the garbage."""
+    d = tmp_path / "torn"
+    j = blackbox.BlackboxJournal(str(d), now_fn=lambda: 1.0)
+    j.record("health", blackbox.BBHealth(label="before", prev="a",
+                                         state="b"))
+    j._file.close()   # force the next write to fail (closed handle)
+    j.record("health", blackbox.BBHealth(label="lost", prev="a",
+                                         state="b"))
+    assert j.dropped_errors == 1
+    j.record("health", blackbox.BBHealth(label="after", prev="a",
+                                         state="b"))
+    j.close()
+    assert len(blackbox._segment_paths(str(d))) == 2
+    labels = [e.payload.label for e in blackbox.read_journal(str(d))]
+    assert labels == ["before", "after"]
+
+
+def test_knob_path_gets_per_campaign_subdirectory(tmp_path):
+    """A multi-campaign run with resolver_blackbox=on must not share one
+    directory across campaigns (each opens fresh and would wipe the
+    previous campaign's journal while its report still points there)."""
+    from foundationdb_tpu.core.knobs import SERVER_KNOBS
+    from foundationdb_tpu.real.nemesis import (NemesisConfig,
+                                               _campaign_blackbox)
+
+    base = str(tmp_path / "knobdir")
+    SERVER_KNOBS.set_knob("resolver_blackbox", base)
+    try:
+        j1 = _campaign_blackbox(NemesisConfig(seed=1, engine_mode="jax"))
+        j1.record("health", blackbox.BBHealth(label="c1", prev="a",
+                                              state="b"))
+        j1.close()
+        j2 = _campaign_blackbox(
+            NemesisConfig(seed=2, engine_mode="device_loop"))
+        j2.close()
+        assert j1.directory != j2.directory
+        assert j1.directory.startswith(base)
+        assert "jax_s1" in j1.directory
+        assert "device_loop_s2" in j2.directory
+        # campaign 2 opening its own subdir left campaign 1's journal
+        assert len(blackbox.read_journal(j1.directory)) == 1
+        # explicit dir still used verbatim; "" forces off
+        j3 = _campaign_blackbox(NemesisConfig(
+            seed=3, engine_mode="oracle",
+            blackbox_dir=str(tmp_path / "explicit")))
+        assert j3.directory == str(tmp_path / "explicit")
+        j3.close()
+        assert _campaign_blackbox(NemesisConfig(
+            seed=4, engine_mode="oracle", blackbox_dir="")) is None
+    finally:
+        SERVER_KNOBS.set_knob("resolver_blackbox", "")
+
+
+def test_correlate_journals_each_incident_once(tmp_path):
+    """correlate() may legitimately run more than once; the append-only
+    journal must record each incident exactly once."""
+    from foundationdb_tpu.core.watchdog import Incident, Watchdog
+
+    wd = Watchdog(rules=[], now_fn=lambda: 5.0)
+    inc = Incident(1, 1.0)
+    inc.t1 = 2.0
+    wd.incidents.append(inc)
+    j = blackbox.BlackboxJournal(str(tmp_path / "inc"),
+                                 now_fn=lambda: 5.0)
+    blackbox.install(j)
+    try:
+        wd.correlate([])
+        wd.correlate([], breached_slo="p99_budget")
+    finally:
+        blackbox.uninstall()
+    evs = blackbox.read_journal(str(tmp_path / "inc"))
+    assert [e.kind for e in evs] == ["incident"]
+
+
+# -- disabled path -------------------------------------------------------------
+
+def test_disabled_path_zero_allocation_on_hot_dispatch(sim):
+    """resolver_blackbox off (no journal installed): the hot dispatch
+    path — supervised resolves plus every producer helper — must not
+    bump the allocation counter."""
+    assert not blackbox.enabled()
+    _inner, _inj, eng = oracle_factory()
+    batches = _hot_batches(20, 64, 0, 64, seed=7)
+
+    async def go():
+        for txns, v, old in batches:
+            await eng.resolve(txns, v, old)
+
+    before = blackbox.blackbox_allocations[0]
+    drive(sim, go())
+    # every producer surface, called disabled
+    blackbox.record_batch([], 1, 0, [])
+    blackbox.record_span({"Name": "x", "Trace": 1, "Begin": 0, "End": 1})
+    blackbox.record_health("l", "a", "b")
+    blackbox.record_flight("failover", 1, [])
+    blackbox.record_alert("a", "s", "firing", 1.0, "d")
+    blackbox.record_incident({"id": 1})
+    blackbox.record_admission("adm", 1, 2)
+    blackbox.record_heat({"conflicts": 0})
+    blackbox.record_window({"kind": "partition", "t0": 0.0, "t1": 1.0})
+    assert blackbox.blackbox_allocations[0] == before
+
+
+# -- journal-on observational parity (real engine) ----------------------------
+
+def test_blackbox_on_abort_sets_bit_identical_jax(sim, tmp_path):
+    """Recording happens ABOVE the engine, so verdicts are structurally
+    untouched — pinned anyway: the same stream through a real jax engine
+    with the journal on and off yields bit-identical abort sets, with
+    zero post-warmup compiles either way."""
+    jax = pytest.importorskip("jax")
+    from foundationdb_tpu.ops.conflict_kernel import KernelConfig
+    from foundationdb_tpu.ops.host_engine import JaxConflictEngine
+
+    cfg = KernelConfig(key_words=4, capacity=512, max_reads=64,
+                       max_writes=64, max_txns=32)
+    stream = _hot_batches(10, 48, 0, 48, seed=13)
+
+    def run(journal_dir):
+        eng = JaxConflictEngine(cfg)
+        eng.warmup()
+        j = None
+        if journal_dir is not None:
+            j = blackbox.BlackboxJournal(str(journal_dir))
+            blackbox.install(j)
+        try:
+            out = []
+            for txns, v, old in stream:
+                verdicts = [int(x) for x in eng.resolve(txns, v, old)]
+                out.append(verdicts)
+                if j is not None:
+                    blackbox.record_batch(txns, v, old, verdicts,
+                                          engine="jax")
+            return out, eng.perf.compiles
+        finally:
+            if j is not None:
+                blackbox.uninstall()
+
+    warm_off, compiles_off = run(None)
+    warm_on, compiles_on = run(tmp_path / "bbj")
+    assert warm_on == warm_off
+    assert compiles_on == compiles_off
+    # the recorded journal replays bit-identical through the oracle too
+    events = blackbox.read_journal(str(tmp_path / "bbj"))
+    ix = forensics.JournalIndex(events)
+    r = forensics.diff_replay(events, ix.batches[0].payload.version,
+                              ix.batches[-1].payload.version)
+    assert r["mismatches"] == 0, r

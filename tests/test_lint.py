@@ -328,6 +328,49 @@ def test_span_registry_fires_on_unregistered_segment(tmp_path):
     assert any("resolver.other_phase" in f.message for f in spans)
 
 
+BLACKBOX_FIXTURE = (
+    "BLACKBOX_EVENT_REGISTRY = {\n"
+    "    'batch': BBBatch,\n"
+    "    'health': BBHealth,\n"
+    "}\n"
+    "def helper(j):\n"
+    "    j.record('batch', None)\n"       # local `record`: policed here
+    "    j.record('mystery', None)\n"     # ... and this one fires
+)
+
+
+def test_blackbox_registry_fires_on_unregistered_kind(tmp_path):
+    _write(tmp_path, "foundationdb_tpu/core/blackbox.py",
+           BLACKBOX_FIXTURE)
+    _write(tmp_path, "foundationdb_tpu/server/bad.py", (
+        "def f(record_event, ok):\n"
+        "    record_event('batch', None)\n"
+        "    record_event('unregistered_kind', None)\n"
+        "    record_event('health' if ok else 'other_kind', None)\n"
+        "    obj.record('not_policed_here', None)\n"  # generic .record
+    ))
+    res = _lint(tmp_path)
+    bb = [f for f in res.new if f.rule == "blackbox-registry"]
+    msgs = [f.message for f in bb]
+    assert any("unregistered_kind" in m for m in msgs), res.new
+    assert any("other_kind" in m for m in msgs), msgs
+    assert any("mystery" in m for m in msgs), msgs
+    assert not any("not_policed_here" in m for m in msgs), msgs
+    assert len(bb) == 3, msgs
+
+
+def test_blackbox_registry_quiet_on_registered_kinds(tmp_path):
+    _write(tmp_path, "foundationdb_tpu/core/blackbox.py",
+           BLACKBOX_FIXTURE.replace("    j.record('mystery', None)\n", ""))
+    _write(tmp_path, "foundationdb_tpu/real/good.py", (
+        "def f(record_event):\n"
+        "    record_event('batch', None)\n"
+        "    record_event('health', None)\n"
+    ))
+    res = _lint(tmp_path)
+    assert [f for f in res.new if f.rule == "blackbox-registry"] == []
+
+
 def test_span_registry_quiet_on_registered_segments(tmp_path):
     _write(tmp_path, "foundationdb_tpu/pipeline/latency_harness.py",
            SEGMENTS_FIXTURE)
@@ -560,7 +603,7 @@ def test_every_rule_has_a_checker_and_docs_row():
     names the dynamic assertion it front-runs, and docs/static_analysis.md
     documents every rule by name."""
     doc = (REPO / "docs" / "static_analysis.md").read_text()
-    assert len(CHECKERS) == 6
+    assert len(CHECKERS) == 7
     for ch in CHECKERS:
         assert ch.rule and ch.fronts, ch
         assert f"#{ch.rule}" in doc or f"`{ch.rule}`" in doc, ch.rule

@@ -144,6 +144,35 @@ def test_chrome_trace_export_and_schema():
                                                    "ts": 0, "dur": 0}]})
 
 
+def test_chrome_trace_window_family_tracks():
+    """Kinded windows render on per-family tracks — nemesis faults,
+    reshard handoff arcs and watchdog incidents each on their own pid —
+    so one timeline shows faults, incidents and reshards together
+    (ISSUE 15 satellite)."""
+    windows = [
+        {"kind": "partition", "t0": 0.000, "t1": 0.004},
+        {"kind": "reshard", "t0": 0.001, "t1": 0.002},
+        {"kind": "reshard_arc", "t0": 0.001, "t1": 0.003},
+        {"kind": "reshard_warm", "t0": 0.000, "t1": 0.001},
+        {"kind": "incident", "t0": 0.001, "t1": 0.004, "summary": "s"},
+        {"kind": "warmup", "t0": 0.000, "t1": 0.001},
+    ]
+    doc = tx.chrome_trace([], windows)
+    tx.validate_chrome_trace(doc)
+    pid_names = {ev["pid"]: ev["args"]["name"]
+                 for ev in doc["traceEvents"] if ev.get("ph") == "M"}
+    track_of = {ev["name"]: pid_names[ev["pid"]]
+                for ev in doc["traceEvents"] if ev.get("cat") == "chaos"}
+    assert track_of["partition"] == "nemesis"
+    assert track_of["warmup"] == "nemesis"
+    assert track_of["reshard"] == "reshard"
+    assert track_of["reshard_arc"] == "reshard"
+    assert track_of["reshard_warm"] == "reshard"
+    assert track_of["incident"] == "watchdog"
+    # all three families share the one timeline
+    assert {"nemesis", "reshard", "watchdog"} <= set(pid_names.values())
+
+
 # -- the Prometheus exposition format (ISSUE 9 satellite) ---------------------
 
 def test_prometheus_exposition_help_type_and_escaping():
