@@ -37,6 +37,15 @@ chaos:
 heat-smoke:
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.heat_smoke
 
+# Conflict-scheduler smoke (docs/scheduling.md, seconds, solo CPU): a
+# planted hot-key A/B must serve a materially lower abort fraction with
+# the scheduler on at an equal-or-better commit count, the scheduled
+# dispatch journal must replay bit-for-bit through a clean serial
+# oracle, the fdbtpu_sched exposition must pass the strict parser, and
+# the disabled path must be an inert FIFO with no telemetry series.
+sched-smoke:
+	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.sched_smoke
+
 # Distributed-tracing smoke (docs/observability.md "Distributed
 # tracing", seconds): boots a 2-OS-process cluster (a --serve traced
 # commit server child), drives a traced fleet, asserts >= 1
@@ -142,4 +151,4 @@ chaos-real:
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.cli \
 		explain --slo chaos_real_report.json
 
-.PHONY: check bench bench-smoke telemetry-smoke heat-smoke trace-smoke chaos chaos-real chaos-drift reshard-smoke lint perf-smoke bench-history watch-smoke forensics-smoke
+.PHONY: check bench bench-smoke telemetry-smoke heat-smoke sched-smoke trace-smoke chaos chaos-real chaos-drift reshard-smoke lint perf-smoke bench-history watch-smoke forensics-smoke

@@ -303,6 +303,7 @@ def main(argv=None):
     chaos_served = served_under_chaos_section()
     while_resharding = served_while_resharding_section()
     heat = conflict_heat_section()
+    sched = conflict_scheduling_section()
 
     print(json.dumps({
         "metric": "resolved_txns_per_sec_per_chip",
@@ -332,6 +333,7 @@ def main(argv=None):
         "served_under_chaos": chaos_served,
         "served_while_resharding": while_resharding,
         "conflict_heat": heat,
+        "conflict_scheduling": sched,
         "compile_memory": compile_memory,
         "profile": PROFILE,
         "device": str(dev),
@@ -867,6 +869,25 @@ def served_while_resharding_section():
         from foundationdb_tpu.real.nemesis import run_served_while_resharding
 
         return run_served_while_resharding()
+    except Exception as e:  # noqa: BLE001 — a socketless/odd environment
+        #                     must not kill the chip bench
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def conflict_scheduling_section():
+    """The conflict-aware admission A/B (docs/scheduling.md): the same
+    contended Zipf-1.2 wall-clock serving point — same seed, same fleet,
+    oracle engines, no injected chaos — with the scheduler OFF and ON.
+    Reports both rows (abort_frac, served txn/s, p99, parity mismatches)
+    plus abort_frac_reduction, served_tps_ratio and goal_met (reduction
+    >= 50% at equal-or-better served txn/s with bit-for-bit dispatch
+    parity through the clean oracle in both arms). Wall-clock + CPU like
+    its chaos siblings; `make sched-smoke` drives the same mechanisms at
+    toy sizes in seconds."""
+    try:
+        from foundationdb_tpu.real.nemesis import run_conflict_scheduling
+
+        return run_conflict_scheduling()
     except Exception as e:  # noqa: BLE001 — a socketless/odd environment
         #                     must not kill the chip bench
         return {"error": f"{type(e).__name__}: {e}"}

@@ -203,6 +203,29 @@ class BBHeat:
 
 
 @dataclass
+class BBSched:
+    """One scheduling tick's decisions against the batch version it
+    produced (pipeline/scheduler.py SchedPlan): how many transactions
+    were dispatched / deferred / laned / pre-aborted / probed this tick,
+    and WHICH ranges convicted the pre-aborts and hosted the lanes — the
+    `why` behind a deferred or refused transaction that `cli explain`
+    renders for the version."""
+
+    version: int = 0
+    dispatched: int = 0
+    deferred: int = 0
+    laned: int = 0
+    preaborted: int = 0
+    probes: int = 0
+    forced: int = 0
+    lanes: int = 0
+    pending: int = 0
+    epoch: int = -1
+    preabort_ranges: Tuple = ()
+    lane_ranges: Tuple = ()
+
+
+@dataclass
 class BBWindow:
     """An injected fault / maintenance window (the nemesis' kinded
     records — partition, device_incident, reshard, warmup, ...)."""
@@ -229,6 +252,7 @@ BLACKBOX_EVENT_REGISTRY = {
     "admission": BBAdmission,
     "heat": BBHeat,
     "fault_window": BBWindow,
+    "sched": BBSched,
 }
 
 for _cls in (BBEnvelope, *BLACKBOX_EVENT_REGISTRY.values()):
@@ -625,6 +649,30 @@ def record_heat(brief: Dict[str, Any]) -> None:
         concentration=float(brief.get("concentration", 0.0)),
         top_range=brief.get("top_range"),
         top_share=float(brief.get("top_share", 0.0))))
+
+
+def record_sched(plan, version, lanes: int, pending: int,
+                 epoch: int = -1) -> None:
+    """One scheduling tick's decisions (pipeline/scheduler.py SchedPlan)
+    stamped with the batch version the tick produced — recorded only for
+    ticks that DECIDED something, so an idle scheduler writes nothing."""
+    j = _g[0]
+    if j is None:
+        return
+    d = plan.decided
+    j.record(
+        "sched",
+        BBSched(version=int(version),
+                dispatched=int(d.get("dispatch", 0)),
+                deferred=int(d.get("defer", 0)),
+                laned=int(d.get("lane", 0)),
+                preaborted=int(d.get("preabort", 0)),
+                probes=int(d.get("probe", 0)),
+                forced=int(d.get("forced", 0)),
+                lanes=int(lanes), pending=int(pending), epoch=int(epoch),
+                preabort_ranges=tuple(plan.preabort_ranges),
+                lane_ranges=tuple(plan.lane_ranges)),
+        commit_version=int(version), epoch=int(epoch))
 
 
 def record_window(w: Dict[str, Any]) -> None:

@@ -88,6 +88,10 @@ database_locked = _define(1038, "database_locked", "Database is locked (DR switc
 transaction_throttled = _define(
     1213, "transaction_throttled",
     "Tenant over its admission rate; retry after backoff", retryable=True)
+transaction_conflict_predicted = _define(
+    1214, "transaction_conflict_predicted",
+    "Conflict scheduler predicts this transaction is doomed; refresh read "
+    "version and retry", retryable=True)
 please_reboot = _define(1207, "please_reboot", "Process should reboot")
 io_error = _define(1510, "io_error", "Disk i/o operation failed")
 file_not_found = _define(1511, "file_not_found", "File not found")

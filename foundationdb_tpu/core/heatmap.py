@@ -100,6 +100,13 @@ class KeyRangeHeatAggregator:
         #: recent first-witness abort attributions: which prior write
         #: (version) killed a transaction, and in which key range
         self.attribution: deque = deque(maxlen=self.MAX_ATTRIBUTION)
+        #: consumable copy of the witness stream for drain_witnesses():
+        #: `attribution` above is a DISPLAY ring (cli heat, blackbox,
+        #: attribution_for) that readers peek without consuming; a second
+        #: reader that also peeked it would double-count samples, so
+        #: consumers (the conflict scheduler) get their own queue that
+        #: drains atomically. Raw begin-key bytes, not formatted.
+        self._pending_witnesses: deque = deque(maxlen=4 * self.MAX_ATTRIBUTION)
         #: last ADOPTED split points (split-point hysteresis: a fresh
         #: equal-load derivation replaces these only when it improves the
         #: measured imbalance by at least the hysteresis knob — two
@@ -168,6 +175,11 @@ class KeyRangeHeatAggregator:
                         "witness_version": int(wv[t]) + base,
                         "range_begin": _fmt_key(keys[int(wb[t])]),
                     })
+                    self._pending_witnesses.append({
+                        "version": version,
+                        "witness_version": int(wv[t]) + base,
+                        "range_begin": keys[int(wb[t])],
+                    })
         self._prune()
 
     def observe_batch(self, transactions, verdicts,
@@ -230,7 +242,27 @@ class KeyRangeHeatAggregator:
                     "range_begin": _fmt_key(
                         txn.read_conflict_ranges[0].begin),
                 })
+                self._pending_witnesses.append({
+                    "version": int(version),
+                    "witness_version": None,
+                    "range_begin": txn.read_conflict_ranges[0].begin,
+                })
         self._prune()
+
+    def drain_witnesses(self) -> List[dict]:
+        """Consume the pending first-witness samples atomically and return
+        them. `attribution` is a peek-only display ring shared by `cli
+        heat`, the black-box batch records and `attribution_for`; any
+        consumer that also peeked it would double-count samples it saw on
+        a previous read. Consumers (the conflict scheduler) call this
+        instead: each sample is returned exactly once, with the RAW begin
+        key bytes (`range_begin`) so the consumer can key its own maps.
+        Single swap-then-read, so a merge interleaved from the pipeline's
+        pack/force never splits a sample between two drains."""
+        pending, self._pending_witnesses = (
+            self._pending_witnesses,
+            deque(maxlen=self._pending_witnesses.maxlen))
+        return list(pending)
 
     def attribution_for(self, version: int) -> List[dict]:
         """The retained first-witness attribution samples of ONE batch
@@ -249,6 +281,7 @@ class KeyRangeHeatAggregator:
         state on a stationary grid."""
         self._w.clear()
         self.attribution.clear()
+        self._pending_witnesses.clear()
         self._last_splits = None
 
     def _prune(self) -> None:

@@ -285,6 +285,46 @@ def _make_server_knobs() -> Knobs:
     k.init("resolver_blackbox_segments", 8)
     #: in-memory ring of recent envelopes for live explain / summaries
     k.init("resolver_blackbox_ring", 4096)
+    # Conflict-aware scheduler (pipeline/scheduler.py; docs/scheduling.md).
+    # Deliberately no BUGGIFY randomizers: scheduling is deterministic
+    # (counter-based probing, no rng) and the fully-off path must stay
+    # byte-identical — a randomizer draw would shift every sim's stream.
+    #: master switch: "" = off (admission hands batches through untouched,
+    #: compiled programs byte-identical); "on" = predictive reorder +
+    #: serialization lanes + pre-abort between admission and the batcher
+    k.init("resolver_sched", "")
+    #: max pending transactions the scheduler examines per batching tick
+    #: (the reorder window; pendings beyond it keep arrival order)
+    k.init("resolver_sched_window", 256)
+    #: decayed conflict score at which a key range is HOT — hot ranges get
+    #: a serialization lane and feed the doom predictor
+    k.init("resolver_sched_hot_score", 4.0)
+    #: per-tick multiplicative decay of range conflict scores (forgets
+    #: cooled hot spots; pairs with resolver_heat_decay upstream). Ticks
+    #: run at the batch cadence — hundreds per second — so the half-life
+    #: at the default is tens of milliseconds, not seconds
+    k.init("resolver_sched_decay", 0.98)
+    #: pre-abort predicted-doomed transactions with
+    #: transaction_conflict_predicted before device dispatch (clients
+    #: refresh their read version and retry); False = predict + lane only
+    k.init("resolver_sched_preabort", True)
+    #: deterministic 1-in-N probe cadence: every Nth predicted-doomed
+    #: transaction is dispatched anyway; a probe that COMMITS increments
+    #: the mispredict counter the watchdog alerts on (no rng)
+    k.init("resolver_sched_probe_interval", 16)
+    #: upper bound on live serialization lanes (hottest ranges win;
+    #: excess hot ranges fall back to reorder-only handling)
+    k.init("resolver_sched_lane_max", 8)
+    #: max transactions queued in one lane; a full lane stops capturing
+    #: (overflow keeps normal batching) so lanes bound, never grow, work
+    k.init("resolver_sched_lane_depth", 32)
+    #: starvation bound: a transaction deferred this many ticks is
+    #: dispatched regardless of predicted conflicts
+    k.init("resolver_sched_defer_max", 4)
+    #: watchdog threshold: probes that commit / probes dispatched above
+    #: this fraction means the predictor has gone stale — sched_mispredict
+    #: fires and the incident names the counter pair (core/watchdog.py)
+    k.init("resolver_sched_mispredict_frac", 0.5)
     # Cluster watchdog (core/watchdog.py; docs/observability.md
     # "Watchdog, burn rates & incidents"). Deliberately no BUGGIFY
     # randomizers: evaluation is observational (host-side reads only,

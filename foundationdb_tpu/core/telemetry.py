@@ -90,6 +90,10 @@ class TelemetryHub:
         #: executed/stalled/blackout gauges feed the `fdbtpu_reshard`
         #: family and the watchdog's reshard rules)
         self._reshards: Dict[str, "weakref.ref"] = {}
+        #: label -> weakref to ConflictScheduler (pipeline/scheduler.py —
+        #: decision counters, probe/mispredict pair and lane gauges feed
+        #: the `fdbtpu_sched` family and the sched_mispredict rule)
+        self._scheds: Dict[str, "weakref.ref"] = {}
         self._seq = 0
         #: bounded ring of recent nemesis/chaos events (real/chaos.py,
         #: real/nemesis.py) — rendered by `tools/cli.py chaos-status`
@@ -157,6 +161,16 @@ class TelemetryHub:
         blackout-overrun rule evaluate."""
         label = self._label("reshard", name)
         self._reshards[label] = weakref.ref(controller)
+        return label
+
+    def register_scheduler(self, scheduler, name: str = "sched") -> str:
+        """A conflict scheduler (pipeline/scheduler.py ConflictScheduler):
+        per-decision counters (dispatched/deferred/laned/pre-aborted),
+        the probe vs mispredict pair the watchdog's sched_mispredict
+        burn rule consumes, and lane/predictor gauges, synced as
+        `sched.<label>.*` series — the `fdbtpu_sched` family."""
+        label = self._label("sched", name)
+        self._scheds[label] = weakref.ref(scheduler)
         return label
 
     def reshard_source(self, label: str):
@@ -320,6 +334,20 @@ class TelemetryHub:
                 sum(adm.admitted.values()))
             td.int64(f"admission.{label}.rejected").set(
                 sum(adm.rejected.values()))
+        for label, sch in self._live(self._scheds):
+            # conflict-scheduler eyes (pipeline/scheduler.py): every
+            # decision counter, the probe_ok/mispredicts pair the
+            # watchdog's sched_mispredict rule burns against, live lane
+            # depth and the predictor's tracked-range count
+            for key, n in sch.counters.items():
+                td.int64(f"sched.{label}.{key}").set(int(n))
+            td.int64(f"sched.{label}.lanes").set(len(sch.lanes))
+            td.int64(f"sched.{label}.pending_laned").set(
+                sch.pending_laned())
+            td.int64(f"sched.{label}.tracked_ranges").set(
+                len(sch.predictor.scores))
+            td.int64(f"sched.{label}.mispredict_frac_x1000").set(
+                int(sch.mispredict_frac() * 1000))
         for label, eng in self._live(self._loops):
             # device-loop eyes (ops/device_loop.py): the double buffer's
             # slot occupancy, the result ring's depth, and every
@@ -393,6 +421,8 @@ class TelemetryHub:
                           for label, adm in self._live(self._admissions)},
             "reshard": {label: rc.snapshot()
                         for label, rc in self._live(self._reshards)},
+            "sched": {label: sch.snapshot()
+                      for label, sch in self._live(self._scheds)},
             "watchdog": (self._watchdog.snapshot()
                          if self._watchdog is not None else None),
         }
@@ -426,6 +456,10 @@ class TelemetryHub:
         "reshard": "online-resharding controller gauges "
                    "(server/reshard.py: live epoch/shard count, executed/"
                    "stalled ops, in-flight age, blackout vs budget)",
+        "sched": "conflict-scheduler gauges (pipeline/scheduler.py: "
+                 "decision counters, probe vs mispredict pair, lane "
+                 "depth, tracked predictor ranges; fractions are x1000 "
+                 "fixed-point)",
     }
 
     @staticmethod

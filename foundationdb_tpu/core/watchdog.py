@@ -526,6 +526,14 @@ def default_rules() -> List[AlertRule]:
         BurnRateRule("tenant_throttle_burn",
                      "admission.*.admitted", "admission.*.rejected",
                      budget_frac=float(k.watchdog_throttle_budget_frac)),
+        # conflict-scheduler predictor health (pipeline/scheduler.py):
+        # probes are predicted-doomed transactions dispatched anyway —
+        # one that COMMITS is a mispredict. A mispredict share above the
+        # budget means the predictor has gone stale and pre-abort is
+        # refusing transactions that would have won.
+        BurnRateRule("sched_mispredict",
+                     "sched.*.probe_ok", "sched.*.mispredicts",
+                     budget_frac=float(k.resolver_sched_mispredict_frac)),
         # -- discipline thresholds (must-be-zero invariants, live) -------
         ThresholdRule("blocking_syncs", "loop.*.blocking_syncs", 0, ">",
                       hold_s=0.0),

@@ -248,10 +248,15 @@ class ResolverPipeline:
 
     def __init__(self, engine, depth: int = 2, executor=None,
                  batcher: Optional[BudgetBatcher] = None,
-                 transport_degraded_fn=None):
+                 transport_degraded_fn=None, conflict_sched=None):
         assert depth >= 1
         self.engine = engine
         self.depth = depth
+        #: optional ConflictScheduler (pipeline/scheduler.py) to train on
+        #: every forced batch's verdicts: the wall-clock pipeline is the
+        #: resolution point, so its feedback keeps the admission-side doom
+        #: model current whichever layer did the scheduling
+        self.conflict_sched = conflict_sched
         #: optional transport-health probe (RealNetwork.transport_degraded):
         #: while it reports True the pipeline collapses to depth 1, exactly
         #: as it does for a degraded ResilientEngine — keeping batches in
@@ -321,6 +326,7 @@ class ResolverPipeline:
             except BaseException as e:
                 pb._error = e
             pb._state = _DONE
+            self._observe(pb, list(transactions))
             self._queue.append(pb)
             return pb
         if self._executor is not None:
@@ -367,6 +373,7 @@ class ResolverPipeline:
             except BaseException as e:
                 pb._error = e
             pb._state = _DONE
+            self._observe(pb, txns)
             return
         pb._force = self.engine.columnar_dispatch(plan)
         pb._buckets = plan.get("chunk_buckets")
@@ -400,6 +407,18 @@ class ResolverPipeline:
                            txns=pb.n_txns, parent="resolver.queue_wait")
             pb._force = None
             pb._state = _DONE
+            self._observe(pb)
+
+    def _observe(self, pb: PendingResolve, txns=None) -> None:
+        """Feed one completed batch's verdicts to the conflict predictor
+        (no-op without an enabled scheduler or on an errored batch)."""
+        cs = self.conflict_sched
+        if cs is None or not cs.enabled or pb._error is not None:
+            return
+        if txns is None:
+            txns = pb._txns[0] if pb._txns is not None else None
+        if txns:
+            cs.observe_batch(txns, pb._result, pb.version)
 
     def _force_oldest(self) -> None:
         while self._queue and self._queue[0].is_done:

@@ -64,6 +64,16 @@ class PipelineConfig:
                           BudgetBatcher's per-(bucket, mode) EWMAs so a
                           mode flip never poisons the other mode's
                           latency estimate.
+    sched               — conflict-aware admission scheduling
+                          (pipeline/scheduler.py, docs/scheduling.md):
+                          "" = the resolver_sched knob decides, "on" /
+                          "off" force it for this service. The service
+                          resolves batches whose versions are already
+                          assigned, so it never reorders; it OWNS the
+                          shared ConflictScheduler instance — admission
+                          layers call service.conflict_sched.select(),
+                          and resolve() trains the predictor on every
+                          batch's verdicts regardless of who admitted it.
     dispatch_mode       — how batches reach the device (docs/perf.md
                           "Device-resident loop"): "step" is the
                           launch-per-batch path whose device segment is
@@ -89,6 +99,7 @@ class PipelineConfig:
     dispatch_mode: str = "step"
     queue_enqueue_ms: float = 0.0
     result_drain_ms: float = 0.0
+    sched: str = ""
 
     def as_dict(self) -> dict:
         return {"depth": self.depth,
@@ -103,7 +114,8 @@ class PipelineConfig:
                                           else None),
                 "dispatch_mode": self.dispatch_mode,
                 "queue_enqueue_ms": self.queue_enqueue_ms,
-                "result_drain_ms": self.result_drain_ms}
+                "result_drain_ms": self.result_drain_ms,
+                "sched": self.sched}
 
 
 class PipelinedResolverService:
@@ -121,6 +133,17 @@ class PipelinedResolverService:
         #: a per-bucket device-time table): virtual-time service delays
         #: feed the EWMA; target_batch_txns() is the adaptive production
         #: point the proxy's commit batcher is capped to (via ratekeeper)
+        #: shared conflict scheduler (pipeline/scheduler.py): the service
+        #: owns the instance and trains its predictor on every resolved
+        #: batch; admission layers consult it for select()/pre-abort.
+        #: Config "" defers to the resolver_sched knob, "on"/"off" force.
+        from .scheduler import ConflictScheduler, SchedConfig
+
+        sched_cfg = SchedConfig.from_knobs()
+        if cfg.sched:
+            sched_cfg.enabled = cfg.sched.strip().lower() == "on"
+        self.conflict_sched = ConflictScheduler(
+            sched_cfg, heat=getattr(engine, "heat", None))
         self.batcher: Optional[BudgetBatcher] = None
         if cfg.device_ms_by_bucket:
             bucket_modes = dict(cfg.search_mode_by_bucket or {})
@@ -310,6 +333,11 @@ class PipelinedResolverService:
                 # segment and the sim's line up in attribution output
                 span_event("resolver.force", version, t3, span_now(),
                            parent="resolver.queue_wait")
+            if self.conflict_sched.enabled and transactions:
+                # predictor feedback at the resolution point: every batch
+                # trains the doom model, whichever layer admitted it
+                self.conflict_sched.observe_batch(
+                    list(transactions), verdicts, version)
             return verdicts
         finally:
             # On any exit (including cancellation mid-wait) unblock the

@@ -162,7 +162,7 @@ class ChaosCommitServer:
                  transport_degraded_fn=None, port: int = 0,
                  dispatch_timeout_s: Optional[float] = None,
                  elastic: bool = False, reshard: bool = False,
-                 reshard_spares: int = 2):
+                 reshard_spares: int = 2, conflict_sched=None):
         from ..server.ratekeeper import TenantAdmission
         from .runtime import make_dispatcher
 
@@ -215,6 +215,21 @@ class ChaosCommitServer:
             self._heat_agg = self.engine.heat
         else:
             self._heat_agg = getattr(self.inner, "heat", None)
+        #: conflict-aware admission scheduling (pipeline/scheduler.py):
+        #: None = the resolver_sched knob decides; a SchedConfig is used
+        #: as-is; any other truthy/falsy value forces enabled on/off over
+        #: the knob family's tuning. Disabled, the scheduler is inert —
+        #: select() is the same FIFO slice the batcher always took.
+        from ..pipeline.scheduler import ConflictScheduler, SchedConfig
+
+        if isinstance(conflict_sched, SchedConfig):
+            sched_cfg = conflict_sched
+        else:
+            sched_cfg = SchedConfig.from_knobs()
+            if conflict_sched is not None:
+                sched_cfg.enabled = bool(conflict_sched)
+        self.conflict_sched = ConflictScheduler(
+            sched_cfg, heat=self._heat_agg, entry_txn=lambda e: e[0])
         self.batch_interval_s = batch_interval_s
         self.max_batch = max_batch
         #: injected per-batch service floor: the campaign's stand-in for
@@ -269,6 +284,11 @@ class ChaosCommitServer:
 
     async def stop(self) -> None:
         self._running = False
+        # fail any still-laned entries the batcher will never drain, so
+        # no in-flight commit awaits a promise nothing owns anymore
+        for _t, p, _t0, _m in self.conflict_sched.flush():
+            if not p.is_set:
+                p.send_error(error.operation_cancelled(""))
         if self.reshard_ctl is not None:
             self.reshard_ctl.stop()
         if self._batcher_task is not None:
@@ -328,6 +348,12 @@ class ChaosCommitServer:
         try:
             v = await p.future
         except error.FDBError as e:
+            if (e.name == "transaction_conflict_predicted"
+                    and self.admission is not None):
+                # a pre-abort consumed no device capacity: hand the
+                # admission token back so the client's fresh-version
+                # retry isn't double-charged (server/ratekeeper.py)
+                self.admission.refund(tenant)
             if ctx is not None:
                 span_event("server.commit", ctx.trace_id, t_recv, span_now(),
                            parent=ctx.parent, err=e.name,
@@ -356,6 +382,8 @@ class ChaosCommitServer:
         }
         if self.reshard_ctl is not None:
             out["reshard"] = self.reshard_ctl.snapshot()
+        if self.conflict_sched.enabled:
+            out["sched"] = self.conflict_sched.snapshot()
         loop_stats = getattr(self.inner, "loop_stats", None)
         if loop_stats is not None:
             out["loop_stats"] = dict(loop_stats)
@@ -411,7 +439,8 @@ class ChaosCommitServer:
                         weights=adm.weights)
                 if self._heat_agg is not None:
                     blackbox.record_heat(self._heat_agg.brief())
-            if not self._pending:
+            sched = self.conflict_sched
+            if not self._pending and not sched.pending_laned():
                 continue
             self._refresh_admission()
             # depth/batch collapse on degradation: a degraded engine or
@@ -422,8 +451,31 @@ class ChaosCommitServer:
             if self.degraded:
                 cap = max(1, self.max_batch // 8)
                 self.depth_collapses += 1
-            batch = self._pending[:cap]
-            del self._pending[:cap]
+            plan = None
+            if sched.enabled:
+                if self._elastic:
+                    # lanes were derived under the current shard map; an
+                    # epoch flip drains and retires them so no laned
+                    # transaction straddles two map generations
+                    sched.notify_epoch(self.engine.emap.epoch)
+                t_sel = span_now()
+                plan = sched.select(self._pending, cap)
+                self._pending = plan.remaining
+                batch = plan.dispatch
+                for (_t, p, _t0, _m), rng in plan.preaborts:
+                    if not p.is_set:
+                        p.send_error(error.transaction_conflict_predicted(
+                            f"range {rng.hex()}"))
+                if g_spans.enabled and (batch or plan.preaborts):
+                    span_event("sched.select", self._version, t_sel,
+                               span_now(), txns=len(batch),
+                               preaborts=len(plan.preaborts),
+                               Proc=self._span_proc)
+                if not batch:
+                    continue
+            else:
+                batch = self._pending[:cap]
+                del self._pending[:cap]
             self._version += VERSIONS_PER_BATCH
             v = self._version
             new_oldest = max(0, v - GC_LAG_BATCHES * VERSIONS_PER_BATCH)
@@ -450,6 +502,16 @@ class ChaosCommitServer:
             t1 = span_now()
             self.batches += 1
             self._committed = v
+            if sched.enabled:
+                # close the prediction loop: committed writes stamp
+                # last-write versions, conflicts bump range scores, and
+                # dispatched probes resolve to probe_ok/mispredict
+                sched.observe_batch(txns, verdicts, v)
+                if plan is not None and blackbox.enabled():
+                    blackbox.record_sched(
+                        plan, v, len(sched.lanes),
+                        len(self._pending) + sched.pending_laned(),
+                        epoch=sched.epoch)
             if not self._elastic and blackbox.enabled():
                 # non-elastic: the commit server IS the resolution tier's
                 # top level, so it records the batch (an elastic group
@@ -545,6 +607,12 @@ class NemesisConfig:
     #: summary and `cli explain <version> REPORT.json` narrates any
     #: resolved version post-hoc
     blackbox_dir: Optional[str] = None
+    #: conflict-aware admission scheduler (pipeline/scheduler.py): None =
+    #: the resolver_sched knob decides; True/False force it on/off for
+    #: this campaign. On, the report carries a `sched` snapshot and the
+    #: fleet's submit loop retries transaction_conflict_predicted with a
+    #: refreshed read version (the pre-abort contract, docs/scheduling.md)
+    sched: Optional[bool] = None
 
     #: budget multiplier for CPU-emulated device modes: a real chip-
     #: adjacent resolver serves a batch in well under a millisecond, but
@@ -646,6 +714,11 @@ class CampaignReport:
     reshard_span_blackouts_ms: Optional[list] = None
     #: post-reshard per-tenant admission weights (rebalance_admission)
     admission_weights: Optional[dict] = None
+    #: conflict scheduler snapshot (pipeline/scheduler.py
+    #: ConflictScheduler.snapshot()): decision counters, lane states,
+    #: predictor hot ranges and the mispredict fraction — `cli sched
+    #: REPORT.json` renders it
+    sched: Optional[dict] = None
     wall_s: float = 0.0
 
     def as_dict(self) -> dict:
@@ -898,7 +971,7 @@ async def _campaign(cfg: NemesisConfig) -> CampaignReport:
         service_floor_s=cfg.service_floor_s,
         dispatch_timeout_s=cfg.dispatch_timeout_s,
         elastic=cfg.elastic or cfg.reshard, reshard=cfg.reshard,
-        reshard_spares=cfg.reshard_spares)
+        reshard_spares=cfg.reshard_spares, conflict_sched=cfg.sched)
     nemesis = NetworkNemesis(cfg.seed, cfg.chaos)
     transports: Dict[str, ChaosTransport] = {}
     versions: Dict[str, int] = {}
@@ -938,6 +1011,24 @@ async def _campaign(cfg: NemesisConfig) -> CampaignReport:
                 refreshing[tenant] = False
 
         async def submit(spec: TenantSpec, reads, writes):
+            # pre-abort contract (docs/scheduling.md): the scheduler's
+            # transaction_conflict_predicted reject is a fast retryable
+            # error issued BEFORE device dispatch — the client refreshes
+            # its read version off the status endpoint and resubmits.
+            # Bounded so a mispredicting predictor cannot livelock a
+            # client; exhaustion reports as a conflict (not_committed),
+            # the honest verdict class for a txn the predictor deemed
+            # un-commitable at every snapshot it was offered.
+            for _attempt in range(8):
+                try:
+                    return await submit_once(spec, reads, writes)
+                except error.FDBError as e:
+                    if e.name != "transaction_conflict_predicted":
+                        raise
+                    await refresh_version(spec.name)
+            raise error.not_committed("sched_retry_exhausted")
+
+        async def submit_once(spec: TenantSpec, reads, writes):
             # distributed tracing: one context per request, attached to the
             # RPC frame by the transport and RE-ATTACHED verbatim on any
             # retry (the ambient context is re-read per send), so the
@@ -1099,6 +1190,8 @@ async def _campaign(cfg: NemesisConfig) -> CampaignReport:
             report.loop_stats = dict(loop_stats)
         report.admission = (server.admission.as_dict()
                             if server.admission is not None else None)
+        if server.conflict_sched.enabled:
+            report.sched = server.conflict_sched.snapshot()
         if server.reshard_ctl is not None:
             report.reshard = server.reshard_ctl.snapshot()
             if server.admission is not None:
@@ -1266,6 +1359,22 @@ def assert_slos(report: CampaignReport, cfg: NemesisConfig,
                  f"{rs.get('executed')} executed reshards: {ctx}")
             assert all(b is not None and b <= bo_budget for b in bos), \
                 f"span-measured blackout over budget {bo_budget} ms: {ctx}"
+    if report.sched is not None:
+        # conflict-scheduler SLOs (docs/scheduling.md): the scheduler saw
+        # the campaign's traffic, and once the probe population is big
+        # enough to mean anything, the measured mispredict fraction stays
+        # inside the same budget the sched_mispredict watchdog rule burns
+        sc = report.sched
+        assert sc["counters"].get("examined", 0) > 0, \
+            f"scheduler enabled but examined no transactions: {ctx}"
+        probes = (sc["counters"].get("probe_ok", 0)
+                  + sc["counters"].get("mispredicts", 0))
+        frac_budget = float(SERVER_KNOBS.resolver_sched_mispredict_frac)
+        if probes >= 20:
+            assert sc["mispredict_frac"] <= frac_budget, \
+                (f"scheduler mispredict fraction "
+                 f"{sc['mispredict_frac']:.3f} exceeds {frac_budget} "
+                 f"over {probes} probes: {ctx}")
     if report.incidents is not None:
         # every firing incident must be EXPLAINED: it overlaps an
         # injected fault window or names a measured breach. An alert
@@ -1527,6 +1636,83 @@ def run_served_while_resharding(seconds: float = 6.0, seed: int = 2027,
             "static": users(static),
             "while_resharding": users(resharding),
         },
+    }
+
+
+def run_conflict_scheduling(seconds: float = 4.0, seed: int = 3026) -> dict:
+    """The conflict-scheduling A/B (bench.py `conflict_scheduling`): the
+    SAME contended Zipf-1.2 wall-clock serving point with the conflict
+    scheduler OFF and ON, same seeds, same fleet. The claim under test
+    (docs/scheduling.md): pre-abort + refresh-and-retry plus hot-range
+    serialization lanes at least HALVE the abort fraction at equal-or-
+    better served txn/s — aborts become fast early rejects the client
+    retries at a fresh snapshot instead of wasted device verdicts. Both
+    rows replay their engine journal through a clean serial oracle: the
+    scheduler reorders ADMISSION, never resolution, so parity must hold
+    bit-for-bit in the scheduled order too."""
+
+    def point(sched_on: bool, pseed: int) -> dict:
+        # a contention-dominated point, NOT a capacity-dominated one: a
+        # small hot key pool under Zipf 1.2 makes write-write collisions
+        # the limiting factor while the serving slot stays uncongested,
+        # so abort_frac measures conflict handling, not queueing
+        tenants = [
+            TenantSpec("hot", target_tps=200, s=1.2, n_keys=16),
+            TenantSpec("bg", target_tps=25, s=0.0, n_keys=1024),
+        ]
+        cfg = NemesisConfig(
+            seed=pseed, engine_mode="oracle", duration_s=seconds,
+            tenants=tenants, admission=True,
+            rpc_timeout_s=30.0, batch_interval_s=0.002, max_batch=48,
+            chaos=ChaosConfig(latency_prob=0, drop_prob=0, reset_prob=0,
+                              handshake_stall_prob=0),
+            partitions=0, device_faults=False, kill_child=False,
+            collect_spans=False, sched=sched_on)
+        rep = run_campaign(cfg)
+        counts = rep.counts
+        offered = max(counts.get("offered", 0), 1)
+        served = counts.get("committed", 0) + counts.get("conflicted", 0)
+        row = {
+            "sched": sched_on,
+            "p99_ms": round(rep.p99_outside_ms, 3),
+            "sustained_tps": rep.sustained_tps,
+            "offered": offered,
+            "committed": counts.get("committed", 0),
+            "conflicted": counts.get("conflicted", 0),
+            "served": served,
+            "served_tps": round(served / max(seconds, 1e-9), 1),
+            "throttled_frac": round(counts.get("throttled", 0) / offered, 3),
+            "abort_frac": round(counts.get("conflicted", 0)
+                                / max(served, 1), 4),
+            "parity_checked": rep.parity_checked,
+            "parity_mismatches": rep.parity_mismatches,
+        }
+        if rep.sched is not None:
+            sc = rep.sched["counters"]
+            row["preaborts"] = sc.get("preaborts", 0)
+            row["laned"] = sc.get("laned", 0)
+            row["deferred"] = sc.get("deferred", 0)
+            row["probes"] = sc.get("probes", 0)
+            row["mispredict_frac"] = rep.sched["mispredict_frac"]
+        return row
+
+    # same seed both arms: identical arrival processes, so the delta is
+    # the scheduler, not sampling noise
+    off = point(False, seed)
+    on = point(True, seed)
+    reduction = (1.0 - on["abort_frac"] / off["abort_frac"]
+                 if off["abort_frac"] > 0 else 0.0)
+    return {
+        "off": off,
+        "on": on,
+        "abort_frac_reduction": round(reduction, 3),
+        "served_tps_ratio": round(on["served_tps"]
+                                  / max(off["served_tps"], 1e-9), 3),
+        "goal_met": bool(
+            reduction >= 0.5
+            and on["served_tps"] >= off["served_tps"] * 0.98
+            and on["parity_mismatches"] == 0
+            and off["parity_mismatches"] == 0),
     }
 
 

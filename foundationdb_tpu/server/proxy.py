@@ -268,6 +268,17 @@ class Proxy:
         self._grv_budget: float = 0.0
         self._grv_budget_t: float = 0.0
         self._dead = False
+        #: conflict-aware admission scheduling (pipeline/scheduler.py),
+        #: knob-gated hard off by default (`resolver_sched`): between the
+        #: dynamic batcher and dispatch, the scheduler may pre-abort
+        #: predicted-doomed commits, capture hot-range writers into
+        #: serialization lanes, and defer separation losers into
+        #: `_sched_carry` (consumed ahead of the next batch's arrivals)
+        from ..pipeline.scheduler import ConflictScheduler, SchedConfig
+
+        self.conflict_sched = ConflictScheduler(
+            SchedConfig.from_knobs(), entry_txn=lambda e: e[0])
+        self._sched_carry: List[Tuple[CommitTransaction, Promise]] = []
         #: proxy-owned tasks: cancelled on shutdown() without touching other
         #: roles hosted by the same worker process
         self.actors = ActorCollection()
@@ -350,6 +361,13 @@ class Proxy:
         for tok in (GRV_TOKEN, COMMIT_TOKEN, LOCATIONS_TOKEN, STATS_TOKEN,
                     COMMITTED_VERSION_TOKEN, METADATA_VERSION_TOKEN):
             self.proc.unregister(tok)
+        # laned/carried commits this generation will never dispatch: the
+        # successor decides nothing about them, so the honest answer is
+        # the same broken-promise path every other queued commit gets
+        for _t, pr in self.conflict_sched.flush() + self._sched_carry:
+            if not pr.is_set:
+                pr.send_error(error.commit_unknown_result("proxy shutdown"))
+        self._sched_carry = []
         self.actors.cancel_all()
 
     async def _stats_req(self, _req):
@@ -457,7 +475,16 @@ class Proxy:
                 raise error.transaction_throttled(f"tenant {tenant}")
         p = Promise()
         self._commit_queue.send((req.transaction, p))
-        return await p.future
+        try:
+            return await p.future
+        except error.FDBError as e:
+            if (e.name == "transaction_conflict_predicted"
+                    and adm is not None and tenant is not None):
+                # a pre-abort consumed no resolver capacity: hand the
+                # admission token back so the client's refreshed retry
+                # isn't double-charged (server/ratekeeper.py refund)
+                adm.refund(tenant)
+            raise
 
     async def idle_committer(self) -> None:
         """Commit an empty batch when idle (the reference's interval-driven
@@ -482,10 +509,26 @@ class Proxy:
                 # KCV horizon (phase-4 pushes are ordered behind the stall)
                 # and would breach the bound the window exists to enforce
                 continue
+            sched = self.conflict_sched
+            items: List[Tuple[CommitTransaction, Promise]] = []
+            if sched.enabled and (self._sched_carry or sched.pending_laned()):
+                # idle drain: laned and carried transactions must keep
+                # flowing when no fresh commit wakes the batcher — the
+                # idle batch carries them instead of running empty
+                cap = min(self.cfg.max_commit_batch or MAX_COMMIT_BATCH,
+                          SERVER_KNOBS.commit_transaction_batch_count_max)
+                plan = sched.select(self._sched_carry, cap)
+                self._sched_carry = plan.remaining
+                for (_t, pr), rng in plan.preaborts:
+                    if not pr.is_set:
+                        self.stats.add("txn_commit_preaborted")
+                        pr.send_error(error.transaction_conflict_predicted(
+                            f"range {rng.hex()}"))
+                items = plan.dispatch
             self._batch_num += 1
             self._last_batch_time = now()
             self._spawn(
-                self.commit_batch(self._batch_num, []),
+                self.commit_batch(self._batch_num, items),
                 TaskPriority.PROXY_COMMIT_DISPATCH,
                 f"idleBatch:{self._batch_num}",
             )
@@ -530,6 +573,21 @@ class Proxy:
                         pending = self._commit_queue.stream.pop()
                 if not gate.is_ready:
                     await gate
+            sched = self.conflict_sched
+            if sched.enabled:
+                # conflict-aware admission (pipeline/scheduler.py): the
+                # carry (previous ticks' deferrals) goes ahead of this
+                # batch's arrivals; pre-aborted commits are rejected here
+                # with the retryable typed error, laned/deferred entries
+                # wait in the scheduler or the carry for a later batch
+                plan = sched.select(self._sched_carry + batch, cap)
+                self._sched_carry = plan.remaining
+                for (_t, pr), rng in plan.preaborts:
+                    if not pr.is_set:
+                        self.stats.add("txn_commit_preaborted")
+                        pr.send_error(error.transaction_conflict_predicted(
+                            f"range {rng.hex()}"))
+                batch = plan.dispatch
             self._batch_num += 1
             from ..sim.loop import now as _now
 
@@ -829,6 +887,12 @@ class Proxy:
                 if (verdicts[t] == int(TransactionCommitResult.COMMITTED)
                         and not getattr(txn, "lock_aware", False)):
                     verdicts[t] = _VERDICT_LOCKED
+
+        if self.conflict_sched.enabled and items:
+            # predictor feedback (pipeline/scheduler.py): committed writes
+            # stamp last-write versions, conflicts re-score their ranges
+            self.conflict_sched.observe_batch(
+                [txn for txn, _p in items], verdicts, v)
 
         # Assign committed mutations to storage tags, preserving batch order.
         # Versionstamped mutations become SET_VALUE here, stamped with

@@ -100,6 +100,7 @@ class TenantAdmission:
         self._buckets: Dict[str, List[float]] = {}
         self.admitted: Dict[str, int] = {}
         self.rejected: Dict[str, int] = {}
+        self.refunded: Dict[str, int] = {}
 
     def set_rate(self, tps_limit: float) -> None:
         self.rate_limit = float(tps_limit)
@@ -132,6 +133,23 @@ class TenantAdmission:
         self.rejected[tenant] = self.rejected.get(tenant, 0) + 1
         return False
 
+    def refund(self, tenant: str) -> None:
+        """Return one admission token. The conflict scheduler's pre-abort
+        (pipeline/scheduler.py) refuses an admitted transaction before it
+        consumes ANY device capacity — the retry the client sends with a
+        fresh read version must not be double-charged against the
+        tenant's bucket, or pre-abort would convert conflict aborts into
+        throttle rejections instead of commits."""
+        rate = self.tenant_rate(tenant)
+        if rate == float("inf"):
+            return
+        bucket = self._buckets.get(tenant)
+        if bucket is None:
+            return
+        burst = max(1.0, rate * self.burst_s)
+        bucket[0] = min(burst, bucket[0] + 1.0)
+        self.refunded[tenant] = self.refunded.get(tenant, 0) + 1
+
     def as_dict(self) -> dict:
         return {
             "rate_limit": (None if self.rate_limit == float("inf")
@@ -139,6 +157,7 @@ class TenantAdmission:
             "burst_s": self.burst_s,
             "admitted": dict(self.admitted),
             "rejected": dict(self.rejected),
+            "refunded": dict(self.refunded),
         }
 
 

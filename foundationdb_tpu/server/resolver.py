@@ -207,6 +207,13 @@ class Resolver:
             heat = heat_fn()
             if heat is not None:
                 tel["heat"] = heat
+        # conflict-aware admission (pipeline/scheduler.py): predictor
+        # scores, lane occupancy and pre-abort counters ride the same
+        # poll -> ratekeeper -> CC status doc -> `tools/cli.py sched`
+        cs = getattr(self._service, "conflict_sched", None) \
+            if self._service is not None else None
+        if cs is not None and cs.enabled:
+            tel["sched"] = cs.snapshot()
         if tel:
             out["telemetry"] = tel
         return out

@@ -53,6 +53,13 @@ commands:
                        status doc, or from a campaign report JSON
                        (real/nemesis.py --json) / bench artifact with a
                        conflict_heat section (docs/observability.md)
+  sched [json|FILE.json]  conflict-aware admission (pipeline/scheduler.py):
+                       predictor hot ranges, serialization lanes,
+                       pre-abort / defer / reorder counters and the
+                       probe-measured mispredict fraction — live from
+                       the cluster status doc, or from a campaign report
+                       JSON / bench artifact with a conflict_scheduling
+                       section (docs/scheduling.md)
   alerts [json|FILE.json]  cluster-watchdog alert states (core/watchdog.py):
                        rule catalog, pending/firing/resolved lifecycle and
                        burn-rate values — live from the cluster status doc,
@@ -473,6 +480,108 @@ class Cli:
         if not rendered:
             self._print("no keyspace heat yet (oracle engines, "
                         "resolver_heat_buckets=0, or no traffic)")
+
+    # -- conflict-aware admission (docs/scheduling.md) ----------------------
+    def _render_sched(self, label: str, snap: dict) -> None:
+        """One scheduler snapshot (pipeline/scheduler.py layout)."""
+        c = snap.get("counters") or {}
+        self._print(f"  {label}: epoch {snap.get('epoch', -1)}, "
+                    f"{c.get('ticks', 0)} ticks, "
+                    f"{c.get('examined', 0)} examined")
+        self._print(f"    dispatched   - {c.get('dispatched', 0)} "
+                    f"(reordered {c.get('reordered', 0)}, "
+                    f"forced {c.get('forced', 0)})")
+        self._print(f"    deferred     - {c.get('deferred', 0)}  "
+                    f"laned {c.get('laned', 0)}  "
+                    f"lane_drained {c.get('lane_drained', 0)}")
+        self._print(f"    pre-aborts   - {c.get('preaborts', 0)}  "
+                    f"probes {c.get('probes', 0)} "
+                    f"(ok {c.get('probe_ok', 0)}, "
+                    f"mispredict {c.get('mispredicts', 0)}) -> "
+                    f"mispredict_frac {snap.get('mispredict_frac', 0.0)}")
+        lanes = snap.get("lanes") or []
+        if lanes or snap.get("pending_laned"):
+            self._print(f"    lanes        - {len(lanes)} open "
+                        f"({c.get('lanes_opened', 0)} opened, "
+                        f"{c.get('lanes_retired', 0)} retired, "
+                        f"{snap.get('pending_laned', 0)} queued, "
+                        f"{c.get('epoch_flips', 0)} epoch flips)")
+            for lane in lanes[:6]:
+                self._print(
+                    f"      [{lane.get('range_begin')} ..) "
+                    f"{lane.get('state'):<8} depth {lane.get('depth')} "
+                    f"captured {lane.get('captured')} "
+                    f"drained {lane.get('drained')} "
+                    f"epoch {lane.get('epoch')}")
+        pred = snap.get("predictor") or {}
+        hot = pred.get("hot_ranges") or []
+        if hot:
+            self._print(f"    predictor    - {pred.get('tracked_ranges', 0)}"
+                        " tracked, "
+                        f"{pred.get('witnesses_consumed', 0)} witnesses; "
+                        "hottest:")
+            for r in hot:
+                self._print(f"      [{r.get('range_begin')} ..) "
+                            f"score {r.get('score')}")
+
+    def _render_sched_ab(self, ab: dict) -> None:
+        """A/B section a bench artifact records (conflict_scheduling)."""
+        self._print("  A/B (same seed, scheduler off vs on):")
+        for arm in ("off", "on"):
+            row = ab.get(arm) or {}
+            self._print(
+                f"    {arm:<3} abort_frac {row.get('abort_frac')}  "
+                f"served_tps {row.get('served_tps')}  "
+                f"p99 {row.get('p99_ms')} ms  parity_mismatches "
+                f"{row.get('parity_mismatches')}")
+        self._print(
+            f"    abort_frac_reduction "
+            f"{ab.get('abort_frac_reduction')}  served_tps_ratio "
+            f"{ab.get('served_tps_ratio')}  goal_met {ab.get('goal_met')}")
+
+    def do_sched(self, args: List[str]) -> None:
+        """Conflict-aware admission (docs/scheduling.md): predictor hot
+        ranges, serialization lanes, pre-abort and mispredict-probe
+        counters — live from the cluster status doc's
+        qos.resolver_telemetry fragment, or from a campaign report /
+        bench JSON artifact."""
+        if args and args[0].endswith(".json"):
+            doc, rows = self._report_campaigns(args[0])
+            if doc is None:
+                return
+            rendered = 0
+            for label, rep in rows:
+                snap = rep.get("sched")
+                if snap:
+                    self._render_sched(label, snap)
+                    rendered += 1
+            ab = (doc.get("parsed", doc)).get("conflict_scheduling")
+            if ab and isinstance(ab, dict) and "on" in ab:
+                self._render_sched_ab(ab)
+                rendered += 1
+            if not rendered:
+                self._print(f"no scheduler snapshots in {args[0]} "
+                            "(resolver_sched off, or an old report)")
+            return
+        doc = self._drive(self.db.get_status())
+        if doc is None:
+            self._print("status unavailable (no cluster controller reachable)")
+            return
+        tel = (doc.get("qos") or {}).get("resolver_telemetry") or {}
+        if args and args[0] == "json":
+            self._print(json.dumps(
+                {addr: frag.get("sched") for addr, frag in tel.items()},
+                indent=2, sort_keys=True))
+            return
+        rendered = 0
+        for addr in sorted(tel):
+            snap = (tel.get(addr) or {}).get("sched")
+            if snap:
+                self._render_sched(f"resolver {addr}", snap)
+                rendered += 1
+        if not rendered:
+            self._print("no conflict-scheduler telemetry yet "
+                        "(resolver_sched knob off, or no traffic)")
 
     # -- cluster watchdog (docs/observability.md "Watchdog, burn rates &
     # incidents"; per-alert runbook table in docs/operations.md) ------------
@@ -1130,7 +1239,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     cmd0 = args.command[0].replace("-", "_") if args.command else ""
     if cmd0 in ("chaos_status", "trace") or (
-            cmd0 in ("heat", "alerts", "incidents", "shards")
+            cmd0 in ("heat", "sched", "alerts", "incidents", "shards")
             and len(args.command) > 1
             and args.command[1].endswith(".json")):
         # no cluster needed: renders the hub / a report, trace or heat
@@ -1142,6 +1251,8 @@ def main(argv=None) -> int:
             cli.do_chaos_status(args.command[1:])
         elif cmd0 == "heat":
             cli.do_heat(args.command[1:])
+        elif cmd0 == "sched":
+            cli.do_sched(args.command[1:])
         elif cmd0 == "alerts":
             cli.do_alerts(args.command[1:])
         elif cmd0 == "incidents":

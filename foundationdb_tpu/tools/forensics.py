@@ -286,6 +286,24 @@ def explain(events: Sequence, version: int,
         }
         sources.append("admission")
 
+    # conflict-scheduling decisions at the tick that produced this batch
+    # (pipeline/scheduler.py): why transactions were deferred, laned or
+    # pre-aborted before this version dispatched
+    for e in ix.by_kind.get("sched", ()):
+        p = e.payload
+        if p.version != version:
+            continue
+        info["sched"] = {
+            "dispatched": p.dispatched, "deferred": p.deferred,
+            "laned": p.laned, "preaborted": p.preaborted,
+            "probes": p.probes, "forced": p.forced,
+            "lanes": p.lanes, "pending": p.pending,
+            "preabort_ranges": list(p.preabort_ranges),
+            "lane_ranges": list(p.lane_ranges),
+        }
+        sources.append("sched")
+        break
+
     # routing under the batch's epoch
     routing = ix.routing_for(version)
     if routing is not None:
@@ -436,6 +454,19 @@ def render_explain(info: dict) -> List[str]:
             f"{adm['rejected']} ({adm['shed_frac'] * 100:.1f}% shed)"
             + (f" at rate {adm['rate']:.1f}/s" if adm["rate"] else "")
             + f"  [{adm['t_rel']}]")
+    sch = info.get("sched")
+    if sch is not None:
+        out.append(
+            f"  sched       dispatched {sch['dispatched']}, laned "
+            f"{sch['laned']}, deferred {sch['deferred']}, pre-aborted "
+            f"{sch['preaborted']}, probes {sch['probes']} "
+            f"({sch['lanes']} lanes, {sch['pending']} queued)")
+        if sch.get("preabort_ranges"):
+            out.append("    pre-abort ranges: "
+                       + ", ".join(sch["preabort_ranges"][:4]))
+        if sch.get("lane_ranges"):
+            out.append("    lane ranges: "
+                       + ", ".join(sch["lane_ranges"][:4]))
     routing = info.get("routing")
     if routing is not None:
         if routing.get("flip_version") is not None:

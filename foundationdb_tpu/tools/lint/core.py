@@ -337,6 +337,11 @@ DEFAULT_POLICY: Dict[str, RulePolicy] = {
             "extra_registries": (
                 ("reshard.", "foundationdb_tpu/server/reshard.py",
                  "RESHARD_SEGMENTS"),
+                # sched.* scheduler-arc segments: select ticks happen
+                # outside any one transaction's latency, so they are not
+                # part of the commit waterfall's telescoping sum either
+                ("sched.", "foundationdb_tpu/pipeline/scheduler.py",
+                 "SCHED_SEGMENTS"),
             ),
             "span_calls": ("span", "span_event", "Span", "subspan"),
         }),

@@ -109,9 +109,10 @@ class KnobDriftChecker(Checker):
         for ctx in ctxs:
             if ctx.rel == knobs_rel:
                 continue
-            # registry names reachable in this file: the canonical three
-            # plus local aliases (`k = SERVER_KNOBS; k.resolver_...` is the
-            # fault/resilient.py idiom)
+            # registry names reachable in this file: the canonical three,
+            # local aliases (`k = SERVER_KNOBS; k.resolver_...` is the
+            # fault/resilient.py idiom) and import aliases (`from
+            # ..core.knobs import SERVER_KNOBS as k`, pipeline/scheduler.py)
             reg_names = set(_KNOB_REGISTRY_NAMES)
             for node in ast.walk(ctx.tree):
                 if (isinstance(node, ast.Assign)
@@ -119,6 +120,10 @@ class KnobDriftChecker(Checker):
                         and node.value.id in _KNOB_REGISTRY_NAMES):
                     reg_names.update(t.id for t in node.targets
                                      if isinstance(t, ast.Name))
+                elif isinstance(node, ast.ImportFrom):
+                    reg_names.update(a.asname for a in node.names
+                                     if a.asname
+                                     and a.name in _KNOB_REGISTRY_NAMES)
             for node in ast.walk(ctx.tree):
                 if (isinstance(node, ast.Attribute)
                         and isinstance(node.value, ast.Name)
