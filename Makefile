@@ -121,6 +121,30 @@ chaos-drift:
 forensics-smoke:
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.forensics_smoke
 
+# Crash-restart campaigns (docs/fault_tolerance.md "Crash-stop
+# recovery"): 2 seeds x {jax, device_loop} — a recoverable commit-server
+# child (journal fsync_interval=1 + cadenced snapshots + on-disk
+# progcache) killed -9 mid-load under injected disk faults, supervised
+# back up, and machine-asserted to recover inside
+# resolver_recovery_budget_ms (span-verified), serve NEW commits, and
+# replay the whole retained batch stream bit-identical through the clean
+# serial oracle. Solo-CPU: do not overlap with tier-1.
+chaos-crash:
+	JAX_PLATFORMS=cpu python -m foundationdb_tpu.real.nemesis \
+		--crash --seeds 2 --engine-modes jax,device_loop \
+		--blackbox-dir chaos_crash_blackbox \
+		--json chaos_crash_report.json
+	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.cli \
+		recovery chaos_crash_report.json
+
+# Crash-stop recovery smoke (~30s, solo-CPU safe — one parent + one
+# supervised child on the miniature jax ladder): ONE seeded kill -9 ->
+# supervised restart -> recovery-inside-budget arc, with progcache
+# rewarm, cross-crash oracle replay parity and the `cli recovery`
+# render asserted end to end.
+crash-smoke:
+	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.crash_smoke
+
 # Static invariant check (docs/static_analysis.md, ~2s, pure AST — never
 # imports jax): determinism, host-sync discipline, donation safety,
 # recompile hazards, knob/doc drift, span + blackbox registries.
@@ -151,4 +175,4 @@ chaos-real:
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.cli \
 		explain --slo chaos_real_report.json
 
-.PHONY: check bench bench-smoke telemetry-smoke heat-smoke sched-smoke trace-smoke chaos chaos-real chaos-drift reshard-smoke lint perf-smoke bench-history watch-smoke forensics-smoke
+.PHONY: check bench bench-smoke telemetry-smoke heat-smoke sched-smoke trace-smoke chaos chaos-real chaos-drift chaos-crash reshard-smoke lint perf-smoke bench-history watch-smoke forensics-smoke crash-smoke

@@ -304,6 +304,7 @@ def main(argv=None):
     while_resharding = served_while_resharding_section()
     heat = conflict_heat_section()
     sched = conflict_scheduling_section()
+    recovery = recovery_section()
 
     print(json.dumps({
         "metric": "resolved_txns_per_sec_per_chip",
@@ -334,6 +335,7 @@ def main(argv=None):
         "served_while_resharding": while_resharding,
         "conflict_heat": heat,
         "conflict_scheduling": sched,
+        "recovery": recovery,
         "compile_memory": compile_memory,
         "profile": PROFILE,
         "device": str(dev),
@@ -888,6 +890,24 @@ def conflict_scheduling_section():
         from foundationdb_tpu.real.nemesis import run_conflict_scheduling
 
         return run_conflict_scheduling()
+    except Exception as e:  # noqa: BLE001 — a socketless/odd environment
+        #                     must not kill the chip bench
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def recovery_section():
+    """The crash-stop recovery economics (docs/fault_tolerance.md
+    "Crash-stop recovery"): cold vs progcache-warm rewarm of the bucket
+    ladder in fresh subprocesses (the >= 5x acceptance bar, zero warm
+    compiles), snapshot + differential journal replay vs full-journal
+    replay over the same recorded stream (parity witnessed on both
+    arms), and one real kill -9 campaign's measured recovery blackout
+    vs resolver_recovery_budget_ms. tools/recovery_bench.py owns the
+    methodology; wall-clock + CPU like the chaos siblings."""
+    try:
+        from foundationdb_tpu.tools.recovery_bench import run_recovery_bench
+
+        return run_recovery_bench()
     except Exception as e:  # noqa: BLE001 — a socketless/odd environment
         #                     must not kill the chip bench
         return {"error": f"{type(e).__name__}: {e}"}

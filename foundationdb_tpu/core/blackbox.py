@@ -50,12 +50,13 @@ from __future__ import annotations
 
 import os
 import struct
+import time
 import zlib
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
-from . import wire
+from . import buggify, wire
 from .trace import span_now
 
 #: allocation counter for the disabled-path regression guard (the
@@ -226,6 +227,44 @@ class BBSched:
 
 
 @dataclass
+class BBSnapshotEvt:
+    """One engine-state snapshot written beside the journal segments
+    (fault/recovery.py SnapshotManager): the recovery floor moves to
+    `version`, bounded by `entries` distinct-version write batches (the
+    handoff pre-copy coalescing, NOT history length)."""
+
+    version: int = 0
+    oldest: int = 0
+    entries: int = 0
+    bytes: int = 0
+    ms: float = 0.0
+    path: str = ""
+
+
+@dataclass
+class BBRecovery:
+    """One crash-stop recovery arc (fault/recovery.py recover()): where
+    the state came from (snapshot version + replayed journal suffix),
+    whether retained history fully covered the gap (`coverage_ok` /
+    `mode`), verdict parity of the differential replay, and the blackout
+    the restart cost — `cli recovery` renders exactly this record."""
+
+    mode: str = ""
+    coverage_ok: bool = True
+    snapshot_version: int = -1
+    recovered_version: int = -1
+    oldest: int = 0
+    snapshot_entries: int = 0
+    replayed_batches: int = 0
+    verdict_mismatches: int = 0
+    blackout_ms: float = 0.0
+    progcache_hits: int = 0
+    progcache_misses: int = 0
+    warm_ms: float = 0.0
+    error: Optional[str] = None
+
+
+@dataclass
 class BBWindow:
     """An injected fault / maintenance window (the nemesis' kinded
     records — partition, device_incident, reshard, warmup, ...)."""
@@ -253,6 +292,8 @@ BLACKBOX_EVENT_REGISTRY = {
     "heat": BBHeat,
     "fault_window": BBWindow,
     "sched": BBSched,
+    "snapshot": BBSnapshotEvt,
+    "recovery": BBRecovery,
 }
 
 for _cls in (BBEnvelope, *BLACKBOX_EVENT_REGISTRY.values()):
@@ -269,7 +310,9 @@ class BlackboxJournal:
                  max_segments: Optional[int] = None,
                  ring: Optional[int] = None,
                  now_fn=span_now, proc: str = "",
-                 fresh: bool = False):
+                 fresh: bool = False,
+                 fsync_interval: Optional[int] = None,
+                 disk: Optional[Any] = None):
         """`fresh=True` truncates any retained segments first — a
         campaign reusing a deterministic directory (`make chaos-drift`
         re-run) must not append a second event stream whose commit
@@ -300,6 +343,25 @@ class BlackboxJournal:
             else SERVER_KNOBS.resolver_blackbox_ring))
         self.events_written = 0
         self.dropped_errors = 0
+        #: fsync cadence (resolver_blackbox_fsync_interval): 0 = flush
+        #: per record only (the OS may buffer a crash-window tail); N>=1
+        #: = os.fsync every N records — acked implies durable at N=1
+        #: (docs/observability.md "crash-window contract")
+        self.fsync_interval = int(
+            fsync_interval if fsync_interval is not None
+            else SERVER_KNOBS.resolver_blackbox_fsync_interval)
+        self.fsyncs = 0
+        self.fsync_ms = 0.0
+        self._since_fsync = 0
+        #: optional DiskFaults hook (fault/inject.py) — the disk nemesis'
+        #: entry point into the journal's writes
+        self.disk = disk
+        #: shed-to-memory accounting: events the DISK refused but the
+        #: in-memory ring kept — live explain still sees them, and
+        #: summary() reports the durability gap honestly instead of
+        #: silently narrowing the journal's coverage
+        self.shed_events = 0
+        self.durability_gap = False
         #: whole-journal accounting for summary() — the ring is bounded,
         #: so kind counts and the version range are tracked at record()
         #: time, never derived from whatever the ring still holds
@@ -333,6 +395,17 @@ class BlackboxJournal:
         self._seg_bytes_written = self._file.tell()
 
     def _rotate(self) -> None:
+        if buggify.buggify():
+            # BUGGIFY: rotation mid-append — the process died after
+            # starting a frame but before completing it, then rotated on
+            # restart: the closed segment carries a torn junk tail every
+            # reader (read_segment, strict_parse, recovery replay) must
+            # absorb without losing the complete frames before it
+            try:
+                self._file.write(_FRAME.pack(1 << 20, 0) + b"\xde\xad")
+                self._file.flush()
+            except OSError:
+                pass
         self._file.close()
         self._seg_index += 1
         self._open_segment()
@@ -344,11 +417,66 @@ class BlackboxJournal:
                 self.dropped_errors += 1
                 break
 
+    def _flush(self) -> None:
+        """Flush, then fsync every `fsync_interval` records. fsync_ms is
+        wall-clock observability only (never journaled), so same-seed
+        byte-identical journals are unaffected."""
+        self._file.flush()
+        if self.fsync_interval > 0:
+            self._since_fsync += 1
+            if self._since_fsync >= self.fsync_interval:
+                t0 = time.perf_counter()
+                os.fsync(self._file.fileno())
+                self.fsync_ms += (time.perf_counter() - t0) * 1e3
+                self.fsyncs += 1
+                self._since_fsync = 0
+
+    def _append(self, data: bytes) -> bool:
+        """One framed record to the segment file; False = the disk did
+        not take it (the caller sheds the event to the memory ring)."""
+        try:
+            if self.disk is not None:
+                # the disk nemesis: may stall (sleep), raise ENOSPC, tear
+                # the write (OSError carrying the prefix that DID land),
+                # or bit-rot the payload in passing (crc catches at read)
+                data = self.disk.apply("journal", data)
+            if buggify.buggify():
+                # BUGGIFY: short write — only a prefix of the frame
+                # reaches the segment (the crash-mid-append shape); the
+                # reader must tolerate the torn tail and the journal must
+                # rotate so later records stay parseable
+                self._file.write(data[:max(1, len(data) // 2)])
+                self._file.flush()
+                raise OSError("buggify: short segment write")
+            self._file.write(data)
+            self._flush()
+            return True
+        except (OSError, ValueError) as e:
+            # ValueError covers a write on a file another layer already
+            # closed (teardown races, the nemesis killing the handle) —
+            # same shedding contract as a disk refusal
+            prefix = getattr(e, "prefix", None)
+            if prefix:
+                # a torn write persists the prefix that reached the disk
+                # before failing — exactly what the crc-framed reader
+                # tolerates (read_segment stops at the torn frame)
+                try:
+                    self._file.write(prefix)
+                    self._file.flush()
+                except (OSError, ValueError):
+                    pass
+            return False
+
     def record(self, kind: str, payload: Any, commit_version: int = -1,
                epoch: int = -1, shard: int = -1, trace_id: Any = None,
                proc: Optional[str] = None) -> None:
         """Append one event. Never raises into the caller: the journal is
-        observational — a full disk degrades forensics, not serving."""
+        observational — a full disk degrades forensics, not serving. A
+        write the disk refuses is SHED TO MEMORY: the bounded ring keeps
+        the envelope for live explain, `shed_events`/`durability_gap`
+        report the coverage hole honestly, and the on-disk sequence stays
+        contiguous (the shed event's seq is reused by the next durable
+        record, so strict_parse still proves no silent gaps)."""
         blackbox_allocations[0] += 1
         env = BBEnvelope(
             seq=self._seq, t=round(float(self.now_fn()), 6), kind=kind,
@@ -357,15 +485,24 @@ class BlackboxJournal:
             trace_id=trace_id, payload=payload)
         try:
             raw = wire.dumps(env)
-            self._file.write(_FRAME.pack(len(raw), zlib.crc32(raw)))
-            self._file.write(raw)
-            self._file.flush()
-        except (OSError, ValueError, TypeError):
+        except (ValueError, TypeError):
+            self.dropped_errors += 1
+            return
+        data = _FRAME.pack(len(raw), zlib.crc32(raw)) + raw
+        self.ring.append(env)
+        self._kind_counts[kind] = self._kind_counts.get(kind, 0) + 1
+        if kind == "batch":
+            v = int(payload.version)
+            self._v_min = v if self._v_min is None else min(self._v_min, v)
+            self._v_max = v if self._v_max is None else max(self._v_max, v)
+        if not self._append(data):
             # a failed write may have left a torn frame mid-segment, and
             # the reader stops at the first torn frame — rotate so later
             # records land in a fresh segment instead of appending
             # unreadably after the garbage
             self.dropped_errors += 1
+            self.shed_events += 1
+            self.durability_gap = True
             try:
                 self._rotate()
             except OSError:
@@ -373,13 +510,7 @@ class BlackboxJournal:
             return
         self._seq += 1
         self.events_written += 1
-        self._kind_counts[kind] = self._kind_counts.get(kind, 0) + 1
-        if kind == "batch":
-            v = int(payload.version)
-            self._v_min = v if self._v_min is None else min(self._v_min, v)
-            self._v_max = v if self._v_max is None else max(self._v_max, v)
-        self._seg_bytes_written += _FRAME.size + len(raw)
-        self.ring.append(env)
+        self._seg_bytes_written += len(data)
         if self._seg_bytes_written >= self.segment_bytes:
             self._rotate()
 
@@ -411,6 +542,14 @@ class BlackboxJournal:
             "kinds": dict(self._kind_counts),
             "version_range": ([self._v_min, self._v_max]
                               if self._v_min is not None else None),
+            # durability accounting (docs/observability.md "crash-window
+            # contract"): fsync cadence + cost, and the honest flag for
+            # events the disk refused but the memory ring kept
+            "fsyncs": self.fsyncs,
+            "fsync_ms": round(self.fsync_ms, 3),
+            "fsync_interval": self.fsync_interval,
+            "shed_events": self.shed_events,
+            "durability_gap": self.durability_gap,
         }
 
 
@@ -484,6 +623,14 @@ def active() -> Optional[BlackboxJournal]:
 
 def install(journal: BlackboxJournal) -> BlackboxJournal:
     _g[0] = journal
+    # the installed journal is the process's durable record — register
+    # its durability accounting with the telemetry hub (weakly, like
+    # every other source) so `blackbox.<label>.*` series exist wherever
+    # a journal is writing (docs/observability.md crash-window contract)
+    from . import telemetry
+
+    journal.label = telemetry.hub().register_blackbox(
+        journal, journal.proc or "blackbox")
     return journal
 
 
@@ -686,3 +833,41 @@ def record_window(w: Dict[str, Any]) -> None:
                       t0=float(w.get("t0", 0.0)),
                       t1=float(w.get("t1", w.get("t0", 0.0))),
                       detail=detail))
+
+
+def record_snapshot(version: int, oldest: int, entries: int,
+                    nbytes: int, ms: float, path: str = "") -> None:
+    """One engine-state snapshot written (fault/recovery.py): the
+    journaled marker recovery + `cli recovery` anchor the floor on."""
+    j = _g[0]
+    if j is None:
+        return
+    j.record("snapshot",
+             BBSnapshotEvt(version=int(version), oldest=int(oldest),
+                           entries=int(entries), bytes=int(nbytes),
+                           ms=round(float(ms), 3), path=path),
+             commit_version=int(version))
+
+
+def record_recovery(res: Dict[str, Any]) -> None:
+    """One completed crash-stop recovery arc (fault/recovery.py
+    RecoveryResult.as_dict()) — the record `cli recovery` renders."""
+    j = _g[0]
+    if j is None:
+        return
+    j.record("recovery",
+             BBRecovery(
+                 mode=str(res.get("mode", "")),
+                 coverage_ok=bool(res.get("coverage_ok", True)),
+                 snapshot_version=int(res.get("snapshot_version", -1)),
+                 recovered_version=int(res.get("recovered_version", -1)),
+                 oldest=int(res.get("oldest", 0)),
+                 snapshot_entries=int(res.get("snapshot_entries", 0)),
+                 replayed_batches=int(res.get("replayed_batches", 0)),
+                 verdict_mismatches=int(res.get("verdict_mismatches", 0)),
+                 blackout_ms=round(float(res.get("blackout_ms", 0.0)), 3),
+                 progcache_hits=int(res.get("progcache_hits", 0)),
+                 progcache_misses=int(res.get("progcache_misses", 0)),
+                 warm_ms=round(float(res.get("warm_ms", 0.0)), 3),
+                 error=res.get("error")),
+             commit_version=int(res.get("recovered_version", -1)))

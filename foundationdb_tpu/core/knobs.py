@@ -285,6 +285,37 @@ def _make_server_knobs() -> Knobs:
     k.init("resolver_blackbox_segments", 8)
     #: in-memory ring of recent envelopes for live explain / summaries
     k.init("resolver_blackbox_ring", 4096)
+    #: journal durability cadence: fsync the segment file every N records
+    #: (1 = every record: acked implies durable — the crash campaign's
+    #: child sets this so recovery never serves behind an ack). 0 keeps
+    #: today's contract: flush per record (no data buffered in process)
+    #: but no fsync — a power loss may eat the OS-buffered tail
+    #: (docs/observability.md "crash-window contract")
+    k.init("resolver_blackbox_fsync_interval", 0)
+    # Crash-stop recovery (fault/recovery.py; docs/fault_tolerance.md
+    # "Crash-stop recovery"). Deliberately no BUGGIFY randomizers: the
+    # snapshot writer is observational and the crash campaign stresses
+    # the recovery path directly.
+    #: engine-state snapshot cadence in commit versions: the recovery
+    #: manager writes a coalesced snapshot beside the journal segments
+    #: every this-many versions (0 disables snapshotting — recovery
+    #: falls back to full journal replay)
+    k.init("resolver_recovery_snapshot_interval", 5000)
+    #: recovery blackout SLO in ms: kill -> serving again, measured by
+    #: the recovery.blackout span — the crash campaign machine-asserts
+    #: every recovery under this (real/nemesis.py --crash)
+    k.init("resolver_recovery_budget_ms", 5000.0)
+    #: a recovery in flight longer than this is STALLED — the watchdog's
+    #: RecoveryStalledRule fires (core/watchdog.py)
+    k.init("resolver_recovery_stall_s", 10.0)
+    # On-disk AOT program cache (core/progcache.py). Deliberately no
+    # BUGGIFY randomizers: cache misses only cost a compile.
+    #: master switch: "" = off (every program compiles); "on" = cache
+    #: compiled artifacts under resolver_progcache_dir; any other value
+    #: is itself the cache directory
+    k.init("resolver_progcache", "")
+    #: cache directory when resolver_progcache is "on"
+    k.init("resolver_progcache_dir", "progcache")
     # Conflict-aware scheduler (pipeline/scheduler.py; docs/scheduling.md).
     # Deliberately no BUGGIFY randomizers: scheduling is deterministic
     # (counter-based probing, no rng) and the fully-off path must stay
@@ -384,6 +415,22 @@ def _make_server_knobs() -> Knobs:
     #: probability a fresh connection's handshake stalls (the peer accepts
     #: but never answers the hello; real_handshake_timeout_s must bound it)
     k.init("chaos_handshake_stall_prob", 0.05)
+    # Disk nemesis (fault/inject.py DiskFaults + real/chaos.py
+    # DiskNemesis): seeded fault mix for the durability surfaces — the
+    # journal writer, the snapshot writer and the program cache. All
+    # default 0: disk faults are campaign-armed, never ambient.
+    #: probability a durable write stalls (a slow/contended fsync)
+    k.init("chaos_disk_stall_prob", 0.0)
+    #: stall length in ms when the draw fires (uniform in [0.5x, 1.5x])
+    k.init("chaos_disk_stall_ms", 20.0)
+    #: probability a write is TORN: only a prefix reaches the disk and
+    #: the writer sees an IO error (the crash-mid-append shape)
+    k.init("chaos_disk_torn_prob", 0.0)
+    #: probability a write fails with ENOSPC (disk full)
+    k.init("chaos_disk_enospc_prob", 0.0)
+    #: probability a written payload suffers silent bit-rot (crc framing
+    #: must catch it at read time and quarantine the data)
+    k.init("chaos_disk_rot_prob", 0.0)
     #: wall-clock SLO scale: the chaos campaign's p99 budget is
     #: resolver_p99_budget_ms x this factor. The 2.5 ms budget prices a
     #: chip-adjacent resolver (sub-ms device time, in-rack RTT); the

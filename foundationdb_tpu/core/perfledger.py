@@ -127,6 +127,13 @@ class PerfLedger:
         self.peak_bytes = 0
         self.flops_total = 0
         self.bytes_accessed_total = 0
+        #: on-disk program-cache accounting (core/progcache.py): event
+        #: counts ("hit"/"miss"/"store") + bytes moved + wall ms — kept
+        #: OUT of `compiles` so a progcache-warm engine still reports
+        #: zero compiles
+        self.progcache: Dict[str, int] = {}
+        self.progcache_bytes = 0
+        self.progcache_ms = 0.0
 
     def record_compile(self, *, engine: str, bucket: int, n_chunks: int,
                        search_mode: str, dispatch_mode: str, kind: str,
@@ -157,6 +164,18 @@ class PerfLedger:
             self.bytes_accessed_total += analysis["bytes_accessed"]
         return rec
 
+    def record_progcache(self, *, engine: str, bucket: int, event: str,
+                         nbytes: int = 0, duration_ms: float = 0.0) -> None:
+        """File one on-disk program-cache event (`hit` / `miss` /
+        `store`, core/progcache.py). Deliberately NOT a compile record:
+        a cache hit is the absence of a compile, so it must not touch
+        `compiles` (the zero-steady-state-compile guard keeps its
+        meaning) or the pinned RECORD_FIELDS row schema."""
+        del engine, bucket  # keyed per-ledger already (one ledger/engine)
+        self.progcache[event] = self.progcache.get(event, 0) + 1
+        self.progcache_bytes += int(nbytes)
+        self.progcache_ms += float(duration_ms)
+
     def rows(self) -> List[dict]:
         return list(self.records)
 
@@ -170,5 +189,8 @@ class PerfLedger:
             "peak_bytes": self.peak_bytes,
             "flops_total": self.flops_total,
             "bytes_accessed_total": self.bytes_accessed_total,
+            "progcache": dict(sorted(self.progcache.items())),
+            "progcache_bytes": self.progcache_bytes,
+            "progcache_ms": round(self.progcache_ms, 1),
             "rows": list(self.records)[-max_rows:],
         }

@@ -1,0 +1,249 @@
+"""On-disk AOT compiled-program cache: restart-warm in milliseconds.
+
+The bucket-ladder engines (ops/host_engine.py, ops/device_loop.py) pay
+seconds of XLA compile per (bucket, scan-size) program at warmup — the
+price ROADMAP item 2 cites as the reason the spare pool exists, and the
+dominant term in a crash-restarted resolver's blackout. This module
+caches the compiled artifacts themselves on disk so a restarted,
+failed-over, or spare-pool resolver warms by LOADING, not recompiling.
+
+Mechanism: `jax.experimental.serialize_executable` round-trips the
+already-compiled executable (the serialized XLA binary plus its I/O
+pytree defs). Measured on the chaos ladder's top bucket this loads in
+~85 ms against a ~2 s cold trace+lower+compile — ~25x, against the 5x
+acceptance bar. (`jax.export` was evaluated and rejected for this cache:
+its round trip re-lowers through StableHLO and XLA-compiles on load, so
+a "hit" costs nearly as much as the miss it was meant to avoid.)
+
+Keying: `(backend fingerprint, engine kind, bucket, n_chunks, search
+mode, dispatch mode)` — the same tuple the perf ledger files compiles
+under. The backend fingerprint folds in the jax/jaxlib versions and the
+device platform/kind, so an artifact compiled by a different toolchain
+or for a different device NEVER loads: a stale key is a miss and the
+engine falls back to a normal compile (tests/test_recovery.py pins it).
+
+Durability discipline mirrors the black-box journal: entries are
+crc-framed (`FBPC` magic), verified by a decode round-trip BEFORE they
+are published (see `store`), written via tmp-file + atomic rename, and
+a poisoned entry (bit rot, torn write, version skew, unpickleable) is a
+MISS that quarantines the file — the serving path degrades to compile,
+never crashes. The `DiskFaults` hook (fault/inject.py) injects faults
+into exactly these writes under the crash campaign.
+
+Cost discipline: no cache installed = one list-index check in
+`_build_and_record`; hits/misses/bytes are filed through the engine's
+perf ledger (core/perfledger.py `record_progcache`), NOT the compile
+counters — the zero-post-warmup-steady-compile assertions keep their
+meaning, and a progcache-warm engine reports compiles == 0.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import struct
+import time
+import zlib
+from typing import Any, Dict, List, Optional
+
+#: entry file header: magic + format version
+MAGIC = b"FBPC"
+FORMAT_VERSION = 1
+_HEADER = MAGIC + bytes([FORMAT_VERSION])
+#: per-entry frame: little-endian (payload length, crc32 of payload)
+_FRAME = struct.Struct("<II")
+
+
+def backend_fingerprint() -> str:
+    """The toolchain + device identity a compiled artifact is only valid
+    for. Folded into every cache key so upgrading jax/jaxlib or moving
+    the directory to a different device kind turns every entry into a
+    clean miss (fall back to compile), never a wrong-artifact load."""
+    import jax
+    import jaxlib
+
+    dev = jax.devices()[0]
+    return "|".join((jax.__version__, jaxlib.__version__, dev.platform,
+                     str(getattr(dev, "device_kind", ""))))
+
+
+class ProgramCache:
+    """Content-addressed directory of serialized compiled executables."""
+
+    def __init__(self, directory: str, disk: Optional[Any] = None):
+        self.directory = str(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        #: optional DiskFaults hook (fault/inject.py) — the nemesis'
+        #: entry point into the cache's writes
+        self.disk = disk
+        self.stats: Dict[str, Any] = {
+            "hits": 0, "misses": 0, "stores": 0, "poisoned": 0,
+            "unverifiable": 0, "errors": 0, "hit_bytes": 0,
+            "store_bytes": 0, "load_ms": 0.0, "store_ms": 0.0,
+        }
+
+    # -- keying ---------------------------------------------------------------
+    def key(self, *, engine: str, bucket: int, n_chunks: int,
+            search_mode: str, dispatch_mode: str) -> str:
+        blob = "|".join(map(str, (backend_fingerprint(), engine, bucket,
+                                  n_chunks, search_mode, dispatch_mode)))
+        return hashlib.sha256(blob.encode()).hexdigest()[:40]
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.directory, f"{key}.prog")
+
+    # -- load -----------------------------------------------------------------
+    def load(self, key: str):
+        """The loaded, immediately-callable executable for `key`, or None
+        (miss). Any corruption — bad magic, torn frame, crc mismatch,
+        deserialize failure — quarantines the entry (unlinks it, counts
+        `poisoned`) and reports a miss: the caller compiles."""
+        path = self._path(key)
+        t0 = time.perf_counter()
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            self.stats["misses"] += 1
+            return None
+        try:
+            prog = self._decode(data)
+        except Exception:                       # poisoned entry, any shape
+            self.stats["poisoned"] += 1
+            self.stats["misses"] += 1
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return None
+        self.stats["hits"] += 1
+        self.stats["hit_bytes"] += len(data)
+        self.stats["load_ms"] += (time.perf_counter() - t0) * 1e3
+        return prog
+
+    @staticmethod
+    def _decode(data: bytes):
+        if len(data) < len(_HEADER) + _FRAME.size or \
+                data[:len(_HEADER)] != _HEADER:
+            raise ValueError("bad progcache header")
+        length, crc = _FRAME.unpack_from(data, len(_HEADER))
+        raw = data[len(_HEADER) + _FRAME.size:
+                   len(_HEADER) + _FRAME.size + length]
+        if len(raw) != length or zlib.crc32(raw) != crc:
+            raise ValueError("torn or rotted progcache entry")
+        from jax.experimental import serialize_executable
+
+        payload, in_tree, out_tree = pickle.loads(raw)
+        return serialize_executable.deserialize_and_load(
+            payload, in_tree, out_tree)
+
+    # -- store ----------------------------------------------------------------
+    def store(self, key: str, compiled) -> bool:
+        """Serialize `compiled` under `key` (tmp + atomic rename). Never
+        raises: a full disk, an unserializable program or an injected
+        disk fault degrade to a future compile, not a crash.
+
+        Every artifact is VERIFIED by decoding it back before it is
+        published: serialize_executable round-trips are not universally
+        self-contained — an executable jax itself loaded from its
+        persistent compilation cache re-serializes into bytes whose
+        deserialize fails with "Symbols not found" — and publishing such
+        an entry would poison every future restart's rewarm. An
+        unverifiable artifact is counted and dropped (the next boot
+        compiles); verification runs on the pre-fault bytes, so injected
+        bit rot is still discovered at read time by the crc, the
+        quarantine path the nemesis exercises."""
+        t0 = time.perf_counter()
+        try:
+            from jax.experimental import serialize_executable
+
+            payload, in_tree, out_tree = serialize_executable.serialize(
+                compiled)
+            raw = pickle.dumps((payload, in_tree, out_tree))
+        except Exception:
+            self.stats["errors"] += 1
+            return False
+        data = _HEADER + _FRAME.pack(len(raw), zlib.crc32(raw)) + raw
+        try:
+            self._decode(data)
+        except Exception:
+            self.stats["unverifiable"] += 1
+            self.stats["errors"] += 1
+            return False
+        path = self._path(key)
+        tmp = path + ".tmp"
+        try:
+            if self.disk is not None:
+                data = self.disk.apply("progcache", data)
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        except OSError:
+            self.stats["errors"] += 1
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return False
+        self.stats["stores"] += 1
+        self.stats["store_bytes"] += len(data)
+        self.stats["store_ms"] += (time.perf_counter() - t0) * 1e3
+        return True
+
+    # -- read model -----------------------------------------------------------
+    def entries(self) -> List[str]:
+        try:
+            return sorted(n for n in os.listdir(self.directory)
+                          if n.endswith(".prog"))
+        except OSError:
+            return []
+
+    def summary(self) -> dict:
+        s = dict(self.stats)
+        s["load_ms"] = round(s["load_ms"], 3)
+        s["store_ms"] = round(s["store_ms"], 3)
+        return {"dir": self.directory, "entries": len(self.entries()), **s}
+
+
+# -- process-global installation ----------------------------------------------
+#: the one installed cache (None = disabled: `_build_and_record` pays one
+#: list-index check and compiles exactly as before)
+_g: List[Optional[ProgramCache]] = [None]
+
+
+def enabled() -> bool:
+    return _g[0] is not None
+
+
+def active() -> Optional[ProgramCache]:
+    return _g[0]
+
+
+def install(cache: ProgramCache) -> ProgramCache:
+    _g[0] = cache
+    return cache
+
+
+def uninstall() -> Optional[ProgramCache]:
+    c, _g[0] = _g[0], None
+    return c
+
+
+def knob_directory() -> Optional[str]:
+    """The cache directory the `resolver_progcache` knob selects: None
+    when off ("" / "off"); `resolver_progcache_dir` when "on"; any other
+    value is itself the directory (the resolver_blackbox pattern)."""
+    from .knobs import SERVER_KNOBS
+
+    sel = str(SERVER_KNOBS.resolver_progcache or "").strip()
+    if not sel or sel.lower() == "off":
+        return None
+    return (str(SERVER_KNOBS.resolver_progcache_dir)
+            if sel.lower() == "on" else sel)
+
+
+def cache_from_knobs(disk: Optional[Any] = None) -> Optional[ProgramCache]:
+    directory = knob_directory()
+    if directory is None:
+        return None
+    return ProgramCache(directory, disk=disk)

@@ -94,6 +94,14 @@ class TelemetryHub:
         #: decision counters, probe/mispredict pair and lane gauges feed
         #: the `fdbtpu_sched` family and the sched_mispredict rule)
         self._scheds: Dict[str, "weakref.ref"] = {}
+        #: label -> weakref to BlackBoxJournal (core/blackbox.py —
+        #: durable-write accounting: events, fsync cadence cost, shed
+        #: events and the durability-gap flag)
+        self._blackboxes: Dict[str, "weakref.ref"] = {}
+        #: label -> weakref to RecoveryTracker (fault/recovery.py —
+        #: in-flight recovery age feeds the `recovery_stalled` rule,
+        #: completed arcs feed the blackout gauges)
+        self._recoveries: Dict[str, "weakref.ref"] = {}
         self._seq = 0
         #: bounded ring of recent nemesis/chaos events (real/chaos.py,
         #: real/nemesis.py) — rendered by `tools/cli.py chaos-status`
@@ -172,6 +180,32 @@ class TelemetryHub:
         label = self._label("sched", name)
         self._scheds[label] = weakref.ref(scheduler)
         return label
+
+    def register_blackbox(self, journal, name: str = "blackbox") -> str:
+        """A durable black-box journal (core/blackbox.py): event/fsync
+        counts, fsync wall cost and the shed-to-memory accounting
+        (`shed_events` / `durability_gap`), synced as
+        `blackbox.<label>.*` series — the crash-window contract's eyes
+        (docs/observability.md)."""
+        label = self._label("blackbox", name)
+        self._blackboxes[label] = weakref.ref(journal)
+        return label
+
+    def register_recovery(self, tracker, name: str = "recovery") -> str:
+        """A crash-stop recovery tracker (fault/recovery.py
+        RecoveryTracker): recovery counts, worst blackout and the
+        in-flight age the watchdog's `recovery_stalled` rule evaluates,
+        synced as `recovery.<label>.*` series."""
+        label = self._label("recovery", name)
+        self._recoveries[label] = weakref.ref(tracker)
+        return label
+
+    def recovery_source(self, label: str):
+        """The live RecoveryTracker registered under `label` (None if
+        collected) — the stalled-recovery rule reads its in-flight
+        detail through this to compose a speakable incident line."""
+        ref = self._recoveries.get(label)
+        return ref() if ref is not None else None
 
     def reshard_source(self, label: str):
         """The live controller registered under `label` (None if
@@ -394,6 +428,35 @@ class TelemetryHub:
                 int(b["concentration"] * 1000))
             td.int64(f"heat.{label}.top_range_share_x1000").set(
                 int(b["top_share"] * 1000))
+        for label, bb in self._live(self._blackboxes):
+            # durable-journal eyes (core/blackbox.py): event/segment
+            # counts, the knobbed fsync cadence's wall cost, and the
+            # shed-to-memory accounting — `durability_gap` reading 1
+            # means the on-disk suffix is honest-but-incomplete
+            td.int64(f"blackbox.{label}.events").set(
+                int(bb.events_written))
+            td.int64(f"blackbox.{label}.fsyncs").set(int(bb.fsyncs))
+            td.int64(f"blackbox.{label}.fsync_us").set(
+                int(bb.fsync_ms * 1000))
+            td.int64(f"blackbox.{label}.dropped_errors").set(
+                int(bb.dropped_errors))
+            td.int64(f"blackbox.{label}.shed_events").set(
+                int(bb.shed_events))
+            td.int64(f"blackbox.{label}.durability_gap").set(
+                1 if bb.durability_gap else 0)
+        for label, rt in self._live(self._recoveries):
+            # crash-stop recovery eyes (fault/recovery.py): completed
+            # and failed recoveries, the worst observed blackout, and
+            # the in-flight age the RecoveryStalledRule evaluates
+            td.int64(f"recovery.{label}.recoveries").set(
+                int(rt.recoveries))
+            td.int64(f"recovery.{label}.failures").set(int(rt.failures))
+            td.int64(f"recovery.{label}.in_flight").set(
+                1 if rt.in_flight() else 0)
+            td.int64(f"recovery.{label}.in_flight_age_us").set(
+                int(rt.in_flight_age_s() * 1e6))
+            td.int64(f"recovery.{label}.blackout_us_max").set(
+                int(rt.blackout_ms_max * 1000))
         # cluster watchdog (core/watchdog.py): evaluate the rule set over
         # the series refreshed above. The disabled path is this one
         # attribute check — no call, no allocation (the <5 µs/call
@@ -423,6 +486,15 @@ class TelemetryHub:
                         for label, rc in self._live(self._reshards)},
             "sched": {label: sch.snapshot()
                       for label, sch in self._live(self._scheds)},
+            "blackbox": {label: bb.summary()
+                         for label, bb in self._live(self._blackboxes)},
+            "recovery": {label: {"recoveries": rt.recoveries,
+                                 "failures": rt.failures,
+                                 "in_flight": rt.in_flight(),
+                                 "blackout_ms_max":
+                                     round(rt.blackout_ms_max, 3),
+                                 "last": rt.last}
+                         for label, rt in self._live(self._recoveries)},
             "watchdog": (self._watchdog.snapshot()
                          if self._watchdog is not None else None),
         }
@@ -460,6 +532,14 @@ class TelemetryHub:
                  "decision counters, probe vs mispredict pair, lane "
                  "depth, tracked predictor ranges; fractions are x1000 "
                  "fixed-point)",
+        "blackbox": "durable black-box journal gauges (core/blackbox.py: "
+                    "event/segment/fsync counts, fsync microseconds, "
+                    "shed-to-memory events; durability_gap=1 means the "
+                    "on-disk suffix is honest-but-incomplete)",
+        "recovery": "crash-stop recovery gauges (fault/recovery.py: "
+                    "recovery/failure counts, in-flight age, worst "
+                    "blackout microseconds — the recovery_stalled "
+                    "rule's series)",
     }
 
     @staticmethod

@@ -429,6 +429,48 @@ class ReshardStalledRule(AlertRule):
         return {**super().describe(), "pattern": self.pattern}
 
 
+class RecoveryStalledRule(AlertRule):
+    """A crash-stop recovery has been in flight longer than the
+    `resolver_recovery_stall_s` knob (fault/recovery.py's
+    RecoveryTracker publishes `recovery.<label>.in_flight_age_us`; a
+    completed — even failed — recovery resets it to 0, clearing the
+    alert). A stalled recovery is the worst blackout shape: the process
+    is up but serving nothing, which no liveness probe distinguishes
+    from warm. Fires immediately (hold 0): a wedged restart is a fact,
+    not a rate."""
+
+    kind = "recovery"
+
+    def __init__(self, name: str = "recovery_stalled",
+                 pattern: str = "recovery.*.in_flight_age_us", **kw):
+        kw.setdefault("hold_s", 0.0)
+        super().__init__(name, **kw)
+        self.pattern = pattern
+        self._rx = _pattern_re(pattern)
+
+    def conditions(self, t, view):
+        from .knobs import SERVER_KNOBS
+
+        stall_us = float(SERVER_KNOBS.resolver_recovery_stall_s) * 1e6
+        for series, caps in self._matches(view, self.pattern, self._rx):
+            v = view.value(series)
+            if v is None:
+                continue
+            active = v > stall_us
+            detail = (f"in flight {v / 1e6:.2f}s "
+                      f"(stall after {stall_us / 1e6:g}s)")
+            if active and view.hub is not None and caps:
+                rt = view.hub.recovery_source(caps[0])
+                if rt is not None:
+                    live = rt.in_flight_detail()
+                    if live:
+                        detail = f"{live} · {detail}"
+            yield (series, active, round(v / 1e6, 3), detail)
+
+    def describe(self):
+        return {**super().describe(), "pattern": self.pattern}
+
+
 class _AlertState:
     """Lifecycle state of one (rule, series) pair."""
 
@@ -555,6 +597,8 @@ def default_rules() -> List[AlertRule]:
         ThresholdRule("reshard_blackout",
                       "reshard.*.blackout_over_budget", 0, ">",
                       hold_s=0.0),
+        # -- crash-stop recovery (fault/recovery.py) ----------------------
+        RecoveryStalledRule("recovery_stalled"),
         # -- staleness/absence -------------------------------------------
         StalenessRule("commit_flow_stalled", "sli.*.total",
                       max_age_s=float(k.watchdog_staleness_s)),

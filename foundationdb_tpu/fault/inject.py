@@ -159,3 +159,111 @@ class FaultInjectingEngine:
                     else TransactionCommitResult.COMMITTED)
             verdicts[i] = flip
         return verdicts
+
+
+# -- disk faults ---------------------------------------------------------------
+
+class TornWrite(OSError):
+    """A write that persisted only a prefix before failing — the
+    crash-mid-append shape. `prefix` is what DID reach the disk; the
+    journal writes it so the crc-framed reader's torn-tail tolerance is
+    exercised against real torn bytes, not just truncated files."""
+
+    def __init__(self, prefix: bytes):
+        super().__init__("injected torn write")
+        self.prefix = prefix
+
+
+@dataclass
+class DiskFaultRates:
+    """Per-durable-write fault probabilities for the disk nemesis. All
+    zero by default (campaign-armed); `from_knobs()` reads the
+    `chaos_disk_*` family so campaigns steer injection by knob override,
+    the ChaosConfig pattern (real/chaos.py)."""
+
+    stall: float = 0.0
+    stall_ms: float = 20.0
+    torn: float = 0.0
+    enospc: float = 0.0
+    rot: float = 0.0
+
+    @classmethod
+    def from_knobs(cls) -> "DiskFaultRates":
+        from ..core.knobs import SERVER_KNOBS
+
+        return cls(
+            stall=float(SERVER_KNOBS.chaos_disk_stall_prob),
+            stall_ms=float(SERVER_KNOBS.chaos_disk_stall_ms),
+            torn=float(SERVER_KNOBS.chaos_disk_torn_prob),
+            enospc=float(SERVER_KNOBS.chaos_disk_enospc_prob),
+            rot=float(SERVER_KNOBS.chaos_disk_rot_prob))
+
+
+class DiskFaults:
+    """Seeded per-write fault decisions for the durability surfaces: the
+    black-box journal writer, the recovery snapshot writer and the AOT
+    program cache (the sim2 AsyncFileNonDurable role for OUR disk layer).
+
+    One `apply(surface, data)` call per durable write draws at most one
+    fault: a stall sleeps (a contended fsync), ENOSPC raises plain
+    OSError, a torn write raises `TornWrite` carrying the prefix that
+    landed, and bit-rot returns silently-corrupted bytes the crc framing
+    must catch at read time. Every injection is counted per (surface,
+    kind) and reported through `on_fault` — real/chaos.py's DiskNemesis
+    wires that to the telemetry hub's chaos.* counters and its kinded
+    fault-window log."""
+
+    def __init__(self, rates: Optional[DiskFaultRates] = None,
+                 rng: Optional[DeterministicRandom] = None,
+                 seed: int = 0, sleep_fn=None, on_fault=None):
+        self.rates = rates or DiskFaultRates()
+        self.rng = rng if rng is not None else DeterministicRandom(seed)
+        #: injected-fault counters keyed "surface.kind"
+        self.injected: dict = {}
+        self.on_fault = on_fault
+        if sleep_fn is None:
+            import time as _time
+
+            sleep_fn = _time.sleep
+        self._sleep = sleep_fn
+
+    def _draw(self) -> Optional[str]:
+        r = self.rates
+        x = self.rng.random01()
+        for kind, p in (("stall", r.stall), ("torn", r.torn),
+                        ("enospc", r.enospc), ("rot", r.rot)):
+            if x < p:
+                return kind
+            x -= p
+        return None
+
+    def _count(self, surface: str, kind: str) -> None:
+        key = f"{surface}.{kind}"
+        self.injected[key] = self.injected.get(key, 0) + 1
+        if self.on_fault is not None:
+            self.on_fault(surface, kind)
+
+    def apply(self, surface: str, data: bytes) -> bytes:
+        """Draw for one durable write of `data` to `surface`. Returns the
+        (possibly bit-rotted) bytes to write, sleeps through a stall, or
+        raises OSError/TornWrite. Callers must already treat any OSError
+        as a degraded write, never a crash."""
+        kind = self._draw()
+        if kind is None:
+            return data
+        self._count(surface, kind)
+        if kind == "stall":
+            self._sleep(self.rates.stall_ms
+                        * (0.5 + self.rng.random01()) / 1e3)
+            return data
+        if kind == "enospc":
+            raise OSError(28, f"injected ENOSPC on {surface}")
+        if kind == "torn":
+            raise TornWrite(bytes(data[:self.rng.random_int(
+                1, max(2, len(data)))]))
+        # rot: flip one bit in place — the write SUCCEEDS; only the crc
+        # framing at read time can tell, and it must quarantine, not crash
+        buf = bytearray(data)
+        i = self.rng.random_int(0, len(buf))
+        buf[i] ^= 1 << self.rng.random_int(0, 8)
+        return bytes(buf)

@@ -26,7 +26,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..core import error
+from ..core import error, progcache
 from ..core.keyshard import KeyShardMap
 from ..core.types import CommitTransaction, Key, TransactionCommitResult, Version
 from . import conflict_kernel as ck
@@ -810,18 +810,52 @@ class RoutedConflictEngineBase:
         build in the compile & memory ledger (core/perfledger.py):
         duration plus the compiled artifact's cost/memory analysis, keyed
         (bucket, search mode, dispatch mode), classified warmup vs
-        steady by the flag warmup() holds."""
+        steady by the flag warmup() holds.
+
+        When an on-disk program cache is installed (core/progcache.py)
+        the cache is consulted FIRST under the same key: a hit returns
+        the deserialized executable with no compile at all — filed as a
+        progcache hit, never a compile, so `perf.compiles` and the
+        zero-steady-state-compile guard keep their meaning — and a fresh
+        compile is stored back so the next restart warms by loading."""
+        search_mode = self.perf.search_modes.get(
+            bucket.max_txns, ck.resolved_history_search(bucket))
+        cache = progcache.active()
+        key = None
+        if cache is not None:
+            key = cache.key(engine=self.name, bucket=bucket.max_txns,
+                            n_chunks=n_chunks, search_mode=search_mode,
+                            dispatch_mode=self.dispatch_mode)
+            b0 = cache.stats["hit_bytes"]
+            t0 = time.perf_counter()
+            prog = cache.load(key)
+            if prog is not None:
+                self.perf_ledger.record_progcache(
+                    engine=self.name, bucket=bucket.max_txns,
+                    event="hit", nbytes=cache.stats["hit_bytes"] - b0,
+                    duration_ms=(time.perf_counter() - t0) * 1e3)
+                return prog
+            self.perf_ledger.record_progcache(
+                engine=self.name, bucket=bucket.max_txns, event="miss")
         t0 = time.perf_counter()
         prog = self._make_program(bucket, n_chunks)
         self.perf.compiles += 1
         self.perf_ledger.record_compile(
             engine=self.name, bucket=bucket.max_txns, n_chunks=n_chunks,
-            search_mode=self.perf.search_modes.get(
-                bucket.max_txns, ck.resolved_history_search(bucket)),
+            search_mode=search_mode,
             dispatch_mode=self.dispatch_mode,
             kind="warmup" if self._warming else "steady",
             duration_ms=(time.perf_counter() - t0) * 1e3,
             compiled=prog)
+        if cache is not None:
+            b0 = cache.stats["store_bytes"]
+            t0 = time.perf_counter()
+            if cache.store(key, prog):
+                self.perf_ledger.record_progcache(
+                    engine=self.name, bucket=bucket.max_txns,
+                    event="store",
+                    nbytes=cache.stats["store_bytes"] - b0,
+                    duration_ms=(time.perf_counter() - t0) * 1e3)
         return prog
 
     def _make_program(self, bucket: KernelConfig, n_chunks: int):

@@ -238,6 +238,84 @@ class ChaosTransport:
         self.inner.close()
 
 
+class DiskNemesis:
+    """Seeded disk-fault nemesis for the durability surfaces — the
+    campaign-facing wrapper over `fault/inject.DiskFaults`, shaped like
+    NetworkNemesis: one seeded decision engine per campaign, every
+    injection counted in the telemetry hub (`chaos.disk_*` counters +
+    event ring, so `cli chaos-status` renders them) and every stall
+    logged as a wall-clock window for SLO exclusion.
+
+    A DiskNemesis IS the `disk=` hook the black-box journal
+    (core/blackbox.py), the snapshot writer (fault/recovery.py) and the
+    AOT program cache (core/progcache.py) accept: `apply(surface, data)`
+    per durable write. The serving path's contract is that every fault
+    this injects degrades gracefully — shed-to-memory journaling, a
+    skipped snapshot, a compile instead of a cache hit — never a crash
+    or silent corruption (crc framing catches the bit-rot at read)."""
+
+    def __init__(self, seed: int, rates: Optional["object"] = None,
+                 surface_rates: Optional[Dict[str, "object"]] = None):
+        from ..fault.inject import DiskFaultRates, DiskFaults
+
+        self.seed = seed
+        self.rates = rates or DiskFaultRates.from_knobs()
+        #: every injected fault: {kind, surface, t0, t1} (stalls have
+        #: real width; point faults are zero-width windows)
+        self.windows: List[dict] = []
+        self.faults = DiskFaults(rates=self.rates, seed=seed,
+                                 on_fault=self._on_fault)
+        #: per-surface overrides: the crash campaign keeps the JOURNAL
+        #: surface stall-only (no record loss, so post-recovery replay
+        #: parity stays provable) while the snapshot and progcache
+        #: surfaces take the destructive kinds their readers must
+        #: tolerate by design (torn-tail fallback, poisoned-entry miss)
+        self._by_surface = {
+            s: DiskFaults(rates=r, seed=seed + 1 + i,
+                          on_fault=self._on_fault)
+            for i, (s, r) in enumerate(sorted(
+                (surface_rates or {}).items()))}
+        self.enabled = True
+
+    def _on_fault(self, surface: str, kind: str) -> None:
+        t0 = time.monotonic()
+        width = (self.rates.stall_ms / 1e3) if kind == "stall" else 0.0
+        self.windows.append({"kind": f"disk_{kind}", "surface": surface,
+                             "t0": t0, "t1": t0 + width})
+        telemetry.hub().chaos_event(f"disk_{kind}", surface=surface)
+
+    def apply(self, surface: str, data: bytes) -> bytes:
+        """The durable-write hook (see DiskFaults.apply): returns the
+        bytes to write (possibly bit-rotted), sleeps through a stall, or
+        raises OSError/TornWrite for the caller's degraded path."""
+        if not self.enabled:
+            return data
+        return self._by_surface.get(surface, self.faults).apply(
+            surface, data)
+
+    def fault_windows(self, pad_s: float = 0.0) -> List[Tuple[float, float]]:
+        """(t0, t1) of every injected disk window, padded backwards like
+        NetworkNemesis.fault_windows — a write submitted just before a
+        stall lands inside it."""
+        return [(w["t0"] - pad_s, w["t1"]) for w in self.windows]
+
+    def summary(self) -> dict:
+        """Campaign-report fragment: the seeded rates and what actually
+        got injected, per (surface, kind) — the `disk-fault incidents
+        explained` half of the chaos-crash acceptance gate."""
+        injected = dict(self.faults.injected)
+        for df in self._by_surface.values():
+            for k, n in df.injected.items():
+                injected[k] = injected.get(k, 0) + n
+        return {"seed": self.seed,
+                "rates": {"stall": self.rates.stall,
+                          "stall_ms": self.rates.stall_ms,
+                          "torn": self.rates.torn,
+                          "enospc": self.rates.enospc,
+                          "rot": self.rates.rot},
+                "injected": injected}
+
+
 def chaos_status_lines() -> List[str]:
     """Render this process's nemesis activity from the telemetry hub —
     the body of `tools/cli.py chaos-status` and the campaign's summary

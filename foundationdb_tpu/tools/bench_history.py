@@ -107,6 +107,16 @@ METRICS: Tuple[Metric, ...] = (
            higher_is_better=False, noise_frac=0.15),
     Metric("compile_memory", "steady_state_compiles",
            "post-warmup compiles", higher_is_better=False, noise_frac=0.0),
+    Metric("recovery", "rewarm.rewarm_speedup",
+           "progcache rewarm speedup", noise_frac=0.5),
+    Metric("recovery", "rewarm.warm.compiles",
+           "progcache-warm rewarm compiles", higher_is_better=False,
+           noise_frac=0.0),
+    Metric("recovery", "replay.speedup",
+           "snapshot vs full-journal replay", noise_frac=0.5),
+    Metric("recovery", "crash.blackout_ms",
+           "crash recovery blackout ms", higher_is_better=False,
+           noise_frac=0.5),
 )
 
 

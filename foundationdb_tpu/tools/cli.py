@@ -891,6 +891,58 @@ class Cli:
             for line in forensics.summarize(label, events):
                 self._print(line)
 
+    def do_recovery(self, args: List[str]) -> None:
+        """Crash-stop recovery workflows (docs/fault_tolerance.md
+        "Crash-stop recovery"): render the durable recovery arc — the
+        snapshot cadence, the last restart's mode/coverage/replay, its
+        blackout against the resolver_recovery_budget_ms knob and the
+        progcache rewarm — from a black-box journal directory or a
+        crash-campaign report JSON (the journaled `snapshot` /
+        `recovery` events ARE the source; nothing is recomputed)."""
+        from ..core.knobs import SERVER_KNOBS
+
+        if not args:
+            self._print("usage: recovery DIR_OR_REPORT.json")
+            return
+        rows = self._forensics_rows(args[0])
+        if rows is None:
+            return
+        budget = float(SERVER_KNOBS.resolver_recovery_budget_ms)
+        for label, events in rows:
+            snaps = [e for e in events if e.kind == "snapshot"]
+            recs = [e for e in events if e.kind == "recovery"]
+            self._print(f"  {label}: {len(snaps)} snapshot(s), "
+                        f"{len(recs)} recovery arc(s)")
+            if snaps:
+                s = snaps[-1].payload
+                ent = "entry" if s.entries == 1 else "entries"
+                self._print(
+                    f"    last snapshot v{s.version} (oldest {s.oldest}, "
+                    f"{s.entries} coalesced {ent}, {s.bytes} B, "
+                    f"{s.ms}ms)")
+            if not recs:
+                self._print("    no recovery recorded (the node never "
+                            "restarted into this journal)")
+                continue
+            r = recs[-1].payload
+            cov = ("ok" if r.coverage_ok
+                   else "DEGRADED (rotation ate the horizon)")
+            self._print(
+                f"    last recovery: mode={r.mode} coverage={cov} "
+                f"snapshot v{r.snapshot_version} + {r.replayed_batches} "
+                f"replayed batch(es) -> v{r.recovered_version}")
+            over = "" if r.blackout_ms <= budget else "  ** OVER BUDGET **"
+            self._print(
+                f"    blackout {r.blackout_ms}ms (budget {budget}ms"
+                f"{over}), warm {r.warm_ms}ms, progcache "
+                f"{r.progcache_hits} hit(s) / {r.progcache_misses} "
+                f"miss(es)")
+            if r.verdict_mismatches:
+                self._print(f"    ** {r.verdict_mismatches} VERDICT "
+                            "MISMATCH(ES) during replay **")
+            if r.error:
+                self._print(f"    ** recovery error: {r.error} **")
+
     def do_lint(self, args: List[str]) -> int:
         """Static invariant check (docs/static_analysis.md): run the
         fdbtpu-lint checkers over the repo — cluster-less, pure AST (never
@@ -1220,13 +1272,16 @@ def main(argv=None) -> int:
         cli = Cli.__new__(Cli)
         cli.out = sys.stdout
         return cli.do_bench_history(raw[1:])
-    if raw and raw[0].replace("-", "_") in ("explain", "blackbox"):
+    if raw and raw[0].replace("-", "_") in ("explain", "blackbox",
+                                            "recovery"):
         # pre-argparse pass-through: forensics owns its own flags
         # (--slo, --window) and reads journals/reports, never a cluster
         cli = Cli.__new__(Cli)
         cli.out = sys.stdout
         if raw[0].replace("-", "_") == "explain":
             cli.do_explain(raw[1:])
+        elif raw[0].replace("-", "_") == "recovery":
+            cli.do_recovery(raw[1:])
         else:
             cli.do_blackbox(raw[1:])
         return 0

@@ -104,7 +104,8 @@ def test_registry_records_wire_round_trip():
     core/wire.py — the journal format is exactly these schemas."""
     assert set(blackbox.BLACKBOX_EVENT_REGISTRY) == {
         "batch", "span", "health", "flight", "alert", "incident",
-        "reshard", "admission", "heat", "fault_window", "sched"}
+        "reshard", "admission", "heat", "fault_window", "sched",
+        "snapshot", "recovery"}
     for kind, cls in blackbox.BLACKBOX_EVENT_REGISTRY.items():
         rec = cls()
         env = blackbox.BBEnvelope(seq=3, t=1.5, kind=kind, payload=rec)

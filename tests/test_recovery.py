@@ -213,3 +213,296 @@ def test_locked_tlog_stays_locked_across_reboot():
         return True
 
     assert sim.run_until(sim.sched.spawn(scenario(), name="s"), until=60.0)
+
+
+# =============================================================================
+# -- crash-stop recovery: durable resolver restart from the black-box journal
+#    (fault/recovery.py, core/progcache.py; docs/fault_tolerance.md
+#    "Crash-stop recovery")
+# =============================================================================
+
+def _resilient_oracle():
+    """A supervised oracle engine (the shadow-carrying stack snapshots
+    and recovery operate on), with every device-fault rate zeroed."""
+    from foundationdb_tpu.fault.inject import FaultInjectingEngine, FaultRates
+    from foundationdb_tpu.fault.resilient import (
+        ResilienceConfig,
+        ResilientEngine,
+    )
+    from foundationdb_tpu.ops.oracle import OracleConflictEngine
+
+    injector = FaultInjectingEngine(
+        OracleConflictEngine(),
+        rates=FaultRates(exception=0, hang=0, slow=0, flip=0, outage=0))
+    return ResilientEngine(injector, ResilienceConfig(
+        dispatch_timeout=0.5, retry_budget=2, retry_backoff=0.02,
+        probe_rate=0.0, probation_batches=2, failover_min_batches=2))
+
+
+def _point_batches(n, pool, seed, start_v=0):
+    """Deterministic point read+write batches over a `r/NNN` pool."""
+    import random
+
+    from foundationdb_tpu.core.types import CommitTransaction, KeyRange
+
+    rng = random.Random(seed)
+    v = start_v
+    out = []
+    for _ in range(n):
+        v += rng.randrange(40, 120)
+        txns = []
+        for _ in range(rng.randrange(2, 6)):
+            t = CommitTransaction(
+                read_snapshot=max(0, v - rng.randrange(1, 400)))
+            k = b"r/%03d" % rng.randrange(pool)
+            t.read_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            t.write_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            txns.append(t)
+        out.append((txns, v, max(0, v - 2000)))
+    return out
+
+
+@pytest.fixture
+def crash_sim(tmp_path):
+    """A simulator plus clean journal/telemetry state, torn back down."""
+    from foundationdb_tpu.core import blackbox, buggify, telemetry
+    from foundationdb_tpu.core.trace import g_trace
+    from foundationdb_tpu.sim.loop import set_scheduler
+    from foundationdb_tpu.sim.simulator import Simulator
+
+    sim = Simulator(47)
+    buggify.disable()
+    g_trace.clear()
+    telemetry.reset()
+    blackbox.uninstall()
+    yield sim
+    blackbox.uninstall()
+    set_scheduler(None)
+    telemetry.reset()
+
+
+def test_recover_bit_parity_across_epoch_flip(crash_sim, tmp_path):
+    """Snapshot + differential journal replay converges to an engine
+    that CONTINUES the uninterrupted one's verdict stream bit-for-bit —
+    across a journal window containing a reshard epoch flip — with the
+    replayed verdicts diffed clean against the journaled ones."""
+    from foundationdb_tpu.core import blackbox
+    from foundationdb_tpu.fault import recovery
+
+    sim = crash_sim
+    blackbox.install(blackbox.BlackboxJournal(str(tmp_path)))
+    live = _resilient_oracle()
+    mgr = recovery.SnapshotManager(str(tmp_path), interval=400, proc="t")
+    stream = _point_batches(30, 64, seed=51)
+    flip_v = stream[14][1]
+    probes = _point_batches(8, 64, seed=52, start_v=stream[-1][1])
+
+    async def go():
+        for txns, v, old in stream:
+            verdicts = [int(x) for x in await live.resolve(txns, v, old)]
+            blackbox.record_batch(txns, v, old, verdicts,
+                                  epoch=(0 if v < flip_v else 1),
+                                  engine="oracle")
+            mgr.note_batch(live, v)
+            if v == flip_v:
+                op = type("Op", (), dict(
+                    id=1, kind="split", begin="", end=None,
+                    donor_sids=[0], recipient_sid=1, blackout_ms=3.0,
+                    error=None))()
+                blackbox.record_reshard(op, "flip", epoch=1,
+                                        flip_version=v)
+        assert mgr.stats["written"] >= 1, mgr.stats
+
+        fresh = _resilient_oracle()
+        res = await recovery.recover(fresh, str(tmp_path), warm=False)
+        assert res.error is None, res.error
+        assert res.mode == recovery.MODE_COMPLETE and res.coverage_ok
+        assert res.snapshot_version >= 0
+        assert res.replayed_batches > 0, res.as_dict()
+        assert res.verdict_mismatches == 0, res.mismatch_detail
+        assert res.recovered_version == stream[-1][1]
+        for txns, v, old in probes:
+            a = [int(x) for x in await live.resolve(txns, v, old)]
+            b = [int(x) for x in await fresh.resolve(txns, v, old)]
+            assert a == b, (v, a, b)
+        return True
+
+    assert sim.sched.run_until(sim.sched.spawn(go()), until=100000)
+    # the arc is durable: the journal retains snapshot + recovery events
+    events = blackbox.read_journal(str(tmp_path))
+    kinds = {e.kind for e in events}
+    assert "snapshot" in kinds and "recovery" in kinds
+    rec = [e for e in events if e.kind == "recovery"][-1].payload
+    assert rec.mode == "complete" and rec.verdict_mismatches == 0
+
+
+def test_torn_snapshot_tail_falls_back(crash_sim, tmp_path):
+    """A crash mid-snapshot leaves a torn newest file: read_snapshot
+    must reject it by crc and recovery must fall back to the previous
+    readable snapshot, still converging clean."""
+    from foundationdb_tpu.core import blackbox
+    from foundationdb_tpu.fault import recovery
+
+    sim = crash_sim
+    blackbox.install(blackbox.BlackboxJournal(str(tmp_path)))
+    live = _resilient_oracle()
+    stream = _point_batches(12, 48, seed=61)
+
+    async def go():
+        for txns, v, old in stream:
+            verdicts = [int(x) for x in await live.resolve(txns, v, old)]
+            blackbox.record_batch(txns, v, old, verdicts, engine="oracle")
+        snap = recovery.capture(live, proc="t")
+        acct = recovery.write_snapshot(str(tmp_path), snap)
+        assert acct is not None
+        with open(acct["path"], "rb") as f:
+            good = f.read()
+        torn = recovery.snapshot_path(str(tmp_path), snap.version + 999)
+        with open(torn, "wb") as f:
+            f.write(good[: len(good) // 2])
+        assert recovery.read_snapshot(torn) is None
+        latest = recovery.latest_snapshot(str(tmp_path))
+        assert latest is not None and latest.version == snap.version
+
+        fresh = _resilient_oracle()
+        res = await recovery.recover(fresh, str(tmp_path), warm=False)
+        assert res.error is None, res.error
+        assert res.mode == recovery.MODE_COMPLETE and res.coverage_ok
+        assert res.snapshot_version == snap.version
+        assert res.verdict_mismatches == 0, res.mismatch_detail
+        return True
+
+    assert sim.sched.run_until(sim.sched.spawn(go()), until=100000)
+
+
+def _no_jax_compile_cache():
+    """Context: disable jax's persistent compilation cache (tests
+    enable it globally in conftest). serialize_executable artifacts are
+    only self-contained for executables the process compiled itself —
+    progcache.store's verification would (correctly) refuse everything
+    under a warm jax cache, leaving these tests nothing to load."""
+    import contextlib
+
+    import jax
+
+    from jax._src import compilation_cache
+
+    @contextlib.contextmanager
+    def ctx():
+        prev = jax.config.jax_compilation_cache_dir
+        jax.config.update("jax_compilation_cache_dir", None)
+        # the config update alone is not enough: jax initializes its
+        # cache singleton at most once per process, so any compile that
+        # already ran under the conftest cache dir (even a trivial
+        # dtype-convert jit from building test inputs) pins the cache ON
+        # and later compiles HIT it — handing this test deserialized
+        # executables that store-verification correctly refuses
+        compilation_cache.reset_cache()
+        try:
+            yield
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
+            compilation_cache.reset_cache()
+    return ctx()
+
+
+def test_progcache_warm_identical_verdicts_zero_compiles(tmp_path):
+    """A progcache-warm engine rewarms by LOADING: zero compiles at
+    warmup and zero after, serving verdicts bit-identical to the cold
+    engine that populated the cache."""
+    pytest.importorskip("jax")
+    from foundationdb_tpu.core import progcache as pc
+    from foundationdb_tpu.ops.conflict_kernel import KernelConfig
+    from foundationdb_tpu.ops.host_engine import JaxConflictEngine
+
+    # a kernel shape no other test compiles: jax's in-process executable
+    # cache would otherwise hand this test an executable another test
+    # DESERIALIZED (from the progcache or jax's own persistent cache),
+    # which store-verification correctly refuses to re-serialize
+    cfg = KernelConfig(key_words=4, capacity=256, max_reads=32,
+                       max_writes=32, max_txns=16)
+    stream = _point_batches(8, 48, seed=71)
+    with _no_jax_compile_cache():
+        pc.uninstall()
+        pc.install(pc.ProgramCache(str(tmp_path)))
+        try:
+            cold = JaxConflictEngine(cfg).warmup()
+            assert cold.perf.compiles > 0
+            stats = pc.active().stats
+            assert stats["stores"] >= 1 and stats["hits"] == 0, stats
+            assert stats["unverifiable"] == 0, stats
+            c0 = cold.perf.compiles
+            cold_out = [[int(x) for x in cold.resolve(t, v, o)]
+                        for t, v, o in stream]
+            assert cold.perf.compiles == c0  # zero steady-state
+
+            warm = JaxConflictEngine(cfg).warmup()
+            assert warm.perf.compiles == 0, \
+                "progcache-warm engine recompiled"
+            assert pc.active().stats["hits"] >= 1
+            warm_out = [[int(x) for x in warm.resolve(t, v, o)]
+                        for t, v, o in stream]
+            assert warm_out == cold_out
+            assert warm.perf.compiles == 0
+        finally:
+            pc.uninstall()
+
+
+def test_progcache_stale_key_falls_back_to_compile(tmp_path, monkeypatch):
+    """A stale cache key (different toolchain/device fingerprint) is a
+    clean MISS: the engine compiles, never loads a wrong artifact, and
+    the old entries are left in place (not quarantined)."""
+    pytest.importorskip("jax")
+    from foundationdb_tpu.core import progcache as pc
+    from foundationdb_tpu.ops.conflict_kernel import KernelConfig
+    from foundationdb_tpu.ops.host_engine import JaxConflictEngine
+
+    # unique kernel shape, same reason as the zero-compiles test above
+    cfg = KernelConfig(key_words=4, capacity=256, max_reads=32,
+                       max_writes=32, max_txns=8)
+    with _no_jax_compile_cache():
+        pc.uninstall()
+        pc.install(pc.ProgramCache(str(tmp_path)))
+        try:
+            JaxConflictEngine(cfg).warmup()
+            old_entries = set(pc.active().entries())
+            assert old_entries
+        finally:
+            pc.uninstall()
+
+        pc.install(pc.ProgramCache(str(tmp_path)))
+        monkeypatch.setattr(pc, "backend_fingerprint",
+                            lambda: "other-jax|0.0.0|tpu|v9")
+        try:
+            eng = JaxConflictEngine(cfg).warmup()
+            assert eng.perf.compiles > 0  # fell back to compile
+            stats = pc.active().stats
+            assert stats["hits"] == 0 and stats["misses"] >= 1, stats
+            # stale entries stay (a future boot with the right
+            # toolchain still loads them); new keys stored beside them
+            assert old_entries <= set(pc.active().entries())
+        finally:
+            pc.uninstall()
+
+
+def test_kill9_demo_child_recovers_e2e(tmp_path):
+    """The whole arc against a REAL process: a recoverable commit-server
+    child (oracle engine — fast boot, supervised so snapshots work) is
+    killed -9 mid-load, monitor.Child supervises it back up, and the
+    restart recovers from snapshot + journal inside budget with the
+    cross-crash oracle replay bit-identical (assert_crash_slos)."""
+    from foundationdb_tpu.real.nemesis import (
+        assert_crash_slos,
+        crash_config,
+        run_crash_campaign,
+    )
+
+    cfg = crash_config(31, engine_mode="oracle",
+                       datadir=str(tmp_path / "node0"),
+                       warm_s=1.5, post_s=0.8, rate_tps=80.0)
+    rep = run_crash_campaign(cfg)
+    assert_crash_slos(rep, cfg)
+    rec = rep["recovery"]
+    assert rec["error"] is None and rec["mode"] == "complete"
+    assert rep["child_restarts"] >= 1
+    assert rep["parity_checked"] > 0 and rep["parity_mismatches"] == 0
