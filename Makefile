@@ -95,6 +95,18 @@ bench-history:
 reshard-smoke:
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.reshard_smoke
 
+# Measured-mesh smoke (docs/perf.md "Measured mesh resolution", ~45s,
+# solo-CPU safe — one process, no sockets, do not overlap with tier-1):
+# forces 8 XLA host devices and drives the mesh engine's full
+# split -> exchange -> apply arc on REAL jax engines behind an elastic
+# group: oracle parity live and via journal replay across a device-shard
+# epoch flip, blocking_syncs == 0 in the overlapped exchange ring, zero
+# post-warmup compiles, measured exchange intervals + device view,
+# measured-split adoption from a skewed heat histogram, and a strict
+# parse of the fdbtpu_mesh Prometheus family.
+mesh-smoke:
+	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.mesh_smoke
+
 # Diurnal drift campaigns (docs/elasticity.md): the live-elasticity SLO
 # gate — 2 seeds x {jax, device_loop} wall-clock campaigns where the hot
 # range DRIFTS across the keyspace while the heat-driven controller
@@ -175,4 +187,4 @@ chaos-real:
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.cli \
 		explain --slo chaos_real_report.json
 
-.PHONY: check bench bench-smoke telemetry-smoke heat-smoke sched-smoke trace-smoke chaos chaos-real chaos-drift chaos-crash reshard-smoke lint perf-smoke bench-history watch-smoke forensics-smoke crash-smoke
+.PHONY: check bench bench-smoke telemetry-smoke heat-smoke sched-smoke trace-smoke chaos chaos-real chaos-drift chaos-crash reshard-smoke mesh-smoke lint perf-smoke bench-history watch-smoke forensics-smoke crash-smoke

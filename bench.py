@@ -299,6 +299,7 @@ def main(argv=None):
     e2e_pipelined = CFG.max_txns / (max(device_ms_per_batch, host_pack_ms) / 1e3)
     native_cpu = native_baseline_txns_per_sec()
     sharded = sharded_cpu_numbers()
+    sharded_measured = sharded_measured_numbers()
     floor = history_floor_section()
     chaos_served = served_under_chaos_section()
     while_resharding = served_while_resharding_section()
@@ -324,6 +325,7 @@ def main(argv=None):
         "native_cpu_txns_per_sec": native_cpu,
         "vs_native_cpu": round(txns_per_sec / native_cpu, 2) if native_cpu else None,
         "sharded_cpu_mesh": sharded,
+        "sharded_measured": sharded_measured,
         "sharded_tpu_weak_scale": weak8,
         "bucket_ladder": ladder,
         "history_floor": floor,
@@ -356,7 +358,10 @@ WEAK8_CFG = ck.KernelConfig(
 )
 #: ICI collective budget per batch for the extrapolation: one [T] i32
 #: hist-hits psum + ~5 fixpoint rounds of [T] i32 blocked counts = 6 x
-#: (64KB / ~45GB/s per v5e ICI link + ~20us launch+latency) — rounded UP
+#: (64KB / ~45GB/s per v5e ICI link + ~20us launch+latency) — rounded UP.
+#: An ESTIMATE, used only by the chip-era weak-scale extrapolation; the
+#: `sharded_measured` section carries the MEASURED per-psum collective
+#: at each mesh width on this machine's platform (tools/mesh_bench.py).
 WEAK8_COLLECTIVE_MS = 0.15
 
 
@@ -934,6 +939,38 @@ def sharded_cpu_numbers():
     try:
         r = subprocess.run(
             [sys.executable, "-m", "foundationdb_tpu.tools.sharded_bench"],
+            capture_output=True, timeout=900, env=env, text=True,
+        )
+        if r.returncode != 0:
+            return None
+        return json.loads(r.stdout.strip().splitlines()[-1])
+    except Exception:
+        return None
+
+
+def sharded_measured_numbers():
+    """The MEASURED mesh-resolution numbers (parallel/mesh_engine.py):
+    per-width scan/exchange intervals from the engine's own result-ring
+    stamps, a dedicated AOT psum-chain collective measurement at each
+    mesh width (replacing sharded_tpu_weak_scale's estimated 0.15 ms ICI
+    figure with a measured one — on CPU it measures the XLA host
+    collective, tagged by platform so bench_history never compares it
+    against chip-era estimates), oracle parity at every width, and the
+    overlapped-vs-serialized A/B the double-buffered exchange ring must
+    win. Runs tools/mesh_bench.py as a subprocess with 8 forced host
+    devices; returns its JSON dict or None."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "foundationdb_tpu.tools.mesh_bench"],
             capture_output=True, timeout=900, env=env, text=True,
         )
         if r.returncode != 0:

@@ -49,6 +49,23 @@ class KeyShardMap:
         splits = [bytes([(256 * i) // n_shards]) for i in range(1, n_shards)]
         return KeyShardMap(splits)
 
+    @staticmethod
+    def from_split_points(splits: Sequence[Key],
+                          n_shards: int) -> "KeyShardMap":
+        """An n_shards-way map from MEASURED split keys — what the mesh
+        engine adopts from KeyRangeHeatAggregator.split_points(). The
+        aggregator's proposals are best-effort (an empty or one-hot heat
+        histogram can emit duplicate, empty or too-few keys), so this
+        sanitizes: sorted, deduplicated, non-empty keys only; anything
+        short of the n_shards - 1 boundaries a full map needs falls back
+        to the byte-uniform split — a cold engine starts uniform and
+        adopts measured splits on the next (re)build, it never crashes on
+        a degenerate histogram."""
+        clean = sorted({bytes(k) for k in splits if k})
+        if len(clean) != max(int(n_shards), 1) - 1:
+            return KeyShardMap.uniform(n_shards)
+        return KeyShardMap(clean)
+
     def span_end(self, s: int) -> Optional[Key]:
         return self.begins[s + 1] if s + 1 < self.n_shards else None
 

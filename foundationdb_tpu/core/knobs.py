@@ -168,6 +168,27 @@ def _make_server_knobs() -> Knobs:
     #: host/dispatch time. Deliberately no BUGGIFY randomizer: the modes
     #: are proven equivalent directly, and a draw would shift sim rng.
     k.init("resolver_device_loop", "")
+    # Measured multi-device mesh resolution (docs/perf.md "Measured mesh
+    # resolution"). Deliberately no BUGGIFY randomizers: the mesh modes
+    # are proven verdict-identical to the serial oracle directly
+    # (tests/test_mesh_parity.py) and a randomizer draw would shift
+    # every sim's rng stream.
+    #: devices the mesh engine spans: 0 = every visible XLA device; an
+    #: explicit N takes the first N. Tests and `make mesh-smoke` force 8
+    #: virtual CPU devices via XLA_FLAGS=--xla_force_host_platform_
+    #: device_count=8, so mesh shapes are exercised without hardware.
+    k.init("resolver_mesh_devices", 0)
+    #: dispatch units the mesh result ring holds before the host drains
+    #: the oldest — the double buffer: 2 keeps one batch's exchange
+    #: collectives draining while the next batch's shard-local scan is
+    #: already dispatched (parallel/mesh_engine.py)
+    k.init("resolver_mesh_queue_depth", 2)
+    #: "on" (default): overlapped dispatch — scan/exchange enqueue
+    #: async, results drain through the non-blocking ring; "serial"
+    #: forces every dispatch unit's outputs before the next enqueue (the
+    #: A/B baseline tools/mesh_bench.py records as serialized_ms —
+    #: overlapped must beat it)
+    k.init("resolver_mesh_overlap", "on")
     # Observability (docs/observability.md).
     #: commit-path span collection (core/trace.py): 0 disables span
     #: recording entirely — instrumented sites pay one attribute check and

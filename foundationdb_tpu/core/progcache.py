@@ -58,13 +58,23 @@ def backend_fingerprint() -> str:
     """The toolchain + device identity a compiled artifact is only valid
     for. Folded into every cache key so upgrading jax/jaxlib or moving
     the directory to a different device kind turns every entry into a
-    clean miss (fall back to compile), never a wrong-artifact load."""
+    clean miss (fall back to compile), never a wrong-artifact load.
+
+    The visible DEVICE COUNT is part of the identity: a shard_map program
+    compiled against an 8-device mesh embeds that topology in the
+    executable, and serving it to a resolver restarted with 1 visible
+    device (or vice versa) would be a wrong-artifact load, not a slower
+    one (tests/test_progcache_mesh.py flips
+    xla_force_host_platform_device_count across processes and pins the
+    clean miss)."""
     import jax
     import jaxlib
 
-    dev = jax.devices()[0]
+    devs = jax.devices()
+    dev = devs[0]
     return "|".join((jax.__version__, jaxlib.__version__, dev.platform,
-                     str(getattr(dev, "device_kind", ""))))
+                     str(getattr(dev, "device_kind", "")),
+                     f"ndev{len(devs)}"))
 
 
 class ProgramCache:
@@ -84,9 +94,18 @@ class ProgramCache:
 
     # -- keying ---------------------------------------------------------------
     def key(self, *, engine: str, bucket: int, n_chunks: int,
-            search_mode: str, dispatch_mode: str) -> str:
+            search_mode: str, dispatch_mode: str, mesh: str = "",
+            variant: str = "") -> str:
+        """`mesh` is the engine's sharding-layout fingerprint
+        (RoutedConflictEngineBase._progcache_fingerprint): "" for the
+        single-device families, "mesh:<S>/<ndev>"-shaped for engines whose
+        programs bake a device mesh — two engines whose programs differ
+        only in mesh topology must never share an entry. `variant` names
+        one program of a multi-program dispatch unit (the mesh engine's
+        split "scan" / "exchange" pair under one (bucket, n_chunks))."""
         blob = "|".join(map(str, (backend_fingerprint(), engine, bucket,
-                                  n_chunks, search_mode, dispatch_mode)))
+                                  n_chunks, search_mode, dispatch_mode,
+                                  mesh, variant)))
         return hashlib.sha256(blob.encode()).hexdigest()[:40]
 
     def _path(self, key: str) -> str:

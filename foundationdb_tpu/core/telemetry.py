@@ -77,6 +77,10 @@ class TelemetryHub:
         #: label -> weakref to DeviceLoopEngine (queue/ring gauges —
         #: ops/device_loop.py loop_stats + occupancy)
         self._loops: Dict[str, "weakref.ref"] = {}
+        #: label -> weakref to MeshShardedConflictEngine (device-mesh
+        #: gauges — parallel/mesh_engine.py mesh_stats + ring drain
+        #: accounting)
+        self._meshes: Dict[str, "weakref.ref"] = {}
         #: label -> weakref to KeyRangeHeatAggregator (core/heatmap.py —
         #: keyspace heat, occupancy headroom, split planning)
         self._heat: Dict[str, "weakref.ref"] = {}
@@ -141,6 +145,16 @@ class TelemetryHub:
         sync-accounting counters, synced as `loop.<label>.*` series."""
         label = self._label("loop", name)
         self._loops[label] = weakref.ref(engine)
+        return label
+
+    def register_mesh(self, engine, name: str = "mesh") -> str:
+        """A multi-device mesh engine's topology + exchange gauges
+        (parallel/mesh_engine.py): device count, per-shard table bytes,
+        the measured cross-shard exchange interval and the same
+        non-blocking drain accounting as the device loop, synced as
+        `mesh.<label>.*` series (the `fdbtpu_mesh` exposition family)."""
+        label = self._label("mesh", name)
+        self._meshes[label] = weakref.ref(engine)
         return label
 
     def register_perf_ledger(self, ledger, name: str = "perf") -> str:
@@ -396,6 +410,25 @@ class TelemetryHub:
             td.int64(f"loop.{label}.ring_depth").set(eng.ring_depth())
             td.int64(f"loop.{label}.slots_in_flight").set(
                 eng.slots_in_flight())
+        for label, eng in self._live(self._meshes):
+            # mesh eyes (parallel/mesh_engine.py): the device topology,
+            # per-shard table residency, the measured exchange interval
+            # and the same sync accounting as the loop family —
+            # blocking_syncs must read 0 on any healthy scrape
+            st = eng.loop_stats
+            for key in ("enqueued_chunks", "units", "drained_nonblocking",
+                        "forced_waits", "blocking_syncs"):
+                td.int64(f"mesh.{label}.{key}").set(int(st.get(key, 0)))
+            td.int64(f"mesh.{label}.wait_us").set(
+                int(st.get("wait_ms", 0.0) * 1000))
+            td.int64(f"mesh.{label}.ring_depth").set(eng.ring_depth())
+            ms = eng.mesh_stats
+            td.int64(f"mesh.{label}.n_devices").set(int(ms["n_devices"]))
+            td.int64(f"mesh.{label}.exchanges").set(int(ms["exchanges"]))
+            td.int64(f"mesh.{label}.table_bytes_per_shard").set(
+                int(ms["table_bytes_per_shard"]))
+            td.int64(f"mesh.{label}.last_collective_us").set(
+                int(ms.get("last_collective_ms", 0.0) * 1000))
         for label, led in self._live(self._perf_ledgers):
             # compile & memory ledger (core/perfledger.py): warmup/steady
             # compile counts + total build time, the cost-analysis
@@ -476,6 +509,8 @@ class TelemetryHub:
                        for label, eng in self._live(self._health)},
             "loops": {label: eng.loop_stats_snapshot()
                       for label, eng in self._live(self._loops)},
+            "meshes": {label: eng.mesh_stats_snapshot()
+                       for label, eng in self._live(self._meshes)},
             "heat": {label: agg.snapshot()
                      for label, agg in self._live(self._heat)},
             "perf_ledgers": {label: led.snapshot()
@@ -511,6 +546,9 @@ class TelemetryHub:
                     "(fault/resilient.py)",
         "loop": "device-resident loop queue/ring gauges "
                 "(ops/device_loop.py; blocking_syncs must be 0)",
+        "mesh": "multi-device mesh engine gauges (parallel/mesh_engine"
+                ".py: device topology, per-shard table bytes, measured "
+                "exchange interval; blocking_syncs must be 0)",
         "heat": "keyspace heat & history-occupancy gauges "
                 "(core/heatmap.py; fractions are x1000 fixed-point)",
         "perf": "compile & memory ledger gauges (core/perfledger.py: "

@@ -805,12 +805,24 @@ class RoutedConflictEngineBase:
             self._programs[key] = prog
         return prog
 
-    def _build_and_record(self, bucket: KernelConfig, n_chunks: int):
+    def _progcache_fingerprint(self) -> str:
+        """The sharding-layout half of the progcache key (core/progcache
+        `key(mesh=)`): "" for single-device engines; mesh-backed engines
+        override with their device topology so a program compiled against
+        one mesh shape is never served to another. The device COUNT of
+        the process itself rides `backend_fingerprint()`."""
+        return ""
+
+    def _build_and_record(self, bucket: KernelConfig, n_chunks: int,
+                          variant: str = "", make=None):
         """Build one program, bump the compile counter, and file the
         build in the compile & memory ledger (core/perfledger.py):
         duration plus the compiled artifact's cost/memory analysis, keyed
         (bucket, search mode, dispatch mode), classified warmup vs
-        steady by the flag warmup() holds.
+        steady by the flag warmup() holds. `variant` + `make` let an
+        engine whose dispatch unit is a PAIR of programs (the mesh
+        engine's split scan/exchange) build each half under its own
+        progcache key; the default is the engine's one `_make_program`.
 
         When an on-disk program cache is installed (core/progcache.py)
         the cache is consulted FIRST under the same key: a hit returns
@@ -825,7 +837,9 @@ class RoutedConflictEngineBase:
         if cache is not None:
             key = cache.key(engine=self.name, bucket=bucket.max_txns,
                             n_chunks=n_chunks, search_mode=search_mode,
-                            dispatch_mode=self.dispatch_mode)
+                            dispatch_mode=self.dispatch_mode,
+                            mesh=self._progcache_fingerprint(),
+                            variant=variant)
             b0 = cache.stats["hit_bytes"]
             t0 = time.perf_counter()
             prog = cache.load(key)
@@ -838,7 +852,7 @@ class RoutedConflictEngineBase:
             self.perf_ledger.record_progcache(
                 engine=self.name, bucket=bucket.max_txns, event="miss")
         t0 = time.perf_counter()
-        prog = self._make_program(bucket, n_chunks)
+        prog = (make or self._make_program)(bucket, n_chunks)
         self.perf.compiles += 1
         self.perf_ledger.record_compile(
             engine=self.name, bucket=bucket.max_txns, n_chunks=n_chunks,
@@ -1816,11 +1830,13 @@ class JaxConflictEngine(RoutedConflictEngineBase):
 
 #: the engine-mode router: every device-backed ConflictSet family by its
 #: serving mode — "jax" (single chip, step dispatch), "subsharded" (S
-#: key-range sub-shards on one device), "sharded" (multi-chip mesh),
+#: key-range sub-shards on one device), "sharded" (multi-chip mesh, jit
+#: + blocking force), "mesh" (multi-chip mesh, AOT split scan/exchange
+#: with the overlapped result-ring drain; parallel/mesh_engine.py),
 #: "device_loop" (single chip, device-resident server loop;
 #: ops/device_loop.py). make_engine resolves lazily so importing this
 #: module never pulls the mesh or loop machinery.
-ENGINE_MODES = ("jax", "subsharded", "sharded", "device_loop")
+ENGINE_MODES = ("jax", "subsharded", "sharded", "mesh", "device_loop")
 
 
 def default_engine_mode() -> str:
@@ -1842,6 +1858,10 @@ def make_engine(mode: str, cfg: KernelConfig, **kw):
         from ..parallel.sharding import ShardedConflictEngine
 
         return ShardedConflictEngine(cfg, **kw)
+    if mode == "mesh":
+        from ..parallel.mesh_engine import MeshShardedConflictEngine
+
+        return MeshShardedConflictEngine(cfg, **kw)
     if mode == "device_loop":
         from .device_loop import DeviceLoopEngine
 

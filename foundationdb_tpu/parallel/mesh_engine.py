@@ -1,0 +1,553 @@
+"""Measured multi-device mesh resolution with overlapped exchange.
+
+`ShardedConflictEngine` (parallel/sharding.py) proved the shard_map math:
+one fused program per batch, verdicts a pure function of psum'd [T]
+planes. But it is jit-served (compiles can stall steady state on a
+restarted resolver) and its force() blocks the host on every batch, so
+the cross-shard collective cost had to be ESTIMATED in the bench
+(BENCH_r05's 0.15 ms). This module is the mesh path grown to the full
+single-chip treatment, so the protocol costs become measured:
+
+  * SPLIT dispatch unit: phase-1 scans are one shard-LOCAL program
+    (make_mesh_scan_step — no collective anywhere), the cross-shard
+    abort-set/witness exchange plus commit fixpoint plus apply is a
+    second program (make_mesh_exchange_step). Both are AOT
+    `.lower().compile()`d per ladder bucket against NamedSharding-placed
+    ShapeDtypeStructs and served through the on-disk progcache
+    (core/progcache.py) under distinct `variant=` keys, so a restarted
+    mesh resolver warms by loading — and the cache key's mesh
+    fingerprint + device count guarantee an artifact compiled for one
+    topology is never served to another.
+  * OVERLAPPED exchange: everything is JAX async dispatch and nothing is
+    forced inline — the host enqueues scan(i), exchange(i), then packs
+    and enqueues scan(i+1) while exchange(i)'s collectives are still
+    draining on the mesh (scan(i+1) only data-depends on exchange(i)'s
+    table update, not on its status readback). Results retire through
+    the same non-blocking result-ring discipline as the device loop
+    (ops/device_loop.py): `poll()` decodes exactly the ready prefix via
+    `jax.Array.is_ready()`, `loop_stats` files every drain as
+    drained_nonblocking / forced_waits / blocking_syncs, and
+    blocking_syncs == 0 is the acceptance bar (`make mesh-smoke`).
+    `overlap=False` (knob `resolver_mesh_overlap=serial`) forces every
+    unit at dispatch — the serialized A/B baseline tools/mesh_bench.py
+    records; overlapped must beat it.
+  * MEASURED exchange interval: the ticket keeps a handle on the scan
+    program's history-hit plane; the drain stamps when the scan outputs
+    landed vs when the exchange outputs landed, so `mesh_stats` carries
+    a host-observed scan-ready -> exchange-ready interval per drained
+    batch (`last_collective_ms`). tools/mesh_bench.py additionally times
+    a dedicated compiled psum-chain program for the clean
+    collective-only number that replaces the BENCH_r05 estimate.
+  * A shard is a DEVICE, not a host engine: the shard map is adopted
+    from the heat aggregator's measured equal-load split keys
+    (`measured_shard_map`), and under `ElasticResolverGroup` a mesh
+    engine slots in behind the epoched shard map exactly like the
+    single-chip engines (same resolve()/journal/handoff contract), so
+    `ReshardController` split/merge moves device-resident table slices
+    through fault/handoff.py's replay protocol unchanged.
+
+Exactness: the split pair composes the SAME phases as make_sharded_step
+— local_phases, psum'd commit_fixpoint, apply_writes_and_gc — so abort
+sets are bit-identical to the fused mesh step, the single-chip engines
+and the CPU oracle at every shard count (tests/test_mesh_parity.py
+drives N in {1, 2, 4, 8} across bucket boundaries and a live epoch
+flip).
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+from ..core import telemetry
+from ..core.keyshard import KeyShardMap
+from ..core.knobs import SERVER_KNOBS
+from ..core.types import Version
+from ..ops import conflict_kernel as ck
+from ..ops.conflict_kernel import KernelConfig
+from ..ops.host_engine import RoutedConflictEngineBase, donate_state_kwargs
+from .sharding import (make_mesh_exchange_step, make_mesh_scan_step,
+                       make_sharded_scan_step, make_sharded_split_steps)
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["MeshShardedConflictEngine", "measured_shard_map",
+           "mesh_overlap_requested"]
+
+#: legal values of the `resolver_mesh_overlap` knob
+MESH_OVERLAP_MODES = ("", "on", "serial")
+
+
+def mesh_overlap_requested() -> bool:
+    """False iff the `resolver_mesh_overlap` knob selects the serialized
+    A/B baseline (force every dispatch unit before the next enqueue)."""
+    raw = str(getattr(SERVER_KNOBS, "resolver_mesh_overlap", "on") or "").strip()
+    if raw not in MESH_OVERLAP_MODES:
+        raise ValueError(
+            f"unknown resolver_mesh_overlap mode {raw!r}; expected one of "
+            f"{MESH_OVERLAP_MODES}")
+    return raw != "serial"
+
+
+def mesh_device_count() -> int:
+    """Devices the mesh engine spans by default: the
+    `resolver_mesh_devices` knob, 0 meaning every visible XLA device."""
+    n = int(getattr(SERVER_KNOBS, "resolver_mesh_devices", 0) or 0)
+    return n if n > 0 else len(jax.devices())
+
+
+def measured_shard_map(heat, n_shards: int) -> KeyShardMap:
+    """The shard map a mesh (re)build adopts: the heat aggregator's
+    MEASURED equal-load split keys when the histogram can supply a full
+    set, byte-uniform otherwise (KeyShardMap.from_split_points
+    sanitizes). This is the split-key adoption half of ROADMAP item 1:
+    the same `split_points()` the ReshardController plans host-engine
+    splits from now shapes the device mesh partition."""
+    splits: List[bytes] = []
+    if heat is not None:
+        try:
+            splits = list(heat.split_points(shards=n_shards) or [])
+        except Exception:
+            splits = []
+    return KeyShardMap.from_split_points(splits, n_shards)
+
+
+class _MeshTicket:
+    """One dispatched mesh unit's place in the result ring."""
+
+    __slots__ = ("status_dev", "ov_dev", "heat_dev", "heat_base",
+                 "heat_version", "heat_layout", "n_chunks", "scan_probe",
+                 "enq_t", "scan_ready_t", "keep", "status", "overflow",
+                 "done", "sample")
+
+    def __init__(self, status_dev, ov_dev, n_chunks: int, keep,
+                 scan_probe=None, heat_dev=None, heat_base: int = 0,
+                 heat_version=None, heat_layout: str = "s"):
+        self.status_dev = status_dev
+        self.ov_dev = ov_dev
+        self.heat_dev = heat_dev
+        self.heat_base = heat_base
+        self.heat_version = heat_version
+        self.heat_layout = heat_layout
+        self.n_chunks = n_chunks
+        #: the scan program's [S, T] history-hit plane (split units only):
+        #: probed non-blockingly so the drain can stamp scan-ready vs
+        #: exchange-ready — the measured exchange interval
+        self.scan_probe = scan_probe
+        self.enq_t = time.perf_counter()
+        self.scan_ready_t: Optional[float] = None
+        #: zero-copy keepalive: everything the dispatched programs may
+        #: still read (host_engine._dispatch_unit contract)
+        self.keep = keep
+        self.status: Optional[np.ndarray] = None
+        self.overflow = False
+        self.done = False
+        #: sampled device timing (t0_wall, t0_span, version) or None
+        self.sample = None
+
+    def probe_scan(self) -> None:
+        """Stamp the moment the scan outputs were first OBSERVED ready
+        (non-blocking; exchange-interval measurement only)."""
+        if (self.scan_ready_t is None and self.scan_probe is not None
+                and self.scan_probe.is_ready()):
+            self.scan_ready_t = time.perf_counter()
+
+    def ready(self) -> bool:
+        """Non-blocking: have this unit's verdict planes (and heat, when
+        on) landed?"""
+        self.probe_scan()
+        r = self.status_dev.is_ready() and self.ov_dev.is_ready()
+        if r and self.heat_dev is not None:
+            r = all(v.is_ready() for v in self.heat_dev.values())
+        return r
+
+
+class MeshShardedConflictEngine(RoutedConflictEngineBase):
+    """N-device mesh ConflictSet engine: AOT split scan/exchange
+    programs, overlapped cross-shard exchange, progcache-served warmup.
+    Same resolve() contract as every other engine family."""
+
+    name = "mesh"
+    dispatch_mode = "mesh"
+
+    def __init__(
+        self,
+        cfg: KernelConfig = KernelConfig(),
+        shards: Optional[KeyShardMap] = None,
+        mesh: Optional[Mesh] = None,
+        initial_version: Version = 0,
+        ladder=None,
+        scan_sizes: Sequence[int] = (2, 4, 8),
+        arena: bool = True,
+        history_search: Optional[str] = None,
+        heat_buckets: Optional[int] = None,
+        device_time_sample_rate: Optional[float] = None,
+        queue_depth: Optional[int] = None,
+        overlap: Optional[bool] = None,
+        drain_deadline_s: float = 5.0,
+    ):
+        if mesh is None:
+            devs = jax.devices()
+            n = shards.n_shards if shards is not None else mesh_device_count()
+            if n > len(devs):
+                raise ValueError(
+                    f"mesh engine needs {n} devices, only {len(devs)} visible")
+            mesh = jax.make_mesh((n,), ("shard",), devices=devs[:n])
+        (n_devices,) = mesh.devices.shape
+        #: dispatched-but-undrained tickets — the result ring; its bound
+        #: is the double buffer (knob resolver_mesh_queue_depth)
+        self._ring: deque = deque()
+        self.queue_depth = max(1, int(
+            queue_depth if queue_depth is not None
+            else int(getattr(SERVER_KNOBS, "resolver_mesh_queue_depth", 2))))
+        self.overlap = bool(overlap if overlap is not None
+                            else mesh_overlap_requested())
+        self.drain_deadline_s = drain_deadline_s
+        #: same sync-accounting keys as ops/device_loop.py loop_stats, so
+        #: ElasticResolverGroup.loop_stats aggregation and the
+        #: blocking_syncs == 0 acceptance read mesh slots unchanged
+        self.loop_stats = {"enqueued_chunks": 0, "units": 0,
+                           "drained_nonblocking": 0, "forced_waits": 0,
+                           "blocking_syncs": 0, "wait_ms": 0.0,
+                           "enqueue_ms": 0.0, "decode_ms": 0.0}
+        #: mesh-topology + measured-exchange gauges (fdbtpu_mesh family)
+        self.mesh_stats: Dict[str, float] = {
+            "n_devices": int(n_devices), "n_shards": int(n_devices),
+            "exchanges": 0, "timed_exchanges": 0,
+            "table_bytes_per_shard": 0,
+            "last_collective_ms": 0.0, "exchange_ms_total": 0.0,
+            "scan_ms_total": 0.0,
+        }
+        self._sample_pending = None
+        super().__init__(cfg, shards or KeyShardMap.uniform(n_devices),
+                         ladder=ladder, scan_sizes=scan_sizes, arena=arena,
+                         history_search=history_search,
+                         heat_buckets=heat_buckets,
+                         device_time_sample_rate=device_time_sample_rate)
+        cfg = self.cfg   # base resolved history-search + heat into it
+        assert self.n_shards == n_devices
+        self.mesh = mesh
+        self._sharding = NamedSharding(mesh, P("shard"))
+        # split-step programs for the host long-key tier (jit, compiled
+        # lazily — short-key-only workloads never pay for them)
+        self._detect_m, self._fix_m, self._apply_m = \
+            make_sharded_split_steps(cfg, mesh)
+        self._reset_device_state(self._rel(initial_version))
+        from ..ops.oracle import VersionIntervalMap
+
+        self.tier_map = VersionIntervalMap(initial_version)
+        self.mesh_stats["table_bytes_per_shard"] = \
+            self._table_bytes_per_shard()
+        self._mesh_telemetry_label = telemetry.hub().register_mesh(
+            self, name=self.name)
+
+    # -- telemetry ------------------------------------------------------------
+    def ring_depth(self) -> int:
+        """Dispatched-but-undrained tickets in the result ring."""
+        return len(self._ring)
+
+    def _table_bytes_per_shard(self) -> int:
+        total = sum(int(np.prod(x.shape)) * x.dtype.itemsize
+                    for x in jax.tree.leaves(self.state))
+        return total // max(self.n_shards, 1)
+
+    def mesh_stats_snapshot(self) -> Dict[str, float]:
+        """One batch-attachable snapshot of the topology + measured
+        exchange gauges plus the sync accounting — what `cli shards`
+        renders as the per-shard device view and what rides the
+        fdbtpu_mesh exposition."""
+        snap = {k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in self.mesh_stats.items()}
+        snap.update({k: (round(v, 4) if isinstance(v, float) else v)
+                     for k, v in self.loop_stats.items()})
+        snap["ring_depth"] = self.ring_depth()
+        snap["overlap"] = self.overlap
+        return snap
+
+    def loop_stats_snapshot(self) -> Dict[str, float]:
+        return self.mesh_stats_snapshot()
+
+    def device_view(self) -> List[dict]:
+        """Per-shard device placement — shard id, owning device, table
+        residency, last measured exchange interval — the `cli shards`
+        device-view rows (live and via campaign-report JSON)."""
+        from ..core.keyshard import _fmt_key
+
+        devs = list(self.mesh.devices.reshape(-1))
+        tb = self._table_bytes_per_shard()
+        last = round(float(self.mesh_stats["last_collective_ms"]), 4)
+        out = []
+        for s in range(self.n_shards):
+            d = devs[s]
+            out.append({
+                "shard": s,
+                "device": int(getattr(d, "id", s)),
+                "platform": str(getattr(d, "platform", "")),
+                "span_begin": _fmt_key(self.shards.begins[s]),
+                "table_bytes": tb,
+                "last_collective_ms": last,
+            })
+        return out
+
+    # -- device state ---------------------------------------------------------
+    def _stack_shards(self, per_shard: List[Dict]):
+        stacked = jax.tree.map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]), *per_shard)
+        return jax.tree.map(
+            lambda x: jax.device_put(x, self._sharding), stacked)
+
+    def _reset_device_state(self, version_rel: int) -> None:
+        self.drain_ring()
+        per = [
+            ck.initial_state(self.cfg, version_rel=version_rel,
+                             first_key=self.shards.begins[s])
+            for s in range(self.n_shards)
+        ]
+        self.state = self._stack_shards(per)
+
+    # -- AOT program pairs ----------------------------------------------------
+    def _progcache_fingerprint(self) -> str:
+        # programs bake the mesh topology: never share entries across
+        # shard counts or visible-device sets (the satellite-1 bugfix)
+        return f"mesh:{self.n_shards}/{len(jax.devices())}"
+
+    def _struct(self, tree):
+        return jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                           sharding=self._sharding), tree)
+
+    def _program(self, bucket: KernelConfig, n_chunks: int):
+        key = (bucket.max_txns, n_chunks)
+        prog = self._programs.get(key)
+        if prog is None:
+            if n_chunks == 1:
+                # the split pair: each half builds (or progcache-loads)
+                # under its own variant key
+                scan = self._build_and_record(
+                    bucket, 1, variant="scan",
+                    make=self._make_scan_program)
+                exch = self._build_and_record(
+                    bucket, 1, variant="exchange",
+                    make=self._make_exchange_program)
+                prog = (scan, exch)
+            else:
+                prog = self._build_and_record(bucket, n_chunks)
+            self._programs[key] = prog
+        return prog
+
+    def _structs_for(self, bucket: KernelConfig, n_chunks: int):
+        S = self.n_shards
+        st = self._struct(ck.state_struct(self.cfg, stack=(S,)))
+        stack = (S,) if n_chunks == 1 else (S, n_chunks)
+        bt = self._struct(ck.batch_struct(bucket, stack=stack))
+        return st, bt
+
+    def _make_scan_program(self, bucket: KernelConfig, n_chunks: int):
+        st, bt = self._structs_for(bucket, 1)
+        mapped = make_mesh_scan_step(bucket, self.mesh)
+        # AOT: compiled eagerly against the sharded structs — can never
+        # re-trace, and serialize_executable round-trips it (progcache)
+        return jax.jit(mapped).lower(st, bt).compile()
+
+    def _make_exchange_program(self, bucket: KernelConfig, n_chunks: int):
+        st, bt = self._structs_for(bucket, 1)
+        scan_mapped = make_mesh_scan_step(bucket, self.mesh)
+        outs = jax.eval_shape(scan_mapped, st, bt)
+        hist_s, ovp_s, wpos_s = jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                           sharding=self._sharding), outs)
+        mapped = make_mesh_exchange_step(bucket, self.mesh)
+        return jax.jit(mapped, **donate_state_kwargs()).lower(
+            st, bt, hist_s, ovp_s, wpos_s).compile()
+
+    def _make_program(self, bucket: KernelConfig, n_chunks: int):
+        # fused multi-chunk unit (C > 1): the split pair cannot span
+        # chunks — chunk c+1's scan reads the table chunk c's apply
+        # wrote — so the scan-size ladder keeps the one-program shape,
+        # AOT-lowered (make_sharded_scan_step returns the jit)
+        st, bt = self._structs_for(bucket, n_chunks)
+        return make_sharded_scan_step(bucket, self.mesh,
+                                      n_chunks).lower(st, bt).compile()
+
+    # -- dispatch / result ring ----------------------------------------------
+    def _dispatch_unit(self, bucket: KernelConfig,
+                       per_chunks: List[List[Dict[str, np.ndarray]]]):
+        C = len(per_chunks)
+        prog = self._program(bucket, C)
+        t_enq = time.perf_counter()
+        scan_probe = None
+        if C == 1:
+            scan_p, exch_p = prog
+            batch = self._stack_shards(per_chunks[0])
+            hist, ovp, wpos = scan_p(self.state, batch)
+            self.state, out = exch_p(self.state, batch, hist, ovp, wpos)
+            scan_probe = hist
+            self.mesh_stats["exchanges"] += 1
+            heat_layout = "s"
+        else:
+            stacked = {
+                k: np.stack([
+                    np.stack([np.asarray(pc[s][k]) for pc in per_chunks])
+                    for s in range(self.n_shards)
+                ])
+                for k in per_chunks[0][0]
+            }
+            batch = jax.tree.map(
+                lambda x: jax.device_put(x, self._sharding), stacked)
+            self.state, out = prog(self.state, batch)
+            heat_layout = "sc"
+        self.loop_stats["enqueue_ms"] += (time.perf_counter() - t_enq) * 1e3
+        ticket = _MeshTicket(out["status"], out["overflow"], C, batch,
+                             scan_probe=scan_probe,
+                             heat_dev=out.get("heat"), heat_base=self.base,
+                             heat_version=self._heat_version,
+                             heat_layout=heat_layout)
+        if self._sample_pending is not None:
+            ticket.sample = (bucket.max_txns, C) + self._sample_pending
+            self._sample_pending = None
+        self._ring.append(ticket)
+        self.loop_stats["units"] += 1
+        self.loop_stats["enqueued_chunks"] += C
+        if not self.overlap:
+            # serialized A/B baseline: retire the unit before the host
+            # packs anything else — what mesh_bench compares against
+            self._drain_through(ticket)
+        else:
+            # bound the in-flight depth to the double buffer, then drain
+            # whatever already landed — the non-blocking steady state
+            while len(self._ring) > self.queue_depth:
+                self._drain_through(self._ring[0])
+            self.poll()
+
+        def force() -> Tuple[np.ndarray, bool]:
+            self._drain_through(ticket)
+            return ticket.status, ticket.overflow
+
+        return force
+
+    def _dispatch_sampled(self, bucket: KernelConfig, per_chunks):
+        """Mesh sampled device timing rides the TICKET (recorded when the
+        drain sees the results — ops/device_loop.py's discipline), not
+        force(), which in overlapped steady state runs long after the
+        results landed."""
+        from ..core.trace import g_spans, span_now
+
+        self._sample_pending = (time.perf_counter(),
+                                span_now() if g_spans.enabled else 0.0,
+                                self._heat_version)
+        try:
+            return self._dispatch_unit(bucket, per_chunks)
+        finally:
+            self._sample_pending = None
+
+    def poll(self) -> int:
+        """Drain the READY prefix of the result ring — the non-blocking
+        steady-state path. Returns the number of tickets completed."""
+        n = 0
+        for t in self._ring:
+            # stamp every in-flight scan, not just the head's: under
+            # overlap, batch i+1's scan lands while batch i's exchange
+            # is still draining — that stamp IS the overlap evidence
+            t.probe_scan()
+        while self._ring and self._ring[0].ready():
+            self._finish(self._ring.popleft())
+            self.loop_stats["drained_nonblocking"] += 1
+            n += 1
+        return n
+
+    def drain_ring(self) -> None:
+        """Block until every in-flight unit drained — the explicit
+        barrier before host code touches the table state (clear, the
+        split-step long-key path, shadow rebuild)."""
+        if getattr(self, "_ring", None):
+            self._drain_through(self._ring[-1])
+
+    def _drain_through(self, ticket: _MeshTicket) -> None:
+        while not ticket.done:
+            head = self._ring[0]
+            if not head.ready():
+                # poll-wait for readiness (the host is never inside a
+                # device sync call); only the deadline fallback is a
+                # true blocking sync
+                self.loop_stats["forced_waits"] += 1
+                t0 = time.perf_counter()
+                deadline = t0 + self.drain_deadline_s
+                while not head.ready() and time.perf_counter() < deadline:
+                    time.sleep(2e-5)
+                self.loop_stats["wait_ms"] += (time.perf_counter() - t0) * 1e3
+                if not head.ready():
+                    self.loop_stats["blocking_syncs"] += 1
+            self._finish(self._ring.popleft())
+
+    # fdbtpu-lint: drain-point — only reached once ticket.ready() (or the
+    # deadline fallback, which loop_stats charges as a blocking sync): the
+    # asarray below copies a COMPLETED buffer, it never parks in the device
+    def _finish(self, ticket: _MeshTicket) -> None:
+        t_dec = time.perf_counter()
+        status = np.asarray(ticket.status_dev)[0]  # identical across shards
+        ticket.status = status[None] if ticket.n_chunks == 1 else status
+        ticket.overflow = bool(np.any(np.asarray(ticket.ov_dev)))
+        if ticket.heat_dev is not None:
+            self._merge_heat(ticket.heat_dev, version=ticket.heat_version,
+                             base=ticket.heat_base,
+                             layout=ticket.heat_layout)
+        self.loop_stats["decode_ms"] += (time.perf_counter() - t_dec) * 1e3
+        if ticket.scan_ready_t is not None:
+            # host-observed scan-ready -> exchange-ready interval: the
+            # measured cost of the psum exchange + lockstep fixpoint +
+            # apply on the real mesh (an upper bound in overlapped mode
+            # — the drain may observe late; mesh_bench's dedicated psum
+            # timing is the clean collective-only figure)
+            ex_ms = (t_dec - ticket.scan_ready_t) * 1e3
+            self.mesh_stats["last_collective_ms"] = ex_ms
+            self.mesh_stats["exchange_ms_total"] += ex_ms
+            self.mesh_stats["scan_ms_total"] += \
+                (ticket.scan_ready_t - ticket.enq_t) * 1e3
+            self.mesh_stats["timed_exchanges"] += 1
+        if ticket.sample is not None:
+            self._record_device_sample(*ticket.sample)
+            ticket.sample = None
+        ticket.done = True
+        ticket.status_dev = ticket.ov_dev = None
+        ticket.heat_dev = None
+        ticket.scan_probe = None
+        ticket.keep = None
+
+    # -- resolve paths --------------------------------------------------------
+    def _run_step(self, per_shard: List[Dict[str, np.ndarray]]) -> Tuple[np.ndarray, bool]:
+        status, overflow = self._dispatch_unit(self.cfg, [per_shard])()
+        return status[0], overflow
+
+    # -- split-step path (host long-key tier) --------------------------------
+    def _run_detect(self, per_shard):
+        # the split-step jits read/write self.state directly: quiesce the
+        # ring first so no async unit still owns the table
+        self.drain_ring()
+        batch = self._stack_shards(per_shard)
+        hist, ovp, wpos = self._detect_m(self.state, batch)
+        return {"batch": batch, "hist": hist, "ovp": ovp, "wpos": wpos}
+
+    def _run_fix(self, ctx, per_shard, t_ok: np.ndarray) -> np.ndarray:
+        t_ok_stacked = jax.device_put(
+            np.broadcast_to(t_ok, (self.n_shards,) + t_ok.shape).copy(),
+            self._sharding,
+        )
+        committed = self._fix_m(t_ok_stacked, ctx["hist"], ctx["ovp"],
+                                ctx["batch"])
+        return np.asarray(committed)[0]
+
+    def _run_apply(self, ctx, per_shard, committed: np.ndarray) -> Tuple[np.ndarray, bool]:
+        cm = jax.device_put(
+            np.broadcast_to(committed,
+                            (self.n_shards,) + committed.shape).copy(),
+            self._sharding,
+        )
+        self.state, overflow = self._apply_m(self.state, ctx["batch"], cm,
+                                             ctx["wpos"])
+        t_too_old = np.asarray(ctx["batch"]["t_too_old"])[0]
+        status = np.asarray(ck.status_of(t_too_old, committed))
+        return status, bool(np.any(np.asarray(overflow)))

@@ -59,7 +59,8 @@ from ..ops.conflict_kernel import KernelConfig
 from ..core.keyshard import KeyShardMap
 from ..ops.host_engine import RoutedConflictEngineBase, donate_state_kwargs
 
-__all__ = ["KeyShardMap", "ShardedConflictEngine", "make_sharded_step"]
+__all__ = ["KeyShardMap", "ShardedConflictEngine", "make_sharded_step",
+           "make_mesh_scan_step", "make_mesh_exchange_step"]
 
 
 def make_sharded_step(cfg: KernelConfig, mesh: Mesh, axis: str = "shard"):
@@ -141,6 +142,64 @@ def make_sharded_scan_step(cfg: KernelConfig, mesh: Mesh, n_chunks: int,
 
     mapped = _shard_map(step, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P(axis))
     return jax.jit(mapped, **donate_state_kwargs())
+
+
+def make_mesh_scan_step(cfg: KernelConfig, mesh: Mesh, axis: str = "shard"):
+    """Phase-1 half of the mesh engine's split dispatch unit: shard-LOCAL
+    scans only — history probes, overlap edges, write positions — with NO
+    collective anywhere in the program. Returns the un-jitted shard_map
+    (the mesh engine AOT-lowers it per bucket so the progcache can serve
+    it); outputs keep the [S, ...] stacking and stay device-resident,
+    feeding make_mesh_exchange_step without a host round-trip. Because
+    this program touches no other shard's data, the NEXT batch's scan can
+    run while the PREVIOUS batch's exchange collectives drain — the
+    overlap the mesh engine's double-buffered ring exploits."""
+
+    def scan(state, batch):
+        state = jax.tree.map(lambda x: x[0], state)
+        batch = jax.tree.map(lambda x: x[0], batch)
+        hist_hits, ovp, wpos = ck.local_phases(cfg, state, batch)
+        return jax.tree.map(lambda x: jnp.asarray(x)[None],
+                            (hist_hits, ovp, wpos))
+
+    return _shard_map(scan, mesh=mesh, in_specs=(P(axis), P(axis)),
+                      out_specs=P(axis))
+
+
+def make_mesh_exchange_step(cfg: KernelConfig, mesh: Mesh, axis: str = "shard"):
+    """Exchange + commit half of the mesh engine's split dispatch unit:
+    ALL the cross-shard traffic of one batch — one [T] psum of the
+    per-shard history-hit planes, one [T] psum of blocked-txn counts per
+    fixpoint iteration (counts are additive across disjoint key shards,
+    so every shard runs the identical lockstep while_loop) — then the
+    shard-local apply of globally-committed writes. Same stacking
+    conventions as make_sharded_step; status rows are replicated across
+    shards. Un-jitted shard_map (AOT-lowered by the engine)."""
+
+    def exchange(state, batch, hist_local, ovp, wpos):
+        state = jax.tree.map(lambda x: x[0], state)
+        batch = jax.tree.map(lambda x: x[0], batch)
+        hist_local = hist_local[0]
+        ovp = jax.tree.map(lambda x: x[0], ovp)
+        wpos = jax.tree.map(lambda x: x[0], wpos)
+        hist = lax.psum(hist_local, axis)
+        committed = ck.commit_fixpoint(
+            cfg, batch["t_ok"], hist, ovp, batch,
+            allreduce=lambda x: lax.psum(x, axis),
+        )
+        new_state, overflow, reclaimed = ck.apply_writes_and_gc(
+            cfg, state, batch, committed, wpos)
+        out = {
+            "status": ck.status_of(batch["t_too_old"], committed),
+            "overflow": overflow,
+        }
+        if cfg.heat_buckets > 0:
+            out["heat"] = ck.heat_of(cfg, new_state, batch, committed, ovp,
+                                     reclaimed)
+        return jax.tree.map(lambda x: jnp.asarray(x)[None], (new_state, out))
+
+    return _shard_map(exchange, mesh=mesh,
+                      in_specs=(P(axis),) * 5, out_specs=P(axis))
 
 
 def make_sharded_split_steps(cfg: KernelConfig, mesh: Mesh, axis: str = "shard"):
@@ -239,6 +298,11 @@ class ShardedConflictEngine(RoutedConflictEngineBase):
         self.state = self._stack_shards(per)
 
     # -- bucketed program cache (RoutedConflictEngineBase) -------------------
+    def _progcache_fingerprint(self) -> str:
+        # programs bake the mesh topology (shard_map over self.mesh): the
+        # cache key must separate an S-shard layout from any other
+        return f"mesh:{self.n_shards}/{len(jax.devices())}"
+
     def _make_program(self, bucket: KernelConfig, n_chunks: int):
         # jit-based (not AOT): pinning input shardings through an AOT
         # .lower() of a shard_map is version-fragile on the pinned jax;

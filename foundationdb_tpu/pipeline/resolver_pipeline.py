@@ -67,8 +67,10 @@ class BudgetBatcher:
     each bucket to its engine's resolved search mode
     (RoutedConflictEngineBase.history_search_modes()); unmapped buckets
     default to "fused_sort", the pre-ladder behavior. `dispatch_mode` is
-    the engine family's serving path ("step" | "loop"), one value per
-    batcher (an engine serves through exactly one at a time)."""
+    the engine family's serving path ("step" | "loop" | "mesh"), one
+    value per batcher (an engine serves through exactly one at a time) —
+    a multi-device mesh batch carries collective time a single-chip step
+    never pays, so its estimates file under their own key too."""
 
     def __init__(self, ladder: Sequence[int], budget_ms: Optional[float] = None,
                  pack_ms_per_txn: float = 0.0, alpha: Optional[float] = None,
@@ -121,7 +123,8 @@ class BudgetBatcher:
                 self.ewma_ms[new_key] = self.ewma_ms.pop(old_key)
 
     def set_dispatch_mode(self, dispatch: str) -> None:
-        """Adopt an engine family's dispatch path ("step" | "loop") —
+        """Adopt an engine family's dispatch path ("step" | "loop" |
+        "mesh") —
         mirrors set_bucket_modes: seeds filed under the previous dispatch
         mode migrate iff the new key has no estimate, so enabling the
         device loop starts from the prior without ever overwriting a real

@@ -82,6 +82,13 @@ class PipelineConfig:
                           splits into queue_enqueue / device_resident /
                           result_drain segments and the BudgetBatcher
                           files EWMAs under the "loop" dispatch key.
+                          "mesh" is the multi-device engine (docs/perf.md
+                          "Measured mesh resolution"): the same ring
+                          segment split — its enqueue/drain shares are
+                          the split dispatch + non-blocking exchange
+                          retirement — with EWMAs filed under "mesh" and
+                          the engine's mesh_stats snapshot riding the
+                          device span next to loop_stats.
     queue_enqueue_ms    — loop mode: host cost to pack a queue slot and
                           async-dispatch the server step (no sync).
     result_drain_ms     — loop mode: host cost to poll + decode the
@@ -160,6 +167,7 @@ class PipelinedResolverService:
                 # resolver, so a device-loop rollout never poisons the
                 # step path's estimates (docs/perf.md)
                 dispatch_mode=("loop" if cfg.dispatch_mode == "device_loop"
+                               else "mesh" if cfg.dispatch_mode == "mesh"
                                else getattr(engine, "dispatch_mode", "step")),
             )
 
@@ -250,7 +258,10 @@ class PipelinedResolverService:
             await self._device_done.when_at_least(seq - 1)
             from ..sim.loop import now as _now
 
-            loop_mode = self.cfg.dispatch_mode == "device_loop"
+            # the mesh engine shares the device loop's ring discipline
+            # (enqueue share, non-blocking drain share, loop_stats), so
+            # it gets the same segment split and snapshot attachment
+            loop_mode = self.cfg.dispatch_mode in ("device_loop", "mesh")
             if spans_on:
                 t2 = span_now()
                 span_event("resolver.pipeline_wait", version, t1, t2,
@@ -297,6 +308,13 @@ class PipelinedResolverService:
                     snap = snap_fn() if snap_fn is not None else None
                     if snap is not None:
                         extra["loop_stats"] = snap
+                    mesh_fn = getattr(self.engine, "mesh_stats_snapshot",
+                                      None)
+                    if mesh_fn is not None:
+                        # mesh engines: shard fan-out + measured exchange
+                        # intervals ride the span too, so a slow batch's
+                        # trace says what the collectives cost it
+                        extra["mesh_stats"] = mesh_fn()
                 # keyspace-heat context (core/heatmap.py): the batch-time
                 # hot-range pressure rides the device span, so a slow
                 # batch's trace says whether the keyspace was hot

@@ -105,9 +105,12 @@ def make_chaos_engine(engine_mode: str,
         from ..ops.oracle import OracleConflictEngine
 
         inner = OracleConflictEngine()
-    elif engine_mode in ("jax", "device_loop"):
+    elif engine_mode in ("jax", "device_loop", "mesh"):
         from ..ops.host_engine import make_engine
 
+        # "mesh" spans every visible XLA device (resolver_mesh_devices):
+        # a chaos campaign over mesh slots exercises device-shard
+        # restart/handoff, not just single-chip rebuilds
         inner = make_engine(engine_mode, _small_kernel_cfg())
     else:
         raise ValueError(f"unknown chaos engine mode {engine_mode!r}")
@@ -1347,10 +1350,10 @@ def assert_slos(report: CampaignReport, cfg: NemesisConfig,
             f"no failover observed: {ctx}"
         assert report.engine_stats.get("swap_backs", 0) >= 1, \
             f"no swap-back observed: {ctx}"
-    if cfg.engine_mode == "device_loop":
+    if cfg.engine_mode in ("device_loop", "mesh"):
         assert report.loop_stats is not None, f"no loop stats: {ctx}"
         assert report.loop_stats.get("blocking_syncs", 0) == 0, \
-            f"device loop fell back to a blocking sync: {ctx}"
+            f"{cfg.engine_mode} ring fell back to a blocking sync: {ctx}"
     if cfg.kill_child:
         assert report.child_restarts >= 1, \
             f"supervised child never restarted: {ctx}"
@@ -2175,7 +2178,7 @@ def main(argv=None) -> int:
     ap.add_argument("--seeds", type=int, default=2)
     ap.add_argument("--base-seed", type=int, default=11)
     ap.add_argument("--engine-modes", default="jax,device_loop",
-                    help="comma list of oracle|jax|device_loop")
+                    help="comma list of oracle|jax|device_loop|mesh")
     ap.add_argument("--duration", type=float, default=None,
                     help="campaign seconds (default 4.0; --drift defaults "
                          "6.0 oracle / 10.0 device-backed)")

@@ -228,6 +228,22 @@ class ElasticResolverGroup:
                 agg[k] = agg.get(k, 0) + v
         return agg
 
+    def device_view(self) -> Optional[List[dict]]:
+        """Per-slot device placement for mesh-backed slots — each active
+        slot's mesh engine reports its shard -> device rows (device id,
+        table bytes, last measured collective ms) tagged with the slot id
+        routing sends it traffic under. None when no slot is mesh-backed
+        (single-chip engine modes): `cli shards` renders the epoch map
+        alone, old reports stay readable."""
+        out: List[dict] = []
+        for sid in self.active_sids():
+            fn = getattr(self.slots[sid].inner, "device_view", None)
+            if fn is None:
+                continue
+            for row in fn():
+                out.append({"sid": sid, **row})
+        return out or None
+
     def health_stats(self) -> dict:
         sev = {"healthy": 0, "suspect": 1, "failed": 2, "probation": 3,
                "quarantined": 4}
@@ -651,6 +667,7 @@ class ReshardController:
             "blackout_over_budget": self.blackout_over_budget,
             "epoch": self.group.emap.epoch,
             "shard_map": self.group.emap.as_dict(),
+            "device_view": self.group.device_view(),
             "ops": [op.as_dict() for op in self.ops],
             "group": {k: v for k, v in self.group.extra_stats.items()},
         }

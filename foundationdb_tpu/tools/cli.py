@@ -1084,6 +1084,19 @@ class Cli:
         for i, b in enumerate(begins):
             e = begins[i + 1] if i + 1 < len(begins) else "+inf"
             self._print(f"    shard {i}: [{b} .. {e})")
+        # mesh-backed slots: device placement per shard (absent in
+        # pre-mesh reports and single-chip engine modes — render nothing)
+        dview = rs.get("device_view") or []
+        if dview:
+            self._print("    device placement:")
+            for row in dview:
+                ms = row.get("last_collective_ms")
+                self._print(
+                    f"      slot {row.get('sid')} shard {row.get('shard')}"
+                    f" -> {row.get('platform', '?')}:{row.get('device')}"
+                    f"  [{row.get('span_begin', '')!r} ...)"
+                    f"  table {row.get('table_bytes', 0)} B"
+                    + (f"  exchange {ms:.3f} ms" if ms else ""))
         hist = sm.get("history") or []
         if len(hist) > 1:
             self._print("    epoch history:")

@@ -1,0 +1,220 @@
+"""Measured mesh resolution numbers (bench.py's `sharded_measured`).
+
+BENCH_r05's weak-scale projection priced the cross-shard exchange with an
+ESTIMATED 0.15 ms ICI collective. This module replaces the estimate with
+measurements on a real N-device mesh (8 forced XLA host devices when no
+accelerator is attached — genuine XLA devices running genuine collectives,
+time-sharing host cores):
+
+  * `collective_ms`: a dedicated AOT-compiled psum-chain program — eight
+    dependent [T] i32 psums across the mesh, timed end to end, reported
+    per psum. This is the collective-only cost the r05 model wanted, at
+    each mesh width.
+  * `scaling`: per width N in {1, 2, 4, 8}, the mesh engine run in
+    SERIALIZED mode over the identical point-txn stream: txn/s, the
+    measured scan interval (dispatch -> scan outputs ready) and the
+    measured exchange interval (scan ready -> verdict planes ready, i.e.
+    psum + lockstep fixpoint + apply) from the engine's own result-ring
+    stamps, plus oracle-parity counts for every batch resolved.
+  * `overlap_ab`: the 8-wide A/B — the same pipelined driver (pack batch
+    i+1 while batch i's exchange drains, force one batch behind, exactly
+    the ResolverPipeline's dispatch discipline) against an overlapped
+    engine and a serialized one (`resolver_mesh_overlap=serial`
+    semantics). Overlapped must win: the host's pack+decode hides under
+    device compute, and blocking_syncs stays 0.
+
+On the CPU host platform one core time-shares all N "devices", so
+absolute times are total-compute proxies (the platform field says which
+era a number belongs to — bench_history never compares cpu measurements
+against chip-era estimates).
+"""
+import json
+import os
+import sys
+import time
+from collections import deque
+
+WIDTHS = (1, 2, 4, 8)
+PSUM_CHAIN = 8
+
+
+def _force_host_devices(n=8):
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+
+
+def main():
+    _force_host_devices(8)   # before jax initializes its backend
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.expanduser("~"), ".cache", "fdb_tpu_jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    import numpy as np
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from foundationdb_tpu.core.keyshard import KeyShardMap
+    from foundationdb_tpu.core.types import CommitTransaction, KeyRange
+    from foundationdb_tpu.ops.conflict_kernel import KernelConfig
+    from foundationdb_tpu.ops.oracle import OracleConflictEngine
+    from foundationdb_tpu.parallel.mesh_engine import MeshShardedConflictEngine
+    from foundationdb_tpu.parallel.sharding import _shard_map
+
+    T = 512               # txns per batch, identical stream at every width
+    POOL = 2048
+    N_BATCHES = 4
+    REPS = 3
+    CFG = KernelConfig(
+        key_words=4, capacity=4096,
+        max_point_reads=1152, max_point_writes=1152,
+        max_reads=8, max_writes=8, max_txns=T,
+    )
+
+    rng = np.random.default_rng(11)
+
+    def synth(n_txns):
+        txns = []
+        for _ in range(n_txns):
+            t = CommitTransaction()
+            for _ in range(2):
+                k = b"%06d" % rng.integers(0, POOL)
+                t.read_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            for _ in range(2):
+                k = b"%06d" % rng.integers(0, POOL)
+                t.write_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            txns.append(t)
+        return txns
+
+    streams = [synth(T) for _ in range(N_BATCHES)]
+
+    def reset_snapshots():
+        for txns in streams:
+            for tr in txns:
+                tr.read_snapshot = 990
+
+    def make_engine(n, overlap):
+        return MeshShardedConflictEngine(
+            CFG, KeyShardMap.uniform(n),
+            jax.make_mesh((n,), ("shard",), devices=jax.devices()[:n]),
+            ladder=(), scan_sizes=(), overlap=overlap)
+
+    def run_pipelined(engine, reps, oracle=None):
+        """Pack/dispatch with force one batch behind — the overlap
+        window the mesh ring exploits. Returns (txns_per_s, parity)."""
+        now = 1000
+        mism = checked = 0
+
+        def settle(force, want):
+            nonlocal mism, checked
+            got = force()
+            if want is not None:
+                checked += len(got)
+                mism += sum(int(g) != int(w) for g, w in zip(got, want))
+
+        # warm: compile + fill the interval tables
+        for txns in streams:
+            got = engine.resolve(txns, now, max(0, now - 200_000))
+            if oracle is not None:
+                want = oracle.resolve(txns, now, max(0, now - 200_000))
+                checked += len(got)
+                mism += sum(int(g) != int(w) for g, w in zip(got, want))
+            now += T
+        t0 = time.perf_counter()
+        total = 0
+        pending = deque()
+        for _ in range(reps):
+            for txns in streams:
+                old = max(0, now - 200_000)
+                plan = engine.columnar_pack(txns, now, old)
+                assert plan is not None, "point stream must pack columnar"
+                want = (oracle.resolve(txns, now, old)
+                        if oracle is not None else None)
+                force = engine.columnar_dispatch(plan)
+                while len(pending) > 1:
+                    settle(*pending.popleft())
+                pending.append((force, want))
+                now += T
+                total += len(txns)
+        while pending:
+            settle(*pending.popleft())
+        dt = time.perf_counter() - t0
+        return total / dt, {"checked": checked, "mismatches": mism}
+
+    def timed_psum_chain(n):
+        """The collective-only measurement: PSUM_CHAIN dependent [T] i32
+        psums over an n-wide mesh, AOT-compiled, timed per psum."""
+        mesh = jax.make_mesh((n,), ("shard",), devices=jax.devices()[:n])
+        sh = NamedSharding(mesh, P("shard"))
+
+        def chain(x):
+            x = x[0]
+            for i in range(PSUM_CHAIN):
+                # the +i data dependency keeps XLA from folding the chain
+                x = lax.psum(x, "shard") + np.int32(i)
+            return x[None]
+
+        mapped = _shard_map(chain, mesh=mesh, in_specs=(P("shard"),),
+                            out_specs=P("shard"))
+        x = jax.device_put(np.ones((n, T), np.int32), sh)
+        prog = jax.jit(mapped).lower(
+            jax.ShapeDtypeStruct((n, T), np.int32, sharding=sh)).compile()
+        jax.block_until_ready(prog(x))   # warm
+        t0 = time.perf_counter()
+        reps = 20
+        for _ in range(reps):
+            jax.block_until_ready(prog(x))
+        return (time.perf_counter() - t0) * 1e3 / (reps * PSUM_CHAIN)
+
+    res = {
+        "devices": len(jax.devices()),
+        "platform": jax.devices()[0].platform,
+        "batch_txns": T,
+        "psum_chain": PSUM_CHAIN,
+        "collective_ms": {},
+        "scaling": {},
+    }
+
+    for n in WIDTHS:
+        if n > 1:
+            res["collective_ms"][str(n)] = round(timed_psum_chain(n), 4)
+        reset_snapshots()
+        eng = make_engine(n, overlap=False)   # tight phase stamps
+        txns_s, parity = run_pipelined(eng, REPS, oracle=OracleConflictEngine())
+        ms = eng.mesh_stats
+        timed = max(1, int(ms["timed_exchanges"]))
+        res["scaling"][str(n)] = {
+            "txns_per_s": round(txns_s, 1),
+            "scan_ms": round(ms["scan_ms_total"] / timed, 4),
+            "exchange_ms": round(ms["exchange_ms_total"] / timed, 4),
+            "timed_batches": timed,
+            "blocking_syncs": int(eng.loop_stats["blocking_syncs"]),
+            "parity": parity,
+        }
+        assert parity["mismatches"] == 0, f"parity broke at N={n}: {parity}"
+
+    # the 8-wide A/B: identical pipelined driver, overlap on vs off
+    reset_snapshots()
+    over = make_engine(8, overlap=True)
+    over_txns_s, _ = run_pipelined(over, REPS)
+    serial_txns_s = res["scaling"]["8"]["txns_per_s"]
+    res["overlap_ab"] = {
+        "overlapped_txns_per_s": round(over_txns_s, 1),
+        "serialized_txns_per_s": serial_txns_s,
+        "speedup": round(over_txns_s / serial_txns_s, 3),
+        "blocking_syncs": int(over.loop_stats["blocking_syncs"]),
+        "drained_nonblocking": int(over.loop_stats["drained_nonblocking"]),
+    }
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

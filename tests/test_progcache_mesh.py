@@ -1,0 +1,151 @@
+"""Progcache mesh-safety (PR 18 satellite): a compiled mesh program is
+topology-specific, so the on-disk program cache key must carry BOTH the
+process device count (core/progcache.backend_fingerprint's `ndevN`) and
+the engine's mesh/sharding layout fingerprint (`key(mesh=)`). Before the
+fix, an artifact AOT-compiled for an 8-device mesh could be served to a
+4-device relaunch of the same binary — XLA rejects the mismatched
+sharding at best and mis-executes at worst. The regression here flips
+`xla_force_host_platform_device_count` between populate and load in
+REAL subprocesses and asserts the reload is a clean MISS, never a
+(poisoned) hit."""
+import contextlib
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytest.importorskip("jax")
+import jax
+
+from foundationdb_tpu.core import progcache as pc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@contextlib.contextmanager
+def _no_jax_compile_cache():
+    # store-verification refuses executables the process deserialized
+    # from jax's own persistent cache (test_recovery.py rationale) —
+    # progcache population must run with that cache off AND reset
+    from jax._src import compilation_cache
+
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    compilation_cache.reset_cache()
+    try:
+        yield
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+        compilation_cache.reset_cache()
+
+
+def test_key_separates_mesh_layout_and_variant():
+    """Same bucket/chunks/search/dispatch: different mesh fingerprints or
+    program variants (the split pair's scan vs exchange) never collide."""
+    cache = pc.ProgramCache("/tmp/unused-keys-only")
+    base = dict(engine="mesh", bucket=32, n_chunks=1,
+                search_mode="fused_sort", dispatch_mode="mesh")
+    k8 = cache.key(mesh="mesh:8/8", **base)
+    k4 = cache.key(mesh="mesh:4/8", **base)
+    kscan = cache.key(mesh="mesh:8/8", variant="scan", **base)
+    kexch = cache.key(mesh="mesh:8/8", variant="exchange", **base)
+    assert len({k8, k4, kscan, kexch}) == 4
+
+
+def test_mesh_width_is_a_clean_in_process_miss(tmp_path):
+    """Two mesh widths in ONE process (device count fixed at 8): the
+    4-shard engine never loads the 2-shard engine's programs — misses,
+    zero hits, zero poisoned entries — then a same-width rebuild loads
+    everything back."""
+    from foundationdb_tpu.core.keyshard import KeyShardMap
+    from foundationdb_tpu.ops.conflict_kernel import KernelConfig
+    from foundationdb_tpu.parallel.mesh_engine import MeshShardedConflictEngine
+
+    # a shape no other test compiles (jax in-process cache would hand us
+    # a deserialized executable store-verification refuses)
+    cfg = KernelConfig(key_words=2, capacity=256, max_reads=64,
+                       max_writes=64, max_txns=32)
+
+    def build(n):
+        mesh = jax.make_mesh((n,), ("shard",), devices=jax.devices()[:n])
+        return MeshShardedConflictEngine(cfg, KeyShardMap.uniform(n), mesh,
+                                         ladder=(), scan_sizes=()).warmup()
+
+    with _no_jax_compile_cache():
+        pc.uninstall()
+        pc.install(pc.ProgramCache(str(tmp_path)))
+        try:
+            build(2)
+            s = pc.active().stats
+            assert s["stores"] >= 2 and s["hits"] == 0, s
+            build(4)
+            s = pc.active().stats
+            assert s["hits"] == 0 and s["poisoned"] == 0, s
+            assert s["misses"] >= 2, s
+            build(2)
+            assert pc.active().stats["hits"] >= 2, pc.active().stats
+        finally:
+            pc.uninstall()
+
+
+_CHILD = r"""
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from foundationdb_tpu.core import progcache as pc
+from foundationdb_tpu.core.keyshard import KeyShardMap
+from foundationdb_tpu.ops.conflict_kernel import KernelConfig
+from foundationdb_tpu.parallel.mesh_engine import MeshShardedConflictEngine
+
+cache_dir = sys.argv[1]
+cfg = KernelConfig(key_words=2, capacity=256, max_reads=64,
+                   max_writes=64, max_txns=32)
+pc.install(pc.ProgramCache(cache_dir))
+n = 2   # mesh width fixed; only the PROCESS device count varies
+mesh = jax.make_mesh((n,), ("shard",), devices=jax.devices()[:n])
+eng = MeshShardedConflictEngine(cfg, KeyShardMap.uniform(n), mesh,
+                                ladder=(), scan_sizes=()).warmup()
+print(json.dumps({"devices": len(jax.devices()),
+                  "compiles": eng.perf.compiles,
+                  **{k: v for k, v in pc.active().stats.items()
+                     if isinstance(v, (int, float))}}))
+"""
+
+
+def _run_child(cache_dir, device_count):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # keep the child's serialize path verifiable: no jax persistent cache
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={device_count}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    out = subprocess.run([sys.executable, "-c", _CHILD, cache_dir],
+                         env=env, capture_output=True, text=True,
+                         timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+@pytest.mark.slow
+def test_device_count_flip_between_populate_and_load(tmp_path):
+    """Populate at 8 forced host devices, relaunch at 4: the cache key's
+    ndev fingerprint turns the reload into a clean miss (fresh compile,
+    nothing poisoned); relaunching back at 8 loads the original entries
+    with zero compiles."""
+    cache = str(tmp_path)
+    first = _run_child(cache, 8)
+    assert first["devices"] == 8 and first["stores"] >= 2, first
+    assert first["hits"] == 0, first
+
+    flipped = _run_child(cache, 4)
+    assert flipped["devices"] == 4, flipped
+    assert flipped["hits"] == 0 and flipped["poisoned"] == 0, flipped
+    assert flipped["misses"] >= 2 and flipped["compiles"] >= 2, flipped
+
+    back = _run_child(cache, 8)
+    assert back["hits"] >= 2 and back["compiles"] == 0, back
