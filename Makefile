@@ -114,14 +114,15 @@ mesh-smoke:
 # additionally requires >= 2 executed reshards per campaign with every
 # per-range blackout inside budget. Solo-CPU: do not overlap with tier-1.
 chaos-drift:
+	mkdir -p _artifacts
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.real.nemesis \
 		--drift --seeds 2 --engine-modes jax,device_loop --watchdog \
-		--blackbox-dir chaos_drift_blackbox \
-		--json chaos_drift_report.json
+		--blackbox-dir _artifacts/chaos_drift_blackbox \
+		--json _artifacts/chaos_drift_report.json
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.cli \
-		shards chaos_drift_report.json
+		shards _artifacts/chaos_drift_report.json
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.cli \
-		blackbox chaos_drift_report.json
+		blackbox _artifacts/chaos_drift_report.json
 
 # Commit-forensics smoke (docs/observability.md "Black-box journal &
 # forensics", ~30s, solo-CPU safe — oracle engines, one process): a short
@@ -142,12 +143,13 @@ forensics-smoke:
 # replay the whole retained batch stream bit-identical through the clean
 # serial oracle. Solo-CPU: do not overlap with tier-1.
 chaos-crash:
+	mkdir -p _artifacts
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.real.nemesis \
 		--crash --seeds 2 --engine-modes jax,device_loop \
-		--blackbox-dir chaos_crash_blackbox \
-		--json chaos_crash_report.json
+		--blackbox-dir _artifacts/chaos_crash_blackbox \
+		--json _artifacts/chaos_crash_report.json
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.cli \
-		recovery chaos_crash_report.json
+		recovery _artifacts/chaos_crash_report.json
 
 # Crash-stop recovery smoke (~30s, solo-CPU safe — one parent + one
 # supervised child on the miniature jax ladder): ONE seeded kill -9 ->
@@ -156,6 +158,17 @@ chaos-crash:
 # render asserted end to end.
 crash-smoke:
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.crash_smoke
+
+# Scenario-atlas smoke (docs/scenarios.md, ~45s, solo-CPU safe — oracle
+# engines, one process): two miniature recipes (flash_sale,
+# session_cache) run end-to-end through run_campaign with scorecards
+# machine-asserted green (every SLO contract row, journal replay parity,
+# all incidents explained), the flash-sale signature measurably hotter
+# than the cache's, `cli atlas` rendering both the live gauges and the
+# report file, and a strict parse of the fdbtpu_scenario Prometheus
+# family. Campaign artifacts land under gitignored _artifacts/.
+atlas-smoke:
+	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.atlas_smoke
 
 # Static invariant check (docs/static_analysis.md, ~2s, pure AST — never
 # imports jax): determinism, host-sync discipline, donation safety,
@@ -175,16 +188,17 @@ lint:
 # cross-process Chrome trace JSON (chaos_real_traces/; `cli trace FILE`
 # renders one). Solo-CPU: do not overlap with tier-1.
 chaos-real:
+	mkdir -p _artifacts
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.real.nemesis \
 		--seeds 2 --engine-modes jax,device_loop --sweep --watchdog \
-		--trace-dir chaos_real_traces \
-		--blackbox-dir chaos_real_blackbox \
-		--json chaos_real_report.json
+		--trace-dir _artifacts/chaos_real_traces \
+		--blackbox-dir _artifacts/chaos_real_blackbox \
+		--json _artifacts/chaos_real_report.json
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.cli \
-		chaos-status chaos_real_report.json
+		chaos-status _artifacts/chaos_real_report.json
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.cli \
-		incidents chaos_real_report.json
+		incidents _artifacts/chaos_real_report.json
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.cli \
-		explain --slo chaos_real_report.json
+		explain --slo _artifacts/chaos_real_report.json
 
-.PHONY: check bench bench-smoke telemetry-smoke heat-smoke sched-smoke trace-smoke chaos chaos-real chaos-drift chaos-crash reshard-smoke mesh-smoke lint perf-smoke bench-history watch-smoke forensics-smoke crash-smoke
+.PHONY: check bench bench-smoke telemetry-smoke heat-smoke sched-smoke trace-smoke chaos chaos-real chaos-drift chaos-crash reshard-smoke mesh-smoke lint perf-smoke bench-history watch-smoke forensics-smoke crash-smoke atlas-smoke

@@ -306,6 +306,7 @@ def main(argv=None):
     heat = conflict_heat_section()
     sched = conflict_scheduling_section()
     recovery = recovery_section()
+    atlas = scenario_atlas_section()
 
     print(json.dumps({
         "metric": "resolved_txns_per_sec_per_chip",
@@ -338,6 +339,7 @@ def main(argv=None):
         "conflict_heat": heat,
         "conflict_scheduling": sched,
         "recovery": recovery,
+        "scenario_atlas": atlas,
         "compile_memory": compile_memory,
         "profile": PROFILE,
         "device": str(dev),
@@ -895,6 +897,25 @@ def conflict_scheduling_section():
         from foundationdb_tpu.real.nemesis import run_conflict_scheduling
 
         return run_conflict_scheduling()
+    except Exception as e:  # noqa: BLE001 — a socketless/odd environment
+        #                     must not kill the chip bench
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def scenario_atlas_section():
+    """The scenario atlas (docs/scenarios.md, recorded from BENCH_r11):
+    all six named production recipes — flash_sale, payment_ledger,
+    secondary_index, task_queue, timeseries_ingest, session_cache —
+    each a full wall-clock chaos campaign (elastic group, one injected
+    partition, watchdog + spans + journal parity) judged against its
+    own SLO contract rows. Per-scenario headline metrics land under
+    `scenarios.<name>.*`; tools/bench_history.py gates every scenario's
+    `slo_pass`, so a regression in ANY one recipe fails the trend gate.
+    `make atlas-smoke` drives two recipes at miniature scale in seconds."""
+    try:
+        from foundationdb_tpu.real.scenarios import run_scenario_atlas
+
+        return run_scenario_atlas()
     except Exception as e:  # noqa: BLE001 — a socketless/odd environment
         #                     must not kill the chip bench
         return {"error": f"{type(e).__name__}: {e}"}

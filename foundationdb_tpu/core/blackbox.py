@@ -275,6 +275,25 @@ class BBWindow:
     detail: Dict = field(default_factory=dict)
 
 
+@dataclass
+class BBScenario:
+    """The scenario-atlas stamp (real/scenarios.py): which named
+    production recipe this campaign ran, with its measured heat/abort
+    signature — load concentration, the top range's identity and share,
+    the verdict mix — so forensics over a bare journal can answer
+    "which workload shape produced these batches?"."""
+
+    name: str = ""
+    seed: int = 0
+    engine_mode: str = ""
+    concentration: float = 0.0
+    top_range: Optional[str] = None
+    top_share: float = 0.0
+    abort_frac: float = 0.0
+    throttle_frac: float = 0.0
+    witnesses: int = 0
+
+
 #: The CLOSED event schema: kind -> wire record type. Policed by the
 #: fdbtpu-lint `blackbox-registry` rule — a `record_event("<kind>", ...)`
 #: whose kind is not a key here is a lint finding, so the journal format
@@ -294,6 +313,7 @@ BLACKBOX_EVENT_REGISTRY = {
     "sched": BBSched,
     "snapshot": BBSnapshotEvt,
     "recovery": BBRecovery,
+    "scenario": BBScenario,
 }
 
 for _cls in (BBEnvelope, *BLACKBOX_EVENT_REGISTRY.values()):
@@ -833,6 +853,27 @@ def record_window(w: Dict[str, Any]) -> None:
                       t0=float(w.get("t0", 0.0)),
                       t1=float(w.get("t1", w.get("t0", 0.0))),
                       detail=detail))
+
+
+def record_scenario(name: str, seed: int, engine_mode: str,
+                    signature: Dict[str, Any]) -> None:
+    """The scenario-atlas stamp (real/scenarios.py build_signature):
+    written once per named campaign while the journal is still
+    installed, so a bare journal directory identifies the production
+    recipe — and its measured heat/abort signature — that produced it."""
+    j = _g[0]
+    if j is None:
+        return
+    j.record("scenario",
+             BBScenario(
+                 name=str(name), seed=int(seed),
+                 engine_mode=str(engine_mode),
+                 concentration=float(signature.get("concentration", 0.0)),
+                 top_range=signature.get("top_range"),
+                 top_share=float(signature.get("top_share", 0.0)),
+                 abort_frac=float(signature.get("abort_frac", 0.0)),
+                 throttle_frac=float(signature.get("throttle_frac", 0.0)),
+                 witnesses=int(signature.get("witnesses", 0))))
 
 
 def record_snapshot(version: int, oldest: int, entries: int,

@@ -578,6 +578,10 @@ class TelemetryHub:
                     "recovery/failure counts, in-flight age, worst "
                     "blackout microseconds — the recovery_stalled "
                     "rule's series)",
+        "scenario": "scenario-atlas scorecard gauges (real/scenarios.py "
+                    "publish_scenario: per-scenario p99 microseconds, "
+                    "abort/throttle fractions and heat concentration as "
+                    "x1000 fixed-point, slo_pass 0/1)",
     }
 
     @staticmethod

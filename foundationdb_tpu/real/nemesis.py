@@ -80,6 +80,16 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+def _key_range(k) -> KeyRange:
+    """Conflict range of one submitted key entry: a point key (bytes)
+    covers [k, k\\x00); a (begin, end) pair — the ttl_cache shape's TTL
+    sweep — covers the whole [begin, end) segment in ONE range."""
+    if isinstance(k, (tuple, list)):
+        begin, end = k
+        return KeyRange(begin, end)
+    return KeyRange(k, k + b"\x00")
+
+
 def _small_kernel_cfg():
     from ..ops.conflict_kernel import KernelConfig
 
@@ -347,10 +357,13 @@ class ChaosCommitServer:
                            parent=ctx.parent, err="transaction_throttled",
                            tenant=tenant, Proc=self._span_proc)
             raise error.transaction_throttled(f"tenant {tenant}")
+        # a key entry is a point key (bytes) or a (begin, end) RANGE pair
+        # (TTL sweeps — workload.py TxnShaper "ttl_cache"): one conflict
+        # range either way, so range deletes cost one interval-table row
         txn = CommitTransaction(
             read_snapshot=int(snapshot),
-            read_conflict_ranges=[KeyRange(k, k + b"\x00") for k in reads],
-            write_conflict_ranges=[KeyRange(k, k + b"\x00") for k in writes])
+            read_conflict_ranges=[_key_range(k) for k in reads],
+            write_conflict_ranges=[_key_range(k) for k in writes])
         p = Promise()
         #: meta cell: the batcher writes the batch's commit version here
         #: before dispatch, so even a conflicted/too-old verdict's server
@@ -641,6 +654,12 @@ class NemesisConfig:
     #: fleet's submit loop retries transaction_conflict_predicted with a
     #: refreshed read version (the pre-abort contract, docs/scheduling.md)
     sched: Optional[bool] = None
+    #: scenario-atlas stamp (real/scenarios.py): the named recipe this
+    #: campaign instantiates. Stamped into the report, the heat/abort
+    #: SIGNATURE computed while the black-box journal is still installed
+    #: (a `scenario` event), and `scenario.<name>.*` telemetry gauges —
+    #: None keeps the pre-atlas campaign byte-identical
+    scenario: Optional[str] = None
 
     #: budget multiplier for CPU-emulated device modes: a real chip-
     #: adjacent resolver serves a batch in well under a millisecond, but
@@ -747,6 +766,14 @@ class CampaignReport:
     #: predictor hot ranges and the mispredict fraction — `cli sched
     #: REPORT.json` renders it
     sched: Optional[dict] = None
+    #: scenario-atlas stamp (real/scenarios.py): which named recipe this
+    #: campaign ran (None on pre-atlas / unnamed campaigns — `cli atlas`
+    #: renders the absence as "—", never a KeyError)
+    scenario: Optional[str] = None
+    #: the scenario's heat/abort signature (real/scenarios.py
+    #: build_signature): load concentration, top-range shares, verdict
+    #: and witness mix — recorded into the black-box journal too
+    signature: Optional[dict] = None
     wall_s: float = 0.0
 
     def as_dict(self) -> dict:
@@ -1301,6 +1328,21 @@ async def _campaign(cfg: NemesisConfig) -> CampaignReport:
             with open(cfg.trace_export, "w") as f:
                 json.dump(doc, f, default=str)
             report.trace_file = cfg.trace_export
+        if cfg.scenario is not None:
+            # scenario-atlas stamp (real/scenarios.py): the recipe name
+            # + the heat/abort signature ride the report, the
+            # `scenario.<name>.*` telemetry gauges, and — while the
+            # journal is still installed — a black-box `scenario` event,
+            # so post-hoc forensics can answer "which production shape
+            # was this run?" from the journal alone
+            from .scenarios import build_signature, publish_scenario
+
+            report.scenario = cfg.scenario
+            report.signature = build_signature(report)
+            publish_scenario(cfg.scenario, report)
+            if blackbox.enabled():
+                blackbox.record_scenario(cfg.scenario, cfg.seed,
+                                         cfg.engine_mode, report.signature)
         if bb is not None:
             report.blackbox = bb.summary()
     finally:
@@ -1332,6 +1374,15 @@ def assert_slos(report: CampaignReport, cfg: NemesisConfig,
     full report on any breach (docs/real_cluster.md, 'SLO contract')."""
     budget = cfg.resolved_budget_ms()
     ctx = json.dumps(report.as_dict(), default=str)
+    if cfg.scenario is not None:
+        # scenario-atlas stamp integrity (real/scenarios.py): a NAMED
+        # campaign must carry its name and heat/abort signature — the
+        # scenario's own budget rows (abort/throttle fractions, witness
+        # mix) are then asserted by scenarios.assert_scenario_slos on top
+        assert report.scenario == cfg.scenario, \
+            f"scenario stamp lost ({report.scenario!r}): {ctx}"
+        assert report.signature, \
+            f"scenario {cfg.scenario} recorded no signature: {ctx}"
     assert report.parity_checked > 0, f"no journal batches to replay: {ctx}"
     assert report.parity_mismatches == 0, \
         f"abort sets NOT bit-identical to the clean oracle: {ctx}"

@@ -32,6 +32,13 @@ from ..core.rng import DeterministicRandom
 #: ack error names that are honest, full-path verdicts (their latency
 #: belongs in the SLO population, like the sim harness's conflict acks)
 VERDICT_ERRORS = ("not_committed", "transaction_too_old")
+#: txn-shape registry (docs/scenarios.md): how a tenant stream turns
+#: sampled key indices into (reads, writes) lists. "zipf" is the classic
+#: independent point-read/point-write stream every pre-atlas campaign
+#: ran; the rest model the scenario atlas's production access shapes. A
+#: write entry is either a point key (bytes) or a (begin, end) RANGE
+#: tuple — TTL sweeps clear whole segments in one conflict range.
+TXN_SHAPES = ("zipf", "rmw", "fanout", "monotone", "queue", "ttl_cache")
 #: fast typed rejection from per-tenant admission control — NOT a latency
 #: sample (the tenant was told to back off in microseconds); reported as
 #: rejected_frac instead
@@ -91,9 +98,109 @@ class TenantSpec:
     #: Zipf head sweeps the pool at this speed, so load concentration
     #: MOVES through the keyspace over the campaign
     drift_keys_per_s: float = 0.0
+    #: txn shape (one of TXN_SHAPES): how sampled indices become the
+    #: commit's reads/writes. "zipf" keeps the pre-atlas stream
+    #: byte-identical (same rng draws in the same order).
+    shape: str = "zipf"
+    #: ttl_cache only: one commit in `ttl_sweep_every` is a TTL sweep —
+    #: ONE (begin, end) range delete spanning `ttl_sweep_span` key
+    #: indices of the tenant's pool
+    ttl_sweep_every: int = 24
+    ttl_sweep_span: int = 64
 
     def prefix(self) -> bytes:
         return self.key_prefix or self.name.encode()
+
+
+class TxnShaper:
+    """Per-stream seeded (reads, writes) generator for one tenant.
+
+    One instance per tenant stream: the monotone/queue shapes carry a
+    tail counter, and the op-mix shapes draw from their OWN
+    DeterministicRandom so the sampler's Zipf stream stays untouched.
+    The "zipf" shape is stateless and never touches `rng` — the fleet
+    passes rng=None there so the legacy per-tenant seed stream (and
+    therefore every pre-atlas campaign) is byte-identical."""
+
+    def __init__(self, spec: TenantSpec, sampler: ZipfKeySampler,
+                 rng: Optional[DeterministicRandom] = None):
+        if spec.shape not in TXN_SHAPES:
+            raise ValueError(
+                f"unknown txn shape {spec.shape!r} (one of {TXN_SHAPES})")
+        self.spec = spec
+        self.sampler = sampler
+        self.rng = rng
+        #: monotone/queue tail position (key index of the newest row)
+        self.counter = 0
+
+    def build(self, t_rel: float = 0.0) -> Tuple[List, List]:
+        spec, sampler = self.spec, self.sampler
+        pfx = spec.prefix()
+
+        def key(i: int) -> bytes:
+            return b"%s/%06d" % (pfx, max(int(i), 0))
+
+        shape = spec.shape
+        if shape == "zipf":
+            reads = [key(sampler.sample(t_rel))
+                     for _ in range(spec.reads_per_txn)]
+            writes = [key(sampler.sample(t_rel))
+                      for _ in range(spec.writes_per_txn)]
+            return reads, writes
+        if shape == "rmw":
+            # read-modify-write chain: every written row is read first
+            # at the same snapshot (the balance rows of a payment
+            # ledger) — the conflict-heavy shape Proust's design-space
+            # analysis shows optimistic schemes bite on
+            ks, seen = [], set()
+            for _ in range(max(spec.writes_per_txn, 1)):
+                i = sampler.sample(t_rel)
+                if i not in seen:
+                    seen.add(i)
+                    ks.append(i)
+            keys = [key(i) for i in ks]
+            return keys, list(keys)
+        if shape == "fanout":
+            # secondary-index maintenance: one base-row update fans out
+            # to index entries under disjoint `.ixN` prefixes — ONE txn
+            # whose conflict ranges span multiple key ranges
+            base = sampler.sample(t_rel)
+            writes = [key(base)] + [
+                b"%s.ix%d/%06d" % (pfx, j, sampler.sample(t_rel))
+                for j in range(max(spec.writes_per_txn, 1))]
+            return [key(base)], writes
+        if shape == "monotone":
+            # time-series ingest: every commit appends at the tail, so
+            # the hottest range is always the NEWEST one — adversarial
+            # for static key-range splits (the tail outruns any split
+            # chosen from past heat)
+            self.counter += 1
+            tail = self.counter
+            reads = [key(tail - 1 - self.rng.random_int(0, 8))
+                     for _ in range(max(spec.reads_per_txn, 1))]
+            return reads, [key(tail)]
+        if shape == "queue":
+            # task queue: producers append at the tail, consumers claim
+            # at the head by read-then-write of the same slot — the
+            # future commutative-lane showcase (appends commute; claims
+            # contend on the head)
+            if self.rng.random01() < 0.5:
+                self.counter += 1
+                return [], [key(self.counter)]
+            head = self.counter - self.rng.random_int(0, 15)
+            return [key(head)], [key(head)]
+        # ttl_cache — session cache: read-mostly point gets with a
+        # cadenced TTL sweep: ONE (begin, end) RANGE delete clearing a
+        # cold segment of the pool in a single conflict range
+        self.counter += 1
+        if self.counter % max(spec.ttl_sweep_every, 1) == 0:
+            lo = sampler.sample(t_rel)
+            return [], [(key(lo), key(lo + max(spec.ttl_sweep_span, 1)))]
+        reads = [key(sampler.sample(t_rel))
+                 for _ in range(max(spec.reads_per_txn, 1))]
+        writes = ([key(sampler.sample(t_rel))]
+                  if self.rng.random01() < 0.125 else [])
+        return reads, writes
 
 
 @dataclass
@@ -172,16 +279,12 @@ class WorkloadFleet:
         self._outstanding: Dict[str, int] = {}
         self._phase_start = 0.0
 
-    async def _one_txn(self, spec: TenantSpec, sampler: ZipfKeySampler) -> None:
+    async def _one_txn(self, spec: TenantSpec, shaper: TxnShaper) -> None:
         from ..core import error as _error
 
         rep = self.report
-        pfx = spec.prefix()
         t_rel = time.monotonic() - (rep.t_start or self._phase_start)
-        reads = [b"%s/%06d" % (pfx, sampler.sample(t_rel))
-                 for _ in range(spec.reads_per_txn)]
-        writes = [b"%s/%06d" % (pfx, sampler.sample(t_rel))
-                  for _ in range(spec.writes_per_txn)]
+        reads, writes = shaper.build(t_rel)
         t0 = time.monotonic()
         ok, version, err = False, None, None
         try:
@@ -203,6 +306,11 @@ class WorkloadFleet:
         sampler = ZipfKeySampler(spec.n_keys, spec.s,
                                  DeterministicRandom(rng.random_int(0, 2**31 - 1)),
                                  drift=spec.drift_keys_per_s)
+        # the zipf shape draws NO extra seed: the legacy arrival stream
+        # (and every pre-atlas campaign) stays byte-identical
+        shape_rng = (DeterministicRandom(rng.random_int(0, 2**31 - 1))
+                     if spec.shape != "zipf" else None)
+        shaper = TxnShaper(spec, sampler, shape_rng)
         lam = max(spec.target_tps, 1e-3)
         t_end = self._phase_start + self.duration_s
         tasks: set = set()
@@ -215,7 +323,7 @@ class WorkloadFleet:
                 e["client_overload"] = e.get("client_overload", 0) + 1
                 continue
             self._outstanding[spec.name] += 1
-            t = asyncio.ensure_future(self._one_txn(spec, sampler))
+            t = asyncio.ensure_future(self._one_txn(spec, shaper))
             tasks.add(t)
             t.add_done_callback(tasks.discard)
         if tasks:

@@ -140,6 +140,23 @@ METRICS: Tuple[Metric, ...] = (
     Metric("recovery", "crash.blackout_ms",
            "crash recovery blackout ms", higher_is_better=False,
            noise_frac=0.5),
+    # scenario atlas (real/scenarios.py, recorded from BENCH_r11): every
+    # scenario's SLO verdict is a zero-noise HEADLINE — a 1 -> 0 drop in
+    # ANY recipe fails the gate outright, and a scenario that stops
+    # being recorded trips the headline went-missing check. The measured
+    # abort fractions ride along informationally at chaos-grade noise.
+    *(Metric("scenario_atlas", f"scenarios.{name}.slo_pass",
+             f"{name} scenario SLO pass", headline=True, noise_frac=0.0)
+      for name in ("flash_sale", "payment_ledger", "secondary_index",
+                   "task_queue", "timeseries_ingest", "session_cache")),
+    # sustained tps is bounded above by the recipe's fixed offered rate
+    # (it can't inflate), so a beyond-noise drop is a real serving
+    # regression; the abort/throttle fractions are judged by slo_pass
+    # instead of raw trend rows — at ~0.005 absolute they are too
+    # ratio-noisy for a relative gate
+    *(Metric("scenario_atlas", f"scenarios.{name}.sustained_tps",
+             f"{name} scenario sustained txn/s", noise_frac=0.5)
+      for name in ("flash_sale", "payment_ledger", "session_cache")),
 )
 
 

@@ -71,6 +71,12 @@ commands:
                        fault windows, resolver health transitions and the
                        trace root cause ("p99 burn firing · overlaps
                        partition window · dominant=server_resolve")
+  atlas [FILE.json]    scenario-atlas scorecards (docs/scenarios.md):
+                       per-scenario SLO verdicts (p99/abort/throttle/
+                       parity/incidents) + heat/abort signatures — from
+                       a campaign report JSON with scenario stamps, a
+                       bench artifact's scenario_atlas section, or this
+                       process's scenario.* gauges
   chaos-status [FILE]  nemesis event counts from this process's telemetry
                        hub, or from a campaign report JSON written by
                        `python -m foundationdb_tpu.real.nemesis --json`
@@ -480,6 +486,110 @@ class Cli:
         if not rendered:
             self._print("no keyspace heat yet (oracle engines, "
                         "resolver_heat_buckets=0, or no traffic)")
+
+    # -- scenario atlas (docs/scenarios.md) ---------------------------------
+    def _render_atlas_campaigns(self, path: str, rows) -> int:
+        """Cross-campaign scorecard table from report campaigns. Every
+        campaign gets a row; fields a pre-atlas report never recorded
+        (`scenario`, `signature`) render as `—`, never a KeyError."""
+        self._print(f"{len(rows)} campaign(s) in {path}")
+        self._print(f"  {'scenario':<18} {'seed':>5} {'mode':<11} "
+                    f"{'p99ms':>8} {'abort':>6} {'thrtl':>6} {'conc':>6} "
+                    f"{'parity':>9}  top range")
+        stamped = 0
+        for _label, rep in rows:
+            name = rep.get("scenario") or "—"
+            sig = rep.get("signature") or {}
+            if rep.get("scenario"):
+                stamped += 1
+            p99 = rep.get("p99_outside_ms")
+            def frac(k):
+                return f"{sig[k]:.3f}" if k in sig else "—"
+            top = (f"{sig['top_range']!r} ({sig.get('top_share', 0) * 100:.0f}%)"
+                   if sig.get("top_range") else "—")
+            self._print(
+                f"  {name:<18} {rep.get('cfg_seed', 0):>5} "
+                f"{str(rep.get('engine_mode')):<11} "
+                f"{(f'{p99:.2f}' if isinstance(p99, (int, float)) else '—'):>8} "
+                f"{frac('abort_frac'):>6} {frac('throttle_frac'):>6} "
+                f"{frac('concentration'):>6} "
+                f"{rep.get('parity_checked', 0):>5}/{rep.get('parity_mismatches', 0)}mm"
+                f"  {top}")
+        if not stamped:
+            self._print("  (no scenario stamps — pre-atlas report; run "
+                        "real/scenarios.py recipes to record signatures)")
+        return len(rows)
+
+    def _render_atlas_section(self, sa: dict) -> None:
+        """Bench-artifact scenario_atlas section: the full scorecard."""
+        self._print(f"scenario atlas — seed {sa.get('seed')} "
+                    f"[{sa.get('engine_mode')}], "
+                    f"{sa.get('seconds')}s per scenario, "
+                    f"{'ALL GREEN' if sa.get('all_green') else 'RED'}")
+        self._print(f"  {'scenario':<18} {'slo':<4} {'p99ms':>8} "
+                    f"{'budget':>7} {'abort':>12} {'throttle':>12} "
+                    f"{'tps':>6} {'commits':>7} {'resh':>4}")
+        for row in sa.get("scorecard", []):
+            p99 = row.get("p99_ms")
+            self._print(
+                f"  {row.get('scenario', '—'):<18} "
+                f"{'ok' if row.get('slo_pass') else 'RED':<4} "
+                f"{(f'{p99:.2f}' if isinstance(p99, (int, float)) else '—'):>8} "
+                f"{row.get('budget_ms', 0):>7.0f} "
+                f"{row.get('abort_frac', 0):>5.3f}<={row.get('max_abort_frac', 0):<5.2f} "
+                f"{row.get('throttle_frac', 0):>5.3f}<={row.get('max_throttle_frac', 0):<5.2f} "
+                f"{row.get('sustained_tps', 0):>6.1f} "
+                f"{row.get('committed', 0):>7} "
+                f"{row.get('reshards_executed', 0):>4}")
+
+    def do_atlas(self, args: List[str]) -> None:
+        """Scenario-atlas scorecards (docs/scenarios.md): per-scenario
+        SLO verdicts and heat/abort signatures — cluster-less from a
+        campaign report JSON (real/nemesis.py --json with scenario
+        stamps) or a bench artifact with a scenario_atlas section, or
+        live from this process's scenario.* telemetry gauges after an
+        in-process run_scenario."""
+        if args and args[0].endswith(".json"):
+            doc, rows = self._report_campaigns(args[0])
+            if doc is None:
+                return
+            rendered = 0
+            if rows:
+                rendered += self._render_atlas_campaigns(args[0], rows)
+            sa = (doc.get("parsed", doc)).get("scenario_atlas")
+            if sa and not sa.get("error"):
+                self._render_atlas_section(sa)
+                rendered += 1
+            if not rendered:
+                self._print(f"no scenario records in {args[0]} (neither "
+                            "campaign reports nor a scenario_atlas "
+                            "bench section)")
+            return
+        from ..core import telemetry
+
+        metrics = telemetry.hub().tdmetrics.metrics
+        by_scenario: dict = {}
+        for name, m in metrics.items():
+            if name.startswith("scenario."):
+                _, scen, metric = name.split(".", 2)
+                by_scenario.setdefault(scen, {})[metric] = int(
+                    getattr(m, "value", 0))
+        if not by_scenario:
+            self._print("no scenario gauges in this process "
+                        "(run real/scenarios.py run_scenario first, or "
+                        "point at a report: atlas REPORT.json)")
+            return
+        for scen in sorted(by_scenario):
+            g = by_scenario[scen]
+            verdict = g.get("slo_pass")
+            self._print(
+                f"  {scen:<18} "
+                f"{'ok' if verdict else ('RED' if verdict == 0 else '—'):<4}"
+                f" p99={g.get('p99_us', -1) / 1000:.2f}ms"
+                f" abort={g.get('abort_frac_x1000', 0) / 1000:.3f}"
+                f" throttle={g.get('throttle_frac_x1000', 0) / 1000:.3f}"
+                f" conc={g.get('concentration_x1000', 0) / 1000:.3f}"
+                f" commits={g.get('committed', 0)}")
 
     # -- conflict-aware admission (docs/scheduling.md) ----------------------
     def _render_sched(self, label: str, snap: dict) -> None:
@@ -1306,7 +1416,7 @@ def main(argv=None) -> int:
                          "`chaos-status reports.json`, `status`)")
     args = ap.parse_args(argv)
     cmd0 = args.command[0].replace("-", "_") if args.command else ""
-    if cmd0 in ("chaos_status", "trace") or (
+    if cmd0 in ("chaos_status", "trace", "atlas") or (
             cmd0 in ("heat", "sched", "alerts", "incidents", "shards")
             and len(args.command) > 1
             and args.command[1].endswith(".json")):
@@ -1317,6 +1427,8 @@ def main(argv=None) -> int:
         cli.out = sys.stdout
         if cmd0 == "chaos_status":
             cli.do_chaos_status(args.command[1:])
+        elif cmd0 == "atlas":
+            cli.do_atlas(args.command[1:])
         elif cmd0 == "heat":
             cli.do_heat(args.command[1:])
         elif cmd0 == "sched":

@@ -422,6 +422,31 @@ def render(artifacts: List[Tuple[str, dict]]) -> str:
             f"client-observed figure — {detail}" + s.tag(i),
         ]
 
+    i = s.newest(lambda m: (m.get("scenario_atlas") or {}).get("scenarios"))
+    if i is not None:
+        sa = artifacts[i][1]["scenario_atlas"]
+        scen = sa["scenarios"]
+        n_green = sum(1 for r in scen.values() if r.get("slo_pass"))
+        conc = max(scen.items(),
+                   key=lambda kv: kv[1].get("concentration", 0))
+        detail = ", ".join(
+            f"{name} {'✓' if r.get('slo_pass') else '✗'}"
+            f" {r.get('sustained_tps', 0):.0f} tps"
+            for name, r in scen.items())
+        lines += [
+            "- **scenario atlas** (`docs/scenarios.md`): six named "
+            "production recipes — flash-sale hotspot, payment ledger, "
+            "secondary-index fan-out, task queue, time-series ingest, "
+            "session cache — each a full chaos campaign judged against "
+            f"its own SLO contract: **{n_green}/{len(scen)} scorecards "
+            "green** with journal-replay parity and every watchdog "
+            f"incident explained ({detail}); hottest signature "
+            f"{conc[0]} at {conc[1].get('concentration', 0):.2f} "
+            "load concentration"
+            + s.arrow(i, "scenario_atlas",
+                      "scenarios.flash_sale.sustained_tps") + s.tag(i),
+        ]
+
     lines.append(END)
     return "\n".join(lines)
 

@@ -105,7 +105,7 @@ def test_registry_records_wire_round_trip():
     assert set(blackbox.BLACKBOX_EVENT_REGISTRY) == {
         "batch", "span", "health", "flight", "alert", "incident",
         "reshard", "admission", "heat", "fault_window", "sched",
-        "snapshot", "recovery"}
+        "snapshot", "recovery", "scenario"}
     for kind, cls in blackbox.BLACKBOX_EVENT_REGISTRY.items():
         rec = cls()
         env = blackbox.BBEnvelope(seq=3, t=1.5, kind=kind, payload=rec)
