@@ -84,6 +84,15 @@ watch-smoke:
 bench-history:
 	python -m foundationdb_tpu.tools.bench_history
 
+# Incremental-history smoke (docs/perf.md "Incremental history
+# maintenance", ~30s, solo-CPU safe): isolated apply_writes_and_gc cost
+# at two capacities proves tiered apply scales with the batch not the
+# table, zero post-warmup compiles across several lazy compactions, a
+# monolithic/tiered/oracle parity canary, and a strict parse of the
+# fdbtpu_history Prometheus family.
+history-smoke:
+	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.history_smoke
+
 # Online-resharding smoke (docs/elasticity.md, ~45s, solo-CPU safe — one
 # process, no sockets, do not overlap with tier-1): synthetic drift
 # against REAL jax engines drives one split AND one merge end-to-end
@@ -201,4 +210,4 @@ chaos-real:
 	JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.cli \
 		explain --slo _artifacts/chaos_real_report.json
 
-.PHONY: check bench bench-smoke telemetry-smoke heat-smoke sched-smoke trace-smoke chaos chaos-real chaos-drift chaos-crash reshard-smoke mesh-smoke lint perf-smoke bench-history watch-smoke forensics-smoke crash-smoke atlas-smoke
+.PHONY: check bench bench-smoke telemetry-smoke heat-smoke sched-smoke trace-smoke chaos chaos-real chaos-drift chaos-crash reshard-smoke mesh-smoke lint perf-smoke bench-history watch-smoke forensics-smoke crash-smoke atlas-smoke history-smoke

@@ -693,8 +693,17 @@ def history_floor_section(smoke: bool = False):
     whose cost tracks the batch. tools/floor_bench.py owns the
     methodology (synthesized table at exact occupancy, read-only batches,
     scan timing, zero-recompile counters); `make bench-smoke` drives the
-    same sweep on CPU."""
-    from foundationdb_tpu.tools.floor_bench import run_floor_sweep
+    same sweep on CPU.
+
+    The `apply` sub-section (recorded since BENCH_r12) is the MAINTENANCE
+    floor (docs/perf.md "Incremental history maintenance"): isolated
+    `apply_writes_and_gc` cost vs occupancy, monolithic vs tiered, at the
+    512-txn production point with SMALL-TOUCH batches (read-mostly
+    transactions, 64 point-write rows against a 24k-row table — the
+    regime the tiered structure exists for; a write-heavy batch touching
+    ~capacity/11 rows per apply amortizes to parity and is not the
+    claim). Tiered apply must scale with the batch, not the capacity."""
+    from foundationdb_tpu.tools.floor_bench import run_apply_sweep, run_floor_sweep
 
     # pallas is the production fixpoint; the xla fallback keeps the
     # section alive on backends without the fused kernel (CPU runs) —
@@ -708,10 +717,22 @@ def history_floor_section(smoke: bool = False):
             max_reads=64, max_writes=64, max_txns=512, fixpoint=fixpoint,
         )
         try:
-            return run_floor_sweep(
+            out = run_floor_sweep(
                 cfg, scan_steps=64 if (smoke or PROFILE == "cpu") else 256)
         except Exception:
             continue
+        try:
+            apply_cfg = ck.KernelConfig(
+                key_words=4, capacity=CFG.capacity,
+                max_point_reads=1024, max_point_writes=64,
+                max_reads=64, max_writes=16, max_txns=512,
+                fixpoint=fixpoint,
+            )
+            out["apply"] = run_apply_sweep(
+                apply_cfg, scan_steps=48 if (smoke or PROFILE == "cpu") else 128)
+        except Exception:
+            out["apply"] = None
+        return out
     return None
 
 

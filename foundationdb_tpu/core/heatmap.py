@@ -97,6 +97,19 @@ class KeyRangeHeatAggregator:
         self.occupancy = 0
         self.gc_reclaimed_total = 0
         self.verdict_totals = {"committed": 0, "conflicts": 0, "too_old": 0}
+        # tiered-history run accounting (docs/perf.md "Incremental history
+        # maintenance"): mirrored host-side from the heat aggregate's
+        # `runs` leaf — the live run-stack depth each batch leaves behind.
+        # Appends/merges are derived from per-shard depth TRANSITIONS
+        # (depth up by d = d appends; depth down = one lazy merge
+        # compacted the stack, and the post-merge depth is the appends it
+        # was left with), so the counters are exact with zero device
+        # syncs. Monolithic engines never emit the leaf; everything stays 0.
+        self.history_appends_total = 0
+        self.history_merges_total = 0
+        self.history_runs_live = 0
+        self.history_run_rows_live = 0
+        self._hist_nruns: Dict[int, int] = {}
         #: recent first-witness abort attributions: which prior write
         #: (version) killed a transaction, and in which key range
         self.attribution: deque = deque(maxlen=self.MAX_ATTRIBUTION)
@@ -142,15 +155,20 @@ class KeyRangeHeatAggregator:
         self.verdict_totals["too_old"] += int(counts0[C_TOO_OLD])
         self.occupancy = sum(int(np.asarray(h["occupancy"]))
                              for h in per_shard)
+        if "run_rows" in per_shard[0]:
+            self.history_run_rows_live = sum(
+                int(np.asarray(h["run_rows"])) for h in per_shard)
         if self.decay < 1.0 and self._w:
             for w in self._w.values():
                 w *= self.decay
         samples = 0
-        for heat in per_shard:
+        for si, heat in enumerate(per_shard):
             bounds = np.asarray(heat["bounds"])
             hist = np.asarray(heat["hist"], dtype=np.int64)
             self.gc_reclaimed_total += int(
                 np.asarray(heat["counts"], dtype=np.int64)[C_RECLAIMED])
+            if "runs" in heat:
+                self._note_history_runs(si, int(np.asarray(heat["runs"])))
             keys = _unpack_keys(bounds, self.key_words)
             for b, key in enumerate(keys):
                 row = hist[b]
@@ -181,6 +199,38 @@ class KeyRangeHeatAggregator:
                         "range_begin": keys[int(wb[t])],
                     })
         self._prune()
+
+    def _note_history_runs(self, shard: int, nruns: int) -> None:
+        """Fold one shard's post-apply run-stack depth into the derived
+        append/merge counters (see __init__). `nruns == 0` with a prior
+        nonzero depth is a zero-initialized plane (a loop slot that never
+        ran a batch), not a merge — real merges always fire under a batch
+        that then appends, leaving depth >= 1."""
+        old = self._hist_nruns.get(shard, 0)
+        if nruns > old:
+            self.history_appends_total += nruns - old
+        elif 0 < nruns < old:
+            # the stack can only SHRINK through a lazy merge: the slots
+            # were full at apply time, the merge retired them into the
+            # base table, and the depth left behind is the batch's own
+            # appends (1 on the device path). Equal depth is a
+            # write-free batch — no append, no merge.
+            self.history_merges_total += 1
+            self.history_appends_total += nruns
+        else:
+            return  # equal depth (no writes) or a zero-initialized plane
+        self._hist_nruns[shard] = nruns
+        self.history_runs_live = sum(self._hist_nruns.values())
+
+    def history_snapshot(self) -> Dict[str, int]:
+        """The tiered-history counter fragment (host_engine
+        history_stats_snapshot merges it under the structure identity)."""
+        return {
+            "appends": self.history_appends_total,
+            "merges": self.history_merges_total,
+            "runs_live": self.history_runs_live,
+            "run_rows_live": self.history_run_rows_live,
+        }
 
     def observe_batch(self, transactions, verdicts,
                       version: Optional[int] = None) -> None:
@@ -495,6 +545,7 @@ class KeyRangeHeatAggregator:
             "split_balance": [round(f, 4)
                               for f in self.split_balance(shards, splits)],
             "recent_attribution": list(self.attribution)[-top_n:],
+            "history": self.history_snapshot(),
         }
 
 

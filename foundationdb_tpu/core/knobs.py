@@ -155,6 +155,28 @@ def _make_server_knobs() -> Knobs:
     #: Deliberately no BUGGIFY randomizer: the modes are proven equivalent
     #: directly, and a randomizer draw would shift every sim's rng stream.
     k.init("resolver_history_search_mode", "auto")
+    #: history STRUCTURE of the device interval table (docs/perf.md
+    #: "Incremental history maintenance"): "monolithic" (default) re-merges
+    #: the full capacity-H boundary table every batch; "tiered" appends
+    #: each batch's committed-write union as a sorted run and compacts
+    #: runs into the base table only when the run slots fill, so
+    #: steady-state apply cost scales with the batch, not capacity, and
+    #: MVCC-horizon/TTL GC becomes a range deletion (an elementwise
+    #: horizon rebase; physical reclamation rides the lazy merge). Abort
+    #: sets are bit-identical either way (the cross-structure parity
+    #: suite pins it); this knob moves apply/GC device time. Engines take
+    #: a `history_structure=` constructor override; a flip is a clean
+    #: progcache miss (core/progcache.py key(structure=)). Deliberately
+    #: no BUGGIFY randomizer: equivalence is proven directly, and a
+    #: randomizer draw would shift every sim's rng stream.
+    k.init("resolver_history_structure", "monolithic")
+    #: run slots of the tiered history structure (KernelConfig
+    #: .history_runs): how many sorted runs accumulate before the lazy
+    #: device-side merge compacts them into the base table. More slots =
+    #: cheaper steady-state applies but more run probes per query; >= 2
+    #: required (one slot would merge every batch). Only read when the
+    #: structure is "tiered".
+    k.init("resolver_history_runs", 8)
     #: device-resident resolver loop (docs/perf.md "Device-resident
     #: loop"), consulted by the engine-mode router
     #: (host_engine.default_engine_mode — wall-clock nodes pick it up via

@@ -95,17 +95,25 @@ class ProgramCache:
     # -- keying ---------------------------------------------------------------
     def key(self, *, engine: str, bucket: int, n_chunks: int,
             search_mode: str, dispatch_mode: str, mesh: str = "",
-            variant: str = "") -> str:
+            variant: str = "", structure: str = "") -> str:
         """`mesh` is the engine's sharding-layout fingerprint
         (RoutedConflictEngineBase._progcache_fingerprint): "" for the
         single-device families, "mesh:<S>/<ndev>"-shaped for engines whose
         programs bake a device mesh — two engines whose programs differ
         only in mesh topology must never share an entry. `variant` names
         one program of a multi-program dispatch unit (the mesh engine's
-        split "scan" / "exchange" pair under one (bucket, n_chunks))."""
+        split "scan" / "exchange" pair under one (bucket, n_chunks)).
+        `structure` is the history-structure fingerprint
+        (RoutedConflictEngineBase._history_fingerprint): "" for the
+        monolithic table (so pre-existing entries keep their hashes),
+        "tiered:<runs>x<rows>"-shaped when the program bakes the tiered
+        sorted-run planes — a structure flip must be a clean miss, never
+        a poisoned hit against mismatched state trees."""
         blob = "|".join(map(str, (backend_fingerprint(), engine, bucket,
                                   n_chunks, search_mode, dispatch_mode,
                                   mesh, variant)))
+        if structure:
+            blob += "|" + structure
         return hashlib.sha256(blob.encode()).hexdigest()[:40]
 
     def _path(self, key: str) -> str:

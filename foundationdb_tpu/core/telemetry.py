@@ -106,6 +106,10 @@ class TelemetryHub:
         #: in-flight recovery age feeds the `recovery_stalled` rule,
         #: completed arcs feed the blackout gauges)
         self._recoveries: Dict[str, "weakref.ref"] = {}
+        #: label -> weakref to a tiered-history engine (ops/host_engine.py
+        #: — run/merge accounting mirrored from the heat aggregate's
+        #: `runs` leaf, synced as `history.<label>.*` / fdbtpu_history)
+        self._histories: Dict[str, "weakref.ref"] = {}
         self._seq = 0
         #: bounded ring of recent nemesis/chaos events (real/chaos.py,
         #: real/nemesis.py) — rendered by `tools/cli.py chaos-status`
@@ -244,6 +248,18 @@ class TelemetryHub:
         synced as `heat.<label>.*` series."""
         label = self._label("heat", name)
         self._heat[label] = weakref.ref(aggregator)
+        return label
+
+    def register_history(self, engine, name: str = "history") -> str:
+        """An engine running the TIERED history structure
+        (ops/host_engine.py): structure identity plus the run
+        append/merge counters its heat aggregator derives from the
+        device heat aggregate's run-depth leaf, synced as
+        `history.<label>.*` series — the `fdbtpu_history` family.
+        Monolithic engines never register (the exposition stays
+        byte-stable for the fleet that hasn't flipped the knob)."""
+        label = self._label("history", name)
+        self._histories[label] = weakref.ref(engine)
         return label
 
     @staticmethod
@@ -477,6 +493,26 @@ class TelemetryHub:
                 int(bb.shed_events))
             td.int64(f"blackbox.{label}.durability_gap").set(
                 1 if bb.durability_gap else 0)
+        for label, eng in self._live(self._histories):
+            # tiered-history eyes (ops/host_engine.py
+            # history_stats_snapshot): run-stack depth, append/merge
+            # counters and live tier occupancy — all mirrored from the
+            # per-batch heat aggregate, zero extra device syncs
+            h = eng.history_stats_snapshot()
+            td.int64(f"history.{label}.tiered").set(
+                1 if h.get("structure") == "tiered" else 0)
+            td.int64(f"history.{label}.run_slots").set(
+                int(h.get("run_slots", 0)))
+            td.int64(f"history.{label}.run_rows").set(
+                int(h.get("run_rows", 0)))
+            td.int64(f"history.{label}.appends").set(
+                int(h.get("appends", 0)))
+            td.int64(f"history.{label}.merges").set(
+                int(h.get("merges", 0)))
+            td.int64(f"history.{label}.runs_live").set(
+                int(h.get("runs_live", 0)))
+            td.int64(f"history.{label}.run_rows_live").set(
+                int(h.get("run_rows_live", 0)))
         for label, rt in self._live(self._recoveries):
             # crash-stop recovery eyes (fault/recovery.py): completed
             # and failed recoveries, the worst observed blackout, and
@@ -513,6 +549,8 @@ class TelemetryHub:
                        for label, eng in self._live(self._meshes)},
             "heat": {label: agg.snapshot()
                      for label, agg in self._live(self._heat)},
+            "history": {label: eng.history_stats_snapshot()
+                        for label, eng in self._live(self._histories)},
             "perf_ledgers": {label: led.snapshot()
                              for label, led in self._live(self._perf_ledgers)},
             "admission": {label: adm.as_dict()
@@ -551,6 +589,10 @@ class TelemetryHub:
                 "exchange interval; blocking_syncs must be 0)",
         "heat": "keyspace heat & history-occupancy gauges "
                 "(core/heatmap.py; fractions are x1000 fixed-point)",
+        "history": "tiered-history structure gauges (ops/conflict_kernel"
+                   ".py tiered sorted runs: run-stack depth, append/merge "
+                   "counters, live tier rows — mirrored from the heat "
+                   "aggregate with zero extra syncs)",
         "perf": "compile & memory ledger gauges (core/perfledger.py: "
                 "warmup/steady compile counts and microseconds, "
                 "cost-analysis totals, peak compiled-program HBM bytes)",

@@ -44,6 +44,124 @@ from ..ops.oracle import VersionIntervalMap
 #: (version, ((begin, end), ...)) — one replayable write-history batch
 HistoryBatch = Tuple[Version, Tuple[Tuple[Key, Key], ...]]
 
+#: history-maintenance span segments, on their own timeline like the
+#: reshard protocol arcs (registered with the fdbtpu-lint span-registry
+#: rule; docs/static_analysis.md#span-registry)
+HISTORY_SEGMENTS = (
+    "snapshot",   # device run-plane readback (history_run_snapshots)
+    "slice",      # run-interval decode + range clip + version regroup
+)
+
+
+def _unwrap(engine):
+    unwrap = getattr(engine, "_rewarm_engine", None)
+    return unwrap() if unwrap is not None else engine
+
+
+def _merge_epoch(engine) -> Optional[int]:
+    """Cumulative compaction count the donor's heat layer has observed
+    (KeyRangeHeatAggregator.history_merges_total) — the monotone epoch
+    an incremental run_slice chain is valid within. None when the donor
+    runs without the heat layer (no epoch -> no incremental proof)."""
+    heat = getattr(engine, "heat", None)
+    total = getattr(heat, "history_merges_total", None)
+    return int(total) if total is not None else None
+
+
+def run_watermarks(engine) -> Optional[Tuple[List[int], Optional[int]]]:
+    """(per-shard nruns vector, merge epoch) seeding an incremental
+    run_slice chain; None when the donor does not serve the tiered
+    path. Capture BEFORE reading the shadow for the same round: a batch
+    landing in between is then re-fetched (idempotent duplicate), never
+    skipped."""
+    engine = _unwrap(engine)
+    fn = getattr(engine, "history_run_snapshots", None)
+    if fn is None:
+        return None
+    snaps = fn(since_runs=None)
+    if snaps is None:
+        return None
+    return [int(s["nruns"]) for s in snaps], _merge_epoch(engine)
+
+
+def run_slice(engine, begin: Key, end: Optional[Key],
+              since_runs: Optional[List[int]] = None,
+              since_epoch: Optional[int] = None) -> Optional[dict]:
+    """Pre-copy source straight off a tiered donor's device run planes —
+    the O(delta) sibling of shadow_slice (docs/perf.md "Incremental
+    history maintenance").
+
+    A tiered engine's un-merged sorted runs ARE the committed-write
+    history since the last compaction, so a repeat pre-copy round only
+    needs the runs appended after the previous round's watermark:
+    `since_runs` is the per-shard nruns vector returned by the prior
+    call; pass None for the first round (all active runs). Rows come
+    back range-clipped and regrouped into ascending-version
+    HistoryBatch entries, ready for replay_slice.
+
+    Returns None when the donor cannot serve the path — monolithic
+    structure, no device-state accessor, or a run row whose endpoint
+    was window-truncated (the exact byte key is not recoverable from
+    the device image; the host shadow has it) — callers then fall back
+    to shadow_slice, which is always sufficient. Otherwise returns
+    {"entries": [HistoryBatch...], "watermarks": [per-shard nruns],
+    "epoch": Optional[int], "resync": bool} — resync=True means a
+    compaction consumed runs below a caller watermark (the LSM manifest
+    contract: the delta chain broke, redo a full pre-copy with
+    since_runs=None).
+
+    `since_epoch` is the `epoch` of the prior round (run_watermarks'
+    second element for a fresh chain). It closes the ABA hole the nruns
+    vector alone cannot see: a merge can absorb an uncopied run and
+    subsequent appends can push nruns back past the caller's watermark,
+    so pass the epoch whenever the chain must be PROVEN unbroken —
+    any intervening merge (or a donor without the heat layer to count
+    them) then flags resync."""
+    engine = _unwrap(engine)        # supervised donor: reach the device
+    fn = getattr(engine, "history_run_snapshots", None)
+    if fn is None:
+        return None
+    from ..core.trace import g_spans, span_event, span_now
+
+    spans_on = g_spans.enabled
+    t0 = span_now()
+    snaps = fn(since_runs=since_runs)
+    if snaps is None:
+        return None
+    t_snap = span_now()
+    from ..ops import conflict_kernel as ck
+    from ..ops import keypack
+
+    cfg = engine.cfg
+    kw = cfg.key_words
+    kb = keypack.max_key_bytes(kw)
+    base = int(getattr(engine, "base", 0))
+    epoch = _merge_epoch(engine)
+    resync = since_epoch is not None and (epoch is None
+                                          or epoch != since_epoch)
+    watermarks: List[int] = []
+    by_version: Dict[Version, List[Tuple[Key, Key]]] = {}
+    for s, snap in enumerate(snaps):
+        watermarks.append(int(snap["nruns"]))
+        if since_runs is not None and int(snap["nruns"]) < since_runs[s]:
+            resync = True
+        for kb_row, ke_row, rel_v in ck.run_intervals(snap):
+            if int(kb_row[kw]) > kb or int(ke_row[kw]) > kb:
+                return None     # window-truncated endpoint: shadow has it
+            b = keypack.unpack_key(kb_row, kw)
+            e = keypack.unpack_key(ke_row, kw)
+            c = clip_range(b, e, begin, end)
+            if c is not None:
+                by_version.setdefault(base + rel_v, []).append(c)
+    entries = [(v, tuple(sorted(by_version[v]))) for v in sorted(by_version)]
+    if spans_on:
+        span_event("history.snapshot", base, t0, t_snap,
+                   shards=len(snaps))
+        span_event("history.slice", base, t_snap, span_now(),
+                   entries=len(entries), resync=resync)
+    return {"entries": entries, "watermarks": watermarks, "epoch": epoch,
+            "resync": resync}
+
 
 def clip_range(b: Key, e: Key, begin: Key,
                end: Optional[Key]) -> Optional[Tuple[Key, Key]]:

@@ -227,6 +227,22 @@ class ResilientEngine:
         fn = getattr(self._rewarm_engine(), "heat_snapshot", None)
         return fn(top_n=top_n, brief=brief) if fn is not None else None
 
+    def history_stats_snapshot(self):
+        """Pass-through to the device engine's tiered-history counters
+        (ops/host_engine.py; docs/perf.md "Incremental history
+        maintenance") — run-stack depth and append/merge totals stay
+        visible under supervision; None for engines without the layer."""
+        fn = getattr(self._rewarm_engine(), "history_stats_snapshot", None)
+        return fn() if fn is not None else None
+
+    def history_run_snapshots(self, since_runs=None):
+        """Pass-through to the device engine's O(delta) run-snapshot
+        export (fault/handoff.py run_slice consumes it on the donor side
+        of a reshard) — None for monolithic devices, where the shadow
+        replay is the only rebuild path."""
+        fn = getattr(self._rewarm_engine(), "history_run_snapshots", None)
+        return fn(since_runs=since_runs) if fn is not None else None
+
     async def resolve(self, transactions, now_v, new_oldest):
         """One batch through the supervisor; callers (server/resolver.py,
         pipeline/service.py) enter strictly in commit-version order."""

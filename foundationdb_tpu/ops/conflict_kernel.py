@@ -60,6 +60,22 @@ NEG_VERSION = jnp.int32(-(2**30))
 #:                buckets on a large capacity).
 HISTORY_SEARCH_MODES = ("fused_sort", "bsearch", "auto")
 
+#: history-structure of the device interval table (docs/perf.md
+#: "Incremental history maintenance"):
+#:   monolithic — ONE key-sorted boundary table; apply_writes_and_gc
+#:                re-merges the full capacity-H table every batch (the
+#:                original path; exact, but apply cost scales with H),
+#:   tiered     — LSM-style sorted runs: each batch's committed-write
+#:                union appends as one run (O(batch)); queries probe
+#:                base + active runs with the same branchless K-word
+#:                comparators; a device-side merge folds every run into
+#:                the base only when the run slots fill, and GC becomes
+#:                a range deletion (elementwise horizon rebase, physical
+#:                reclamation deferred to the merge).
+#: Abort sets are bit-identical across structures (tests/test_history_
+#: tiered.py pins monolithic == tiered == the serial oracle).
+HISTORY_STRUCTURES = ("monolithic", "tiered")
+
 
 @dataclass(frozen=True)
 class KernelConfig:
@@ -89,6 +105,22 @@ class KernelConfig:
     #: every step/scan/loop output. Abort sets are bit-identical either
     #: way (the heat pass only READS the verdict path's values).
     heat_buckets: int = 0
+    #: history-structure of the interval table (HISTORY_STRUCTURES):
+    #: "monolithic" re-merges the capacity-H table every batch;
+    #: "tiered" appends each batch as a sorted run and merges lazily,
+    #: so steady-state apply cost scales with the batch, not capacity
+    history_structure: str = "monolithic"
+    #: tiered only: run slots (tiers) before the lazy merge fires. The
+    #: slot count bounds the size ratio runs:base at history_runs *
+    #: run_rows / capacity by construction — filling the last slot IS
+    #: the compaction trigger
+    history_runs: int = 8
+    #: tiered only: rows per run slot; 0 derives 2*w_all (one batch's
+    #: union can never exceed a begin+end row per committed write row).
+    #: bucket() materializes the derived value so every ladder bucket
+    #: shares the exact device state shape (the loop engine lowers its
+    #: programs against state_struct(bucket))
+    history_run_rows: int = 0
 
     @property
     def lanes(self) -> int:     # K: words per packed key incl. length
@@ -130,6 +162,18 @@ class KernelConfig:
     def levels(self) -> int:    # sparse-table levels
         return int(math.ceil(math.log2(self.capacity))) + 1
 
+    @property
+    def run_slots(self) -> int:  # NR: tiered run slots
+        return self.history_runs
+
+    @property
+    def run_rows(self) -> int:   # RC: rows per tiered run slot
+        return self.history_run_rows if self.history_run_rows > 0 else 2 * self.w_all
+
+    @property
+    def run_levels(self) -> int:  # binary-search rounds into one run
+        return int(math.ceil(math.log2(max(2, self.run_rows)))) + 1
+
     def bucket(self, t: int) -> "KernelConfig":
         """Sub-capacity clone for a bucketed kernel ladder: batch-side
         shapes (txns + read/write row caps) scale down to `t` transactions
@@ -163,6 +207,12 @@ class KernelConfig:
             fixpoint=self.fixpoint,
             history_search=self.history_search,
             heat_buckets=self.heat_buckets,
+            history_structure=self.history_structure,
+            history_runs=self.history_runs,
+            # materialize the base config's derived run capacity: bucket
+            # batch shapes scale down but the device state — run planes
+            # included — must stay SHAPE-INVARIANT across the ladder
+            history_run_rows=self.run_rows,
         )
 
 
@@ -185,6 +235,28 @@ def resolved_history_search(cfg: "KernelConfig") -> str:
             f"unknown history_search mode {mode!r}; expected one of "
             f"{HISTORY_SEARCH_MODES}")
     return pick_history_search(cfg) if mode == "auto" else mode
+
+
+def resolved_history_structure(cfg: "KernelConfig") -> str:
+    """Concrete structure ("monolithic" | "tiered") a config traces, with
+    the tiered shape preconditions checked loudly at trace/build time."""
+    structure = cfg.history_structure
+    if structure not in HISTORY_STRUCTURES:
+        raise ValueError(
+            f"unknown history_structure {structure!r}; expected one of "
+            f"{HISTORY_STRUCTURES}")
+    if structure == "tiered":
+        if cfg.history_runs < 2:
+            raise ValueError(
+                f"history_runs={cfg.history_runs} must be >= 2 for the "
+                f"tiered structure (one slot would merge on every batch — "
+                f"strictly worse than monolithic — and the heat-borne run "
+                f"accounting could not distinguish append from merge)")
+        if cfg.run_rows < 2 * cfg.w_all:
+            raise ValueError(
+                f"history_run_rows={cfg.run_rows} cannot hold one batch's "
+                f"committed-write union (needs >= 2*w_all = {2 * cfg.w_all})")
+    return structure
 
 
 def _key_less(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -230,13 +302,21 @@ def _lower_bound(cfg: KernelConfig, hkeys: jnp.ndarray, n: jnp.ndarray,
     Matches the fused sort's tie discipline exactly: table rows sort AFTER
     equal batch keys there, so its positional count equals this standard
     lower bound (first index with hkeys[i] >= q)."""
+    return _lower_bound_n(hkeys, n, q, cfg.levels)
+
+
+def _lower_bound_n(table: jnp.ndarray, n: jnp.ndarray, q: jnp.ndarray,
+                   levels: int) -> jnp.ndarray:
+    """The same branchless search against ANY key-sorted [*, K] table with
+    valid prefix n — the tiered structure's run probes reuse it with the
+    per-run row capacity's level count."""
     Q = q.shape[0]
     lo = jnp.zeros((Q,), jnp.int32)
     hi = jnp.broadcast_to(n.astype(jnp.int32), (Q,))
-    for _ in range(cfg.levels):
+    for _ in range(levels):
         active = lo < hi
         mid = (lo + hi) >> 1
-        go_right = _key_less(hkeys[mid], q)
+        go_right = _key_less(table[mid], q)
         lo = jnp.where(active & go_right, mid + 1, lo)
         hi = jnp.where(active & ~go_right, mid, hi)
     return lo
@@ -247,23 +327,31 @@ def _build_sparse_max(cfg: KernelConfig, vers: jnp.ndarray, n: jnp.ndarray) -> j
 
     This is the skip-list maxVersion pyramid (SkipList.cpp:350-357) flattened
     into a dense, gather-friendly layout."""
-    h = cfg.capacity
+    return _build_sparse_max_n(vers, n, cfg.capacity, cfg.levels)
+
+
+def _build_sparse_max_n(vers: jnp.ndarray, n: jnp.ndarray, h: int,
+                        n_levels: int) -> jnp.ndarray:
     base = jnp.where(jnp.arange(h) < n, vers, NEG_VERSION)
     levels = [base]
-    for k in range(1, cfg.levels):
+    for k in range(1, n_levels):
         half = 1 << (k - 1)
         prev = levels[-1]
         shifted = jnp.concatenate([prev[half:], jnp.full((half,), NEG_VERSION, prev.dtype)])
         levels.append(jnp.maximum(prev, shifted))
-    return jnp.stack(levels)  # [levels, H]
+    return jnp.stack(levels)  # [n_levels, h]
 
 
 def _range_max(cfg: KernelConfig, sparse: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray) -> jnp.ndarray:
     """max(vers[lo:hi]) for hi > lo, via two overlapping power-of-two blocks."""
+    return _range_max_n(sparse, lo, hi, cfg.capacity)
+
+
+def _range_max_n(sparse: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray,
+                 h: int) -> jnp.ndarray:
     s = (hi - lo).astype(jnp.uint32)
     k = (31 - lax.clz(s)).astype(jnp.int32)
     flat = sparse.reshape(-1)
-    h = cfg.capacity
     m1 = flat[k * h + lo]
     m2 = flat[k * h + hi - (1 << k).astype(jnp.int32)]
     return jnp.maximum(m1, m2)
@@ -290,6 +378,83 @@ def _pack_bits(bits: jnp.ndarray, n_words: int) -> jnp.ndarray:
         bits.reshape(bits.shape[:-1] + (n_words, 32)).astype(jnp.uint32) * weights,
         axis=-1, dtype=jnp.uint32,
     )
+
+
+def _tiered_read_probe(
+    cfg: KernelConfig,
+    state: Dict[str, jnp.ndarray],
+    rpb: jnp.ndarray, rp_valid: jnp.ndarray,
+    rb: jnp.ndarray, re: jnp.ndarray, r_valid: jnp.ndarray,
+    empty_r: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Tiered structure: per-run history contributions for both read
+    classes — (point_max [Rp], range_max [Rr]), to be max-folded into the
+    base table's phase-1 answers BEFORE any hit computation (so heat
+    witnesses stay consistent with verdicts).
+
+    Each run is a key-sorted mini interval table in the base table's own
+    representation (value at k = vers[upper_bound(k) - 1]) whose rows
+    alternate (union-begin, now) / (union-end, NEG gap): inside a
+    committed-write union range the run answers `now`, outside it answers
+    NEG so lower tiers and the base show through, and the effective map
+    value is the max over base + runs (versions only grow with recency,
+    so max == newest covering write — exactly the monolithic map).
+
+    Unlike the base table, a run has no guaranteed minimal-key boundary
+    row, so every probe carries an emptiness guard: upper_bound == 0
+    means the query precedes the whole run (NEG), and an empty row
+    window [lo, hi) with hi <= lo likewise answers NEG. Probe cost is
+    O(NR * (Rp + 3*Rr) * K * run_levels) — batch-scaled, never
+    capacity-scaled, in BOTH search modes (fused_sort keeps its fused
+    base probe; runs are always searched)."""
+    NR, RC = cfg.run_slots, cfg.run_rows
+    Rp, Rr = cfg.rp, cfg.max_reads
+    levels = cfg.run_levels
+    rkeys, rvers = state["rkeys"], state["rvers"]
+    rn = state["rn"]
+
+    qvalid = jnp.concatenate([rp_valid, r_valid, r_valid, r_valid])
+    qkeys = jnp.concatenate([rpb, rb, _bump(rb), re], axis=0)
+    q_eff = jnp.where(qvalid[:, None], qkeys, jnp.uint32(0xFFFFFFFF))
+
+    vp = jnp.full((Rp,), NEG_VERSION, jnp.int32)
+    vr = jnp.full((Rr,), NEG_VERSION, jnp.int32)
+    for j in range(NR):
+        tk, tv, tn = rkeys[j], rvers[j], rn[j]
+        lb = _lower_bound_n(tk, tn, q_eff, levels)
+        lb_p = lb[:Rp]
+        lb_b = lb[Rp:Rp + Rr]
+        lb_bb = lb[Rp + Rr:Rp + 2 * Rr]     # lower(bump(rb)) == upper(rb)
+        lb_e = lb[Rp + 2 * Rr:]
+        # Point read: value at k = vers[upper(k) - 1], NEG before the run.
+        # (Padding rows carry all-ones keys + NEG versions, so a gather
+        # that lands past rn answers NEG and never forges a hit.)
+        up_p = lb_p + _present(tk, rpb, lb_p)
+        vp_j = jnp.where(up_p > 0, tv[jnp.maximum(up_p - 1, 0)], NEG_VERSION)
+        vp = jnp.maximum(vp, vp_j)
+        if Rr > 0:
+            sparse = _build_sparse_max_n(tv, tn, RC, levels)
+            # Empty reads ([q, q)) ask for the version strictly below q —
+            # the value of the effective map's last boundary < q. The
+            # oracle (and the base path, whose row 0 IS the minimal key)
+            # clamp that predecessor scan to the minimal-key row, so for
+            # q == b'' the answer degenerates to the value AT b'': a run
+            # whose union begins exactly at b'' must contribute its row
+            # AT q then. For q > b'' the base's b'' row anchors the
+            # effective predecessor and a run with no row < q correctly
+            # contributes NEG.
+            is_min = jnp.all(rb == 0, axis=-1)       # q == b'' (packed zero)
+            eq_b = _present(tk, rb, lb_b)
+            s_qlo = jnp.where(empty_r,
+                              lb_b + jnp.where(is_min, eq_b, 0), lb_bb)
+            lo = jnp.maximum(s_qlo - 1, 0)
+            hi = jnp.where(empty_r, s_qlo, lb_e)
+            vr_j = jnp.where(
+                hi > lo,
+                _range_max_n(sparse, lo, jnp.maximum(hi, lo + 1), RC),
+                NEG_VERSION)
+            vr = jnp.maximum(vr, vr_j)
+    return vp, vr
 
 
 def local_phases(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[str, jnp.ndarray]):
@@ -525,9 +690,19 @@ def local_phases(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[s
     }
 
     # ---- Phase 1: reads vs. history (checkReadConflictRanges:1210) ----
+    # Tiered structure: fold every active run's contribution into the
+    # base table's answers BEFORE any hit computation, so verdicts AND
+    # the heat witness context both see the effective (base + runs) map.
+    tiered = resolved_history_structure(cfg) == "tiered"
+    if tiered:
+        run_vp, run_vr = _tiered_read_probe(
+            cfg, state, rpb, rp_valid, rb, re, r_valid, empty_r)
+
     # Point read: its single covering interval starts at upper(rpb)-1, so the
     # range-max is one version gather — no sparse table involved.
     vmax_p = hvers[jnp.maximum(s_rp + eq_rp - 1, 0)]
+    if tiered:
+        vmax_p = jnp.maximum(vmax_p, run_vp)
     hit_p = batch["rp_valid"] & (vmax_p > batch["rp_snap"])
     hist_hits = jnp.zeros((T,), jnp.int32).at[batch["rp_txn"]].max(
         hit_p.astype(jnp.int32), mode="drop")
@@ -539,6 +714,8 @@ def local_phases(cfg: KernelConfig, state: Dict[str, jnp.ndarray], batch: Dict[s
         lo = jnp.where(empty_r, lo_e, s_qlo - 1)
         hi = jnp.where(empty_r, lo_e + 1, s_re)
         rmax = _range_max(cfg, sparse, lo, hi)
+        if tiered:
+            rmax = jnp.maximum(rmax, run_vr)
         hit_rg = batch["r_valid"] & (rmax > batch["r_snap"])
         hist_hits = hist_hits.at[batch["r_txn"]].max(hit_rg.astype(jnp.int32), mode="drop")
 
@@ -727,6 +904,265 @@ def commit_fixpoint(
     return committed
 
 
+def _merge_runs(
+    cfg: KernelConfig,
+    hkeys: jnp.ndarray, hvers: jnp.ndarray, n: jnp.ndarray,
+    rkeys: jnp.ndarray, rvers: jnp.ndarray, rn: jnp.ndarray,
+    nruns: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The lazy device-side compaction: fold base + every active run into
+    one key-sorted boundary table. Returns (mkeys, mvers, m_n, overflow,
+    dropped) — dropped counts the physical rows the compaction retired
+    (superseded same-key rows + value-redundant boundaries, which after a
+    horizon rebase is exactly the GC reclamation the monolithic keep rule
+    performs eagerly).
+
+    Two stages, neither of which sorts the base (XLA has no k-way merge
+    primitive, but a full H-row sort per merge priced the merge at ~6x
+    the monolithic re-merge — the base is ALREADY key-sorted, and run
+    rows are O(NR*RC) << H):
+
+      1. Fold the NR runs alone: one small sort of the NR*RC run rows,
+         a batched [NR, NR*RC] cummax forward fill (each run's map value
+         at every sorted run key; the combined runs-map value is the max
+         over runs — versions only grow with recency), one delta row per
+         distinct run key, value-redundant delta rows dropped. Max is
+         associative, so max(base, run_1..run_NR) == max(base, delta).
+      2. Merge the delta boundary list into the base positionally — the
+         same sort-free scatter+cumsum arithmetic as the monolithic
+         phase 4: lower-bound every delta key into the base (the
+         branchless bsearch), mark base rows inside covering delta
+         segments (value != NEG: every run version outstrips every base
+         version, so coverage == overwrite) plus equal-key base rows as
+         dead, rewrite NEG delta rows to the preserved base tail
+         hvers[upper-1] (NEG means "lower tiers show through"), scatter
+         kept base + delta rows into merged order, then one global
+         value-equal-predecessor pass (boundary redundancy; subsumes the
+         monolithic GC compaction once versions have been rebased to the
+         -1 floor). The pre-compaction image is H + NR*RC rows so an
+         overflowing merge still counts m_n exactly before truncating."""
+    NR, RC = cfg.run_slots, cfg.run_rows
+    H, K = cfg.capacity, cfg.lanes
+    Md = NR * RC
+
+    # ---- Stage 1: fold the runs into one coalesced delta boundary list ----
+    akeys = rkeys.reshape(Md, K)
+    avers = rvers.reshape(Md)
+    asrc = jnp.repeat(jnp.arange(NR, dtype=jnp.int32), RC)
+    avalid = ((jnp.arange(RC)[None, :] < rn[:, None])
+              & (jnp.arange(NR)[:, None] < nruns)).reshape(-1)
+
+    idx_bits = max(1, (Md - 1).bit_length())
+    keys_eff = jnp.where(avalid[:, None], akeys, jnp.uint32(0xFFFFFFFF))
+    pidx = jnp.arange(Md, dtype=jnp.uint32)
+    codeidx = (jnp.where(avalid, jnp.uint32(0), jnp.uint32(1)) << idx_bits) | pidx
+    ops = tuple(keys_eff[:, c] for c in range(K)) + (codeidx,)
+    s = lax.sort(ops, num_keys=K + 1)
+    sidx = (s[K] & jnp.uint32((1 << idx_bits) - 1)).astype(jnp.int32)
+    svalid = (s[K] >> idx_bits) == 0
+    skeys = jnp.stack(s[:K], axis=1)
+    ssrc = asrc[sidx]
+    svers = avers[sidx]
+    posn = jnp.arange(Md, dtype=jnp.int32)
+
+    src_ids = jnp.arange(NR, dtype=jnp.int32)[:, None]
+    tag2 = jnp.where(svalid[None, :] & (ssrc[None, :] == src_ids),
+                     posn[None, :], -1)
+    last2 = lax.cummax(tag2, axis=1)
+    val2 = jnp.where(last2 >= 0, svers[jnp.maximum(last2, 0)], NEG_VERSION)
+    dval = jnp.max(val2, axis=0)
+
+    # One delta row per distinct run key: the last row of each equal-key
+    # group (invalid all-ones rows cluster at the end, never equal real
+    # keys). Runs-internal value-redundant boundaries drop here; a row
+    # the global pass below would keep is never dropped early (a base
+    # row between equal-valued run boundaries is itself covered or
+    # carries the same fill, so the global verdict matches).
+    diff_next = jnp.any(skeys != jnp.concatenate([skeys[1:], skeys[-1:]]), axis=-1)
+    diff_next = diff_next.at[Md - 1].set(True)
+    is_cand = svalid & diff_next
+    ptag = jnp.where(is_cand, posn, -1)
+    prevc = jnp.concatenate([jnp.full((1,), -1, jnp.int32), lax.cummax(ptag)[:-1]])
+    prev_val = jnp.where(prevc >= 0, dval[jnp.maximum(prevc, 0)], jnp.int32(2**30))
+    dkeep = is_cand & (dval != prev_val)
+
+    dpos = jnp.cumsum(dkeep.astype(jnp.int32)) - 1
+    d_n = jnp.sum(dkeep.astype(jnp.int32))
+    dc = jnp.zeros((Md, K + 1), jnp.uint32).at[
+        jnp.where(dkeep, dpos, Md)
+    ].set(jnp.concatenate([skeys, _i2u(dval)[:, None]], axis=1), mode="drop")
+    dkeys = dc[:, :K]
+    dvers = _u2i(dc[:, K])
+
+    # ---- Stage 2: positional merge of the delta into the sorted base ----
+    valid_d = jnp.arange(Md, dtype=jnp.int32) < d_n
+    lo = _lower_bound_n(hkeys, n, dkeys, cfg.levels)
+    eq = valid_d & (lo < n) & _key_eq(hkeys[jnp.minimum(lo, H - 1)], dkeys)
+    # Preserved tail for NEG delta rows: the base map value at the delta
+    # key, hvers[upper_bound - 1] (upper == lo + eq: boundary keys are
+    # distinct). No base row at or below the key -> stays NEG.
+    ubm1 = lo + eq.astype(jnp.int32) - 1
+    fill = jnp.where(ubm1 >= 0, hvers[jnp.maximum(ubm1, 0)], NEG_VERSION)
+    dv2 = jnp.where(dvers == NEG_VERSION, fill, dvers)
+
+    # Base rows inside a covering delta segment [key_i, key_{i+1}) with
+    # value != NEG are overwritten (delta versions outstrip base); an
+    # equal-key base row is superseded by its delta row either way.
+    covering = valid_d & (dvers != NEG_VERSION)
+    nxt_lo = jnp.concatenate([lo[1:], jnp.zeros((1,), lo.dtype)])
+    stop = jnp.where(jnp.arange(Md) + 1 < d_n, nxt_lo, n.astype(lo.dtype))
+    cov_delta = (
+        jnp.zeros((H + 1,), jnp.int32)
+        .at[jnp.where(covering, lo, H + 1)].add(1, mode="drop")
+        .at[jnp.where(covering, stop, H + 1)].add(-1, mode="drop")
+    )
+    covered = jnp.cumsum(cov_delta[:H]) > 0
+    eq_kill = jnp.zeros((H,), bool).at[
+        jnp.where(eq, lo, H)].set(True, mode="drop")
+    jslot = jnp.arange(H, dtype=jnp.int32)
+    old_keep = (jslot < n) & ~covered & ~eq_kill
+
+    # Merged positions, monolithic phase-4 style: kept base rows shift by
+    # the delta rows inserted before them; delta rows shift by the kept
+    # base rows before them.
+    cum_keep = jnp.cumsum(old_keep.astype(jnp.int32))
+    new_cnt = (
+        jnp.zeros((H + 1,), jnp.int32)
+        .at[jnp.where(valid_d, lo, H + 1)].add(1, mode="drop")
+    )
+    new_before_old = jnp.cumsum(new_cnt[:H])
+    pos_old = cum_keep - 1 + new_before_old
+    drop_before = jnp.cumsum((covered | eq_kill).astype(jnp.int32))
+    db = jnp.where(lo > 0, drop_before[jnp.maximum(lo - 1, 0)], 0)
+    pos_new = jnp.arange(Md, dtype=jnp.int32) + (lo - db)
+
+    G = H + Md
+    gc_img = jnp.concatenate(
+        [jnp.zeros((G, K), jnp.uint32), jnp.full((G, 1), _i2u(NEG_VERSION))], axis=1
+    ).at[jnp.where(old_keep, pos_old, G)].set(
+        jnp.concatenate([hkeys, _i2u(hvers)[:, None]], axis=1), mode="drop"
+    ).at[jnp.where(valid_d, pos_new, G)].set(
+        jnp.concatenate([dkeys, _i2u(dv2)[:, None]], axis=1), mode="drop")
+    gvers = _u2i(gc_img[:, K])
+    mn_raw = cum_keep[H - 1] + d_n
+
+    # Global boundary-redundancy pass over the merged image: drop rows
+    # whose value equals the previous merged row's (pre-drop) value — the
+    # first row's sentinel can never match a real version.
+    pv = jnp.concatenate([jnp.full((1,), 2**30, jnp.int32), gvers[:-1]])
+    keep = (jnp.arange(G, dtype=jnp.int32) < mn_raw) & (gvers != pv)
+
+    cpos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    m_n = jnp.sum(keep.astype(jnp.int32))
+    outc = jnp.concatenate(
+        [jnp.zeros((H, K), jnp.uint32), jnp.full((H, 1), _i2u(NEG_VERSION))], axis=1
+    ).at[jnp.where(keep, cpos, H)].set(gc_img, mode="drop")
+    total = n + jnp.sum(jnp.where(jnp.arange(NR) < nruns, rn, 0))
+    dropped = (total - m_n).astype(jnp.int32)
+    return outc[:, :K], _u2i(outc[:, K]), m_n.astype(jnp.int32), m_n > H, dropped
+
+
+def _tiered_apply(
+    cfg: KernelConfig,
+    state: Dict[str, jnp.ndarray],
+    batch: Dict[str, jnp.ndarray],
+    ub_keys: jnp.ndarray,
+    ue_keys: jnp.ndarray,
+    u_count: jnp.ndarray,
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray, jnp.ndarray]:
+    """Tiered phases 4-5: append the batch's committed-write union as one
+    sorted run (O(batch) — a stack/reshape plus one dynamic_update_slice;
+    the capacity-H table is never rewritten), merge only when the run
+    slots are full, and apply GC as a range deletion: an elementwise
+    horizon rebase of base + runs with physical reclamation deferred to
+    the next merge. `reclaimed` therefore moves at merge time (rows the
+    compaction retired) instead of per-GC-batch."""
+    NR, RC = cfg.run_slots, cfg.run_rows
+    H, K = cfg.capacity, cfg.lanes
+    Wa = cfg.w_all
+    now, gc = batch["now"], batch["gc"]
+    hkeys, hvers, n = state["hkeys"], state["hvers"], state["n"]
+    rkeys, rvers, rn = state["rkeys"], state["rvers"], state["rn"]
+    nruns = state["nruns"]
+
+    # The new run image [RC, K]/[RC]: interleaved (union-begin, now) /
+    # (union-end, NEG gap) rows — strictly increasing keys because the
+    # union sweep merges touching ranges — padded with all-ones keys and
+    # NEG versions so stray probes past rn answer NEG.
+    valid_u = jnp.arange(Wa, dtype=jnp.int32) < u_count
+    nrk = jnp.stack([ub_keys, ue_keys], axis=1).reshape(2 * Wa, K)
+    nrv = jnp.stack(
+        [jnp.full((Wa,), now, jnp.int32),
+         jnp.full((Wa,), NEG_VERSION, jnp.int32)], axis=1).reshape(2 * Wa)
+    row_valid = jnp.repeat(valid_u, 2)
+    runk = jnp.where(row_valid[:, None], nrk, jnp.uint32(0xFFFFFFFF))
+    runv = jnp.where(row_valid, nrv, NEG_VERSION)
+    pad = RC - 2 * Wa
+    if pad:
+        runk = jnp.concatenate(
+            [runk, jnp.full((pad, K), jnp.uint32(0xFFFFFFFF))], axis=0)
+        runv = jnp.concatenate(
+            [runv, jnp.full((pad,), NEG_VERSION, jnp.int32)], axis=0)
+    has_rows = u_count > 0
+
+    # Lazy merge: only when the incoming run needs a slot and none is
+    # free. Empty unions (read-only batches) never claim a slot, so a
+    # read-dominated steady state never pays a merge at all.
+    do_merge = has_rows & (nruns >= NR)
+
+    def merged(_):
+        mk, mv, mn_, moverflow, dropped = _merge_runs(
+            cfg, hkeys, hvers, n, rkeys, rvers, rn, nruns)
+        return (mk, mv, mn_,
+                jnp.full((NR, RC, K), jnp.uint32(0xFFFFFFFF)),
+                jnp.full((NR, RC), NEG_VERSION, jnp.int32),
+                jnp.zeros((NR,), jnp.int32), jnp.zeros((), jnp.int32),
+                moverflow, dropped)
+
+    def unmerged(_):
+        return (hkeys, hvers, n, rkeys, rvers, rn, nruns,
+                jnp.asarray(False), jnp.zeros((), jnp.int32))
+
+    bk, bv, bn, rk1, rv1, rn1, nr1, overflow, reclaimed = lax.cond(
+        do_merge, merged, unmerged, None)
+
+    # Append at the first free slot (post-merge that is slot 0).
+    def appended(_):
+        slot = jnp.minimum(nr1, NR - 1)
+        z = jnp.zeros((), slot.dtype)   # match index dtypes under x64
+        return (lax.dynamic_update_slice(rk1, runk[None], (slot, z, z)),
+                lax.dynamic_update_slice(rv1, runv[None], (slot, z)),
+                rn1.at[slot].set((2 * u_count).astype(rn1.dtype)),
+                nr1 + 1)
+
+    def skipped(_):
+        return rk1, rv1, rn1, nr1
+
+    rk2, rv2, rn2, nr2 = lax.cond(has_rows, appended, skipped, None)
+
+    # GC as a range deletion: one elementwise horizon rebase over base +
+    # runs (the appended run included — its `now` rows rebase exactly as
+    # the monolithic path rebases its freshly merged rows). NEG gap rows
+    # must stay NEG: a plain subtract would underflow int32 AND turn gaps
+    # into -1 "covered at floor" rows, silently extending coverage.
+    jslot = jnp.arange(H, dtype=jnp.int32)
+    bv = jnp.where(
+        gc > 0,
+        jnp.where(jslot < bn, jnp.maximum(bv - gc, -1), NEG_VERSION),
+        bv)
+    rv2 = jnp.where(
+        gc > 0,
+        jnp.where(rv2 == NEG_VERSION, NEG_VERSION, jnp.maximum(rv2 - gc, -1)),
+        rv2)
+
+    new_state = {
+        "hkeys": bk, "hvers": bv, "n": bn.astype(jnp.int32),
+        "rkeys": rk2, "rvers": rv2, "rn": rn2.astype(jnp.int32),
+        "nruns": nr2.astype(jnp.int32),
+    }
+    return new_state, overflow, reclaimed
+
+
 def apply_writes_and_gc(
     cfg: KernelConfig,
     state: Dict[str, jnp.ndarray],
@@ -794,6 +1230,15 @@ def apply_writes_and_gc(
     # Version at each union end = pre-batch map value there (preserved tail):
     # hvers[upper(ue) - 1].
     ue_ver = hvers[jnp.maximum(_u2i(uec[:, K + 1]) - 1, 0)]
+
+    if resolved_history_structure(cfg) == "tiered":
+        # Tiered structure: phase 3's union IS the new run — phases 4-5
+        # (the capacity-H re-merge + GC compaction) are replaced by an
+        # O(batch) append, an elementwise horizon rebase, and a lazy
+        # slots-full merge (_tiered_apply). ue_ver/u_start/u_stop stay
+        # unused here: a run's NEG gap rows mean "lower tiers show
+        # through", so no preserved-tail version is ever read.
+        return _tiered_apply(cfg, state, batch, ub_keys, ue_keys, u_count)
 
     # ---- Phase 4: merge union into the boundary table at version `now` ----
     # Positions of old rows relative to the union are recovered with
@@ -1041,9 +1486,22 @@ def heat_of(
     fc = jnp.minimum(first, R - 1)
     wit_ver = jnp.where(has, wver[fc], NEG_VERSION)
     wit_bucket = jnp.where(has, rbk[fc], -1)
-    return {"bounds": bounds, "hist": hist, "counts": counts,
-            "occupancy": state["n"], "wit_ver": wit_ver,
-            "wit_bucket": wit_bucket}
+    out = {"bounds": bounds, "hist": hist, "counts": counts,
+           "occupancy": state["n"], "wit_ver": wit_ver,
+           "wit_bucket": wit_bucket}
+    if resolved_history_structure(cfg) == "tiered":
+        # tiered-history gauges ride the heat aggregate so run/merge
+        # accounting reaches the host with ZERO extra syncs on every
+        # dispatch surface: `runs` is the live run-stack depth post-apply
+        # (the aggregator derives appends/merges from its transitions —
+        # a drop means a lazy merge compacted the stack), `run_rows` the
+        # summed valid rows across live runs (tier occupancy)
+        NR = cfg.run_slots
+        live = jnp.arange(NR, dtype=jnp.int32) < state["nruns"]
+        out["runs"] = state["nruns"]
+        out["run_rows"] = jnp.sum(jnp.where(live, state["rn"], 0)).astype(
+            jnp.int32)
+    return out
 
 
 def heat_struct(cfg: KernelConfig, stack: Tuple[int, ...] = ()) -> Dict[str, jax.ShapeDtypeStruct]:
@@ -1051,7 +1509,7 @@ def heat_struct(cfg: KernelConfig, stack: Tuple[int, ...] = ()) -> Dict[str, jax
     zero-initializes its per-slot planes from)."""
     B, K, T = cfg.heat_buckets, cfg.lanes, cfg.max_txns
     s = jax.ShapeDtypeStruct
-    return {
+    out = {
         "bounds": s(stack + (B, K), jnp.uint32),
         "hist": s(stack + (B, HEAT_HIST_LANES), jnp.int32),
         "counts": s(stack + (HEAT_COUNT_LANES,), jnp.int32),
@@ -1059,6 +1517,10 @@ def heat_struct(cfg: KernelConfig, stack: Tuple[int, ...] = ()) -> Dict[str, jax
         "wit_ver": s(stack + (T,), jnp.int32),
         "wit_bucket": s(stack + (T,), jnp.int32),
     }
+    if resolved_history_structure(cfg) == "tiered":
+        out["runs"] = s(stack + (), jnp.int32)
+        out["run_rows"] = s(stack + (), jnp.int32)
+    return out
 
 
 def status_of(t_too_old: jnp.ndarray, committed: jnp.ndarray) -> jnp.ndarray:
@@ -1320,11 +1782,20 @@ def state_struct(cfg: KernelConfig, stack: Tuple[int, ...] = ()) -> Dict[str, ja
     """Abstract shapes of the device interval-table state (initial_state),
     optionally stacked under leading axes — what an AOT .lower() needs."""
     s = jax.ShapeDtypeStruct
-    return {
+    out = {
         "hkeys": s(stack + (cfg.capacity, cfg.lanes), jnp.uint32),
         "hvers": s(stack + (cfg.capacity,), jnp.int32),
         "n": s(stack + (), jnp.int32),
     }
+    if resolved_history_structure(cfg) == "tiered":
+        # run planes exist ONLY under the tiered structure, so monolithic
+        # pytrees — and every already-compiled program — stay byte-for-
+        # byte unchanged
+        out["rkeys"] = s(stack + (cfg.run_slots, cfg.run_rows, cfg.lanes), jnp.uint32)
+        out["rvers"] = s(stack + (cfg.run_slots, cfg.run_rows), jnp.int32)
+        out["rn"] = s(stack + (cfg.run_slots,), jnp.int32)
+        out["nruns"] = s(stack + (), jnp.int32)
+    return out
 
 
 def batch_struct(cfg: KernelConfig, stack: Tuple[int, ...] = ()) -> Dict[str, jax.ShapeDtypeStruct]:
@@ -1368,11 +1839,66 @@ def initial_state(cfg: KernelConfig, version_rel: int = 0, first_key: bytes = b"
     hkeys[0] = keypack.pack_key(first_key, cfg.key_words)
     hvers = np.full((cfg.capacity,), int(NEG_VERSION), np.int32)
     hvers[0] = version_rel
-    return {
+    out = {
         "hkeys": jnp.asarray(hkeys),
         "hvers": jnp.asarray(hvers),
         "n": jnp.asarray(1, jnp.int32),
     }
+    if resolved_history_structure(cfg) == "tiered":
+        out["rkeys"] = jnp.full(
+            (cfg.run_slots, cfg.run_rows, cfg.lanes), 0xFFFFFFFF, jnp.uint32)
+        out["rvers"] = jnp.full(
+            (cfg.run_slots, cfg.run_rows), int(NEG_VERSION), jnp.int32)
+        out["rn"] = jnp.zeros((cfg.run_slots,), jnp.int32)
+        out["nruns"] = jnp.asarray(0, jnp.int32)
+    return out
+
+
+def history_run_snapshot(
+    cfg: KernelConfig,
+    state: Dict[str, jnp.ndarray],
+    since_runs: int = 0,
+) -> Dict[str, object]:
+    """Host copy of the ACTIVE run planes only — the O(delta) incremental
+    surface behind the ResilientEngine shadow rebuild and the reshard
+    pre-copy handoff (fault/handoff.py run_slice): the un-merged runs ARE
+    the history delta since the last compaction, so a receiver that
+    already holds the merged base needs `sum(rn)` rows, never a
+    capacity-H replay.
+
+    `since_runs` is a caller-held watermark (the nruns value of its last
+    snapshot): only runs appended after it are materialized. A merge
+    resets nruns to 0-or-1, so `nruns < since_runs` in the returned dict
+    tells the caller its watermark died with a compaction and a full
+    resync (or base copy) is needed — exactly the LSM manifest contract.
+
+    Returns {"structure", "nruns", "runs": [(keys [rn_j, K] uint32,
+    vers [rn_j] int32), ...]} with numpy rows sliced to each run's valid
+    prefix; rows alternate (interval-begin, version) / (interval-end,
+    NEG gap) — see run_intervals for the decoded form."""
+    structure = resolved_history_structure(cfg)
+    if structure != "tiered":
+        return {"structure": structure, "nruns": 0, "runs": []}
+    nruns = int(state["nruns"])
+    rn = np.asarray(state["rn"])
+    lo = min(max(int(since_runs), 0), nruns)
+    runs = []
+    for j in range(lo, nruns):
+        rows = int(rn[j])
+        runs.append((np.asarray(state["rkeys"][j, :rows]),
+                     np.asarray(state["rvers"][j, :rows])))
+    return {"structure": structure, "nruns": nruns, "runs": runs}
+
+
+def run_intervals(snapshot: Dict[str, object]):
+    """Decode a history_run_snapshot into (begin_row, end_row, version)
+    packed-key interval triples, oldest run first — the shape the host
+    VersionIntervalMap coalescer consumes. Run rows alternate strictly:
+    even rows open a committed-write union range at their version, odd
+    rows close it with the NEG gap sentinel."""
+    for keys, vers in snapshot["runs"]:
+        for i in range(0, keys.shape[0] - 1, 2):
+            yield keys[i], keys[i + 1], int(vers[i])
 
 
 def build_batch_arrays(

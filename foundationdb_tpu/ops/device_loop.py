@@ -233,7 +233,8 @@ class DeviceLoopEngine(JaxConflictEngine):
                  device_time_sample_rate: Optional[float] = None,
                  queue_slots: int = 4,
                  queue_depth: int = 2,
-                 drain_deadline_s: float = 5.0):
+                 drain_deadline_s: float = 5.0,
+                 history_structure: Optional[str] = None):
         #: chunks per queue slot (Q): one compiled loop body per bucket
         #: serves any fill 1..Q, so Q bounds chunks-per-dispatch, not
         #: compile count
@@ -260,7 +261,8 @@ class DeviceLoopEngine(JaxConflictEngine):
                          scan_sizes=(), arena=arena,
                          history_search=history_search,
                          heat_buckets=heat_buckets,
-                         device_time_sample_rate=device_time_sample_rate)
+                         device_time_sample_rate=device_time_sample_rate,
+                         history_structure=history_structure)
         # the loop's queue/ring gauges flow into the unified telemetry hub
         # (docs/observability.md): `loop.<label>.*` series alongside the
         # EnginePerf counters the base class registered above
@@ -451,6 +453,13 @@ class DeviceLoopEngine(JaxConflictEngine):
         if getattr(self, "_ring", None):
             self.drain_loop()
         super()._reset_device_state(version_rel)
+
+    def _device_states_for_snapshot(self):
+        # quiesce the loop first: an in-flight slot's program still owns
+        # the (donated) table, and a run snapshot must see a consistent
+        # post-apply state
+        self.drain_loop()
+        return super()._device_states_for_snapshot()
 
     def _run_detect(self, per_shard):
         # split-step (long-key tier) path reads/writes self.state through

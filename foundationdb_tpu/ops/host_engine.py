@@ -606,10 +606,12 @@ class RoutedConflictEngineBase:
                  arena: bool = True,
                  history_search: Optional[str] = None,
                  heat_buckets: Optional[int] = None,
-                 device_time_sample_rate: Optional[float] = None):
+                 device_time_sample_rate: Optional[float] = None,
+                 history_structure: Optional[str] = None):
         # Subclasses seed their device state (incl. any initial version, as a
         # base-relative offset) via _reset_device_state.
         cfg = self._resolve_history_search(cfg, history_search)
+        cfg = self._resolve_history_structure(cfg, history_structure)
         cfg = self._resolve_heat(cfg, heat_buckets)
         self.cfg = cfg
         self.shards = shards
@@ -673,6 +675,11 @@ class RoutedConflictEngineBase:
         telemetry.hub().register_perf_ledger(self.perf_ledger, name=self.name)
         if self.heat is not None:
             telemetry.hub().register_heat(self.heat, name=self.name)
+        if ck.resolved_history_structure(cfg) == "tiered":
+            # tiered-history eyes (the `history.*` / fdbtpu_history
+            # series): registered only when the structure is live so the
+            # monolithic fleet's exposition stays byte-stable
+            telemetry.hub().register_history(self, name=self.name)
 
     # -- history search mode (docs/perf.md) ---------------------------------
     @staticmethod
@@ -704,6 +711,107 @@ class RoutedConflictEngineBase:
         """Resolved history-search mode per ladder bucket {T: mode} — what
         BudgetBatcher keys its per-(bucket, mode) EWMAs by."""
         return dict(self.perf.search_modes)
+
+    # -- history structure (docs/perf.md "Incremental history maintenance") --
+    @staticmethod
+    def _resolve_history_structure(cfg: KernelConfig,
+                                   requested: Optional[str]) -> KernelConfig:
+        """Fold the history-structure request into the config the ladder
+        is built from. Precedence: explicit constructor argument > a
+        non-default cfg.history_structure > the
+        `resolver_history_structure` knob. The resolved structure is baked
+        into every bucket's compiled program AND its state tree (bucket()
+        clones propagate it together with the materialized run-row
+        capacity), so the whole ladder shares one structure."""
+        from ..core.knobs import SERVER_KNOBS
+
+        structure = requested
+        if structure is None:
+            structure = cfg.history_structure
+            if structure == "monolithic":
+                structure = str(getattr(SERVER_KNOBS,
+                                        "resolver_history_structure",
+                                        "monolithic")
+                                or "monolithic").strip()
+        if structure not in ck.HISTORY_STRUCTURES:
+            raise ValueError(
+                f"unknown history structure {structure!r}; expected one of "
+                f"{ck.HISTORY_STRUCTURES}")
+        runs = cfg.history_runs
+        if structure == "tiered" and runs == KernelConfig.history_runs:
+            # run-slot count: a non-default cfg.history_runs wins; the
+            # dataclass default defers to the `resolver_history_runs` knob
+            runs = int(getattr(SERVER_KNOBS, "resolver_history_runs",
+                               runs) or runs)
+        if structure == cfg.history_structure and runs == cfg.history_runs:
+            ck.resolved_history_structure(cfg)  # validate run geometry
+            return cfg
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, history_structure=structure,
+                                  history_runs=runs)
+        ck.resolved_history_structure(cfg)
+        return cfg
+
+    @property
+    def history_structure(self) -> str:
+        """The resolved history structure ("monolithic" | "tiered")."""
+        return ck.resolved_history_structure(self.cfg)
+
+    def _history_fingerprint(self) -> str:
+        """The history-structure half of the progcache key (core/progcache
+        `key(structure=)`): "" for the monolithic table so pre-existing
+        cache entries keep their hashes, "tiered:<runs>x<rows>" when the
+        compiled programs bake the tiered sorted-run planes into the
+        state tree — a structure (or run-geometry) flip must be a clean
+        progcache miss, never a poisoned hit."""
+        if ck.resolved_history_structure(self.cfg) != "tiered":
+            return ""
+        return f"tiered:{self.cfg.run_slots}x{self.cfg.run_rows}"
+
+    def history_stats_snapshot(self) -> Dict[str, Any]:
+        """Tiered-history accounting for telemetry/status documents: the
+        structure identity plus the run/merge counters the heat
+        aggregator mirrors from the device heat aggregate's `runs` leaf
+        (core/heatmap.py history_snapshot) — the accounting rides the
+        existing per-batch heat output, so it costs zero extra host syncs
+        on every dispatch surface (step / fused scan / loop / mesh). With
+        heat off the counters read 0 (identity rows stay accurate)."""
+        out: Dict[str, Any] = {
+            "structure": ck.resolved_history_structure(self.cfg),
+            "run_slots": self.cfg.run_slots
+            if ck.resolved_history_structure(self.cfg) == "tiered" else 0,
+            "run_rows": self.cfg.run_rows
+            if ck.resolved_history_structure(self.cfg) == "tiered" else 0,
+            "appends": 0, "merges": 0, "runs_live": 0, "run_rows_live": 0,
+        }
+        if self.heat is not None:
+            out.update(self.heat.history_snapshot())
+        return out
+
+    def history_run_snapshots(self, since_runs: Optional[Sequence[int]] = None):
+        """Per-shard tiered run snapshots (ck.history_run_snapshot) — the
+        O(delta) export the ResilientEngine shadow rebuild and the
+        pre-copy handoff consume. `since_runs` is the per-shard run
+        watermark from the previous snapshot; a snapshot whose `nruns`
+        dropped below the watermark means a lazy merge compacted the
+        stack and the consumer must fall back to a full resync. None for
+        monolithic engines (no incremental export — full replay)."""
+        if ck.resolved_history_structure(self.cfg) != "tiered":
+            return None
+        states = self._device_states_for_snapshot()
+        if states is None:
+            return None
+        out = []
+        for s, st in enumerate(states):
+            since = 0 if since_runs is None else int(since_runs[s])
+            out.append(ck.history_run_snapshot(self.cfg, st, since_runs=since))
+        return out
+
+    def _device_states_for_snapshot(self):
+        """Per-shard device state dicts for history_run_snapshots; None
+        when this engine family keeps no host-readable state handle."""
+        return None
 
     # -- keyspace heat (docs/observability.md "Keyspace heat & occupancy") ---
     @staticmethod
@@ -839,7 +947,8 @@ class RoutedConflictEngineBase:
                             n_chunks=n_chunks, search_mode=search_mode,
                             dispatch_mode=self.dispatch_mode,
                             mesh=self._progcache_fingerprint(),
-                            variant=variant)
+                            variant=variant,
+                            structure=self._history_fingerprint())
             b0 = cache.stats["hit_bytes"]
             t0 = time.perf_counter()
             prog = cache.load(key)
@@ -1648,11 +1757,13 @@ class SubshardedConflictEngine(RoutedConflictEngineBase):
                  arena: bool = True,
                  history_search: Optional[str] = None,
                  heat_buckets: Optional[int] = None,
-                 device_time_sample_rate: Optional[float] = None):
+                 device_time_sample_rate: Optional[float] = None,
+                 history_structure: Optional[str] = None):
         super().__init__(cfg, shards, ladder=ladder, scan_sizes=scan_sizes,
                          arena=arena, history_search=history_search,
                          heat_buckets=heat_buckets,
-                         device_time_sample_rate=device_time_sample_rate)
+                         device_time_sample_rate=device_time_sample_rate,
+                         history_structure=history_structure)
         cfg = self.cfg   # base resolved the history-search mode into it
         self._reset_device_state(initial_version)
         self.tier_map = VersionIntervalMap(initial_version)
@@ -1668,6 +1779,10 @@ class SubshardedConflictEngine(RoutedConflictEngineBase):
             for s in range(self.n_shards)
         ]
         self.state = jax.tree.map(lambda *xs: jnp.stack(xs), *per)
+
+    def _device_states_for_snapshot(self):
+        return [jax.tree.map(lambda x, s=s: x[s], self.state)
+                for s in range(self.n_shards)]
 
     def _stack(self, per_shard: List[Dict[str, np.ndarray]]):
         return jax.tree.map(
@@ -1748,12 +1863,14 @@ class JaxConflictEngine(RoutedConflictEngineBase):
                  arena: bool = True,
                  history_search: Optional[str] = None,
                  heat_buckets: Optional[int] = None,
-                 device_time_sample_rate: Optional[float] = None):
+                 device_time_sample_rate: Optional[float] = None,
+                 history_structure: Optional[str] = None):
         super().__init__(cfg, KeyShardMap([]), ladder=ladder,
                          scan_sizes=scan_sizes, arena=arena,
                          history_search=history_search,
                          heat_buckets=heat_buckets,
-                         device_time_sample_rate=device_time_sample_rate)
+                         device_time_sample_rate=device_time_sample_rate,
+                         history_structure=history_structure)
         cfg = self.cfg   # base resolved the history-search mode into it
         self.state = ck.initial_state(cfg, version_rel=initial_version)
         self.tier_map = VersionIntervalMap(initial_version)
@@ -1765,6 +1882,9 @@ class JaxConflictEngine(RoutedConflictEngineBase):
 
     def _reset_device_state(self, version_rel: int) -> None:
         self.state = ck.initial_state(self.cfg, version_rel=version_rel)
+
+    def _device_states_for_snapshot(self):
+        return [self.state]
 
     def _make_program(self, bucket: KernelConfig, n_chunks: int):
         st = ck.state_struct(bucket)

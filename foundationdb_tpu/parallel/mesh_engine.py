@@ -189,6 +189,7 @@ class MeshShardedConflictEngine(RoutedConflictEngineBase):
         queue_depth: Optional[int] = None,
         overlap: Optional[bool] = None,
         drain_deadline_s: float = 5.0,
+        history_structure: Optional[str] = None,
     ):
         if mesh is None:
             devs = jax.devices()
@@ -227,7 +228,8 @@ class MeshShardedConflictEngine(RoutedConflictEngineBase):
                          ladder=ladder, scan_sizes=scan_sizes, arena=arena,
                          history_search=history_search,
                          heat_buckets=heat_buckets,
-                         device_time_sample_rate=device_time_sample_rate)
+                         device_time_sample_rate=device_time_sample_rate,
+                         history_structure=history_structure)
         cfg = self.cfg   # base resolved history-search + heat into it
         assert self.n_shards == n_devices
         self.mesh = mesh
@@ -308,6 +310,12 @@ class MeshShardedConflictEngine(RoutedConflictEngineBase):
             for s in range(self.n_shards)
         ]
         self.state = self._stack_shards(per)
+
+    def _device_states_for_snapshot(self):
+        # quiesce the ring first: an async unit may still own the table
+        self.drain_ring()
+        return [jax.tree.map(lambda x, s=s: np.asarray(x)[s], self.state)
+                for s in range(self.n_shards)]
 
     # -- AOT program pairs ----------------------------------------------------
     def _progcache_fingerprint(self) -> str:

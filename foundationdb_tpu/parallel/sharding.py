@@ -265,6 +265,7 @@ class ShardedConflictEngine(RoutedConflictEngineBase):
         history_search=None,
         heat_buckets=None,
         device_time_sample_rate=None,
+        history_structure=None,
     ):
         if mesh is None:
             devs = jax.devices()
@@ -275,7 +276,8 @@ class ShardedConflictEngine(RoutedConflictEngineBase):
                          ladder=ladder, scan_sizes=scan_sizes, arena=arena,
                          history_search=history_search,
                          heat_buckets=heat_buckets,
-                         device_time_sample_rate=device_time_sample_rate)
+                         device_time_sample_rate=device_time_sample_rate,
+                         history_structure=history_structure)
         cfg = self.cfg   # base resolved the history-search mode into it
         assert self.n_shards == n_devices
         self.mesh = mesh
@@ -296,6 +298,10 @@ class ShardedConflictEngine(RoutedConflictEngineBase):
             for s in range(self.n_shards)
         ]
         self.state = self._stack_shards(per)
+
+    def _device_states_for_snapshot(self):
+        return [jax.tree.map(lambda x, s=s: np.asarray(x)[s], self.state)
+                for s in range(self.n_shards)]
 
     # -- bucketed program cache (RoutedConflictEngineBase) -------------------
     def _progcache_fingerprint(self) -> str:

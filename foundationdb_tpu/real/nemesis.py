@@ -100,14 +100,18 @@ def _small_kernel_cfg():
 
 
 def make_chaos_engine(engine_mode: str,
-                      dispatch_timeout_s: Optional[float] = None):
+                      dispatch_timeout_s: Optional[float] = None,
+                      history_structure: Optional[str] = None):
     """(inner, injector, supervised) for a campaign engine stack.
     `dispatch_timeout_s` overrides the supervisor's per-dispatch
     watchdog: a co-resident CI box stalls the event loop tens to
     hundreds of ms, and a no-fault control campaign (the watchdog
     false-positive guard) must not read such a stall as a device
     fault — operators tune resolver_dispatch_timeout per deployment
-    the same way."""
+    the same way. `history_structure` selects the device history
+    layout ("tiered" = the sorted-run interval table, docs/perf.md
+    "Incremental history maintenance") — the oracle mode has no device
+    table and ignores it."""
     from ..fault.inject import FaultInjectingEngine, FaultRates
     from ..fault.resilient import ResilienceConfig, ResilientEngine
 
@@ -121,7 +125,9 @@ def make_chaos_engine(engine_mode: str,
         # "mesh" spans every visible XLA device (resolver_mesh_devices):
         # a chaos campaign over mesh slots exercises device-shard
         # restart/handoff, not just single-chip rebuilds
-        inner = make_engine(engine_mode, _small_kernel_cfg())
+        kw = ({"history_structure": history_structure}
+              if history_structure else {})
+        inner = make_engine(engine_mode, _small_kernel_cfg(), **kw)
     else:
         raise ValueError(f"unknown chaos engine mode {engine_mode!r}")
     injector = FaultInjectingEngine(
@@ -175,7 +181,8 @@ class ChaosCommitServer:
                  transport_degraded_fn=None, port: int = 0,
                  dispatch_timeout_s: Optional[float] = None,
                  elastic: bool = False, reshard: bool = False,
-                 reshard_spares: int = 2, conflict_sched=None):
+                 reshard_spares: int = 2, conflict_sched=None,
+                 history_structure: Optional[str] = None):
         from ..server.ratekeeper import TenantAdmission
         from .runtime import make_dispatcher
 
@@ -196,7 +203,8 @@ class ChaosCommitServer:
             ladder = sorted({max(8, max_batch // 8), max_batch})
             group = ElasticResolverGroup(
                 lambda: make_chaos_engine(
-                    engine_mode, dispatch_timeout_s=dispatch_timeout_s),
+                    engine_mode, dispatch_timeout_s=dispatch_timeout_s,
+                    history_structure=history_structure),
                 make_batcher=lambda: BudgetBatcher(ladder))
             self.inner, self.engine = group, group
             self.injector = _GroupInjector(group)
@@ -205,7 +213,8 @@ class ChaosCommitServer:
                     group, on_complete=self._on_reshard_complete)
         else:
             self.inner, self.injector, self.engine = make_chaos_engine(
-                engine_mode, dispatch_timeout_s=dispatch_timeout_s)
+                engine_mode, dispatch_timeout_s=dispatch_timeout_s,
+                history_structure=history_structure)
         self.proc = RealProcess(port=port)
         self.proc.dispatcher = make_dispatcher(sched)
         self.proc.register(COMMIT_TOKEN, self._commit)
@@ -660,6 +669,11 @@ class NemesisConfig:
     #: (a `scenario` event), and `scenario.<name>.*` telemetry gauges —
     #: None keeps the pre-atlas campaign byte-identical
     scenario: Optional[str] = None
+    #: device history layout for the campaign engines ("tiered" = the
+    #: sorted-run interval table, docs/perf.md "Incremental history
+    #: maintenance"); None keeps the monolithic table. Oracle mode has
+    #: no device table and ignores it
+    history_structure: Optional[str] = None
 
     #: budget multiplier for CPU-emulated device modes: a real chip-
     #: adjacent resolver serves a batch in well under a millisecond, but
@@ -1026,7 +1040,8 @@ async def _campaign(cfg: NemesisConfig) -> CampaignReport:
         service_floor_s=cfg.service_floor_s,
         dispatch_timeout_s=cfg.dispatch_timeout_s,
         elastic=cfg.elastic or cfg.reshard, reshard=cfg.reshard,
-        reshard_spares=cfg.reshard_spares, conflict_sched=cfg.sched)
+        reshard_spares=cfg.reshard_spares, conflict_sched=cfg.sched,
+        history_structure=cfg.history_structure)
     nemesis = NetworkNemesis(cfg.seed, cfg.chaos)
     transports: Dict[str, ChaosTransport] = {}
     versions: Dict[str, int] = {}

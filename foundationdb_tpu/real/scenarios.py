@@ -186,6 +186,11 @@ SCENARIOS: Dict[str, ScenarioSpec] = {
             "session_cache", "session cache",
             "read-mostly with cadenced TTL range deletes",
             _session_cache,
+            # TTL sweeps are exactly the range-deletion GC lane the
+            # tiered history structure turns into O(batch) work
+            # (docs/perf.md "Incremental history maintenance") — the
+            # atlas pins that lane on device engine modes
+            profile={"history_structure": "tiered"},
             max_abort_frac=0.20, max_throttle_frac=0.45),
     )
 }
@@ -243,6 +248,12 @@ def build_signature(report: CampaignReport) -> dict:
         "witnesses": len(heat.get("recent_attribution") or []),
         "abort_frac": round(counts.get("conflicted", 0) / max(served, 1), 4),
         "throttle_frac": round(counts.get("throttled", 0) / offered, 4),
+        # GC + history-maintenance half: rows reclaimed by the horizon /
+        # TTL range-delete lane, and the tiered structure's append/merge
+        # counters (all-zero on monolithic engines — honest, not absent)
+        "gc_reclaimed": int(heat.get("gc_reclaimed", 0)),
+        "history": {k: int(v) for k, v in
+                    (heat.get("history") or {}).items()},
     }
 
 
@@ -263,6 +274,8 @@ def publish_scenario(name: str, report: CampaignReport) -> None:
         int(sig.get("concentration", 0.0) * 1000))
     td.int64(f"scenario.{name}.committed").set(
         int((report.counts or {}).get("committed", 0)))
+    td.int64(f"scenario.{name}.gc_reclaimed").set(
+        int(sig.get("gc_reclaimed", 0)))
 
 
 def score(report: CampaignReport, cfg: NemesisConfig) -> dict:

@@ -832,6 +832,16 @@ class ReshardController:
             op.state = "precopy"
             ts0 = span_now()
             marks = {sid: 0 for sid, _b, _e in moving}
+            # tiered donors (docs/perf.md "Incremental history
+            # maintenance") serve later rounds straight off their
+            # un-merged device runs: seed the per-donor (nruns, merge
+            # epoch) chain BEFORE the full shadow read so a batch
+            # landing in between is re-fetched, never skipped
+            run_marks: Dict[int, tuple] = {}
+            for sid, _b, _e in moving:
+                wm = handoff.run_watermarks(g.slots[sid].engine)
+                if wm is not None and wm[1] is not None:
+                    run_marks[sid] = wm
             entries = self._slice_all(moving, marks)
             entries = handoff.coalesce(entries, begin, end)
             for sid, _b, _e in moving:
@@ -840,7 +850,7 @@ class ReshardController:
             op.precopied += await handoff.replay_slice(recipient.engine,
                                                        entries)
             for _round in range(PRECOPY_MAX_ROUNDS):
-                delta = self._slice_all(moving, marks)
+                delta = self._slice_all(moving, marks, run_marks)
                 if len(delta) <= PRECOPY_DELTA_TARGET:
                     break
                 for sid, _b, _e in moving:
@@ -861,7 +871,7 @@ class ReshardController:
                 blackbox.record_reshard(op, "frozen")
             ts_freeze = span_now()
             await g.quiesce()
-            delta = sorted(self._slice_all(moving, marks))
+            delta = sorted(self._slice_all(moving, marks, run_marks))
             op.delta = await handoff.replay_slice(recipient.engine, delta)
             if spans_on:
                 span_event("reshard.transfer", rid, ts_freeze, span_now(),
@@ -949,12 +959,40 @@ class ReshardController:
                                      "t1": self.now_fn()})
             return None
 
-    def _slice_all(self, moving, marks) -> List[handoff.HistoryBatch]:
+    def _slice_all(self, moving, marks,
+                   run_marks=None) -> List[handoff.HistoryBatch]:
+        """One pre-copy round's entries across the moving donors. With
+        `run_marks` ({sid: (nruns vector, merge epoch)}), a tiered
+        donor's round reads only the runs appended since its chain mark
+        — O(delta) off the device image — falling back to the
+        always-sufficient shadow when the donor can't serve the path or
+        a compaction broke the chain (resync). Duplicate entries at or
+        below a donor's version mark are filtered exactly like the
+        shadow path filters them."""
         out: List[handoff.HistoryBatch] = []
         for sid, b, e in moving:
-            out.extend(handoff.shadow_slice(
-                self.group.slots[sid].engine, b, e,
-                min_version=marks.get(sid, 0)))
+            eng = self.group.slots[sid].engine
+            mv = marks.get(sid, 0)
+            got = None
+            if run_marks is not None and sid in run_marks:
+                since, epoch = run_marks[sid]
+                got = handoff.run_slice(eng, b, e, since_runs=since,
+                                        since_epoch=epoch)
+                if got is not None and got["resync"]:
+                    got = None
+            if got is None:
+                if run_marks is not None and sid in run_marks:
+                    # re-seed before the shadow read so the NEXT round
+                    # can go incremental again
+                    wm = handoff.run_watermarks(eng)
+                    if wm is not None and wm[1] is not None:
+                        run_marks[sid] = wm
+                    else:
+                        run_marks.pop(sid, None)
+                out.extend(handoff.shadow_slice(eng, b, e, min_version=mv))
+            else:
+                run_marks[sid] = (got["watermarks"], got["epoch"])
+                out.extend((v, w) for v, w in got["entries"] if v > mv)
         return out
 
 

@@ -112,6 +112,11 @@ METRICS: Tuple[Metric, ...] = (
            "steady-state compiles", higher_is_better=False, noise_frac=0.0),
     Metric("history_floor", "points.-1.bsearch_speedup",
            "bsearch speedup @max occupancy"),
+    Metric("history_floor", "apply.points.-1.tiered_speedup",
+           "tiered apply speedup @max occupancy"),
+    Metric("history_floor", "apply.steady_state_compiles.tiered",
+           "tiered apply steady-state compiles", higher_is_better=False,
+           noise_frac=0.0),
     Metric("loop_floor", "loop_speedup", "loop host-time speedup",
            noise_frac=0.25),
     Metric("loop_floor", "loop_stats.blocking_syncs", "loop blocking syncs",

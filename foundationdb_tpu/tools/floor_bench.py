@@ -95,6 +95,128 @@ def _read_batch(cfg: ck.KernelConfig, rng: np.random.Generator,
     }
 
 
+def _write_batch(cfg: ck.KernelConfig, rng: np.random.Generator,
+                 n: int) -> Dict[str, np.ndarray]:
+    """One full batch of valid point WRITES over the table's own keys —
+    the apply-cost probe (docs/perf.md "Incremental history
+    maintenance"). Re-writing existing keys keeps the boundary set
+    stationary after the first apply folds in the point-write end rows,
+    so a warm scan reaches steady occupancy and the timed scan measures
+    maintenance cost at a fixed table size."""
+    b = _read_batch(cfg, rng, n)
+    Rp, Wp, T = cfg.rp, cfg.wp, cfg.max_txns
+    b["rp_valid"] = np.zeros((Rp,), bool)
+    b["wpb"] = keypack.pack_keys(
+        [b"fl/%08d" % i for i in rng.integers(0, max(1, n), size=Wp)],
+        cfg.key_words).astype(np.uint32)
+    b["wp_txn"] = np.sort(rng.integers(0, T, size=Wp)).astype(np.int32)
+    b["wp_valid"] = np.ones((Wp,), bool)
+    return b
+
+
+def run_apply_sweep(
+    cfg: Optional[ck.KernelConfig] = None,
+    *,
+    occupancy_fracs: Sequence[float] = (0.25, 0.5, 0.75),
+    scan_steps: int = 48,
+    history_runs: int = 8,
+    seed: int = 2028,
+) -> Dict:
+    """The `history_floor.apply` section (docs/perf.md "Incremental
+    history maintenance"): device ms per WRITE batch vs table occupancy,
+    monolithic vs tiered. The monolithic `apply_writes_and_gc` re-merges
+    the capacity-H table with every batch, so its apply time carries the
+    same H-shaped floor the fused query sort did; the tiered structure
+    appends the batch as one sorted run and compacts every
+    `history_runs` batches, so its amortized cost tracks the batch.
+    Methodology: the MAINTENANCE phase (`apply_writes_and_gc`) is timed
+    in isolation — the query phases cost the same under either
+    structure (cross-structure parity is their contract), so timing the
+    full step would bury the apply difference under the shared search
+    machinery. The table is first brought to its steady boundary set
+    (one fold admits the point-write end rows), the write positions are
+    recomputed against that steady table, and the timed scan then
+    replays the identical apply at stationary occupancy — asserted by
+    comparing warm-end and timed-end row counts."""
+    cfg = cfg or SMOKE_CFG
+    rng = np.random.default_rng(seed)
+    runs = []
+    for structure in ("monolithic", "tiered"):
+        scfg = dataclasses.replace(cfg, history_structure=structure,
+                                   history_runs=history_runs)
+        for frac in occupancy_fracs:
+            n = max(1, int(frac * cfg.capacity))
+            batch = jax.device_put(_write_batch(cfg, rng, n))
+            committed = jnp.ones((cfg.max_txns,), bool)
+            state = dict(ck.initial_state(scfg))
+            state.update(_table_state(cfg, n))
+            state = jax.device_put(state)
+            # steady boundary set: fold the batch once, then recompute
+            # the write positions against the folded table so the scan
+            # replays a position-correct apply at fixed occupancy
+            phases = jax.jit(
+                lambda st, b, _cfg=scfg: ck.local_phases(_cfg, st, b)[2])
+            one = jax.jit(
+                lambda st, b, c, w, _cfg=scfg:
+                ck.apply_writes_and_gc(_cfg, st, b, c, w)[0])
+            state = one(state, batch, committed, phases(state, batch))
+            wpos = phases(state, batch)
+
+            def step(st, _, _cfg=scfg, _b=batch, _c=committed, _w=wpos):
+                st2, _overflow, _reclaimed = ck.apply_writes_and_gc(
+                    _cfg, st, _b, _c, _w)
+                return st2, st2["n"]
+
+            run = jax.jit(
+                lambda st, _step=step: lax.scan(_step, st, jnp.arange(scan_steps)))
+            runs.append((structure, frac, n, run, state))
+
+    states, steady_n = {}, {}
+    for structure, frac, n, run, state in runs:
+        st, ns = run(state)
+        steady_n[(structure, frac)] = int(np.asarray(ns)[-1])
+        states[(structure, frac)] = st
+
+    compiles = {"monolithic": 0, "tiered": 0}
+    ms: Dict[tuple, float] = {}
+    monitored = True
+    for structure, frac, n, run, _state in runs:
+        counter = _CompileCounter()
+        t0 = time.perf_counter()
+        st, ns = run(states[(structure, frac)])
+        final_n = int(np.asarray(ns)[-1])
+        ms[(structure, frac)] = (time.perf_counter() - t0) / scan_steps * 1e3
+        assert final_n == steady_n[(structure, frac)], (
+            f"{structure} occupancy not stationary: "
+            f"{final_n} != {steady_n[(structure, frac)]}")
+        seen = counter.close()
+        if seen is None:
+            monitored = False
+        else:
+            compiles[structure] += seen
+
+    points = []
+    for frac in occupancy_fracs:
+        mono = ms[("monolithic", frac)]
+        tier = ms[("tiered", frac)]
+        points.append({
+            "occupancy_frac": frac,
+            "n": max(1, int(frac * cfg.capacity)),
+            "monolithic_ms": round(mono, 4),
+            "tiered_ms": round(tier, 4),
+            "tiered_speedup": round(mono / tier, 3) if tier > 0 else None,
+        })
+    return {
+        "batch_txns": cfg.max_txns,
+        "capacity": cfg.capacity,
+        "write_rows": cfg.wp,
+        "history_runs": history_runs,
+        "scan_steps": scan_steps,
+        "points": points,
+        "steady_state_compiles": compiles if monitored else None,
+    }
+
+
 class _CompileCounter:
     """Counts real backend compiles via jax monitoring events (the same
     counter tests/test_bucket_ladder.py pins tier-1 on); degrades to

@@ -1,0 +1,177 @@
+"""Incremental-history CI smoke (`make history-smoke`, CPU backend, ~30s).
+
+Four checks, each loud on failure (docs/perf.md "Incremental history
+maintenance"):
+
+  1. APPLY COST SCALES WITH BATCH, NOT CAPACITY — the isolated
+     `apply_writes_and_gc` sweep (tools/floor_bench.run_apply_sweep) at
+     two capacities, small-touch batches: the tiered structure must beat
+     the monolithic re-merge at the larger table, and its advantage must
+     GROW with capacity (the monolithic apply pays the capacity-H
+     re-merge every batch; the tiered apply pays the batch).
+  2. ZERO POST-WARMUP COMPILES WITH TIERS — a warmed tiered engine serves
+     a mixed stream spanning several lazy compactions without a single
+     backend compile (the same monitoring counter tier-1 pins the bucket
+     ladder on).
+  3. PARITY CANARY — monolithic, tiered/fused_sort and tiered/bsearch
+     engines replay one randomized GC-advancing stream against the
+     reference CPU oracle, bit-identical verdicts every batch.
+  4. PROMETHEUS EXPOSITION PARSES — the hub text now carrying the
+     `history.*` gauges passes the PR 8 strict line parser and exposes
+     the `fdbtpu_history` family, with the driven engine's merge counter
+     visible.
+
+    JAX_PLATFORMS=cpu python -m foundationdb_tpu.tools.history_smoke
+"""
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+
+from ..core import telemetry
+from ..core.rng import DeterministicRandom
+from ..core.types import CommitTransaction, KeyRange
+from ..ops import conflict_kernel as ck
+from ..ops.host_engine import JaxConflictEngine
+from ..ops.oracle import OracleConflictEngine
+from .floor_bench import _CompileCounter, run_apply_sweep
+from .heat_smoke import strict_parse_prometheus
+
+#: two-capacity scaling probe: 512-txn-point write shape scaled down to
+#: smoke size, small-touch (the batch can touch 2*w_all = 96 rows; the
+#: tables hold 4k / 16k)
+SWEEP_SHAPE = dict(key_words=4, max_txns=16, max_point_reads=64,
+                   max_point_writes=32, max_reads=8, max_writes=8)
+CAPACITIES = (4096, 16384)
+#: floor for the large-table speedup; measured ~3x on CPU, the bar keeps
+#: 2x slack for noisy CI hosts while still refusing a regressed merge
+MIN_SPEEDUP_LARGE = 1.5
+
+PARITY_CFG = ck.KernelConfig(key_words=2, capacity=512, max_reads=64,
+                             max_writes=64, max_txns=16)
+
+
+def check_apply_scaling() -> None:
+    by_cap = {}
+    for cap in CAPACITIES:
+        cfg = ck.KernelConfig(capacity=cap, **SWEEP_SHAPE)
+        out = run_apply_sweep(cfg, occupancy_fracs=(0.75,), scan_steps=24)
+        p = out["points"][-1]
+        by_cap[cap] = p
+        assert out["steady_state_compiles"]["tiered"] == 0, (
+            f"tiered apply recompiled post-warmup at capacity {cap}: "
+            f"{out['steady_state_compiles']}")
+    small, large = by_cap[CAPACITIES[0]], by_cap[CAPACITIES[-1]]
+    assert large["tiered_speedup"] >= MIN_SPEEDUP_LARGE, (
+        f"tiered apply speedup {large['tiered_speedup']} < "
+        f"{MIN_SPEEDUP_LARGE} at capacity {CAPACITIES[-1]} "
+        f"(mono {large['monolithic_ms']}ms, tiered {large['tiered_ms']}ms)")
+    assert large["tiered_speedup"] > small["tiered_speedup"], (
+        "tiered advantage must grow with capacity (apply scaling with the "
+        f"table, not the batch?): {small['tiered_speedup']} at "
+        f"{CAPACITIES[0]} vs {large['tiered_speedup']} at {CAPACITIES[-1]}")
+    print(f"  apply scaling: tiered {large['tiered_ms']}ms vs monolithic "
+          f"{large['monolithic_ms']}ms at 75% of {CAPACITIES[-1]} rows "
+          f"({large['tiered_speedup']}x, was {small['tiered_speedup']}x at "
+          f"{CAPACITIES[0]} rows)")
+
+
+def _random_key(rng, alphabet=b"ab\x00\xff", maxlen=6):
+    n = rng.random_int(0, maxlen + 1)
+    return bytes(rng.random_choice(alphabet) for _ in range(n))
+
+
+def _random_txn(rng, version_floor, version_now):
+    t = CommitTransaction()
+    t.read_snapshot = rng.random_int(max(0, version_floor - 40), version_now)
+    for ranges, allow_empty in ((t.read_conflict_ranges, True),
+                                (t.write_conflict_ranges, False)):
+        for _ in range(rng.random_int(0, 4)):
+            a, b = _random_key(rng), _random_key(rng)
+            if a > b:
+                a, b = b, a
+            if a == b and not allow_empty:
+                b = a + b"\x00"
+            ranges.append(KeyRange(a, b))
+    return t
+
+
+def _stream(seed, batches=30):
+    rng = DeterministicRandom(seed)
+    now, oldest = 10, 0
+    for _ in range(batches):
+        now += rng.random_int(1, 30)
+        if rng.random01() < 0.3:
+            oldest = max(oldest, now - rng.random_int(20, 120))
+        txns = [_random_txn(rng, oldest, now)
+                for _ in range(rng.random_int(1, 13))]
+        yield txns, now, oldest
+
+
+def check_parity_and_compiles() -> JaxConflictEngine:
+    """Returns the driven tiered engine — the caller MUST hold it until
+    after check_prometheus: the telemetry hub keeps only weakrefs."""
+    oracle = OracleConflictEngine()
+    mono = JaxConflictEngine(PARITY_CFG, ladder=())
+    tiered_cfg = dataclasses.replace(PARITY_CFG, history_structure="tiered",
+                                     history_runs=3)
+    tiers = {
+        "tiered/fused_sort": JaxConflictEngine(
+            dataclasses.replace(tiered_cfg, history_search="fused_sort"),
+            ladder=(), heat_buckets=16),
+        "tiered/bsearch": JaxConflictEngine(
+            dataclasses.replace(tiered_cfg, history_search="bsearch"),
+            ladder=()),
+    }
+    engines = {"monolithic": mono, **tiers}
+    # one monotone stream; the first batches warm every program (compile
+    # + first merge), then the counter polices the rest — which still
+    # spans several 3-run compaction cycles
+    counter = None
+    for i, (txns, now, oldest) in enumerate(_stream(4, batches=30)):
+        if i == 6:
+            counter = _CompileCounter()
+        want = [int(x) for x in oracle.resolve(txns, now, oldest)]
+        for name, eng in engines.items():
+            got = [int(x) for x in eng.resolve(txns, now, oldest)]
+            assert got == want, f"{name} diverged from oracle: {got} != {want}"
+    seen = counter.close()
+    assert seen in (None, 0), f"{seen} post-warmup compiles serving tiers"
+    hot = tiers["tiered/fused_sort"]
+    hist = hot.heat.history_snapshot()
+    assert hist["appends"] > 0 and hist["merges"] > 0, (
+        f"stream never exercised the run stack: {hist}")
+    n_comp = "unmonitored" if seen is None else seen
+    print(f"  parity: 30 batches bit-identical across monolithic + 2 tiered "
+          f"modes, {hist['merges']} compactions, {n_comp} compiles")
+    return hot
+
+
+def check_prometheus() -> None:
+    telemetry.hub().sync()
+    text = telemetry.hub().prometheus_text()
+    n = strict_parse_prometheus(text)
+    assert "# TYPE fdbtpu_history gauge" in text, "no history family exposed"
+    merge_lines = [ln for ln in text.splitlines()
+                   if ln.startswith("fdbtpu_history") and "merges" in ln]
+    assert any(not ln.rstrip().endswith(" 0") for ln in merge_lines), (
+        f"history merge gauges all zero: {merge_lines}")
+    print(f"  prometheus: {n} samples parse strictly, fdbtpu_history "
+          "family present with live merge counts")
+
+
+def main() -> int:
+    t0 = time.perf_counter()
+    telemetry.reset()
+    print("history-smoke (docs/perf.md):")
+    check_apply_scaling()
+    live = check_parity_and_compiles()  # held: the hub weakrefs it
+    check_prometheus()
+    del live
+    print(f"history-smoke OK in {time.perf_counter() - t0:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

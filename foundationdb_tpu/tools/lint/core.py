@@ -342,6 +342,11 @@ DEFAULT_POLICY: Dict[str, RulePolicy] = {
                 # part of the commit waterfall's telescoping sum either
                 ("sched.", "foundationdb_tpu/pipeline/scheduler.py",
                  "SCHED_SEGMENTS"),
+                # history.* maintenance arcs (tiered run snapshot/slice,
+                # fault/handoff.py): pre-copy plumbing outside any one
+                # transaction's latency, so outside the telescoping sum
+                ("history.", "foundationdb_tpu/fault/handoff.py",
+                 "HISTORY_SEGMENTS"),
             ),
             "span_calls": ("span", "span_event", "Span", "subspan"),
         }),

@@ -1,0 +1,423 @@
+"""Tiered sorted-run history maintenance (PR 20 tentpole).
+
+Parity law: `history_structure="tiered"` must produce abort sets
+bit-identical to the reference-exact CPU oracle AND to the monolithic
+re-merge baseline — across both history-search modes, bucket-ladder
+boundaries (k-1/k/k+1) with the GC horizon advancing mid-stream,
+tier-compaction boundaries (run-stack exactly full, run-row overflow),
+the stacked sub-shard and device-loop dispatch surfaces, a reshard
+epoch flip with a tiered donor, and a crash-recovery snapshot
+round-trip (fault/recovery.py). The empty-read-at-minimal-key
+regression is pinned explicitly: the oracle's version_strictly_below
+clamps its predecessor scan to the table's minimal-key row, so a run
+whose union begins exactly at b'' must answer for an empty read at
+b'' — the one case where a run contributes its row AT the query."""
+import dataclasses
+import random
+
+import pytest
+
+pytest.importorskip("jax")
+
+from foundationdb_tpu.core import blackbox, buggify, telemetry
+from foundationdb_tpu.core.keyshard import KeyShardMap
+from foundationdb_tpu.core.rng import DeterministicRandom
+from foundationdb_tpu.core.trace import g_trace
+from foundationdb_tpu.core.types import CommitTransaction, KeyRange
+from foundationdb_tpu.fault import handoff
+from foundationdb_tpu.fault.inject import FaultInjectingEngine, FaultRates
+from foundationdb_tpu.fault.resilient import ResilienceConfig, ResilientEngine
+from foundationdb_tpu.ops.conflict_kernel import KernelConfig
+from foundationdb_tpu.ops.host_engine import (
+    JaxConflictEngine,
+    SubshardedConflictEngine,
+)
+from foundationdb_tpu.ops.oracle import OracleConflictEngine, VersionIntervalMap
+from foundationdb_tpu.sim.loop import set_scheduler
+from foundationdb_tpu.sim.simulator import Simulator
+
+SMALL = KernelConfig(key_words=2, capacity=512, max_reads=64, max_writes=64,
+                     max_txns=16)
+TIERED = dataclasses.replace(SMALL, history_structure="tiered",
+                             history_runs=3)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    buggify.disable()
+    set_scheduler(None)
+    telemetry.reset()
+
+
+def random_key(rng, alphabet=b"ab\x00\xff", maxlen=6):
+    n = rng.random_int(0, maxlen + 1)
+    return bytes(rng.random_choice(alphabet) for _ in range(n))
+
+
+def random_range(rng, allow_empty=False):
+    a, b = random_key(rng), random_key(rng)
+    if a > b:
+        a, b = b, a
+    if a == b and not allow_empty:
+        b = a + b"\x00"
+    return KeyRange(a, b)
+
+
+def random_txn(rng, version_floor, version_now):
+    t = CommitTransaction()
+    t.read_snapshot = rng.random_int(max(0, version_floor - 40), version_now)
+    for _ in range(rng.random_int(0, 4)):
+        t.read_conflict_ranges.append(random_range(rng, allow_empty=True))
+    for _ in range(rng.random_int(0, 4)):
+        t.write_conflict_ranges.append(random_range(rng, allow_empty=True))
+    return t
+
+
+def parity_stream(seed, engines, batches=35, txns_per_batch=12):
+    """Drive `engines` and the oracle over one randomized stream — empty
+    reads allowed, GC horizon advancing on ~30% of batches — asserting
+    bit-identical verdicts every batch."""
+    rng = DeterministicRandom(seed)
+    oracle = OracleConflictEngine()
+    now, oldest = 10, 0
+    for b in range(batches):
+        now += rng.random_int(1, 30)
+        if rng.random01() < 0.3:
+            oldest = max(oldest, now - rng.random_int(20, 120))
+        txns = [random_txn(rng, oldest, now)
+                for _ in range(rng.random_int(1, txns_per_batch + 1))]
+        want = oracle.resolve(txns, now, oldest)
+        for name, eng in engines.items():
+            got = eng.resolve(txns, now, oldest)
+            assert list(map(int, got)) == list(map(int, want)), \
+                f"{name} seed={seed} batch={b}"
+
+
+def wtxn(version, ranges):
+    t = CommitTransaction(read_snapshot=version)
+    for b, e in ranges:
+        t.write_conflict_ranges.append(KeyRange(b, e))
+    return t
+
+
+# -- the pinned regression ----------------------------------------------------
+
+@pytest.mark.parametrize("mode", ["fused_sort", "bsearch"])
+def test_empty_read_at_minimal_key_regression(mode):
+    """A committed write whose union begins at b'' lands in a run; an
+    empty-range read [b'', b'') with a stale snapshot must still
+    conflict — the oracle's predecessor clamp reads the value AT the
+    minimal key, so the run's first row answers. (The original tiered
+    probe returned NEG here and silently committed.)"""
+    cfg = dataclasses.replace(TIERED, history_search=mode)
+    oracle = OracleConflictEngine()
+    mono = JaxConflictEngine(dataclasses.replace(SMALL, history_search=mode),
+                             ladder=())
+    tier = JaxConflictEngine(cfg, ladder=())
+    w = wtxn(100, [(b"", b"x")])
+    for eng in (oracle, mono, tier):
+        assert [int(x) for x in eng.resolve([w], 100, 0)] == [2]
+    r = CommitTransaction(read_snapshot=50,
+                          read_conflict_ranges=[KeyRange(b"", b"")])
+    fresh = CommitTransaction(read_snapshot=100,
+                              read_conflict_ranges=[KeyRange(b"", b"")])
+    want = [int(x) for x in oracle.resolve([r, fresh], 120, 0)]
+    assert want == [0, 2], want      # stale conflicts, fresh commits
+    assert [int(x) for x in mono.resolve([r, fresh], 120, 0)] == want
+    assert [int(x) for x in tier.resolve([r, fresh], 120, 0)] == want
+
+
+# -- randomized cross-structure parity ----------------------------------------
+
+@pytest.mark.parametrize("mode", ["fused_sort", "bsearch"])
+@pytest.mark.parametrize("seed", [5, 21])
+def test_tiered_parity_random(mode, seed):
+    parity_stream(seed, {
+        "mono": JaxConflictEngine(
+            dataclasses.replace(SMALL, history_search=mode), ladder=()),
+        "tiered": JaxConflictEngine(
+            dataclasses.replace(TIERED, history_search=mode), ladder=()),
+    })
+
+
+def test_tier_compaction_boundaries():
+    """The degenerate geometries: a 2-slot stack (merge every other
+    write batch) and the minimum legal run plane (exactly one batch
+    union, so every wide batch fills its run to the brim) both stay
+    oracle-exact; geometries that cannot hold a batch union — or a
+    single-slot stack — are rejected at construction."""
+    two_slot = dataclasses.replace(SMALL, history_structure="tiered",
+                                   history_runs=2)
+    tight = dataclasses.replace(SMALL, history_structure="tiered",
+                                history_runs=4,
+                                history_run_rows=2 * SMALL.w_all)
+    parity_stream(33, {
+        "two_slot": JaxConflictEngine(two_slot, ladder=()),
+        "tight_rows": JaxConflictEngine(tight, ladder=()),
+    }, batches=40)
+    with pytest.raises(ValueError, match="cannot hold one batch"):
+        JaxConflictEngine(dataclasses.replace(
+            SMALL, history_structure="tiered", history_run_rows=8), ladder=())
+    with pytest.raises(ValueError, match="history_runs"):
+        JaxConflictEngine(dataclasses.replace(
+            SMALL, history_structure="tiered", history_runs=1), ladder=())
+
+
+def test_dispatch_surfaces_parity():
+    """The stacked-vmap sub-shard engine and a 2-shard split both serve
+    tiered history with oracle-exact verdicts (per-shard run planes ride
+    the stacked state tree)."""
+    parity_stream(47, {
+        "sub1": SubshardedConflictEngine(TIERED, KeyShardMap([])),
+        "sub2": SubshardedConflictEngine(TIERED, KeyShardMap([b"b"])),
+    }, batches=30)
+
+
+def test_device_loop_parity():
+    """The device-resident loop carries the run planes in its donated
+    state: verdicts stay oracle-exact and no drain falls back to a
+    blocking sync."""
+    from foundationdb_tpu.ops.device_loop import DeviceLoopEngine
+
+    eng = DeviceLoopEngine(TIERED, ladder=())
+    parity_stream(58, {"loop": eng}, batches=30)
+    stats = eng.loop_stats_snapshot()
+    assert stats is not None and stats.get("blocking_syncs", 0) == 0, stats
+
+
+# -- bucket-ladder boundaries -------------------------------------------------
+
+def test_bucket_ladder_boundary_parity_and_no_retrace():
+    """Batch sizes straddling the 32-txn bucket boundary (31/32/33) and
+    the top bucket, GC advancing mid-stream: tiered verdicts match the
+    oracle, the ladder's run planes stay shape-invariant across buckets
+    (one device state serves every program), and a warmed engine never
+    compiles again."""
+    # row caps sized to the workload (4 ranges/txn at 64 txns), the same
+    # contract the production BudgetBatcher packs batches under
+    cfg = dataclasses.replace(
+        KernelConfig(key_words=2, capacity=1024, max_reads=256,
+                     max_writes=256, max_txns=64),
+        history_structure="tiered", history_runs=3)
+    for t in (32,):
+        b = cfg.bucket(t)
+        assert (b.run_slots, b.run_rows) == (cfg.run_slots, cfg.run_rows)
+    eng = JaxConflictEngine(cfg, ladder=(32,), scan_sizes=()).warmup()
+    compiles_after_warmup = eng.perf.compiles
+    oracle = OracleConflictEngine()
+    rng = DeterministicRandom(71)
+    now, oldest = 10, 0
+    for b, size in enumerate([31, 32, 33, 64, 31, 33, 64, 32]):
+        now += rng.random_int(5, 30)
+        if b % 3 == 2:
+            oldest = max(oldest, now - 60)
+        txns = [random_txn(rng, oldest, now) for _ in range(size)]
+        want = oracle.resolve(txns, now, oldest)
+        got = eng.resolve(txns, now, oldest)
+        assert list(map(int, got)) == list(map(int, want)), (b, size)
+    assert eng.perf.compiles == compiles_after_warmup, \
+        "post-warmup retrace in the tiered ladder"
+
+
+# -- heat-borne run accounting ------------------------------------------------
+
+def test_history_accounting_counters():
+    """Run-stack telemetry derives from per-shard depth transitions in
+    the heat aggregate (zero extra device syncs): N write-bearing
+    batches on a 3-slot stack count exactly N appends and a merge every
+    time the full stack compacts."""
+    cfg = dataclasses.replace(TIERED, heat_buckets=8)
+    eng = JaxConflictEngine(cfg, ladder=())
+    for i, v in enumerate(range(10, 80, 10)):        # 7 write batches
+        r = eng.resolve([wtxn(v, [(b"k%d" % i, b"k%d\x00" % i)])], v, 0)
+        assert [int(x) for x in r] == [2]
+    s = eng.history_stats_snapshot()
+    assert s["structure"] == "tiered"
+    # depth walks 1,2,3 -> merge+1, 2,3 -> merge+1: 7 appends, 2 merges
+    assert s["appends"] == 7, s
+    assert s["merges"] == 2, s
+    assert 1 <= s["runs_live"] <= cfg.run_slots, s
+    assert s["run_rows_live"] >= 2, s
+    # a write-free batch moves nothing
+    ro = CommitTransaction(read_snapshot=75,
+                           read_conflict_ranges=[KeyRange(b"z", b"zz")])
+    eng.resolve([ro], 90, 0)
+    s2 = eng.history_stats_snapshot()
+    assert (s2["appends"], s2["merges"]) == (s["appends"], s["merges"]), s2
+
+
+# -- the O(delta) snapshot export ---------------------------------------------
+
+def test_run_slice_delta_and_resync():
+    """fault/handoff.run_slice off a tiered donor: the full slice
+    reproduces the effective interval map, a second round with the
+    returned watermark carries ONLY the new run, and a compaction under
+    a held watermark flags resync (the LSM manifest contract)."""
+    eng = JaxConflictEngine(dataclasses.replace(TIERED, history_runs=4),
+                            ladder=())
+    batches = [(20, [(b"a", b"c"), (b"m", b"p")]),
+               (35, [(b"b", b"d")]),
+               (50, [(b"", b"a\x00")])]
+    for v, ranges in batches:
+        assert all(int(x) == 2 for x in eng.resolve([wtxn(v, ranges)], v, 0))
+    sl = handoff.run_slice(eng, b"", None)
+    assert sl is not None and not sl["resync"]
+    want, got = VersionIntervalMap(0), VersionIntervalMap(0)
+    for v, ranges in batches:
+        for b, e in ranges:
+            want.write(b, e, v)
+    for v, ranges in sl["entries"]:
+        for b, e in ranges:
+            got.write(b, e, v)
+    for k in (b"", b"a", b"a\x00", b"b", b"c", b"d", b"m", b"n", b"p", b"z"):
+        assert want.version_strictly_below(k) == got.version_strictly_below(k)
+        assert want.range_max(k, k + b"\xff") == got.range_max(k, k + b"\xff")
+    # incremental round: only the delta since the watermark
+    eng.resolve([wtxn(60, [(b"x", b"y")])], 60, 0)
+    sl2 = handoff.run_slice(eng, b"", None, since_runs=sl["watermarks"])
+    assert sl2["entries"] == [(60, ((b"x", b"y"),))], sl2
+    # range clip stays inside [b, n)
+    for _v, ranges in handoff.run_slice(eng, b"b", b"n")["entries"]:
+        assert all(b"b" <= b and e <= b"n" for b, e in ranges)
+    # overflow the stack -> merge -> the held watermark is dead
+    for i, v in enumerate(range(70, 76)):
+        eng.resolve([wtxn(v, [(b"k%d" % i, b"k%d\x00" % i)])], v, 0)
+    sl3 = handoff.run_slice(eng, b"", None, since_runs=sl2["watermarks"])
+    assert sl3["resync"], sl3
+    # monolithic donors don't serve the path at all
+    assert handoff.run_slice(JaxConflictEngine(SMALL, ladder=()),
+                             b"", None) is None
+
+
+# -- reshard epoch flip with a tiered donor -----------------------------------
+
+def _batch_stream(seed, n, pool=60, start_v=0, span_frac=0.2):
+    rng = random.Random(seed)
+    v = start_v
+    out = []
+    for _ in range(n):
+        v += rng.randrange(20, 100)
+        txns = []
+        for _ in range(rng.randrange(1, 6)):
+            t = CommitTransaction(
+                read_snapshot=max(0, v - rng.randrange(1, 300)))
+            for _ in range(rng.randrange(1, 3)):
+                a = rng.randrange(pool)
+                if rng.random() < span_frac:
+                    b = min(pool, a + rng.randrange(2, pool // 2))
+                    t.read_conflict_ranges.append(
+                        KeyRange(b"k/%03d" % a, b"k/%03d" % b))
+                else:
+                    k = b"k/%03d" % a
+                    t.read_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            for _ in range(rng.randrange(0, 3)):
+                a = rng.randrange(pool)
+                k = b"k/%03d" % a
+                t.write_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            txns.append(t)
+        out.append((txns, v, max(0, v - 1500)))
+    return out
+
+
+def _tiered_factory():
+    inner = JaxConflictEngine(TIERED, ladder=())
+    injector = FaultInjectingEngine(
+        inner, rates=FaultRates(exception=0, hang=0, slow=0, flip=0,
+                                outage=0))
+    eng = ResilientEngine(injector, ResilienceConfig(
+        dispatch_timeout=0.5, retry_budget=2, retry_backoff=0.02,
+        probe_rate=0.0, probation_batches=2, failover_min_batches=2),
+        record_journal=True)
+    return inner, injector, eng
+
+
+def test_reshard_epoch_flip_with_tiered_donor():
+    """The straddling-batch reshard law with a TIERED device donor: the
+    moving range's history slides out via the shadow handoff, the epoch
+    flips, and every batch on either side of the flip stays bit-exact
+    against a serial oracle — while the donor also serves the run-slice
+    O(delta) export that incremental pre-copy rounds consume."""
+    from foundationdb_tpu.server.reshard import ElasticResolverGroup
+
+    sim = Simulator(17)
+    buggify.disable()
+    g_trace.clear()
+    telemetry.reset()
+    group = ElasticResolverGroup(_tiered_factory)
+    extra = group.new_slot()
+    clean = OracleConflictEngine()
+    pre = _batch_stream(21, 10)
+    flip_v = pre[-1][1] + 10
+    post = [(t, v + flip_v, o) for t, v, o in _batch_stream(22, 10)]
+
+    async def go():
+        for txns, v, old in pre:
+            got = await group.resolve(txns, v, old)
+            assert [int(x) for x in got] == \
+                [int(x) for x in clean.resolve(txns, v, old)], (v,)
+        donor = group.slots[0].engine
+        # the tiered donor serves the O(delta) run export
+        sl = handoff.run_slice(donor, b"", None)
+        assert sl is not None and sl["watermarks"], sl
+        # the moving range's history slides into the recipient
+        entries = handoff.coalesce(
+            handoff.shadow_slice(donor, b"k/030", None), b"k/030", None)
+        assert entries, "no history to hand off"
+        await handoff.replay_slice(extra.engine, entries)
+        e = group.emap.flip(KeyShardMap([b"k/030"]), flip_v)
+        group._assign[e] = [group.slots[0].sid, extra.sid]
+        for txns, v, old in post:
+            assert group.emap.entry_for_version(v)[0] == e
+            got = await group.resolve(txns, v, old)
+            assert [int(x) for x in got] == \
+                [int(x) for x in clean.resolve(txns, v, old)], (v,)
+        return True
+
+    assert sim.sched.run_until(sim.sched.spawn(go()), until=100000)
+
+
+# -- crash-recovery snapshot round-trip ---------------------------------------
+
+def test_crash_recovery_snapshot_roundtrip(tmp_path):
+    """Snapshot + journal replay (fault/recovery.py) rebuilds a FRESH
+    supervised tiered engine that continues the dead one's verdict
+    stream bit-for-bit, then stays oracle-exact on probe batches."""
+    from foundationdb_tpu.fault import recovery
+
+    sim = Simulator(47)
+    buggify.disable()
+    g_trace.clear()
+    telemetry.reset()
+    blackbox.uninstall()
+    blackbox.install(blackbox.BlackboxJournal(str(tmp_path)))
+    try:
+        live = _tiered_factory()[2]
+        mgr = recovery.SnapshotManager(str(tmp_path), interval=400, proc="t")
+        stream = _batch_stream(51, 18)
+        probes = _batch_stream(52, 6, start_v=stream[-1][1])
+
+        async def go():
+            for txns, v, old in stream:
+                verdicts = [int(x) for x in await live.resolve(txns, v, old)]
+                blackbox.record_batch(txns, v, old, verdicts,
+                                      epoch=0, engine="tiered")
+                mgr.note_batch(live, v)
+            assert mgr.stats["written"] >= 1, mgr.stats
+
+            fresh = _tiered_factory()[2]
+            res = await recovery.recover(fresh, str(tmp_path), warm=False)
+            assert res.error is None, res.error
+            assert res.mode == recovery.MODE_COMPLETE and res.coverage_ok
+            assert res.replayed_batches > 0, res.as_dict()
+            assert res.verdict_mismatches == 0, res.mismatch_detail
+            assert res.recovered_version == stream[-1][1]
+            for txns, v, old in probes:
+                a = [int(x) for x in await live.resolve(txns, v, old)]
+                b = [int(x) for x in await fresh.resolve(txns, v, old)]
+                assert a == b, (v, a, b)
+            return True
+
+        assert sim.sched.run_until(sim.sched.spawn(go()), until=100000)
+    finally:
+        blackbox.uninstall()

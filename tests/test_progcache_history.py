@@ -1,0 +1,132 @@
+"""Progcache history-structure safety (PR 20 satellite): a compiled
+resolver program bakes the shape of its carried history state — the
+monolithic interval table vs the tiered sorted-run planes (rkeys/rvers/
+rn/nruns riding the donated state tree). The on-disk program cache key
+must therefore carry the history-structure fingerprint
+(`key(structure=)`, mirroring the PR 18 mesh fingerprint): an artifact
+AOT-compiled for one structure served to the other would feed a
+mismatched state tree into a donated-buffer program — XLA rejects the
+pytree at best and aliases garbage at worst. A structure (or run
+geometry) flip must be a clean MISS; the monolithic fingerprint stays
+the empty string so every pre-PR cache entry keeps its hash."""
+import contextlib
+import dataclasses
+
+import pytest
+
+pytest.importorskip("jax")
+import jax
+
+from foundationdb_tpu.core import progcache as pc
+
+
+@contextlib.contextmanager
+def _no_jax_compile_cache():
+    # store-verification refuses executables the process deserialized
+    # from jax's own persistent cache (test_progcache_mesh.py rationale)
+    from jax._src import compilation_cache
+
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    compilation_cache.reset_cache()
+    try:
+        yield
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+        compilation_cache.reset_cache()
+
+
+def test_key_separates_history_structure():
+    """Same bucket/chunks/search/dispatch: monolithic vs tiered vs a
+    different tier geometry never collide — and the monolithic spelling
+    ("") hashes identically to a pre-PR key call that never passed
+    `structure`, so existing on-disk artifacts stay loadable."""
+    cache = pc.ProgramCache("/tmp/unused-keys-only")
+    base = dict(engine="jax", bucket=32, n_chunks=1,
+                search_mode="fused_sort", dispatch_mode="step")
+    k_legacy = cache.key(**base)
+    k_mono = cache.key(structure="", **base)
+    k_t8 = cache.key(structure="tiered:8x256", **base)
+    k_t4 = cache.key(structure="tiered:4x256", **base)
+    k_t8w = cache.key(structure="tiered:8x512", **base)
+    assert k_legacy == k_mono
+    assert len({k_mono, k_t8, k_t4, k_t8w}) == 4
+
+
+def test_engine_fingerprints():
+    """The engine-side spelling the key consumes: "" for monolithic,
+    "tiered:<runs>x<rows>" for tiered (run geometry included — rows
+    derive from the bucket's write capacity unless pinned)."""
+    from foundationdb_tpu.ops.conflict_kernel import KernelConfig
+    from foundationdb_tpu.ops.host_engine import JaxConflictEngine
+
+    cfg = KernelConfig(key_words=2, capacity=256, max_reads=64,
+                       max_writes=64, max_txns=16)
+    mono = JaxConflictEngine(cfg)
+    tier = JaxConflictEngine(cfg, history_structure="tiered")
+    assert mono._history_fingerprint() == ""
+    fp = tier._history_fingerprint()
+    assert fp == f"tiered:{tier.cfg.run_slots}x{tier.cfg.run_rows}"
+
+
+def test_structure_flip_is_a_clean_miss(tmp_path):
+    """Both structures in ONE process against one cache directory: the
+    tiered build never loads the monolithic build's programs — misses,
+    zero hits, zero poisoned entries — then a same-structure rebuild
+    loads everything back without compiling."""
+    from foundationdb_tpu.ops.conflict_kernel import KernelConfig
+    from foundationdb_tpu.ops.host_engine import JaxConflictEngine
+
+    # a shape no other test compiles (jax's in-process cache would hand
+    # us a deserialized executable store-verification refuses)
+    cfg = KernelConfig(key_words=2, capacity=320, max_reads=64,
+                       max_writes=64, max_txns=24)
+
+    def build(structure):
+        kw = {} if structure is None else {"history_structure": structure}
+        return JaxConflictEngine(cfg, ladder=(), **kw).warmup()
+
+    with _no_jax_compile_cache():
+        pc.uninstall()
+        pc.install(pc.ProgramCache(str(tmp_path)))
+        try:
+            build(None)
+            s = pc.active().stats
+            assert s["stores"] >= 1 and s["hits"] == 0, s
+            build("tiered")
+            s = pc.active().stats
+            assert s["hits"] == 0 and s["poisoned"] == 0, s
+            assert s["misses"] >= 1, s
+            stores_after_tiered = s["stores"]
+            build("tiered")
+            s = pc.active().stats
+            assert s["hits"] >= 1 and s["stores"] == stores_after_tiered, s
+        finally:
+            pc.uninstall()
+
+
+def test_run_geometry_flip_is_a_clean_miss(tmp_path):
+    """Tiered programs with different run-slot counts bake different
+    state planes — a history_runs change must also miss cleanly."""
+    from foundationdb_tpu.ops.conflict_kernel import KernelConfig
+    from foundationdb_tpu.ops.host_engine import JaxConflictEngine
+
+    cfg = dataclasses.replace(
+        KernelConfig(key_words=2, capacity=320, max_reads=64,
+                     max_writes=64, max_txns=24),
+        history_structure="tiered")
+
+    with _no_jax_compile_cache():
+        pc.uninstall()
+        pc.install(pc.ProgramCache(str(tmp_path)))
+        try:
+            JaxConflictEngine(
+                dataclasses.replace(cfg, history_runs=3), ladder=()).warmup()
+            s = pc.active().stats
+            assert s["stores"] >= 1 and s["hits"] == 0, s
+            JaxConflictEngine(
+                dataclasses.replace(cfg, history_runs=5), ladder=()).warmup()
+            s = pc.active().stats
+            assert s["hits"] == 0 and s["poisoned"] == 0, s
+        finally:
+            pc.uninstall()

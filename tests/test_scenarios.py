@@ -27,7 +27,7 @@ from foundationdb_tpu.real.scenarios import (SCENARIOS, assert_scenario_slos,
                                              build_signature,
                                              run_scenario_atlas,
                                              scenario_config)
-from foundationdb_tpu.real.nemesis import run_campaign
+from foundationdb_tpu.real.nemesis import CampaignReport, run_campaign
 from foundationdb_tpu.real.workload import (TXN_SHAPES, TenantSpec,
                                             TxnShaper, ZipfKeySampler)
 
@@ -199,6 +199,64 @@ def test_session_cache_ttl_sweeps_drive_gc_reclaim():
     assert eng.heat.gc_reclaimed_total > 0, "gc lane never exercised"
     assert eng.heat.verdict_totals["conflicts"] > 0, \
         "range sweeps never conflicted (vacuous)"
+
+
+def test_session_cache_tiered_routes_ttl_through_range_delete_gc():
+    """The atlas session_cache recipe pins the TIERED sorted-run
+    history structure (docs/perf.md "Incremental history maintenance"),
+    so its cadenced TTL (begin, end) range deletes ride the
+    range-deletion GC lane: rows below the MVCC horizon are reclaimed
+    at run compaction (gc_reclaimed moves), the heat-borne history
+    counters record the append/merge traffic, and the verdict stream
+    stays bit-identical to both the serial oracle and the monolithic
+    engine throughout."""
+    from dataclasses import replace
+
+    cfg = ck.KernelConfig(key_words=4, capacity=2048, max_txns=64,
+                          max_reads=64, max_writes=64)
+    tiered = JaxConflictEngine(
+        replace(cfg, history_structure="tiered", history_runs=3),
+        ladder=[32], heat_buckets=16)
+    mono = JaxConflictEngine(cfg, ladder=[32], heat_buckets=16)
+    ora = OracleConflictEngine()
+    sh = _shaper("ttl_cache", seed=41, n_keys=512, ttl_sweep_every=8,
+                 ttl_sweep_span=48)
+    rng = random.Random(41)
+    v = 1000
+    for i in range(12):
+        v += rng.randrange(80, 400)
+        txns = _txns_from([sh.build() for _ in range(32)], v, rng)
+        oldest = max(0, v - (600 if i % 3 == 0 else 100_000))
+        got_t = [int(x) for x in tiered.resolve(txns, v, oldest)]
+        got_m = [int(x) for x in mono.resolve(txns, v, oldest)]
+        want = [int(x) for x in ora.resolve(txns, v, oldest)]
+        assert got_t == want and got_m == want
+    assert tiered.heat.gc_reclaimed_total > 0, \
+        "tiered range-delete GC lane never reclaimed"
+    hist = tiered.heat.history_snapshot()
+    assert hist["appends"] > 0 and hist["merges"] > 0, hist
+    # the signature carries the lane so the scorecard can pin it
+    rep = CampaignReport(cfg_seed=0, engine_mode="jax")
+    rep.heat = tiered.heat_snapshot()
+    rep.counts = {"offered": 4, "committed": 3, "conflicted": 1}
+    sig = build_signature(rep)
+    assert sig["gc_reclaimed"] > 0
+    assert sig["history"]["merges"] > 0
+    # monolithic engines report the half honestly as zero history
+    rep.heat = mono.heat_snapshot()
+    sig_m = build_signature(rep)
+    assert sig_m["history"].get("merges", 0) == 0
+
+
+def test_session_cache_profile_pins_tiered_structure():
+    """scenario_config threads the atlas profile's history structure
+    into the campaign config; oracle-mode campaigns (no device table)
+    carry it inertly, and explicit kw still wins."""
+    cfg = scenario_config("session_cache", seed=3, engine_mode="jax")
+    assert cfg.history_structure == "tiered"
+    over = scenario_config("session_cache", seed=3,
+                           history_structure=None)
+    assert over.history_structure is None
 
 
 # -- cli atlas over pre-atlas artifacts (graceful degradation) -----------
